@@ -1,0 +1,88 @@
+"""Feature type hierarchy tests (≙ features/src/test/.../types/ *Test.scala)."""
+
+import pytest
+
+from transmogrifai_tpu.types import (
+    FEATURE_TYPES, Binary, Currency, Email, FeatureType, Geolocation, ID,
+    Integral, MultiPickList, OPVector, PickList, Prediction, Real, RealMap,
+    RealNN, Text, TextList, TextMap, URL, feature_type_from_name,
+    is_map_kind, is_numeric_kind, is_text_kind, map_value_kind,
+)
+
+
+def test_registry_has_all_types():
+    # 8 numerics + 14 text + 6 collections + 25 maps (incl. Prediction) = 53
+    assert len(FEATURE_TYPES) == 53
+    assert feature_type_from_name("Real") is Real
+    with pytest.raises(ValueError):
+        feature_type_from_name("Nope")
+
+
+def test_empty_and_value_semantics():
+    assert Real(None).is_empty
+    assert Real(1.5).value == 1.5
+    assert not Real(0.0).is_empty
+    assert Text("").is_empty  # empty string normalizes to empty like Option
+    assert Integral(3).value == 3
+    assert Binary(1).value is True
+
+
+def test_realnn_non_nullable():
+    with pytest.raises(ValueError):
+        RealNN(None)
+    assert RealNN(2.0).value == 2.0
+
+
+def test_equality_is_typed():
+    assert Real(1.0) == Real(1.0)
+    assert Real(1.0) != Currency(1.0)
+    assert Text("a") == Text("a")
+
+
+def test_email_parsing():
+    assert Email("a@b.com").prefix() == "a"
+    assert Email("a@b.com").domain() == "b.com"
+    assert Email("nope").prefix() is None
+    assert Email(None).domain() is None
+
+
+def test_url_parsing():
+    u = URL("https://example.com/x?y=1")
+    assert u.domain() == "example.com"
+    assert u.protocol() == "https"
+    assert u.is_valid()
+    assert not URL("not a url").is_valid()
+
+
+def test_geolocation_validation():
+    g = Geolocation([37.77, -122.42, 5.0])
+    assert g.lat == pytest.approx(37.77)
+    assert g.lon == pytest.approx(-122.42)
+    with pytest.raises(ValueError):
+        Geolocation([200.0, 0.0, 1.0])
+    assert Geolocation().is_empty
+
+
+def test_prediction_contract():
+    with pytest.raises(ValueError):
+        Prediction({})
+    p = Prediction(prediction=1.0, probability=[0.2, 0.8], raw_prediction=[-1.0, 1.0])
+    assert p.prediction == 1.0
+    assert p.probability == [0.2, 0.8]
+    assert p.raw_prediction == [-1.0, 1.0]
+    assert not p.is_empty
+
+
+def test_kind_predicates():
+    assert is_numeric_kind(Currency)
+    assert is_text_kind(PickList)
+    assert is_map_kind(TextMap)
+    assert map_value_kind(RealMap) is Real
+    assert not is_numeric_kind(Text)
+
+
+def test_traits():
+    assert RealNN.non_nullable
+    assert PickList.is_categorical
+    assert MultiPickList.is_categorical
+    assert not Text.is_categorical

@@ -1,0 +1,130 @@
+"""Vectorizer stage tests — following the reference's OpTransformerSpec /
+OpEstimatorSpec contract pattern (features/.../test/OpTransformerSpec.scala:52):
+fit on a batch, check output matrix, lineage metadata, and null handling.
+"""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.columns import ColumnBatch, column_from_values
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.ops.categorical import OneHotEstimator, StringIndexer
+from transmogrifai_tpu.ops.combiner import VectorsCombiner
+from transmogrifai_tpu.ops.numeric import (BinaryVectorizer,
+                                           IntegralVectorizer,
+                                           RealNNVectorizer, RealVectorizer)
+from transmogrifai_tpu.ops.text import SmartTextVectorizer, tokenize_text
+from transmogrifai_tpu.types import (Binary, Integral, PickList, Real, RealNN,
+                                     Text)
+
+
+def _batch(**cols):
+    out = {}
+    for name, (kind, vals) in cols.items():
+        out[name] = column_from_values(kind, vals)
+    return ColumnBatch(out)
+
+
+def test_real_vectorizer_mean_fill_and_null_indicator():
+    f = FeatureBuilder.Real("x").as_predictor()
+    batch = _batch(x=(Real, [1.0, None, 3.0, None]))
+    st = RealVectorizer(fill_mode="mean").set_input(f)
+    model = st.fit(batch)
+    out = model.transform(batch)
+    arr = np.asarray(out.values)
+    assert arr.shape == (4, 2)
+    np.testing.assert_allclose(arr[:, 0], [1.0, 2.0, 3.0, 2.0])  # mean=2
+    np.testing.assert_allclose(arr[:, 1], [0, 1, 0, 1])  # null indicators
+    assert out.meta.columns[1].is_null_indicator
+    assert out.meta.columns[0].parent_feature_name == "x"
+
+
+def test_integral_vectorizer_mode_fill():
+    f = FeatureBuilder.Integral("i").as_predictor()
+    batch = _batch(i=(Integral, [5, 5, 7, None]))
+    model = IntegralVectorizer().set_input(f).fit(batch)
+    arr = np.asarray(model.transform(batch).values)
+    np.testing.assert_allclose(arr[:, 0], [5, 5, 7, 5])
+
+
+def test_binary_vectorizer():
+    f = FeatureBuilder.Binary("b").as_predictor()
+    batch = _batch(b=(Binary, [True, None, False]))
+    model = BinaryVectorizer().set_input(f).fit(batch)
+    arr = np.asarray(model.transform(batch).values)
+    np.testing.assert_allclose(arr, [[1, 0], [0, 1], [0, 0]])
+
+
+def test_realnn_vectorizer_rejects_nulls():
+    with pytest.raises(ValueError):
+        _batch(x=(RealNN, [1.0, None]))
+
+
+def test_onehot_topk_min_support_other_null():
+    f = FeatureBuilder.PickList("c").as_predictor()
+    vals = ["a"] * 5 + ["b"] * 3 + ["rare"] + [None]
+    batch = _batch(c=(PickList, vals))
+    model = OneHotEstimator(top_k=2, min_support=2).set_input(f).fit(batch)
+    out = model.transform(batch)
+    arr = np.asarray(out.values)
+    # columns: a, b, OTHER, null
+    assert arr.shape == (10, 4)
+    assert arr[0].tolist() == [1, 0, 0, 0]
+    assert arr[5].tolist() == [0, 1, 0, 0]
+    assert arr[8].tolist() == [0, 0, 1, 0]  # rare → OTHER
+    assert arr[9].tolist() == [0, 0, 0, 1]  # None → null
+    names = [c.indicator_value for c in out.meta.columns]
+    assert names == ["a", "b", "OTHER", "NullIndicatorValue"]
+
+
+def test_string_indexer_frequency_order():
+    f = FeatureBuilder.Text("t").as_predictor()
+    batch = _batch(t=(Text, ["b", "a", "b", "b", "a", "c"]))
+    model = StringIndexer().set_input(f).fit(batch)
+    ids = np.asarray(model.transform(batch).values)
+    # b most frequent → 0, a → 1, c → 2
+    assert ids.tolist() == [0, 1, 0, 0, 1, 2]
+    assert model.metadata["labels"] == ["b", "a", "c"]
+
+
+def test_smart_text_low_cardinality_pivots():
+    f = FeatureBuilder.Text("t").as_predictor()
+    vals = (["x"] * 6 + ["y"] * 4) * 2
+    batch = _batch(t=(Text, vals))
+    model = SmartTextVectorizer(max_cardinality=10, min_support=1).set_input(f).fit(batch)
+    assert model.metadata["strategies"]["t"] == "pivot"
+    arr = np.asarray(model.transform(batch).values)
+    assert arr.shape[1] == 4  # x, y, OTHER, null
+
+
+def test_smart_text_high_cardinality_hashes():
+    f = FeatureBuilder.Text("t").as_predictor()
+    vals = [f"word{i} token{i % 7}" for i in range(50)]
+    batch = _batch(t=(Text, vals))
+    model = SmartTextVectorizer(max_cardinality=5, num_hashes=32).set_input(f).fit(batch)
+    assert model.metadata["strategies"]["t"] == "hash"
+    arr = np.asarray(model.transform(batch).values)
+    assert arr.shape == (50, 33)  # 32 hash + null indicator
+    assert arr.sum() > 0
+
+
+def test_tokenizer():
+    assert tokenize_text("Hello, World! x") == ["hello", "world", "x"]
+    assert tokenize_text(None) == []
+
+
+def test_vectors_combiner_merges_metadata():
+    fx = FeatureBuilder.Real("x").as_predictor()
+    fy = FeatureBuilder.Binary("y").as_predictor()
+    batch = _batch(x=(Real, [1.0, None]), y=(Binary, [True, False]))
+    mx = RealVectorizer().set_input(fx).fit(batch)
+    my = BinaryVectorizer().set_input(fy).fit(batch)
+    batch = mx.transform_batch(batch)
+    batch = my.transform_batch(batch)
+    comb = VectorsCombiner().set_input(mx.get_output(), my.get_output())
+    out = comb.transform(batch)
+    arr = np.asarray(out.values)
+    assert arr.shape == (2, 4)
+    parents = [c.parent_feature_name for c in out.meta.columns]
+    assert parents == ["x", "x", "y", "y"]
+    assert [c.index for c in out.meta.columns] == [0, 1, 2, 3]

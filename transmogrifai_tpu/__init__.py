@@ -1,0 +1,37 @@
+"""transmogrifai_tpu — a TPU-native AutoML framework for structured data.
+
+A from-scratch re-design of Salesforce TransmogrifAI (Scala/Spark) on JAX/XLA:
+typed features with lineage, a compiled stage DAG, automatic per-type
+vectorization, sanity checking / leakage detection, cross-validated model
+selection over linear and tree-ensemble models trained data-parallel on the
+TPU mesh, evaluators, model insights, and a serializable workflow model.
+"""
+
+from . import types
+from .aggregators import CustomMonoidAggregator, MonoidAggregator
+from .columns import Column, ColumnBatch
+from .features import Feature, FeatureBuilder, features_from_schema
+from .stages import (Estimator, FeatureGeneratorStage, PipelineStage,
+                     Transformer, TransformerModel)
+from .vector_meta import VectorColumnMeta, VectorMeta
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "types", "Column", "ColumnBatch", "Feature", "FeatureBuilder",
+    "features_from_schema", "PipelineStage", "Transformer", "Estimator",
+    "TransformerModel", "FeatureGeneratorStage", "VectorMeta",
+    "VectorColumnMeta", "MonoidAggregator", "CustomMonoidAggregator",
+]
+
+
+def __getattr__(name):
+    # Lazy imports of heavier submodules to keep `import transmogrifai_tpu` fast.
+    if name in ("Workflow", "WorkflowModel"):
+        from .workflow import Workflow, WorkflowModel
+        return {"Workflow": Workflow, "WorkflowModel": WorkflowModel}[name]
+    if name in ("BinaryClassificationModelSelector",
+                "MultiClassificationModelSelector", "RegressionModelSelector"):
+        from . import selector
+        return getattr(selector, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

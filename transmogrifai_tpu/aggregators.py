@@ -1,0 +1,100 @@
+"""Monoid aggregators for event-time feature aggregation — the TPU-native
+equivalent of MonoidAggregatorDefaults (reference: features/src/main/scala/com/
+salesforce/op/aggregators/MonoidAggregatorDefaults.scala:41) built on Algebird.
+
+Each feature kind has a default monoid used when an aggregate/conditional
+reader groups multiple events per key into one row.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Type
+
+from .types import (
+    Binary, Date, DateList, DateTime, DateTimeList, FeatureType, Geolocation,
+    Integral, MultiPickList, OPList, OPMap, OPSet, OPVector, Real, RealNN,
+    Text, TextArea, TextList, is_map_kind, is_numeric_kind, is_text_kind,
+)
+
+
+class MonoidAggregator:
+    """zero + plus over raw python values (None = empty)."""
+
+    def __init__(self, zero: Any, plus: Callable[[Any, Any], Any],
+                 name: str = "custom"):
+        self.zero = zero
+        self.plus = plus
+        self.name = name
+
+    def aggregate(self, values: Sequence[Any]) -> Any:
+        acc = self.zero
+        for v in values:
+            if v is None:
+                continue
+            acc = v if acc is None else self.plus(acc, v)
+        return acc
+
+
+def _sum(a, b):
+    return a + b
+
+
+def _min(a, b):
+    return min(a, b)
+
+
+def _max(a, b):
+    return max(a, b)
+
+
+def _concat(a, b):
+    return list(a) + list(b)
+
+
+def _union(a, b):
+    return set(a) | set(b)
+
+
+def _merge_maps(a, b):
+    out = dict(a)
+    out.update(b)
+    return out
+
+
+def _concat_text(a, b):
+    return f"{a} {b}"
+
+
+def _logical_or(a, b):
+    return bool(a) or bool(b)
+
+
+def default_aggregator(kind: Type[FeatureType]) -> MonoidAggregator:
+    """Defaults mirror MonoidAggregatorDefaults.aggregatorOf: numerics sum,
+    booleans OR, text concatenates, lists concat, sets union, maps
+    last-write-wins merge, dates take max (most recent)."""
+    if issubclass(kind, Binary):
+        return MonoidAggregator(None, _logical_or, "or")
+    if issubclass(kind, (Date, DateTime)):
+        return MonoidAggregator(None, _max, "maxDate")
+    if is_numeric_kind(kind):
+        return MonoidAggregator(None, _sum, "sum")
+    if issubclass(kind, (TextArea,)):
+        return MonoidAggregator(None, _concat_text, "concatText")
+    if is_text_kind(kind):
+        return MonoidAggregator(None, lambda a, b: b, "last")
+    if issubclass(kind, Geolocation):
+        return MonoidAggregator(None, lambda a, b: b, "lastGeo")
+    if issubclass(kind, OPSet):
+        return MonoidAggregator(None, _union, "union")
+    if issubclass(kind, OPVector):
+        return MonoidAggregator(None, lambda a, b: [x + y for x, y in zip(a, b)], "sumVec")
+    if issubclass(kind, OPList):
+        return MonoidAggregator(None, _concat, "concat")
+    if is_map_kind(kind):
+        return MonoidAggregator(None, _merge_maps, "mergeMaps")
+    return MonoidAggregator(None, lambda a, b: b, "last")
+
+
+class CustomMonoidAggregator(MonoidAggregator):
+    """User-supplied monoid (≙ CustomMonoidAggregator)."""

@@ -1,0 +1,196 @@
+"""Columnar data representation — the TPU-native replacement for Spark
+DataFrames (reference layer 0).
+
+A ``ColumnBatch`` is an ordered mapping of feature name → ``Column``.  Numeric
+columns live as dense device arrays plus a presence mask (``Option[T]`` →
+(values, mask), cf. SURVEY.md §7.1); strings/lists/maps live host-side as numpy
+object arrays until a fitted vectorizer lowers them to device arrays.  All
+device-side stage transforms are pure functions over these arrays, so the whole
+transform DAG jits into one XLA program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Type
+
+import numpy as np
+
+from .types import (
+    Binary, Date, DateList, DateTime, DateTimeList, FeatureType, Geolocation,
+    Integral, MultiPickList, OPList, OPMap, OPNumeric, OPSet, OPVector,
+    Prediction, Real, RealNN, Text, TextList, is_map_kind, is_numeric_kind,
+    is_text_kind,
+)
+from .vector_meta import VectorMeta
+
+
+@dataclass
+class Column:
+    """A typed column of N rows.
+
+    Storage by kind:
+      * numeric kinds   — ``values``: float32/int64 array [N]; ``mask``: bool [N]
+                        (True = present).  RealNN/Prediction are mask-free.
+      * text kinds      — ``values``: numpy object array [N] of str | None (host).
+      * OPVector        — ``values``: float32 array [N, D]; ``meta``: VectorMeta.
+      * Geolocation     — ``values``: float32 [N, 3]; ``mask``: bool [N].
+      * lists/sets      — ``values``: numpy object array [N] of list/set (host).
+      * maps            — ``values``: numpy object array [N] of dict (host).
+      * Prediction      — ``values``: dict with 'prediction' [N] and optionally
+                        'probability' [N, C], 'rawPrediction' [N, C] arrays.
+    """
+
+    kind: Type[FeatureType]
+    values: Any
+    mask: Optional[Any] = None
+    meta: Optional[VectorMeta] = None
+
+    def __len__(self) -> int:
+        if isinstance(self.values, dict):
+            return len(self.values["prediction"])
+        return len(self.values)
+
+    @property
+    def is_device(self) -> bool:
+        """True if values are dense arrays usable inside jit."""
+        if isinstance(self.values, dict):
+            return True
+        return not (isinstance(self.values, np.ndarray) and self.values.dtype == object)
+
+    def row_value(self, i: int) -> FeatureType:
+        """Materialize row ``i`` as a typed value (local-scoring/test path)."""
+        k = self.kind
+        if k is Prediction or (isinstance(self.values, dict)):
+            d = {"prediction": float(np.asarray(self.values["prediction"])[i])}
+            for base in ("probability", "rawPrediction"):
+                if base in self.values:
+                    row = np.asarray(self.values[base])[i]
+                    for j, v in enumerate(row):
+                        d[f"{base}_{j}"] = float(v)
+            return Prediction(d)
+        if issubclass(k, OPVector):
+            return OPVector(list(np.asarray(self.values)[i].tolist()))
+        if issubclass(k, Geolocation) and not self.is_host_object():
+            if self.mask is not None and not bool(np.asarray(self.mask)[i]):
+                return Geolocation()
+            return Geolocation(list(np.asarray(self.values)[i].tolist()))
+        if self.is_host_object():
+            return k(self.values[i])
+        v = np.asarray(self.values)[i]
+        if self.mask is not None and not bool(np.asarray(self.mask)[i]):
+            return k(None)
+        if issubclass(k, (Integral,)):
+            return k(int(v))
+        if issubclass(k, Binary):
+            return k(bool(v))
+        return k(float(v))
+
+    def is_host_object(self) -> bool:
+        return isinstance(self.values, np.ndarray) and self.values.dtype == object
+
+
+def _full_mask(n: int) -> np.ndarray:
+    return np.ones(n, dtype=bool)
+
+
+def numeric_column(kind: Type[FeatureType], values: Iterable, n: Optional[int] = None) -> Column:
+    """Build a numeric column from python values with Nones."""
+    vals = list(values)
+    n = len(vals) if n is None else n
+    mask = np.array([v is not None for v in vals], dtype=bool)
+    if issubclass(kind, (Date, DateTime)) or issubclass(kind, Integral):
+        arr = np.array([0 if v is None else int(v) for v in vals], dtype=np.int64)
+    elif issubclass(kind, Binary):
+        arr = np.array([False if v is None else bool(v) for v in vals], dtype=bool)
+    else:
+        arr = np.array([np.nan if v is None else float(v) for v in vals], dtype=np.float32)
+    if kind.non_nullable and not mask.all():
+        bad = int((~mask).sum())
+        raise ValueError(f"{kind.__name__} column has {bad} empty values")
+    return Column(kind, arr, mask=None if kind.non_nullable else mask)
+
+
+def text_column(kind: Type[FeatureType], values: Iterable) -> Column:
+    arr = np.array([None if v is None or v == "" else str(v) for v in values], dtype=object)
+    return Column(kind, arr)
+
+
+def object_column(kind: Type[FeatureType], values: Iterable) -> Column:
+    return Column(kind, np.array(list(values) + [None], dtype=object)[:-1])
+
+
+def vector_column(values, meta: VectorMeta) -> Column:
+    return Column(OPVector, values, meta=meta)
+
+
+def column_from_values(kind: Type[FeatureType], values: Iterable) -> Column:
+    """Dispatch on kind to build the right storage."""
+    if is_numeric_kind(kind):
+        return numeric_column(kind, values)
+    if is_text_kind(kind):
+        return text_column(kind, values)
+    return object_column(kind, values)
+
+
+class ColumnBatch:
+    """Ordered name → Column mapping; the working set of a workflow run."""
+
+    def __init__(self, columns: Optional[Dict[str, Column]] = None, length: Optional[int] = None):
+        self._cols: Dict[str, Column] = dict(columns or {})
+        self._length = length
+        if self._length is None and self._cols:
+            self._length = len(next(iter(self._cols.values())))
+
+    def __len__(self) -> int:
+        return self._length or 0
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._cols
+
+    def __getitem__(self, name: str) -> Column:
+        return self._cols[name]
+
+    def get(self, name: str) -> Optional[Column]:
+        return self._cols.get(name)
+
+    def names(self) -> List[str]:
+        return list(self._cols)
+
+    def items(self):
+        return self._cols.items()
+
+    def with_column(self, name: str, col: Column) -> "ColumnBatch":
+        new = dict(self._cols)
+        new[name] = col
+        return ColumnBatch(new, self._length if self._length is not None else len(col))
+
+    def with_columns(self, cols: Dict[str, Column]) -> "ColumnBatch":
+        new = dict(self._cols)
+        new.update(cols)
+        n = self._length
+        if n is None and cols:
+            n = len(next(iter(cols.values())))
+        return ColumnBatch(new, n)
+
+    def select(self, names: Sequence[str]) -> "ColumnBatch":
+        return ColumnBatch({n: self._cols[n] for n in names}, self._length)
+
+    def drop(self, names: Sequence[str]) -> "ColumnBatch":
+        drop = set(names)
+        return ColumnBatch({n: c for n, c in self._cols.items() if n not in drop}, self._length)
+
+    def take_rows(self, idx: np.ndarray) -> "ColumnBatch":
+        """Row subset (host-side gather; used by splitters/CV on small data)."""
+        out: Dict[str, Column] = {}
+        for name, c in self._cols.items():
+            if isinstance(c.values, dict):
+                vals = {k: np.asarray(v)[idx] for k, v in c.values.items()}
+            else:
+                vals = np.asarray(c.values)[idx]
+            mask = None if c.mask is None else np.asarray(c.mask)[idx]
+            out[name] = Column(c.kind, vals, mask=mask, meta=c.meta)
+        return ColumnBatch(out, int(len(idx)))
+
+    def row(self, i: int) -> Dict[str, FeatureType]:
+        return {name: c.row_value(i) for name, c in self._cols.items()}

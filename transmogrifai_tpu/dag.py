@@ -1,0 +1,115 @@
+"""DAG computation and fit/transform scheduling — the TPU-native re-design of
+FitStagesUtil (reference: core/src/main/scala/com/salesforce/op/utils/stages/
+FitStagesUtil.scala:173-304).
+
+``compute_dag`` layers stages by distance-to-result exactly like the reference's
+``computeDAG``; ``fit_dag`` fits estimators layer-by-layer then applies the
+layer's transformers.  Where the reference bulk-applies row closures in a single
+RDD map (applyOpTransformations:96) and persists every K Spark stages to break
+Catalyst (:134-165), we simply apply column transforms — device-resident
+columns stay in HBM and XLA fuses the ops; no persistence hacks are needed
+(SURVEY.md §2.6 P5).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .columns import ColumnBatch
+from .features import Feature
+from .stages.base import Estimator, PipelineStage, Transformer, TransformerModel
+from .stages.generator import FeatureGeneratorStage
+
+StageLayer = List[PipelineStage]
+
+
+def compute_dag(result_features: Sequence[Feature]) -> List[StageLayer]:
+    """Layer stages by max distance to any result feature, deepest first
+    (≙ FitStagesUtil.computeDAG).  FeatureGeneratorStages are excluded — raw
+    data generation is the reader's job."""
+    dist: Dict[PipelineStage, int] = {}
+    for f in result_features:
+        for stage, d in f.parent_stages().items():
+            if dist.get(stage, -1) < d:
+                dist[stage] = d
+    layers: Dict[int, StageLayer] = {}
+    for stage, d in dist.items():
+        if isinstance(stage, FeatureGeneratorStage):
+            continue
+        layers.setdefault(d, []).append(stage)
+    out = [sorted(layers[d], key=lambda s: s.uid) for d in sorted(layers, reverse=True)]
+    return [l for l in out if l]
+
+
+def dag_stages(dag: List[StageLayer]) -> List[PipelineStage]:
+    return [s for layer in dag for s in layer]
+
+
+def fit_layer(batch: ColumnBatch, layer: StageLayer) -> Tuple[ColumnBatch, List[Transformer]]:
+    """Fit all estimators of a layer, then apply every transformer of the layer
+    (≙ fitAndTransformLayer, FitStagesUtil.scala:253)."""
+    fitted: List[Transformer] = []
+    for stage in layer:
+        if isinstance(stage, Estimator):
+            model = stage.fit(batch)
+            fitted.append(model)
+        elif isinstance(stage, Transformer):
+            fitted.append(stage)
+        else:
+            raise TypeError(f"stage {stage} is neither Transformer nor Estimator")
+    for t in fitted:
+        batch = t.transform_batch(batch)
+    return batch, fitted
+
+
+def fit_dag(batch: ColumnBatch, dag: List[StageLayer]) -> Tuple[ColumnBatch, List[StageLayer]]:
+    """Fit + transform the whole DAG (≙ fitAndTransformDAG:213).  Returns the
+    transformed batch and the fitted DAG (same layering, estimators replaced by
+    their models)."""
+    fitted_dag: List[StageLayer] = []
+    for layer in dag:
+        batch, fitted = fit_layer(batch, layer)
+        fitted_dag.append(list(fitted))
+    return batch, fitted_dag
+
+
+def apply_dag(batch: ColumnBatch, dag: List[StageLayer],
+              up_to_feature: Optional[Feature] = None) -> ColumnBatch:
+    """Apply an already-fitted DAG (≙ applyTransformationsDAG,
+    OpWorkflowCore.scala:321)."""
+    for layer in dag:
+        for t in layer:
+            if not isinstance(t, Transformer):
+                raise TypeError(
+                    f"DAG contains unfitted estimator {t}; fit the workflow first")
+            batch = t.transform_batch(batch)
+            if up_to_feature is not None and any(
+                    f.name == up_to_feature.name for f in t.output_features):
+                return batch
+    return batch
+
+
+def cut_dag(dag: List[StageLayer], selector) -> Tuple[List[StageLayer], List[StageLayer], List[StageLayer]]:
+    """Split the DAG into (before, during, after) relative to a ModelSelector
+    (≙ FitStagesUtil.cutDAG:304) for workflow-level cross-validation: 'during'
+    holds the feature-engineering stages that must be refit inside each fold to
+    avoid leakage; 'before' is everything upstream shared by all folds."""
+    sel_layer_idx = None
+    for i, layer in enumerate(dag):
+        if any(s is selector for s in layer):
+            sel_layer_idx = i
+            break
+    if sel_layer_idx is None:
+        return dag, [], []
+    # Estimators feeding the selector (directly or transitively after the last
+    # upstream estimator barrier) must be refit per fold.  The reference cuts at
+    # the last layer containing no estimators before the selector; we do the
+    # same simple cut: 'during' = contiguous estimator-containing layers
+    # immediately preceding the selector.
+    start = sel_layer_idx
+    while start > 0 and any(isinstance(s, Estimator) for s in dag[start - 1]):
+        start -= 1
+    before = dag[:start]
+    during = dag[start:sel_layer_idx]
+    after = dag[sel_layer_idx:]
+    return before, during, after

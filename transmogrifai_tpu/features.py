@@ -1,0 +1,220 @@
+"""Feature graph nodes and builders — the TPU-native re-design of
+``Feature``/``FeatureLike`` (reference: features/src/main/scala/com/salesforce/
+op/features/FeatureLike.scala:50) and ``FeatureBuilder``
+(FeatureBuilder.scala:48, fromDataFrame at :232).
+
+A ``Feature`` is a lazy symbolic column: name + kind + origin stage + parents.
+The workflow reconstructs the stage DAG by DFS over ``parent_stages`` — exactly
+the reference's tracing model, which maps 1:1 onto JAX's trace-then-compile.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from .types import (
+    FEATURE_TYPES, Binary, FeatureType, Integral, Real, RealNN, Text,
+    is_numeric_kind,
+)
+
+_uid_counters: Dict[str, itertools.count] = {}
+
+
+def make_uid(class_name: str) -> str:
+    c = _uid_counters.setdefault(class_name, itertools.count())
+    return f"{class_name}_{next(c):012x}"
+
+
+class Feature:
+    """A node in the feature DAG (≙ FeatureLike)."""
+
+    def __init__(self, name: str, kind: Type[FeatureType], is_response: bool,
+                 origin_stage: Optional["PipelineStage"], parents: Sequence["Feature"],
+                 uid: Optional[str] = None):
+        self.name = name
+        self.kind = kind
+        self.is_response = bool(is_response)
+        self.origin_stage = origin_stage
+        self.parents: Tuple[Feature, ...] = tuple(parents)
+        self.uid = uid or make_uid("Feature")
+
+    @property
+    def is_raw(self) -> bool:
+        from .stages.generator import FeatureGeneratorStage
+        return self.origin_stage is None or isinstance(self.origin_stage, FeatureGeneratorStage)
+
+    def parent_stages(self) -> Dict["PipelineStage", int]:
+        """DFS over lineage → stage → max distance from this feature
+        (≙ FeatureLike.parentStages, used by computeDAG)."""
+        dist: Dict[Any, int] = {}
+        stack: List[Tuple[Feature, int]] = [(self, 0)]
+        while stack:
+            feat, d = stack.pop()
+            st = feat.origin_stage
+            if st is None:
+                continue
+            if dist.get(st, -1) < d:
+                dist[st] = d
+            for p in feat.parents:
+                stack.append((p, d + 1))
+        return dist
+
+    def all_features(self) -> List["Feature"]:
+        seen: Dict[str, Feature] = {}
+        stack = [self]
+        while stack:
+            f = stack.pop()
+            if f.uid in seen:
+                continue
+            seen[f.uid] = f
+            stack.extend(f.parents)
+        return list(seen.values())
+
+    def raw_features(self) -> List["Feature"]:
+        return [f for f in self.all_features() if f.is_raw]
+
+    def history(self) -> Dict[str, Any]:
+        return {
+            "name": self.name, "uid": self.uid, "type": self.kind.__name__,
+            "isResponse": self.is_response,
+            "originStage": self.origin_stage.uid if self.origin_stage else None,
+            "parents": [p.uid for p in self.parents],
+        }
+
+    def __repr__(self):
+        return f"Feature<{self.kind.__name__}>({self.name!r})"
+
+    # ---- DSL sugar (≙ dsl/Rich*Feature) — thin delegates to stages ------
+    def transform_with(self, stage: "PipelineStage", *others: "Feature") -> "Feature":
+        stage.set_input(self, *others)
+        return stage.get_output()
+
+    def alias(self, name: str) -> "Feature":
+        from .stages.transformers import AliasTransformer
+        return self.transform_with(AliasTransformer(name=name))
+
+    def vectorize(self, **kw) -> "Feature":
+        from .ops.transmogrify import transmogrify
+        return transmogrify([self], **kw)
+
+    def transmogrify(self, **kw) -> "Feature":
+        return self.vectorize(**kw)
+
+    def sanity_check(self, feature_vector: "Feature", **kw) -> "Feature":
+        from .preparators.sanity_checker import SanityChecker
+        st = SanityChecker(**kw)
+        st.set_input(self, feature_vector)
+        return st.get_output()
+
+
+class FeatureBuilder:
+    """Typed feature declaration (≙ FeatureBuilder.scala:48).
+
+    Usage::
+
+        age = FeatureBuilder.Real("age").extract(lambda r: r.get("age")).as_predictor()
+        survived = FeatureBuilder.RealNN("survived").extract(...).as_response()
+    """
+
+    def __init__(self, name: str, kind: Type[FeatureType]):
+        self.name = name
+        self.kind = kind
+        self._extract: Optional[Callable[[Dict[str, Any]], Any]] = None
+        self._aggregator = None
+        self._extract_source: Optional[str] = None
+
+    def extract(self, fn: Callable[[Dict[str, Any]], Any], source: Optional[str] = None) -> "FeatureBuilder":
+        self._extract = fn
+        self._extract_source = source
+        return self
+
+    def aggregate(self, aggregator) -> "FeatureBuilder":
+        self._aggregator = aggregator
+        return self
+
+    def _build(self, is_response: bool) -> Feature:
+        from .stages.generator import FeatureGeneratorStage
+        extract = self._extract or (lambda r, _n=self.name: r.get(_n))
+        stage = FeatureGeneratorStage(
+            name=self.name, kind=self.kind, extract_fn=extract,
+            aggregator=self._aggregator, extract_source=self._extract_source)
+        feat = Feature(self.name, self.kind, is_response, stage, parents=())
+        stage._output = feat
+        return feat
+
+    def as_predictor(self) -> Feature:
+        return self._build(is_response=False)
+
+    def as_response(self) -> Feature:
+        return self._build(is_response=True)
+
+    # Typed constructors for every feature type, e.g. FeatureBuilder.Real("x").
+    # Installed below via _install_typed_constructors().
+
+
+def _install_typed_constructors():
+    for name, kind in FEATURE_TYPES.items():
+        def ctor(fname: str, _k=kind) -> FeatureBuilder:
+            return FeatureBuilder(fname, _k)
+        setattr(FeatureBuilder, name, staticmethod(ctor))
+
+
+_install_typed_constructors()
+
+
+# --------------------------------------------------------------------------
+# Schema inference (≙ FeatureBuilder.fromDataFrame, FeatureBuilder.scala:232)
+# --------------------------------------------------------------------------
+
+def infer_feature_kind(values: Sequence[Any]) -> Type[FeatureType]:
+    """Infer a feature type from raw (string-ish) sample values."""
+    non_null = [v for v in values if v is not None and v != ""]
+    if not non_null:
+        return Text
+    def _is_int(v):
+        try:
+            int(str(v))
+            return True
+        except ValueError:
+            return False
+    def _is_float(v):
+        try:
+            float(str(v))
+            return True
+        except ValueError:
+            return False
+    if all(isinstance(v, bool) for v in non_null):
+        return Binary
+    if all(_is_int(v) for v in non_null):
+        uniq = {int(str(v)) for v in non_null}
+        if uniq <= {0, 1}:
+            return Binary
+        return Integral
+    if all(_is_float(v) for v in non_null):
+        return Real
+    uniq = {str(v) for v in non_null}
+    if len(uniq) <= max(30, int(0.1 * len(non_null))) and len(uniq) < len(non_null):
+        from .types import PickList
+        return PickList
+    return Text
+
+
+def features_from_schema(schema: Dict[str, Type[FeatureType]], response: str,
+                         response_kind: Type[FeatureType] = RealNN,
+                         non_nullable: Sequence[str] = ()) -> Tuple[Feature, List[Feature]]:
+    """Build (response, predictors) from a name → kind schema
+    (≙ FeatureBuilder.fromDataFrame[RealNN](df, response))."""
+    if response not in schema:
+        raise ValueError(
+            f"response feature {response!r} is not present in the schema; "
+            f"available: {sorted(schema)}")
+    resp = FeatureBuilder(response, response_kind).as_response()
+    predictors = []
+    for name, kind in schema.items():
+        if name == response:
+            continue
+        predictors.append(FeatureBuilder(name, kind).as_predictor())
+    return resp, predictors

@@ -1,0 +1,10 @@
+from .numeric import (BinaryVectorizer, IntegralVectorizer, RealNNVectorizer,
+                      RealVectorizer)
+from .categorical import OneHotEstimator, StringIndexer, IndexToString
+from .combiner import VectorsCombiner
+from .transmogrify import transmogrify, TransmogrifierDefaults
+
+__all__ = ["RealVectorizer", "RealNNVectorizer", "IntegralVectorizer",
+           "BinaryVectorizer", "OneHotEstimator", "StringIndexer",
+           "IndexToString", "VectorsCombiner", "transmogrify",
+           "TransmogrifierDefaults"]

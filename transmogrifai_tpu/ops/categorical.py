@@ -1,0 +1,166 @@
+"""Categorical vectorizers (reference: core/.../stages/impl/feature/
+OpOneHotVectorizer.scala, OpStringIndexer.scala, OpIndexToString.scala).
+
+One-hot pivot: fit finds the top-K values per feature by count (min support),
+transform maps strings → fixed vocabulary ids on host (numpy hash-map lookup),
+then one-hot expansion is a pure device op.  Static shapes: the vocab is
+resolved at fit time, so the transform jits (SURVEY.md §7 hard part (c)).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columns import Column, ColumnBatch
+from ..stages.base import Estimator, Transformer, TransformerModel
+from ..types import Integral, OPVector, Real, Text
+from ..vector_meta import (NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMeta,
+                           VectorMeta)
+
+
+def _col_strings(col: Column) -> np.ndarray:
+    """Host view of a text-ish column as object array of str|None."""
+    if col.is_host_object():
+        return col.values
+    vals = np.asarray(col.values).astype(str)
+    if col.mask is not None:
+        out = vals.astype(object)
+        out[~np.asarray(col.mask)] = None
+        return out
+    return vals.astype(object)
+
+
+def encode_with_vocab(values: np.ndarray, vocab: Dict[str, int], other_id: int) -> np.ndarray:
+    """strings → int ids; None→other_id+1 (null slot)."""
+    null_id = other_id + 1
+    out = np.full(len(values), other_id, dtype=np.int32)
+    for i, v in enumerate(values):
+        if v is None:
+            out[i] = null_id
+        else:
+            out[i] = vocab.get(v, other_id)
+    return out
+
+
+class OneHotModel(TransformerModel):
+    out_kind = OPVector
+    is_device_op = False  # host vocab lookup, then device one-hot
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        outs = []
+        for f in self.input_features:
+            vocab: Dict[str, int] = self.fitted["vocabs"][f.name]
+            other_id = len(vocab)
+            ids = encode_with_vocab(_col_strings(batch[f.name]), vocab, other_id)
+            width = other_id + (1 if self.get("track_other", True) else 0) \
+                + (1 if self.get("track_nulls", True) else 0)
+            onehot = jnp.asarray(ids[:, None] == np.arange(width)[None, :],
+                                 jnp.float32) if width else jnp.zeros((len(ids), 0))
+            # columns beyond vocab: OTHER then null — clip ids that have no slot
+            keep = min(width, other_id + 2)
+            onehot = onehot[:, :keep]
+            outs.append(onehot)
+        return Column(OPVector, jnp.concatenate(outs, axis=1) if outs else
+                      jnp.zeros((len(batch), 0)), meta=self.fitted["meta"])
+
+
+class OneHotEstimator(Estimator):
+    """Pivot top-K categorical values into indicator columns with OTHER and
+    null slots (≙ OpOneHotVectorizer/OneHotEstimator)."""
+
+    out_kind = OPVector
+
+    def __init__(self, top_k: int = 20, min_support: int = 10,
+                 track_nulls: bool = True, track_other: bool = True,
+                 max_pct_cardinality: float = 1.0, **params):
+        super().__init__(top_k=top_k, min_support=min_support,
+                         track_nulls=track_nulls, track_other=track_other,
+                         max_pct_cardinality=max_pct_cardinality, **params)
+
+    def fit(self, batch: ColumnBatch) -> TransformerModel:
+        vocabs: Dict[str, Dict[str, int]] = {}
+        cols_meta: List[VectorColumnMeta] = []
+        top_k, min_support = self.get("top_k"), self.get("min_support")
+        for f in self.input_features:
+            strings = _col_strings(batch[f.name])
+            counts = Counter(v for v in strings if v is not None)
+            top = [v for v, c in counts.most_common(top_k) if c >= min_support]
+            vocab = {v: i for i, v in enumerate(sorted(top))}
+            vocabs[f.name] = vocab
+            for v in sorted(top):
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, indicator_value=v))
+            if self.get("track_other", True):
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, indicator_value=OTHER_INDICATOR))
+            if self.get("track_nulls", True):
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, indicator_value=NULL_INDICATOR))
+        meta = VectorMeta(self.output_name(), cols_meta)
+        return self._finalize_model(OneHotModel(
+            fitted={"vocabs": vocabs, "meta": meta}, **self.params))
+
+
+class StringIndexerModel(TransformerModel):
+    out_kind = Integral
+    is_device_op = False
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        (f,) = self.input_features
+        vocab = self.fitted["vocab"]
+        strings = _col_strings(batch[f.name])
+        handle = self.get("handle_invalid", "noFilter")
+        unseen = len(vocab)
+        ids = np.zeros(len(strings), np.int64)
+        mask = np.ones(len(strings), bool)
+        for i, v in enumerate(strings):
+            if v is None or v not in vocab:
+                if handle == "error" and v is not None:
+                    raise ValueError(f"unseen label {v!r}")
+                ids[i] = unseen
+            else:
+                ids[i] = vocab[v]
+        return Column(Integral, ids, mask=mask)
+
+
+class StringIndexer(Estimator):
+    """Text → ordinal index by descending frequency (≙ OpStringIndexer;
+    'NoFilter' variant maps unseen to an extra bucket)."""
+
+    out_kind = Integral
+
+    def __init__(self, handle_invalid: str = "noFilter", **params):
+        super().__init__(handle_invalid=handle_invalid, **params)
+
+    def fit(self, batch: ColumnBatch) -> TransformerModel:
+        (f,) = self.input_features
+        strings = _col_strings(batch[f.name])
+        counts = Counter(v for v in strings if v is not None)
+        # Spark orders by freq desc, then value asc
+        ordered = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+        vocab = {v: i for i, (v, _) in enumerate(ordered)}
+        model = StringIndexerModel(fitted={"vocab": vocab}, **self.params)
+        model.metadata["labels"] = [v for v, _ in ordered]
+        return self._finalize_model(model)
+
+
+class IndexToString(Transformer):
+    """Ordinal index → original label (≙ OpIndexToString)."""
+
+    out_kind = Text
+    is_device_op = False
+
+    def __init__(self, labels: Sequence[str], **params):
+        super().__init__(labels=list(labels), **params)
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        (f,) = self.input_features
+        labels = self.get("labels")
+        ids = np.asarray(batch[f.name].values).astype(int)
+        vals = np.array([labels[i] if 0 <= i < len(labels) else None
+                         for i in ids], dtype=object)
+        return Column(Text, vals)

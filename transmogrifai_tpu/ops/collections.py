@@ -1,0 +1,85 @@
+"""Set/list vectorizers (reference: core/.../stages/impl/feature/
+{MultiPickListMapVectorizer for maps, OpSetVectorizer}.scala — the top-K pivot
+over MultiPickList sets).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columns import Column, ColumnBatch
+from ..stages.base import Estimator, TransformerModel
+from ..types import OPVector
+from ..vector_meta import (NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMeta,
+                           VectorMeta)
+
+
+class MultiPickListVectorizerModel(TransformerModel):
+    out_kind = OPVector
+    is_device_op = False
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        outs = []
+        for f in self.input_features:
+            vocab: Dict[str, int] = self.fitted["vocabs"][f.name]
+            sets = batch[f.name].values
+            width = len(vocab) + (1 if self.get("track_other", True) else 0) \
+                + (1 if self.get("track_nulls", True) else 0)
+            block = np.zeros((len(sets), width), np.float32)
+            other_col = len(vocab) if self.get("track_other", True) else None
+            null_col = width - 1 if self.get("track_nulls", True) else None
+            for i, s in enumerate(sets):
+                if not s:
+                    if null_col is not None:
+                        block[i, null_col] = 1.0
+                    continue
+                for v in s:
+                    j = vocab.get(v)
+                    if j is not None:
+                        block[i, j] = 1.0
+                    elif other_col is not None:
+                        block[i, other_col] = 1.0
+            outs.append(block)
+        arr = np.concatenate(outs, axis=1)
+        return Column(OPVector, jnp.asarray(arr), meta=self.fitted["meta"])
+
+
+class MultiPickListVectorizer(Estimator):
+    """Top-K membership pivot of MultiPickList sets with OTHER + null slots."""
+
+    out_kind = OPVector
+
+    def __init__(self, top_k: int = 20, min_support: int = 10,
+                 track_nulls: bool = True, track_other: bool = True, **params):
+        super().__init__(top_k=top_k, min_support=min_support,
+                         track_nulls=track_nulls, track_other=track_other,
+                         **params)
+
+    def fit(self, batch: ColumnBatch) -> TransformerModel:
+        vocabs: Dict[str, Dict[str, int]] = {}
+        cols_meta: List[VectorColumnMeta] = []
+        for f in self.input_features:
+            counts = Counter()
+            for s in batch[f.name].values:
+                for v in (s or ()):
+                    counts[v] += 1
+            top = [v for v, c in counts.most_common(self.get("top_k"))
+                   if c >= self.get("min_support")]
+            vocab = {v: i for i, v in enumerate(sorted(top))}
+            vocabs[f.name] = vocab
+            for v in sorted(top):
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, indicator_value=v))
+            if self.get("track_other", True):
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, indicator_value=OTHER_INDICATOR))
+            if self.get("track_nulls", True):
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, indicator_value=NULL_INDICATOR))
+        meta = VectorMeta(self.output_name(), cols_meta)
+        return self._finalize_model(MultiPickListVectorizerModel(
+            fitted={"vocabs": vocabs, "meta": meta}, **self.params))

@@ -1,0 +1,41 @@
+"""VectorsCombiner — assemble feature vectors and merge their lineage metadata
+(reference: core/.../stages/impl/feature/VectorsCombiner.scala).
+
+A pure concat on device; metadata flattening mirrors OpVectorMetadata.flatten.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from ..columns import Column, ColumnBatch
+from ..stages.base import Transformer
+from ..types import OPVector
+from ..vector_meta import VectorColumnMeta, VectorMeta
+
+
+class VectorsCombiner(Transformer):
+    in_kinds = None
+    out_kind = OPVector
+
+    def output_name(self) -> str:
+        return f"features_{self.uid[-6:]}"
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        arrays, metas = [], []
+        for f in self.input_features:
+            col = batch[f.name]
+            v = jnp.asarray(col.values, jnp.float32)
+            if v.ndim == 1:
+                v = v[:, None]
+            arrays.append(v)
+            if col.meta is not None:
+                metas.append(col.meta)
+            else:
+                metas.append(VectorMeta(f.name, [
+                    VectorColumnMeta(f.name, f.kind.__name__)
+                    for _ in range(v.shape[1])]))
+        meta = VectorMeta.flatten(self.output_name(), metas)
+        return Column(OPVector, jnp.concatenate(arrays, axis=1), meta=meta)

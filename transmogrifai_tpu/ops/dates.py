@@ -1,0 +1,200 @@
+"""Temporal vectorizers (reference: core/.../stages/impl/feature/
+DateToUnitCircleTransformer.scala, DateListVectorizer.scala,
+TimePeriodTransformer.scala).
+
+Dates are epoch-milliseconds (Integral storage).  Unit-circle embedding —
+sin/cos of the requested periods — is a pure device op; the period extraction
+(hour-of-day etc.) is modular arithmetic on ms, jit-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columns import Column, ColumnBatch
+from ..stages.base import Estimator, Transformer, TransformerModel
+from ..types import Date, DateList, Integral, OPVector, Real
+from ..vector_meta import NULL_INDICATOR, VectorColumnMeta, VectorMeta
+
+_MS_HOUR = 3600 * 1000
+_MS_DAY = 24 * _MS_HOUR
+_MS_WEEK = 7 * _MS_DAY
+# epoch 1970-01-01 was a Thursday; shift so 0 = Monday like ISO
+_EPOCH_DOW_SHIFT = 3 * _MS_DAY
+_MS_YEAR = int(365.2425 * _MS_DAY)
+
+
+def _period_fraction(ms, period: str):
+    """Fraction in [0, 1) of the given circular period."""
+    ms = jnp.asarray(ms, jnp.float64) if hasattr(ms, "dtype") else jnp.asarray(ms)
+    if period == "HourOfDay":
+        return (ms % _MS_DAY) / _MS_DAY
+    if period == "DayOfWeek":
+        return ((ms + _EPOCH_DOW_SHIFT) % _MS_WEEK) / _MS_WEEK
+    if period == "DayOfMonth":
+        # approximate month as 30.44 days (exact calendar month needs host calc)
+        month_ms = 30.44 * _MS_DAY
+        return (ms % month_ms) / month_ms
+    if period == "DayOfYear":
+        return (ms % _MS_YEAR) / _MS_YEAR
+    raise ValueError(f"unknown time period {period}")
+
+
+class DateToUnitCircleModel(TransformerModel):
+    out_kind = OPVector
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        periods = self.get("periods")
+        outs = []
+        for f in self.input_features:
+            col = batch[f.name]
+            v = jnp.asarray(col.values, jnp.float64)
+            m = (jnp.ones(v.shape[0], bool) if col.mask is None
+                 else jnp.asarray(col.mask))
+            for p in periods:
+                frac = _period_fraction(v, p)
+                ang = 2 * jnp.pi * frac
+                outs.append(jnp.where(m, jnp.sin(ang), 0.0).astype(jnp.float32)[:, None])
+                outs.append(jnp.where(m, jnp.cos(ang), 0.0).astype(jnp.float32)[:, None])
+            if self.get("track_nulls", True):
+                outs.append((~m).astype(jnp.float32)[:, None])
+        return Column(OPVector, jnp.concatenate(outs, axis=1), meta=self.fitted["meta"])
+
+
+class DateToUnitCircleVectorizer(Estimator):
+    """sin/cos circular embedding of date periods
+    (≙ DateToUnitCircleTransformer + transmogrify's circular-date default)."""
+
+    out_kind = OPVector
+
+    def __init__(self, periods: Sequence[str] = ("HourOfDay", "DayOfWeek",
+                                                 "DayOfMonth", "DayOfYear"),
+                 track_nulls: bool = True, **params):
+        super().__init__(periods=list(periods), track_nulls=track_nulls, **params)
+
+    def fit(self, batch: ColumnBatch) -> TransformerModel:
+        cols_meta: List[VectorColumnMeta] = []
+        for f in self.input_features:
+            for p in self.get("periods"):
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, descriptor_value=f"sin({p})"))
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, descriptor_value=f"cos({p})"))
+            if self.get("track_nulls", True):
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, indicator_value=NULL_INDICATOR))
+        meta = VectorMeta(self.output_name(), cols_meta)
+        return self._finalize_model(DateToUnitCircleModel(
+            fitted={"meta": meta}, **self.params))
+
+
+class TimePeriodTransformer(Transformer):
+    """Date → integral period value (≙ TimePeriodTransformer.scala)."""
+
+    out_kind = Integral
+
+    def __init__(self, period: str = "DayOfWeek", **params):
+        super().__init__(period=period, **params)
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        (f,) = self.input_features
+        col = batch[f.name]
+        v = np.asarray(col.values, np.int64)
+        p = self.get("period")
+        if p == "HourOfDay":
+            out = (v % _MS_DAY) // _MS_HOUR
+        elif p == "DayOfWeek":
+            out = ((v + _EPOCH_DOW_SHIFT) % _MS_WEEK) // _MS_DAY + 1
+        elif p == "DayOfMonth":
+            out = (v % int(30.44 * _MS_DAY)) // _MS_DAY + 1
+        elif p == "DayOfYear":
+            out = (v % _MS_YEAR) // _MS_DAY + 1
+        elif p == "WeekOfYear":
+            out = (v % _MS_YEAR) // _MS_WEEK + 1
+        elif p == "MonthOfYear":
+            out = (v % _MS_YEAR) // int(30.44 * _MS_DAY) + 1
+        else:
+            raise ValueError(f"unknown period {p}")
+        return Column(Integral, out, mask=col.mask)
+
+
+class DateListVectorizerModel(TransformerModel):
+    out_kind = OPVector
+    is_device_op = False
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        pivot = self.get("pivot")
+        ref = self.get("reference_ms")
+        outs = []
+        for f in self.input_features:
+            lists = batch[f.name].values
+            if pivot in ("SinceFirst", "SinceLast"):
+                pick = min if pivot == "SinceFirst" else max
+                vals, mask = [], []
+                for lst in lists:
+                    if lst:
+                        vals.append((ref - pick(lst)) / _MS_DAY)
+                        mask.append(True)
+                    else:
+                        vals.append(0.0)
+                        mask.append(False)
+                outs.append(np.asarray(vals, np.float32)[:, None])
+                if self.get("track_nulls", True):
+                    outs.append((~np.asarray(mask)).astype(np.float32)[:, None])
+            else:  # ModeDay / ModeMonth / ModeHour pivots one-hot the mode
+                period = {"ModeDay": ("DayOfWeek", 7), "ModeMonth": ("MonthOfYear", 12),
+                          "ModeHour": ("HourOfDay", 24)}[pivot]
+                name, width = period
+                block = np.zeros((len(lists), width), np.float32)
+                for i, lst in enumerate(lists):
+                    if not lst:
+                        continue
+                    from collections import Counter
+                    cnt = Counter()
+                    for ms in lst:
+                        if name == "DayOfWeek":
+                            cnt[int(((ms + _EPOCH_DOW_SHIFT) % _MS_WEEK) // _MS_DAY)] += 1
+                        elif name == "MonthOfYear":
+                            cnt[int((ms % _MS_YEAR) // int(30.44 * _MS_DAY)) % 12] += 1
+                        else:
+                            cnt[int((ms % _MS_DAY) // _MS_HOUR)] += 1
+                    block[i, cnt.most_common(1)[0][0]] = 1.0
+                outs.append(block)
+        arr = np.concatenate(outs, axis=1)
+        return Column(OPVector, jnp.asarray(arr), meta=self.fitted["meta"])
+
+
+class DateListVectorizer(Estimator):
+    """DateList pivots (≙ DateListVectorizer.scala): SinceFirst/SinceLast days
+    or mode-of-period one-hot."""
+
+    out_kind = OPVector
+
+    def __init__(self, pivot: str = "SinceLast",
+                 reference_ms: int = 1500000000000, track_nulls: bool = True,
+                 **params):
+        super().__init__(pivot=pivot, reference_ms=reference_ms,
+                         track_nulls=track_nulls, **params)
+
+    def fit(self, batch: ColumnBatch) -> TransformerModel:
+        cols_meta: List[VectorColumnMeta] = []
+        pivot = self.get("pivot")
+        for f in self.input_features:
+            if pivot in ("SinceFirst", "SinceLast"):
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, descriptor_value=pivot))
+                if self.get("track_nulls", True):
+                    cols_meta.append(VectorColumnMeta(
+                        f.name, f.kind.__name__, indicator_value=NULL_INDICATOR))
+            else:
+                width = {"ModeDay": 7, "ModeMonth": 12, "ModeHour": 24}[pivot]
+                for j in range(width):
+                    cols_meta.append(VectorColumnMeta(
+                        f.name, f.kind.__name__,
+                        descriptor_value=f"{pivot}_{j}"))
+        meta = VectorMeta(self.output_name(), cols_meta)
+        return self._finalize_model(DateListVectorizerModel(
+            fitted={"meta": meta}, **self.params))

@@ -1,0 +1,75 @@
+"""Geolocation vectorizer (reference: core/.../stages/impl/feature/
+GeolocationVectorizer.scala): fill missing (lat, lon, accuracy) with the
+train mean and track nulls.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columns import Column, ColumnBatch
+from ..stages.base import Estimator, TransformerModel
+from ..types import OPVector
+from ..vector_meta import NULL_INDICATOR, VectorColumnMeta, VectorMeta
+
+
+def _geo_arrays(col) -> tuple:
+    """Column of Geolocation → ([N,3] float32, [N] bool mask)."""
+    if col.is_host_object():
+        n = len(col.values)
+        arr = np.zeros((n, 3), np.float32)
+        mask = np.zeros(n, bool)
+        for i, v in enumerate(col.values):
+            if v:
+                arr[i] = v[:3]
+                mask[i] = True
+        return arr, mask
+    arr = np.asarray(col.values, np.float32)
+    mask = (np.ones(len(arr), bool) if col.mask is None else np.asarray(col.mask))
+    return arr, mask
+
+
+class GeolocationVectorizerModel(TransformerModel):
+    out_kind = OPVector
+    is_device_op = False
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        outs = []
+        for k, f in enumerate(self.input_features):
+            arr, mask = _geo_arrays(batch[f.name])
+            fill = np.asarray(self.fitted["fills"][k])
+            filled = np.where(mask[:, None], arr, fill[None, :])
+            outs.append(filled)
+            if self.get("track_nulls", True):
+                outs.append((~mask).astype(np.float32)[:, None])
+        out = np.concatenate(outs, axis=1)
+        return Column(OPVector, jnp.asarray(out), meta=self.fitted["meta"])
+
+
+class GeolocationVectorizer(Estimator):
+    out_kind = OPVector
+
+    def __init__(self, track_nulls: bool = True, fill_mode: str = "mean", **params):
+        super().__init__(track_nulls=track_nulls, fill_mode=fill_mode, **params)
+
+    def fit(self, batch: ColumnBatch) -> TransformerModel:
+        fills, cols_meta = [], []
+        for f in self.input_features:
+            arr, mask = _geo_arrays(batch[f.name])
+            if self.get("fill_mode") == "mean" and mask.any():
+                fill = arr[mask].mean(axis=0)
+            else:
+                fill = np.zeros(3, np.float32)
+            fills.append(fill)
+            for d in ("lat", "lon", "accuracy"):
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, descriptor_value=d))
+            if self.get("track_nulls", True):
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, indicator_value=NULL_INDICATOR))
+        meta = VectorMeta(self.output_name(), cols_meta)
+        return self._finalize_model(GeolocationVectorizerModel(
+            fitted={"fills": np.stack(fills), "meta": meta}, **self.params))

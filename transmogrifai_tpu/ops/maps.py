@@ -1,0 +1,240 @@
+"""Map vectorizer — per-key expansion of all 25 map types (reference:
+core/.../stages/impl/feature/OPMapVectorizer.scala, TextMapPivotVectorizer,
+MultiPickListMapVectorizer, DateMapToUnitCircleVectorizer).
+
+Fit discovers the key set (sorted, capped) and per-key statistics, then
+dispatches on the map's value kind: numeric keys → fill+null-indicator,
+categorical/text keys → top-K pivot, binary keys → 0/1+null, date keys →
+unit circle, geolocation keys → mean-fill triple.
+"""
+
+from __future__ import annotations
+
+from collections import Counter, defaultdict
+from typing import Any, Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columns import Column, ColumnBatch
+from ..stages.base import Estimator, TransformerModel
+from ..types import (Binary, Date, DateTime, Geolocation, Integral,
+                     MultiPickList, OPVector, Real, Text, is_numeric_kind,
+                     map_value_kind)
+from ..vector_meta import (NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMeta,
+                           VectorMeta)
+from .dates import _MS_DAY, _period_fraction
+
+
+def _map_values(col) -> List[Dict[str, Any]]:
+    return [v if isinstance(v, dict) else {} for v in col.values]
+
+
+class MapVectorizerModel(TransformerModel):
+    out_kind = OPVector
+    is_device_op = False
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        (f,) = self.input_features
+        maps = _map_values(batch[f.name])
+        n = len(maps)
+        vk = map_value_kind(f.kind)
+        keys: List[str] = self.fitted["keys"]
+        track_nulls = self.get("track_nulls", True)
+        blocks: List[np.ndarray] = []
+        if issubclass(vk, Binary):
+            for k in keys:
+                col = np.zeros((n, 2 if track_nulls else 1), np.float32)
+                for i, m in enumerate(maps):
+                    v = m.get(k)
+                    if v is None:
+                        if track_nulls:
+                            col[i, 1] = 1.0
+                    else:
+                        col[i, 0] = float(bool(v))
+                blocks.append(col)
+        elif issubclass(vk, (Date, DateTime)):
+            periods = self.get("periods", ["HourOfDay", "DayOfWeek", "DayOfMonth", "DayOfYear"])
+            for k in keys:
+                vals = np.array([float(m.get(k) or 0) for m in maps])
+                present = np.array([m.get(k) is not None for m in maps])
+                cols = []
+                for p in periods:
+                    frac = np.asarray(_period_fraction(vals, p))
+                    ang = 2 * np.pi * frac
+                    cols.append(np.where(present, np.sin(ang), 0.0)[:, None])
+                    cols.append(np.where(present, np.cos(ang), 0.0)[:, None])
+                if track_nulls:
+                    cols.append((~present).astype(np.float32)[:, None])
+                blocks.append(np.concatenate(cols, axis=1).astype(np.float32))
+        elif is_numeric_kind(vk):
+            fills = self.fitted["fills"]
+            for k in keys:
+                fill = fills.get(k, 0.0)
+                col = np.zeros((n, 2 if track_nulls else 1), np.float32)
+                for i, m in enumerate(maps):
+                    v = m.get(k)
+                    if v is None:
+                        col[i, 0] = fill
+                        if track_nulls:
+                            col[i, 1] = 1.0
+                    else:
+                        col[i, 0] = float(v)
+                blocks.append(col)
+        elif issubclass(vk, MultiPickList):
+            vocabs = self.fitted["vocabs"]
+            for k in keys:
+                vocab = vocabs.get(k, {})
+                width = len(vocab) + 2
+                col = np.zeros((n, width), np.float32)
+                for i, m in enumerate(maps):
+                    s = m.get(k)
+                    if not s:
+                        col[i, width - 1] = 1.0
+                        continue
+                    for v in s:
+                        j = vocab.get(v)
+                        if j is not None:
+                            col[i, j] = 1.0
+                        else:
+                            col[i, len(vocab)] = 1.0
+                blocks.append(col)
+        elif issubclass(vk, Geolocation):
+            fills = self.fitted["fills"]
+            for k in keys:
+                fill = np.asarray(fills.get(k, np.zeros(3)))
+                col = np.zeros((n, 4 if track_nulls else 3), np.float32)
+                for i, m in enumerate(maps):
+                    v = m.get(k)
+                    if v:
+                        col[i, :3] = np.asarray(v[:3])
+                    else:
+                        col[i, :3] = fill
+                        if track_nulls:
+                            col[i, 3] = 1.0
+                blocks.append(col)
+        else:  # text-like → per-key top-K pivot
+            vocabs = self.fitted["vocabs"]
+            for k in keys:
+                vocab = vocabs.get(k, {})
+                width = len(vocab) + 2  # OTHER + null
+                col = np.zeros((n, width), np.float32)
+                for i, m in enumerate(maps):
+                    v = m.get(k)
+                    if v is None:
+                        col[i, width - 1] = 1.0
+                    else:
+                        j = vocab.get(str(v), len(vocab))
+                        col[i, j] = 1.0
+                blocks.append(col)
+        arr = (np.concatenate(blocks, axis=1) if blocks
+               else np.zeros((n, 0), np.float32))
+        return Column(OPVector, jnp.asarray(arr), meta=self.fitted["meta"])
+
+
+class MapVectorizer(Estimator):
+    """Per-key expansion of a map feature (≙ OPMapVectorizer.scala)."""
+
+    out_kind = OPVector
+
+    def __init__(self, top_k: int = 20, min_support: int = 10,
+                 track_nulls: bool = True, max_keys: int = 100,
+                 allow_list: List[str] = None, block_list: List[str] = None,
+                 **params):
+        super().__init__(top_k=top_k, min_support=min_support,
+                         track_nulls=track_nulls, max_keys=max_keys,
+                         allow_list=allow_list, block_list=block_list, **params)
+
+    def fit(self, batch: ColumnBatch) -> TransformerModel:
+        (f,) = self.input_features
+        maps = _map_values(batch[f.name])
+        vk = map_value_kind(f.kind)
+        key_counts: Counter = Counter()
+        for m in maps:
+            key_counts.update(m.keys())
+        allow = self.get("allow_list")
+        block = set(self.get("block_list") or ())
+        keys = sorted(k for k, _ in key_counts.most_common(self.get("max_keys"))
+                      if (allow is None or k in allow) and k not in block)
+        fitted: Dict[str, Any] = {"keys": keys}
+        cols_meta: List[VectorColumnMeta] = []
+        tn = self.get("track_nulls", True)
+        kindname = f.kind.__name__
+        if issubclass(vk, Binary):
+            for k in keys:
+                cols_meta.append(VectorColumnMeta(f.name, kindname, grouping=k))
+                if tn:
+                    cols_meta.append(VectorColumnMeta(
+                        f.name, kindname, grouping=k, indicator_value=NULL_INDICATOR))
+        elif issubclass(vk, (Date, DateTime)):
+            periods = ["HourOfDay", "DayOfWeek", "DayOfMonth", "DayOfYear"]
+            self.set("periods", periods)
+            for k in keys:
+                for p in periods:
+                    cols_meta.append(VectorColumnMeta(
+                        f.name, kindname, grouping=k, descriptor_value=f"sin({p})"))
+                    cols_meta.append(VectorColumnMeta(
+                        f.name, kindname, grouping=k, descriptor_value=f"cos({p})"))
+                if tn:
+                    cols_meta.append(VectorColumnMeta(
+                        f.name, kindname, grouping=k, indicator_value=NULL_INDICATOR))
+        elif is_numeric_kind(vk):
+            fills: Dict[str, float] = {}
+            for k in keys:
+                vals = [float(m[k]) for m in maps if m.get(k) is not None]
+                fills[k] = float(np.mean(vals)) if vals else 0.0
+                cols_meta.append(VectorColumnMeta(f.name, kindname, grouping=k))
+                if tn:
+                    cols_meta.append(VectorColumnMeta(
+                        f.name, kindname, grouping=k, indicator_value=NULL_INDICATOR))
+            fitted["fills"] = fills
+        elif issubclass(vk, MultiPickList):
+            vocabs: Dict[str, Dict[str, int]] = {}
+            for k in keys:
+                cnt = Counter()
+                for m in maps:
+                    for v in (m.get(k) or ()):
+                        cnt[v] += 1
+                top = [v for v, c in cnt.most_common(self.get("top_k"))
+                       if c >= self.get("min_support")]
+                vocab = {v: i for i, v in enumerate(sorted(top))}
+                vocabs[k] = vocab
+                for v in sorted(top):
+                    cols_meta.append(VectorColumnMeta(
+                        f.name, kindname, grouping=k, indicator_value=v))
+                cols_meta.append(VectorColumnMeta(
+                    f.name, kindname, grouping=k, indicator_value=OTHER_INDICATOR))
+                cols_meta.append(VectorColumnMeta(
+                    f.name, kindname, grouping=k, indicator_value=NULL_INDICATOR))
+            fitted["vocabs"] = vocabs
+        elif issubclass(vk, Geolocation):
+            fills = {}
+            for k in keys:
+                vals = [m[k][:3] for m in maps if m.get(k)]
+                fills[k] = (np.mean(np.asarray(vals, np.float32), axis=0)
+                            if vals else np.zeros(3, np.float32))
+                for d in ("lat", "lon", "accuracy"):
+                    cols_meta.append(VectorColumnMeta(
+                        f.name, kindname, grouping=k, descriptor_value=d))
+                if tn:
+                    cols_meta.append(VectorColumnMeta(
+                        f.name, kindname, grouping=k, indicator_value=NULL_INDICATOR))
+            fitted["fills"] = fills
+        else:
+            vocabs = {}
+            for k in keys:
+                cnt = Counter(str(m[k]) for m in maps if m.get(k) is not None)
+                top = [v for v, c in cnt.most_common(self.get("top_k"))
+                       if c >= self.get("min_support")]
+                vocab = {v: i for i, v in enumerate(sorted(top))}
+                vocabs[k] = vocab
+                for v in sorted(top):
+                    cols_meta.append(VectorColumnMeta(
+                        f.name, kindname, grouping=k, indicator_value=v))
+                cols_meta.append(VectorColumnMeta(
+                    f.name, kindname, grouping=k, indicator_value=OTHER_INDICATOR))
+                cols_meta.append(VectorColumnMeta(
+                    f.name, kindname, grouping=k, indicator_value=NULL_INDICATOR))
+            fitted["vocabs"] = vocabs
+        fitted["meta"] = VectorMeta(self.output_name(), cols_meta)
+        return self._finalize_model(MapVectorizerModel(fitted=fitted, **self.params))

@@ -1,0 +1,207 @@
+"""Numeric vectorizers (reference: core/.../stages/impl/feature/
+{RealVectorizer,IntegralVectorizer,BinaryVectorizer,RealNNVectorizer}.scala and
+OpScalarStandardScaler, NumericBucketizer).
+
+Fit = XLA reduction (masked mean / mode); transform = pure jnp fill +
+null-indicator concat.  These are sequence stages: one stage vectorizes many
+features of the same kind into a single [N, D] block with per-column lineage
+metadata, matching the reference's SequenceEstimator design.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columns import Column, ColumnBatch
+from ..stages.base import Estimator, Transformer, TransformerModel
+from ..types import Binary, Integral, OPNumeric, OPVector, Real, RealNN
+from ..vector_meta import NULL_INDICATOR, VectorColumnMeta, VectorMeta
+
+
+def _masked_f32(col: Column):
+    v = jnp.asarray(col.values, jnp.float32)
+    m = col.mask
+    m = jnp.ones(v.shape[0], bool) if m is None else jnp.asarray(m)
+    return v, m
+
+
+class RealVectorizerModel(TransformerModel):
+    out_kind = OPVector
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        fills = self.fitted["fills"]  # [F]
+        track_nulls = self.get("track_nulls", True)
+        outs = []
+        for i, f in enumerate(self.input_features):
+            v, m = _masked_f32(batch[f.name])
+            filled = jnp.where(m, jnp.nan_to_num(v), fills[i])
+            outs.append(filled[:, None])
+            if track_nulls:
+                outs.append((~m).astype(jnp.float32)[:, None])
+        return Column(OPVector, jnp.concatenate(outs, axis=1), meta=self.fitted["meta"])
+
+
+class RealVectorizer(Estimator):
+    """Fill missing reals with the train-mean (or constant) + null indicator
+    (≙ RealVectorizer.scala).  fill_mode: 'mean' | 'constant'."""
+
+    in_kinds = None
+    out_kind = OPVector
+
+    def __init__(self, fill_mode: str = "mean", fill_value: float = 0.0,
+                 track_nulls: bool = True, **params):
+        super().__init__(fill_mode=fill_mode, fill_value=fill_value,
+                         track_nulls=track_nulls, **params)
+
+    def fit(self, batch: ColumnBatch) -> TransformerModel:
+        fills = []
+        cols_meta: List[VectorColumnMeta] = []
+        for f in self.input_features:
+            v, m = _masked_f32(batch[f.name])
+            if self.get("fill_mode") == "mean":
+                cnt = jnp.maximum(m.sum(), 1)
+                fill = (jnp.where(m, jnp.nan_to_num(v), 0.0).sum() / cnt)
+            else:
+                fill = jnp.asarray(self.get("fill_value"), jnp.float32)
+            fills.append(fill)
+            cols_meta.append(VectorColumnMeta(f.name, f.kind.__name__))
+            if self.get("track_nulls", True):
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, indicator_value=NULL_INDICATOR))
+        meta = VectorMeta(self.output_name(), cols_meta)
+        model = RealVectorizerModel(fitted={
+            "fills": jnp.stack(fills), "meta": meta}, **self.params)
+        return self._finalize_model(model)
+
+
+class RealNNVectorizerModel(TransformerModel):
+    out_kind = OPVector
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        outs = [jnp.asarray(batch[f.name].values, jnp.float32)[:, None]
+                for f in self.input_features]
+        return Column(OPVector, jnp.concatenate(outs, axis=1), meta=self.fitted["meta"])
+
+
+class RealNNVectorizer(Estimator):
+    """Non-nullable reals: straight passthrough into the vector
+    (≙ RealNNVectorizer.scala)."""
+
+    out_kind = OPVector
+
+    def fit(self, batch: ColumnBatch) -> TransformerModel:
+        meta = VectorMeta(self.output_name(), [
+            VectorColumnMeta(f.name, f.kind.__name__) for f in self.input_features])
+        return self._finalize_model(RealNNVectorizerModel(fitted={"meta": meta}))
+
+
+class IntegralVectorizerModel(RealVectorizerModel):
+    pass
+
+
+class IntegralVectorizer(Estimator):
+    """Fill missing integrals with train-mode (most frequent value)
+    (≙ IntegralVectorizer.scala)."""
+
+    out_kind = OPVector
+
+    def __init__(self, fill_mode: str = "mode", fill_value: int = 0,
+                 track_nulls: bool = True, **params):
+        super().__init__(fill_mode=fill_mode, fill_value=fill_value,
+                         track_nulls=track_nulls, **params)
+
+    def fit(self, batch: ColumnBatch) -> TransformerModel:
+        fills = []
+        cols_meta: List[VectorColumnMeta] = []
+        for f in self.input_features:
+            col = batch[f.name]
+            vals = np.asarray(col.values)
+            m = np.ones(len(vals), bool) if col.mask is None else np.asarray(col.mask)
+            if self.get("fill_mode") == "mode" and m.any():
+                uniq, counts = np.unique(vals[m], return_counts=True)
+                fill = float(uniq[np.argmax(counts)])
+            else:
+                fill = float(self.get("fill_value"))
+            fills.append(fill)
+            cols_meta.append(VectorColumnMeta(f.name, f.kind.__name__))
+            if self.get("track_nulls", True):
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, indicator_value=NULL_INDICATOR))
+        meta = VectorMeta(self.output_name(), cols_meta)
+        model = IntegralVectorizerModel(fitted={
+            "fills": jnp.asarray(fills, jnp.float32), "meta": meta}, **self.params)
+        return self._finalize_model(model)
+
+
+class BinaryVectorizerModel(TransformerModel):
+    out_kind = OPVector
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        outs = []
+        for f in self.input_features:
+            col = batch[f.name]
+            v = jnp.asarray(col.values).astype(jnp.float32)
+            m = (jnp.ones(v.shape[0], bool) if col.mask is None
+                 else jnp.asarray(col.mask))
+            outs.append(jnp.where(m, v, 0.0)[:, None])
+            if self.get("track_nulls", True):
+                outs.append((~m).astype(jnp.float32)[:, None])
+        return Column(OPVector, jnp.concatenate(outs, axis=1), meta=self.fitted["meta"])
+
+
+class BinaryVectorizer(Estimator):
+    """Booleans → {0,1} + null indicator (≙ BinaryVectorizer.scala)."""
+
+    out_kind = OPVector
+
+    def __init__(self, track_nulls: bool = True, **params):
+        super().__init__(track_nulls=track_nulls, **params)
+
+    def fit(self, batch: ColumnBatch) -> TransformerModel:
+        cols_meta: List[VectorColumnMeta] = []
+        for f in self.input_features:
+            cols_meta.append(VectorColumnMeta(f.name, f.kind.__name__))
+            if self.get("track_nulls", True):
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, indicator_value=NULL_INDICATOR))
+        meta = VectorMeta(self.output_name(), cols_meta)
+        return self._finalize_model(BinaryVectorizerModel(
+            fitted={"meta": meta}, **self.params))
+
+
+class StandardScalerModel(TransformerModel):
+    out_kind = OPVector
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        (col,) = self.input_columns(batch)
+        v = jnp.asarray(col.values, jnp.float32)
+        if v.ndim == 1:
+            v = v[:, None]
+        out = (v - self.fitted["mean"]) / self.fitted["std"]
+        return Column(OPVector, out, meta=col.meta or self.fitted["meta"])
+
+
+class StandardScaler(Estimator):
+    """z-score scaling of a numeric/vector feature (≙ OpScalarStandardScaler)."""
+
+    out_kind = OPVector
+
+    def __init__(self, with_mean: bool = True, with_std: bool = True, **params):
+        super().__init__(with_mean=with_mean, with_std=with_std, **params)
+
+    def fit(self, batch: ColumnBatch) -> TransformerModel:
+        (f,) = self.input_features
+        col = batch[f.name]
+        v = jnp.asarray(col.values, jnp.float32)
+        if v.ndim == 1:
+            v = v[:, None]
+        mean = v.mean(axis=0) if self.get("with_mean", True) else jnp.zeros(v.shape[1])
+        std = v.std(axis=0) if self.get("with_std", True) else jnp.ones(v.shape[1])
+        std = jnp.where(std == 0, 1.0, std)
+        meta = col.meta or VectorMeta(self.output_name(), [
+            VectorColumnMeta(f.name, f.kind.__name__)])
+        return self._finalize_model(StandardScalerModel(
+            fitted={"mean": mean, "std": std, "meta": meta}, **self.params))

@@ -1,0 +1,277 @@
+"""Text vectorization (reference: core/.../stages/impl/feature/
+SmartTextVectorizer.scala:61, TextTokenizer.scala, OpHashingTF.scala,
+OPCollectionHashingVectorizer.scala, TextLenTransformer.scala).
+
+TPU design: tokenization + hashing happen host-side at transform time (strings
+never reach the device); the hashed term-frequency matrix is the device-side
+product.  Hashing uses a stable 32-bit FNV-1a (vectorizable, seed-stable across
+processes — unlike Python's ``hash``).  The SmartTextVectorizer decision
+(cardinality ≤ max → pivot one-hot, else hash) is made at fit time from a
+single-pass TextStats reduction, so transform shapes are static for jit.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columns import Column, ColumnBatch
+from ..stages.base import Estimator, Transformer, TransformerModel
+from ..types import OPVector, Real, Text, TextList
+from ..vector_meta import (NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMeta,
+                           VectorMeta)
+from .categorical import _col_strings, encode_with_vocab
+
+_TOKEN_RE = re.compile(r"[^\s\p{P}]+") if hasattr(re, "Pattern") and False else \
+    re.compile(r"[A-Za-z0-9_']+")
+
+def fnv1a_32(s: str) -> int:
+    """Stable 32-bit FNV-1a string hash (host-side hashing-trick backbone)."""
+    h = 2166136261
+    for b in s.encode("utf-8"):
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def tokenize_text(s: Optional[str], min_token_length: int = 1,
+                  to_lowercase: bool = True) -> List[str]:
+    """Simple language-agnostic tokenizer (≙ TextTokenizer with the default
+    Lucene analyzer: lowercase + split on non-alphanumerics)."""
+    if s is None:
+        return []
+    if to_lowercase:
+        s = s.lower()
+    return [t for t in _TOKEN_RE.findall(s) if len(t) >= min_token_length]
+
+
+def hash_tokens_to_counts(token_lists: Sequence[Sequence[str]], num_hashes: int,
+                          binary: bool = False) -> np.ndarray:
+    """Hashing trick: token lists → [N, num_hashes] term-frequency matrix."""
+    out = np.zeros((len(token_lists), num_hashes), dtype=np.float32)
+    cache: Dict[str, int] = {}
+    for i, toks in enumerate(token_lists):
+        for t in toks:
+            j = cache.get(t)
+            if j is None:
+                j = fnv1a_32(t) % num_hashes
+                cache[t] = j
+            if binary:
+                out[i, j] = 1.0
+            else:
+                out[i, j] += 1.0
+    return out
+
+
+class TextTokenizer(Transformer):
+    """Text → TextList of tokens (≙ TextTokenizer.scala)."""
+
+    in_kinds = (Text,)
+    out_kind = TextList
+    is_device_op = False
+
+    def __init__(self, min_token_length: int = 1, to_lowercase: bool = True, **params):
+        super().__init__(min_token_length=min_token_length,
+                         to_lowercase=to_lowercase, **params)
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        (f,) = self.input_features
+        strings = _col_strings(batch[f.name])
+        toks = np.empty(len(strings), dtype=object)
+        for i, s in enumerate(strings):
+            toks[i] = tokenize_text(s, self.get("min_token_length", 1),
+                                    self.get("to_lowercase", True))
+        return Column(TextList, toks)
+
+
+class TextLenTransformer(Transformer):
+    """Text length feature (≙ TextLenTransformer.scala)."""
+
+    out_kind = Real
+    is_device_op = False
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        (f,) = self.input_features
+        strings = _col_strings(batch[f.name])
+        vals = np.array([0.0 if s is None else float(len(s)) for s in strings],
+                        np.float32)
+        mask = np.array([s is not None for s in strings])
+        return Column(Real, vals, mask=mask)
+
+
+class HashingVectorizerModel(TransformerModel):
+    out_kind = OPVector
+    is_device_op = False
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        num_hashes = self.get("num_hashes")
+        blocks = []
+        for f in self.input_features:
+            col = batch[f.name]
+            if col.is_host_object() and len(col.values) and isinstance(
+                    next((v for v in col.values if v is not None), ""), list):
+                token_lists = [v or [] for v in col.values]
+            else:
+                strings = _col_strings(col)
+                token_lists = [tokenize_text(s) for s in strings]
+            blocks.append(hash_tokens_to_counts(token_lists, num_hashes,
+                                                binary=self.get("binary", False)))
+        if self.get("shared_hash_space", False):
+            arr = np.sum(blocks, axis=0)
+        else:
+            arr = np.concatenate(blocks, axis=1)
+        return Column(OPVector, jnp.asarray(arr), meta=self.fitted["meta"])
+
+
+class HashingVectorizer(Estimator):
+    """Token/text hashing vectorizer (≙ OpHashingTF +
+    OPCollectionHashingVectorizer): each feature hashed into its own (or a
+    shared) ``num_hashes``-wide space."""
+
+    out_kind = OPVector
+
+    def __init__(self, num_hashes: int = 512, binary: bool = False,
+                 shared_hash_space: bool = False, **params):
+        super().__init__(num_hashes=num_hashes, binary=binary,
+                         shared_hash_space=shared_hash_space, **params)
+
+    def fit(self, batch: ColumnBatch) -> TransformerModel:
+        cols_meta = []
+        n_blocks = 1 if self.get("shared_hash_space") else len(self.input_features)
+        feats = (self.input_features[:1] if self.get("shared_hash_space")
+                 else self.input_features)
+        for f in feats:
+            for j in range(self.get("num_hashes")):
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, descriptor_value=f"hash_{j}"))
+        meta = VectorMeta(self.output_name(), cols_meta)
+        return self._finalize_model(HashingVectorizerModel(
+            fitted={"meta": meta}, **self.params))
+
+
+class TextStats:
+    """Single-pass text cardinality statistics monoid
+    (≙ SmartTextVectorizer.TextStats, SmartTextVectorizer.scala:182-230)."""
+
+    def __init__(self, value_counts: Optional[Counter] = None,
+                 length_counts: Optional[Counter] = None):
+        self.value_counts = value_counts or Counter()
+        self.length_counts = length_counts or Counter()
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.value_counts)
+
+    def combine(self, other: "TextStats") -> "TextStats":
+        return TextStats(self.value_counts + other.value_counts,
+                         self.length_counts + other.length_counts)
+
+    @staticmethod
+    def of_column(strings: np.ndarray, max_card: int) -> "TextStats":
+        vc, lc = Counter(), Counter()
+        for s in strings:
+            if s is None:
+                continue
+            if len(vc) <= max_card:
+                vc[s] += 1
+            lc[len(s)] += 1
+        return TextStats(vc, lc)
+
+
+class SmartTextVectorizerModel(TransformerModel):
+    out_kind = OPVector
+    is_device_op = False
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        blocks = []
+        num_hashes = self.get("num_hashes")
+        for f in self.input_features:
+            strat = self.fitted["strategies"][f.name]
+            strings = _col_strings(batch[f.name])
+            if strat == "pivot":
+                vocab = self.fitted["vocabs"][f.name]
+                other = len(vocab)
+                ids = encode_with_vocab(strings, vocab, other)
+                width = other + 2  # OTHER + null
+                blocks.append(np.asarray(ids[:, None] == np.arange(width)[None, :],
+                                         np.float32))
+            elif strat == "ignore":
+                if self.get("track_nulls", True):
+                    blocks.append(np.array([[1.0] if s is None else [0.0]
+                                            for s in strings], np.float32))
+            else:  # hash
+                token_lists = [tokenize_text(s) for s in strings]
+                h = hash_tokens_to_counts(token_lists, num_hashes)
+                if self.get("track_nulls", True):
+                    nulls = np.array([[1.0] if s is None else [0.0]
+                                      for s in strings], np.float32)
+                    h = np.concatenate([h, nulls], axis=1)
+                blocks.append(h)
+        arr = (np.concatenate(blocks, axis=1) if blocks
+               else np.zeros((len(batch), 0), np.float32))
+        return Column(OPVector, jnp.asarray(arr), meta=self.fitted["meta"])
+
+
+class SmartTextVectorizer(Estimator):
+    """Cardinality-adaptive text vectorization (≙ SmartTextVectorizer.scala:61):
+    one TextStats pass; per feature, cardinality ≤ max_cardinality → pivot
+    one-hot (like categorical), 1 unique value → ignore, else tokenize+hash."""
+
+    out_kind = OPVector
+
+    def __init__(self, max_cardinality: int = 30, top_k: int = 20,
+                 min_support: int = 10, num_hashes: int = 512,
+                 track_nulls: bool = True, auto_detect_languages: bool = False,
+                 **params):
+        super().__init__(max_cardinality=max_cardinality, top_k=top_k,
+                         min_support=min_support, num_hashes=num_hashes,
+                         track_nulls=track_nulls,
+                         auto_detect_languages=auto_detect_languages, **params)
+
+    def fit(self, batch: ColumnBatch) -> TransformerModel:
+        strategies: Dict[str, str] = {}
+        vocabs: Dict[str, Dict[str, int]] = {}
+        cols_meta: List[VectorColumnMeta] = []
+        max_card = self.get("max_cardinality")
+        for f in self.input_features:
+            strings = _col_strings(batch[f.name])
+            stats = TextStats.of_column(strings, max_card)
+            if stats.cardinality <= 1:
+                strategies[f.name] = "ignore"
+                if self.get("track_nulls", True):
+                    cols_meta.append(VectorColumnMeta(
+                        f.name, f.kind.__name__, indicator_value=NULL_INDICATOR))
+            elif stats.cardinality <= max_card:
+                strategies[f.name] = "pivot"
+                top = [v for v, c in stats.value_counts.most_common(self.get("top_k"))
+                       if c >= self.get("min_support")]
+                vocab = {v: i for i, v in enumerate(sorted(top))}
+                vocabs[f.name] = vocab
+                for v in sorted(top):
+                    cols_meta.append(VectorColumnMeta(
+                        f.name, f.kind.__name__, indicator_value=v))
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, indicator_value=OTHER_INDICATOR))
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, indicator_value=NULL_INDICATOR))
+            else:
+                strategies[f.name] = "hash"
+                for j in range(self.get("num_hashes")):
+                    cols_meta.append(VectorColumnMeta(
+                        f.name, f.kind.__name__, descriptor_value=f"hash_{j}"))
+                if self.get("track_nulls", True):
+                    cols_meta.append(VectorColumnMeta(
+                        f.name, f.kind.__name__, indicator_value=NULL_INDICATOR))
+        meta = VectorMeta(self.output_name(), cols_meta)
+        model = SmartTextVectorizerModel(
+            fitted={"strategies": strategies, "vocabs": vocabs, "meta": meta},
+            **self.params)
+        model.metadata["strategies"] = dict(strategies)
+        return self._finalize_model(model)
+
+
+class TextListVectorizer(HashingVectorizer):
+    """TextList → hashed vector (tokens already split)."""

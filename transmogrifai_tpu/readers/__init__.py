@@ -1,0 +1,9 @@
+from .base import (AggregateParams, AggregateReader, ConditionalParams,
+                   ConditionalReader, DataReader, JoinedReader, Reader)
+from .csv import CSVReader, infer_schema_from_records, read_csv_records
+from .factory import DataReaders
+
+__all__ = ["Reader", "DataReader", "AggregateReader", "ConditionalReader",
+           "JoinedReader", "AggregateParams", "ConditionalParams",
+           "CSVReader", "DataReaders", "infer_schema_from_records",
+           "read_csv_records"]

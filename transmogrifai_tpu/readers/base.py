@@ -1,0 +1,218 @@
+"""Data readers — the TPU-native re-design of the readers module (reference:
+readers/src/main/scala/com/salesforce/op/readers/Reader.scala:96,
+DataReader.scala:173,252,288, JoinedDataReader.scala:218).
+
+A reader yields records (dicts); ``generate_batch`` applies every raw feature's
+``extract_fn`` to produce the raw ``ColumnBatch`` (≙ generateDataFrame).
+Aggregate/conditional readers implement event-time aggregation with monoid
+aggregators and cutoff semantics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..columns import Column, ColumnBatch, column_from_values
+from ..features import Feature
+from ..stages.generator import FeatureGeneratorStage
+
+
+def _generator_of(feature: Feature) -> FeatureGeneratorStage:
+    st = feature.origin_stage
+    if not isinstance(st, FeatureGeneratorStage):
+        raise ValueError(f"{feature.name} is not a raw feature")
+    return st
+
+
+class Reader:
+    """Base reader (≙ Reader.scala:96)."""
+
+    def __init__(self, key_fn: Optional[Callable[[Dict], Any]] = None):
+        self.key_fn = key_fn or (lambda r: r.get("key"))
+
+    def read(self) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def generate_batch(self, raw_features: Sequence[Feature]) -> ColumnBatch:
+        records = self.read()
+        cols: Dict[str, Column] = {}
+        for f in raw_features:
+            cols[f.name] = _generator_of(f).extract_column(records)
+        cols["key"] = column_from_values(
+            __import__("transmogrifai_tpu.types", fromlist=["Text"]).Text,
+            [str(self.key_fn(r)) for r in records])
+        return ColumnBatch(cols, len(records))
+
+    # joins (≙ JoinedDataReader)
+    def inner_join(self, other: "Reader", **kw) -> "JoinedReader":
+        return JoinedReader(self, other, "inner", **kw)
+
+    def left_outer_join(self, other: "Reader", **kw) -> "JoinedReader":
+        return JoinedReader(self, other, "left", **kw)
+
+    def outer_join(self, other: "Reader", **kw) -> "JoinedReader":
+        return JoinedReader(self, other, "outer", **kw)
+
+
+class DataReader(Reader):
+    """Simple reader over in-memory records or a record-producing function
+    (≙ DataReader.generateDataFrame, DataReader.scala:173)."""
+
+    def __init__(self, records: Optional[Iterable[Dict[str, Any]]] = None,
+                 read_fn: Optional[Callable[[], Iterable[Dict[str, Any]]]] = None,
+                 key_fn: Optional[Callable[[Dict], Any]] = None):
+        super().__init__(key_fn)
+        self._records = list(records) if records is not None else None
+        self._read_fn = read_fn
+
+    def read(self) -> List[Dict[str, Any]]:
+        if self._records is not None:
+            return self._records
+        return list(self._read_fn())
+
+
+@dataclass
+class AggregateParams:
+    """≙ AggregateParams (DataReader.scala:279)."""
+    cutoff_time_fn: Optional[Callable[[Dict], bool]] = None  # event → is before cutoff
+
+
+class AggregateReader(DataReader):
+    """Event-time aggregation (≙ AggregateDataReader, DataReader.scala:252):
+    group records by key; predictors aggregate events before the cutoff,
+    responses after."""
+
+    def __init__(self, records=None, read_fn=None, key_fn=None,
+                 aggregate_params: Optional[AggregateParams] = None):
+        super().__init__(records, read_fn, key_fn)
+        self.params = aggregate_params or AggregateParams()
+
+    def generate_batch(self, raw_features: Sequence[Feature]) -> ColumnBatch:
+        records = self.read()
+        grouped: Dict[Any, List[Dict]] = {}
+        for r in records:
+            grouped.setdefault(self.key_fn(r), []).append(r)
+        cols: Dict[str, Column] = {}
+        for f in raw_features:
+            gen = _generator_of(f)
+            cols[f.name] = gen.extract_aggregated(
+                grouped, cutoff_fn=self.params.cutoff_time_fn,
+                is_response=f.is_response)
+        from ..types import Text
+        cols["key"] = column_from_values(Text, [str(k) for k in grouped])
+        return ColumnBatch(cols, len(grouped))
+
+
+@dataclass
+class ConditionalParams:
+    """≙ ConditionalParams (DataReader.scala:351)."""
+    target_condition: Callable[[Dict], bool] = lambda r: True
+    response_window_ms: Optional[int] = None
+    predictor_window_ms: Optional[int] = None
+    time_fn: Callable[[Dict], int] = lambda r: int(r.get("timestamp", 0))
+    drop_if_target_condition_not_met: bool = True
+
+
+class ConditionalReader(DataReader):
+    """Aggregation relative to per-key first occurrence of a target condition
+    (≙ ConditionalDataReader, DataReader.scala:288): predictors aggregate
+    events before the condition time (within predictor window), responses
+    after (within response window)."""
+
+    def __init__(self, records=None, read_fn=None, key_fn=None,
+                 conditional_params: Optional[ConditionalParams] = None):
+        super().__init__(records, read_fn, key_fn)
+        self.params = conditional_params or ConditionalParams()
+
+    def generate_batch(self, raw_features: Sequence[Feature]) -> ColumnBatch:
+        records = self.read()
+        p = self.params
+        grouped: Dict[Any, List[Dict]] = {}
+        for r in records:
+            grouped.setdefault(self.key_fn(r), []).append(r)
+        keys, rows = [], {}
+        for k, events in grouped.items():
+            cond_times = [p.time_fn(e) for e in events if p.target_condition(e)]
+            if not cond_times:
+                if p.drop_if_target_condition_not_met:
+                    continue
+                cutoff = max(p.time_fn(e) for e in events) + 1
+            else:
+                cutoff = min(cond_times)
+            pred_events, resp_events = [], []
+            for e in events:
+                t = p.time_fn(e)
+                if t < cutoff:
+                    if p.predictor_window_ms is None or t >= cutoff - p.predictor_window_ms:
+                        pred_events.append(e)
+                else:
+                    if p.response_window_ms is None or t < cutoff + p.response_window_ms:
+                        resp_events.append(e)
+            keys.append(k)
+            rows[k] = (pred_events, resp_events)
+        cols: Dict[str, Column] = {}
+        for f in raw_features:
+            gen = _generator_of(f)
+            vals = []
+            for k in keys:
+                pred_events, resp_events = rows[k]
+                evs = resp_events if f.is_response else pred_events
+                vals.append(gen.aggregator.aggregate([gen.extract_fn(e) for e in evs]))
+            cols[f.name] = column_from_values(f.kind, vals)
+        from ..types import Text
+        cols["key"] = column_from_values(Text, [str(k) for k in keys])
+        return ColumnBatch(cols, len(keys))
+
+
+class JoinedReader(Reader):
+    """Typed key join of two readers (≙ JoinedDataReader.scala:218)."""
+
+    def __init__(self, left: Reader, right: Reader, how: str = "inner",
+                 left_key: Optional[Callable[[Dict], Any]] = None,
+                 right_key: Optional[Callable[[Dict], Any]] = None):
+        super().__init__()
+        self.left, self.right, self.how = left, right, how
+        self.left_key = left_key or left.key_fn
+        self.right_key = right_key or right.key_fn
+
+    def read(self) -> List[Dict[str, Any]]:
+        lrecs, rrecs = self.left.read(), self.right.read()
+        rmap: Dict[Any, List[Dict]] = {}
+        for r in rrecs:
+            rmap.setdefault(self.right_key(r), []).append(r)
+        out: List[Dict] = []
+        seen_right = set()
+        for l in lrecs:
+            k = self.left_key(l)
+            matches = rmap.get(k, [])
+            if matches:
+                seen_right.add(k)
+                for m in matches:
+                    merged = dict(m)
+                    merged.update(l)
+                    merged["key"] = k
+                    out.append(merged)
+            elif self.how in ("left", "outer"):
+                rec = dict(l)
+                rec["key"] = k
+                out.append(rec)
+        if self.how == "outer":
+            for k, ms in rmap.items():
+                if k not in seen_right:
+                    for m in ms:
+                        rec = dict(m)
+                        rec["key"] = k
+                        out.append(rec)
+        return out
+
+    def generate_batch(self, raw_features: Sequence[Feature]) -> ColumnBatch:
+        records = self.read()
+        cols: Dict[str, Column] = {}
+        for f in raw_features:
+            cols[f.name] = _generator_of(f).extract_column(records)
+        from ..types import Text
+        cols["key"] = column_from_values(Text, [str(r.get("key")) for r in records])
+        return ColumnBatch(cols, len(records))

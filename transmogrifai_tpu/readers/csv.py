@@ -1,0 +1,87 @@
+"""CSV ingestion with automatic schema inference (reference:
+readers/src/main/scala/com/salesforce/op/readers/CSVReaders.scala and
+CSVAutoReaders.scala; inference ≙ FeatureBuilder.fromDataFrame auto-typing).
+"""
+
+from __future__ import annotations
+
+import csv
+from typing import Any, Dict, List, Optional, Sequence, Type
+
+from ..features import infer_feature_kind
+from ..types import Binary, FeatureType, Integral, Real, Text
+from .base import DataReader
+
+
+def _coerce(v: str) -> Any:
+    if v is None or v == "":
+        return None
+    return v
+
+
+def read_csv_records(path: str, headers: Optional[Sequence[str]] = None,
+                     has_header: Optional[bool] = None) -> List[Dict[str, Any]]:
+    """Read CSV into records.  If ``headers`` is None, the first row is used as
+    the header (has_header defaults True in that case)."""
+    with open(path, newline="") as f:
+        rows = list(csv.reader(f))
+    if not rows:
+        return []
+    if headers is None:
+        headers = rows[0]
+        rows = rows[1:]
+    elif has_header:
+        rows = rows[1:]
+    return [{h: _coerce(v) for h, v in zip(headers, row)} for row in rows]
+
+
+def infer_schema_from_records(records: Sequence[Dict[str, Any]],
+                              sample: int = 1000) -> Dict[str, Type[FeatureType]]:
+    if not records:
+        return {}
+    schema: Dict[str, Type[FeatureType]] = {}
+    cols = records[0].keys()
+    subset = records[:sample]
+    for c in cols:
+        schema[c] = infer_feature_kind([r.get(c) for r in subset])
+    return schema
+
+
+def _typed_records(records: List[Dict[str, Any]],
+                   schema: Dict[str, Type[FeatureType]]) -> List[Dict[str, Any]]:
+    """Coerce string values to the schema's python types."""
+    out = []
+    for r in records:
+        t: Dict[str, Any] = {}
+        for k, v in r.items():
+            kind = schema.get(k)
+            if v is None or kind is None:
+                t[k] = v
+            elif issubclass(kind, Binary):
+                t[k] = str(v).strip().lower() in ("1", "true", "yes", "t")
+            elif issubclass(kind, Integral):
+                t[k] = int(float(v))
+            elif issubclass(kind, Real):
+                t[k] = float(v)
+            else:
+                t[k] = v
+        out.append(t)
+    return out
+
+
+class CSVReader(DataReader):
+    """CSV file reader (≙ CSVReaders / CSVAutoReaders).
+
+    ``schema``: optional name → FeatureType mapping; inferred if absent.
+    """
+
+    def __init__(self, path: str, headers: Optional[Sequence[str]] = None,
+                 schema: Optional[Dict[str, Type[FeatureType]]] = None,
+                 key_field: Optional[str] = None, has_header: Optional[bool] = None):
+        raw = read_csv_records(path, headers=headers, has_header=has_header)
+        self.schema = dict(schema) if schema else infer_schema_from_records(raw)
+        records = _typed_records(raw, self.schema)
+        key_fn = ((lambda r: r.get(key_field)) if key_field
+                  else (lambda r: id(r)))
+        super().__init__(records=records, key_fn=key_fn)
+        self.path = path

@@ -1,0 +1,33 @@
+"""Reader factory (≙ DataReaders object, readers/DataReaders.scala:44)."""
+
+from __future__ import annotations
+
+from .base import (AggregateParams, AggregateReader, ConditionalParams,
+                   ConditionalReader, DataReader)
+from .csv import CSVReader
+
+
+class DataReaders:
+    class Simple:
+        @staticmethod
+        def csv(path: str, **kw) -> CSVReader:
+            return CSVReader(path, **kw)
+
+        @staticmethod
+        def custom(records=None, read_fn=None, key_fn=None) -> DataReader:
+            return DataReader(records=records, read_fn=read_fn, key_fn=key_fn)
+
+    class Aggregate:
+        @staticmethod
+        def custom(records=None, read_fn=None, key_fn=None,
+                   cutoff_time_fn=None) -> AggregateReader:
+            return AggregateReader(
+                records=records, read_fn=read_fn, key_fn=key_fn,
+                aggregate_params=AggregateParams(cutoff_time_fn=cutoff_time_fn))
+
+    class Conditional:
+        @staticmethod
+        def custom(records=None, read_fn=None, key_fn=None,
+                   params: ConditionalParams = None) -> ConditionalReader:
+            return ConditionalReader(records=records, read_fn=read_fn,
+                                     key_fn=key_fn, conditional_params=params)

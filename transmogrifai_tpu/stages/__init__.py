@@ -1,0 +1,5 @@
+from .base import (Estimator, PipelineStage, Transformer, TransformerModel)
+from .generator import FeatureGeneratorStage
+
+__all__ = ["PipelineStage", "Transformer", "Estimator", "TransformerModel",
+           "FeatureGeneratorStage"]

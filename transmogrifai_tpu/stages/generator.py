@@ -1,0 +1,63 @@
+"""FeatureGeneratorStage — stage-0 of every DAG (reference:
+features/src/main/scala/com/salesforce/op/stages/FeatureGeneratorStage.scala:67).
+
+Wraps ``extract_fn: record → raw value`` plus an optional monoid aggregator and
+event-time window.  Readers call ``extract_column`` over their record batches to
+materialize the raw columns.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Type
+
+from ..columns import Column, column_from_values
+from ..features import make_uid
+from ..types import FeatureType
+from .base import PipelineStage
+
+
+class FeatureGeneratorStage(PipelineStage):
+    def __init__(self, name: str, kind: Type[FeatureType],
+                 extract_fn: Callable[[Dict[str, Any]], Any],
+                 aggregator=None, extract_source: Optional[str] = None, **params):
+        super().__init__(**params)
+        self.name = name
+        self.kind = kind
+        self.out_kind = kind
+        self.extract_fn = extract_fn
+        self.extract_source = extract_source
+        from ..aggregators import default_aggregator
+        self.aggregator = aggregator or default_aggregator(kind)
+
+    @property
+    def operation_name(self) -> str:
+        return f"FeatureGenerator[{self.name}]"
+
+    def output_name(self) -> str:
+        return self.name
+
+    def extract_column(self, records: Iterable[Dict[str, Any]]) -> Column:
+        vals = [self.extract_fn(r) for r in records]
+        return column_from_values(self.kind, vals)
+
+    def extract_aggregated(self, grouped: Dict[Any, Sequence[Dict[str, Any]]],
+                           cutoff_fn=None, is_response: bool = False) -> Column:
+        """Event-time aggregation per key (≙ AggregateDataReader semantics):
+        predictors aggregate events before the cutoff, responses after."""
+        vals = []
+        for _key, events in grouped.items():
+            selected = []
+            for ev in events:
+                if cutoff_fn is None:
+                    selected.append(ev)
+                else:
+                    before = cutoff_fn(ev)
+                    if (not is_response and before) or (is_response and not before):
+                        selected.append(ev)
+            raw = [self.extract_fn(ev) for ev in selected]
+            vals.append(self.aggregator.aggregate(raw))
+        return column_from_values(self.kind, vals)
+
+    def ctor_args(self):
+        return {"name": self.name, "kind": self.kind.__name__,
+                "extract_source": self.extract_source}

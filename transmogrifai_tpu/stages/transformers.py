@@ -1,0 +1,214 @@
+"""Generic feature transformers (reference: core/.../stages/impl/feature/
+{MathTransformers,AliasTransformer,FilterTransformer,...}.scala and the unary
+lambda bases).  All numeric ops are pure jnp functions over (values, mask)
+pairs, so they trace under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Type
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columns import Column, ColumnBatch
+from ..types import (Binary, FeatureType, Integral, OPNumeric, Real, RealNN,
+                     Text)
+from .base import Transformer
+
+
+def _as_float(col: Column):
+    vals = jnp.asarray(col.values, dtype=jnp.float32)
+    mask = None if col.mask is None else jnp.asarray(col.mask)
+    return vals, mask
+
+
+def _and_mask(m1, m2):
+    if m1 is None:
+        return m2
+    if m2 is None:
+        return m1
+    return m1 & m2
+
+
+class AliasTransformer(Transformer):
+    """Rename a feature (≙ AliasTransformer.scala)."""
+
+    def __init__(self, name: str, **params):
+        super().__init__(name=name, **params)
+        self._alias = name
+
+    def output_name(self) -> str:
+        return self._alias
+
+    def make_output_features(self):
+        f = self.input_features[0]
+        self.out_kind = f.kind
+        return super().make_output_features()
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        c = batch[self.input_features[0].name]
+        return Column(c.kind, c.values, mask=c.mask, meta=c.meta)
+
+
+class BinaryMathTransformer(Transformer):
+    """Elementwise binary arithmetic on two numeric features
+    (≙ MathTransformers.scala: AddTransformer, SubtractTransformer, ...).
+    Empty values propagate: result is empty where either input is empty,
+    except +/- which treat empty as identity like the reference."""
+
+    in_kinds = (OPNumeric, OPNumeric)
+    out_kind = Real
+
+    OPS = {
+        "plus": jnp.add, "minus": jnp.subtract,
+        "multiply": jnp.multiply, "divide": jnp.divide,
+    }
+
+    def __init__(self, op: str, **params):
+        super().__init__(op=op, **params)
+        self.op = op
+
+    @property
+    def operation_name(self) -> str:
+        return self.op
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        a, b = self.input_columns(batch)
+        va, ma = _as_float(a)
+        vb, mb = _as_float(b)
+        fn = self.OPS[self.op]
+        if self.op in ("plus", "minus"):
+            # treat empty as 0 (identity), present if either side present
+            za = jnp.where(ma, va, 0.0) if ma is not None else va
+            zb = jnp.where(mb, vb, 0.0) if mb is not None else vb
+            out = fn(za, zb)
+            mask = None
+            if ma is not None or mb is not None:
+                pa = ma if ma is not None else jnp.ones_like(za, dtype=bool)
+                pb = mb if mb is not None else jnp.ones_like(zb, dtype=bool)
+                mask = pa | pb
+            return Column(Real, out, mask=mask)
+        out = fn(va, vb)
+        mask = _and_mask(ma, mb)
+        if self.op == "divide":
+            finite = jnp.isfinite(out)
+            mask = finite if mask is None else (mask & finite)
+        return Column(Real, out, mask=mask)
+
+
+class UnaryMathTransformer(Transformer):
+    """Elementwise unary math (abs, ceil, floor, round, exp, sqrt, log, power,
+    scalar add/multiply) — ≙ MathTransformers.scala unary ops."""
+
+    in_kinds = (OPNumeric,)
+    out_kind = Real
+
+    def __init__(self, op: str, scalar: Optional[float] = None, **params):
+        super().__init__(op=op, scalar=scalar, **params)
+        self.op = op
+        self.scalar = scalar
+
+    @property
+    def operation_name(self) -> str:
+        return self.op
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        (c,) = self.input_columns(batch)
+        v, m = _as_float(c)
+        s = self.scalar
+        fns: dict = {
+            "abs": jnp.abs, "ceil": jnp.ceil, "floor": jnp.floor,
+            "round": jnp.round, "exp": jnp.exp, "sqrt": jnp.sqrt,
+            "log": lambda x: jnp.log(x) / jnp.log(s if s else jnp.e),
+            "power": lambda x: jnp.power(x, s),
+            "addScalar": lambda x: x + s, "multiplyScalar": lambda x: x * s,
+        }
+        out = fns[self.op](v)
+        finite = jnp.isfinite(out)
+        m = finite if m is None else (m & finite)
+        return Column(Real, out, mask=m)
+
+
+class ExistsTransformer(Transformer):
+    """feature → Binary presence flag (≙ ExistsTransformer)."""
+
+    out_kind = Binary
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        (c,) = self.input_columns(batch)
+        if c.is_host_object():
+            vals = np.array([v is not None and (not hasattr(v, "__len__") or len(v) > 0)
+                             for v in c.values], dtype=bool)
+            return Column(Binary, vals)
+        n = len(c)
+        m = c.mask if c.mask is not None else np.ones(n, dtype=bool)
+        return Column(Binary, jnp.asarray(m))
+
+
+class ToOccurTransformer(Transformer):
+    """feature → RealNN 1.0/0.0 occurrence (≙ ToOccurTransformer)."""
+
+    out_kind = RealNN
+
+    def __init__(self, match_fn: Optional[Callable[[Any], bool]] = None, **params):
+        super().__init__(**params)
+        self.match_fn = match_fn
+
+    is_device_op = False
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        (c,) = self.input_columns(batch)
+        if self.match_fn is not None or c.is_host_object():
+            fn = self.match_fn or (lambda v: v is not None)
+            if c.is_host_object():
+                vals = np.array([1.0 if fn(v) else 0.0 for v in c.values], dtype=np.float32)
+            else:
+                m = c.mask if c.mask is not None else np.ones(len(c), bool)
+                raw = np.asarray(c.values)
+                vals = np.array([1.0 if (mm and fn(v)) else 0.0
+                                 for v, mm in zip(raw, np.asarray(m))], dtype=np.float32)
+            return Column(RealNN, vals)
+        v = jnp.asarray(c.values, jnp.float32)
+        m = c.mask if c.mask is not None else jnp.ones(len(c), bool)
+        return Column(RealNN, jnp.where(jnp.asarray(m), (v != 0).astype(jnp.float32), 0.0))
+
+
+class SubstringTransformer(Transformer):
+    """Binary text op: does input2 contain input1 (≙ SubstringTransformer)."""
+
+    in_kinds = (Text, Text)
+    out_kind = Binary
+    is_device_op = False
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        a, b = self.input_columns(batch)
+        vals, mask = [], []
+        for x, y in zip(a.values, b.values):
+            ok = x is not None and y is not None
+            mask.append(ok)
+            vals.append(bool(ok and (x.lower() in y.lower())))
+        return Column(Binary, np.array(vals), mask=np.array(mask))
+
+
+class ReplaceTransformer(Transformer):
+    """Replace matching values (≙ ReplaceTransformer)."""
+
+    is_device_op = False
+
+    def __init__(self, match_value, replace_with, **params):
+        super().__init__(match_value=match_value, replace_with=replace_with, **params)
+
+    def make_output_features(self):
+        self.out_kind = self.input_features[0].kind
+        return super().make_output_features()
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        (c,) = self.input_columns(batch)
+        mv, rw = self.get("match_value"), self.get("replace_with")
+        if c.is_host_object():
+            vals = np.array([rw if v == mv else v for v in c.values], dtype=object)
+            return Column(c.kind, vals)
+        v = jnp.asarray(c.values)
+        out = jnp.where(v == mv, jnp.asarray(rw, dtype=v.dtype), v)
+        return Column(c.kind, out, mask=c.mask)

@@ -1,0 +1,117 @@
+"""Per-column lineage metadata for assembled feature vectors — the TPU-native
+equivalent of OpVectorMetadata / OpVectorColumnMetadata (reference:
+features/src/main/scala/com/salesforce/op/utils/spark/OpVectorColumnMetadata.scala:67).
+
+Every vectorizer emits, alongside its [N, D] array, one ``VectorColumnMeta`` per
+output column recording which raw feature it came from, the grouping (e.g. the
+categorical value pivoted on), and indicator info.  This is the backbone of the
+SanityChecker feature-drop reports and ModelInsights.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Sequence
+
+NULL_INDICATOR = "NullIndicatorValue"   # cf. OpVectorColumnMetadata.NullString
+OTHER_INDICATOR = "OTHER"
+
+
+@dataclass(frozen=True)
+class VectorColumnMeta:
+    """One column of an assembled feature vector."""
+
+    parent_feature_name: str
+    parent_feature_type: str
+    grouping: Optional[str] = None          # e.g. map key or categorical group
+    indicator_value: Optional[str] = None   # pivoted categorical value / null flag
+    descriptor_value: Optional[str] = None  # e.g. "sin(dayOfWeek)" for date circles
+    index: int = 0
+
+    def make_col_name(self) -> str:
+        parts = [self.parent_feature_name]
+        if self.grouping:
+            parts.append(self.grouping)
+        if self.indicator_value:
+            parts.append(self.indicator_value)
+        elif self.descriptor_value:
+            parts.append(self.descriptor_value)
+        return "_".join(parts) + f"_{self.index}"
+
+    @property
+    def is_null_indicator(self) -> bool:
+        return self.indicator_value == NULL_INDICATOR
+
+    @property
+    def is_other_indicator(self) -> bool:
+        return self.indicator_value == OTHER_INDICATOR
+
+    def to_json(self) -> Dict:
+        return {
+            "parentFeatureName": self.parent_feature_name,
+            "parentFeatureType": self.parent_feature_type,
+            "grouping": self.grouping,
+            "indicatorValue": self.indicator_value,
+            "descriptorValue": self.descriptor_value,
+            "index": self.index,
+        }
+
+    @staticmethod
+    def from_json(d: Dict) -> "VectorColumnMeta":
+        return VectorColumnMeta(
+            parent_feature_name=d["parentFeatureName"],
+            parent_feature_type=d["parentFeatureType"],
+            grouping=d.get("grouping"),
+            indicator_value=d.get("indicatorValue"),
+            descriptor_value=d.get("descriptorValue"),
+            index=d.get("index", 0),
+        )
+
+
+@dataclass
+class VectorMeta:
+    """Metadata for a whole feature vector (≙ OpVectorMetadata)."""
+
+    name: str
+    columns: List[VectorColumnMeta] = field(default_factory=list)
+
+    def __post_init__(self):
+        self.columns = [replace(c, index=i) for i, c in enumerate(self.columns)]
+
+    @property
+    def size(self) -> int:
+        return len(self.columns)
+
+    def column_names(self) -> List[str]:
+        return [c.make_col_name() for c in self.columns]
+
+    def parent_features(self) -> List[str]:
+        seen, out = set(), []
+        for c in self.columns:
+            if c.parent_feature_name not in seen:
+                seen.add(c.parent_feature_name)
+                out.append(c.parent_feature_name)
+        return out
+
+    def index_by_parent(self) -> Dict[str, List[int]]:
+        out: Dict[str, List[int]] = {}
+        for c in self.columns:
+            out.setdefault(c.parent_feature_name, []).append(c.index)
+        return out
+
+    def select(self, indices: Sequence[int], name: Optional[str] = None) -> "VectorMeta":
+        return VectorMeta(name or self.name, [self.columns[i] for i in indices])
+
+    @staticmethod
+    def flatten(name: str, metas: Sequence["VectorMeta"]) -> "VectorMeta":
+        cols: List[VectorColumnMeta] = []
+        for m in metas:
+            cols.extend(m.columns)
+        return VectorMeta(name, cols)
+
+    def to_json(self) -> Dict:
+        return {"name": self.name, "columns": [c.to_json() for c in self.columns]}
+
+    @staticmethod
+    def from_json(d: Dict) -> "VectorMeta":
+        return VectorMeta(d["name"], [VectorColumnMeta.from_json(c) for c in d["columns"]])

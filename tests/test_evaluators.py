@@ -1,0 +1,96 @@
+"""Evaluator golden checks against hand-computed / sklearn-style values
+(≙ OpBinaryClassificationEvaluatorTest etc.)."""
+
+import numpy as np
+
+from transmogrifai_tpu.evaluators import (Evaluators, aupr, auroc,
+                                          binary_confusion)
+
+
+def test_auroc_perfect_and_random():
+    y = np.array([0, 0, 1, 1])
+    assert auroc(y, np.array([0.1, 0.2, 0.8, 0.9])) == 1.0
+    assert auroc(y, np.array([0.9, 0.8, 0.2, 0.1])) == 0.0
+    # known sklearn value for this case
+    got = auroc(np.array([0, 0, 1, 1]), np.array([0.1, 0.4, 0.35, 0.8]))
+    assert abs(got - 0.75) < 1e-9
+
+
+def test_auroc_ties():
+    y = np.array([0, 1, 0, 1])
+    s = np.array([0.5, 0.5, 0.5, 0.5])
+    assert abs(auroc(y, s) - 0.5) < 1e-9
+
+
+def test_aupr_known_value():
+    y = np.array([0, 0, 1, 1])
+    s = np.array([0.1, 0.4, 0.35, 0.8])
+    got = aupr(y, s)
+    assert 0.7 < got < 0.9  # sklearn average_precision ≈ 0.83
+
+
+def test_binary_confusion():
+    y = np.array([1, 1, 0, 0, 1])
+    yhat = np.array([1, 0, 0, 1, 1])
+    m = binary_confusion(y, yhat)
+    assert (m["TP"], m["TN"], m["FP"], m["FN"]) == (2, 1, 1, 1)
+    assert abs(m["Precision"] - 2 / 3) < 1e-9
+    assert abs(m["Recall"] - 2 / 3) < 1e-9
+    assert abs(m["Error"] - 2 / 5) < 1e-9
+
+
+def test_binary_evaluator_all_metrics():
+    rng = np.random.default_rng(0)
+    y = (rng.random(200) > 0.5).astype(float)
+    p1 = np.clip(y * 0.6 + rng.random(200) * 0.4, 0, 1)
+    pred = {"prediction": (p1 > 0.5).astype(float),
+            "probability": np.stack([1 - p1, p1], axis=1),
+            "rawPrediction": np.stack([-p1, p1], axis=1)}
+    m = Evaluators.BinaryClassification.auPR().evaluate_all(y, pred)
+    for k in ("AuROC", "AuPR", "Precision", "Recall", "F1", "Error",
+              "TP", "TN", "FP", "FN", "thresholds", "precisionByThreshold"):
+        assert k in m.metrics
+    assert m["AuROC"] > 0.8
+
+
+def test_multiclass_evaluator():
+    y = np.array([0, 1, 2, 0, 1, 2], dtype=float)
+    pred = {"prediction": np.array([0, 1, 2, 0, 2, 2], dtype=float),
+            "probability": None, "rawPrediction": None}
+    m = Evaluators.MultiClassification.f1().evaluate_all(y, pred)
+    assert abs(m["Error"] - 1 / 6) < 1e-9
+    assert 0 < m["F1"] <= 1
+
+
+def test_regression_evaluator():
+    y = np.array([1.0, 2.0, 3.0])
+    pred = {"prediction": np.array([1.1, 1.9, 3.2])}
+    m = Evaluators.Regression.rmse().evaluate_all(y, pred)
+    expect_mse = np.mean([0.01, 0.01, 0.04])
+    assert abs(m["MeanSquaredError"] - expect_mse) < 1e-6
+    assert abs(m["RootMeanSquaredError"] - np.sqrt(expect_mse)) < 1e-6
+    assert m["R2"] > 0.9
+
+
+def test_forecast_evaluator():
+    y = np.array([10.0, 12.0, 14.0, 16.0])
+    pred = {"prediction": y * 1.1}
+    m = Evaluators.Forecast.smape().evaluate_all(y, pred)
+    assert 0 < m["SMAPE"] < 0.2
+    assert m["MASE"] > 0
+
+
+def test_bin_score_evaluator_calibrated():
+    rng = np.random.default_rng(1)
+    p = rng.random(5000)
+    y = (rng.random(5000) < p).astype(float)
+    pred = {"prediction": (p > 0.5).astype(float),
+            "probability": np.stack([1 - p, p], axis=1),
+            "rawPrediction": None}
+    m = Evaluators.BinaryClassification.brierScore().evaluate_all(y, pred)
+    # calibrated scores: avg score ≈ conversion rate in populated bins
+    counts = np.array(m["numberOfDataPoints"])
+    avg = np.array(m["averageScore"])
+    conv = np.array(m["averageConversionRate"])
+    big = counts > 30
+    assert np.abs(avg[big] - conv[big]).mean() < 0.15

@@ -1,0 +1,124 @@
+"""Model-family tests (≙ the reference's OpLogisticRegressionTest,
+OpRandomForestClassifierTest etc. — fit, sensible quality, prediction schema)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.models import (OpGBTClassifier, OpGBTRegressor,
+                                      OpLinearRegression, OpLinearSVC,
+                                      OpLogisticRegression, OpNaiveBayes,
+                                      OpRandomForestClassifier,
+                                      OpRandomForestRegressor)
+
+
+@pytest.fixture(scope="module")
+def binary_data():
+    rng = np.random.default_rng(0)
+    N, D = 800, 8
+    X = rng.normal(size=(N, D)).astype(np.float32)
+    w = rng.normal(size=D)
+    y = ((X @ w + 0.3 * rng.normal(size=N)) > 0).astype(np.float32)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def regression_data():
+    rng = np.random.default_rng(1)
+    N, D = 800, 8
+    X = rng.normal(size=(N, D)).astype(np.float32)
+    w = rng.normal(size=D)
+    y = (X @ w + 0.1 * rng.normal(size=N)).astype(np.float32)
+    return X, y
+
+
+@pytest.mark.parametrize("est,min_auc", [
+    (OpLogisticRegression(reg_param=0.01, elastic_net_param=0.1), 0.95),
+    (OpLinearSVC(reg_param=0.01), 0.95),
+    (OpRandomForestClassifier(num_trees=10, max_depth=4), 0.90),
+    (OpGBTClassifier(max_iter=10, max_depth=3), 0.90),
+])
+def test_binary_classifiers(binary_data, est, min_auc):
+    X, y = binary_data
+    fitted = est.fit_arrays(X, y)
+    model = est.model_cls(fitted=fitted)
+    pred = model.predict_arrays(X)
+    assert pred["prediction"].shape == (len(y),)
+    assert set(np.unique(pred["prediction"])) <= {0.0, 1.0}
+    auc = Evaluators.BinaryClassification.auROC().evaluate(y, pred)
+    assert auc >= min_auc, f"{type(est).__name__} AuROC {auc}"
+
+
+@pytest.mark.parametrize("est,min_r2", [
+    (OpLinearRegression(reg_param=0.01), 0.95),
+    (OpLinearRegression(reg_param=0.05, elastic_net_param=0.5), 0.90),
+    (OpRandomForestRegressor(num_trees=10, max_depth=6), 0.45),
+    (OpGBTRegressor(max_iter=20, max_depth=3), 0.70),
+])
+def test_regressors(regression_data, est, min_r2):
+    X, y = regression_data
+    fitted = est.fit_arrays(X, y)
+    model = est.model_cls(fitted=fitted)
+    pred = model.predict_arrays(X)
+    r2 = Evaluators.Regression.r2().evaluate(y, pred)
+    assert r2 >= min_r2, f"{type(est).__name__} R2 {r2}"
+
+
+def test_naive_bayes_on_counts():
+    """Multinomial NB expects non-negative count-like features
+    (≙ Spark NaiveBayes requirement)."""
+    rng = np.random.default_rng(7)
+    N, D = 600, 10
+    rates = np.stack([rng.uniform(0.5, 3.0, D), rng.uniform(0.5, 3.0, D)])
+    y = (rng.random(N) > 0.5).astype(np.float32)
+    X = rng.poisson(rates[y.astype(int)]).astype(np.float32)
+    est = OpNaiveBayes()
+    model = est.model_cls(fitted=est.fit_arrays(X, y))
+    auc = Evaluators.BinaryClassification.auROC().evaluate(
+        y, model.predict_arrays(X))
+    assert auc > 0.85
+
+
+def test_multinomial_logreg():
+    rng = np.random.default_rng(2)
+    N, D, C = 600, 6, 3
+    X = rng.normal(size=(N, D)).astype(np.float32)
+    W = rng.normal(size=(D, C))
+    y = np.argmax(X @ W, axis=1).astype(np.float32)
+    est = OpLogisticRegression(reg_param=0.01)
+    model = est.model_cls(fitted=est.fit_arrays(X, y))
+    pred = model.predict_arrays(X)
+    assert pred["probability"].shape == (N, C)
+    np.testing.assert_allclose(pred["probability"].sum(axis=1), 1.0, atol=1e-4)
+    err = Evaluators.MultiClassification.error().evaluate(y, pred)
+    assert err < 0.1
+
+
+def test_multiclass_forest():
+    rng = np.random.default_rng(3)
+    N, D, C = 600, 6, 3
+    X = rng.normal(size=(N, D)).astype(np.float32)
+    W = rng.normal(size=(D, C))
+    y = np.argmax(X @ W, axis=1).astype(np.float32)
+    est = OpRandomForestClassifier(num_trees=10, max_depth=5)
+    model = est.model_cls(fitted=est.fit_arrays(X, y))
+    pred = model.predict_arrays(X)
+    assert pred["probability"].shape == (N, C)
+    err = Evaluators.MultiClassification.error().evaluate(y, pred)
+    assert err < 0.25
+
+
+def test_logreg_matches_sklearn_style_solution(binary_data):
+    """Elastic-net-free logistic fit should land near the unregularized MLE
+    direction (golden numeric check, cf. SURVEY §4 'numeric golden checks')."""
+    X, y = binary_data
+    est = OpLogisticRegression(reg_param=0.0, max_iter=300, tol=1e-8)
+    fitted = est.fit_arrays(X, y)
+    # gradient at optimum ≈ 0
+    import jax.nn as jnn
+    import jax.numpy as jnp
+    coef = jnp.asarray(fitted["coef"])
+    logits = X @ coef + fitted["intercept"][0]
+    p = np.asarray(jnn.sigmoid(logits))
+    grad = X.T @ (p - y) / len(y)
+    assert np.abs(grad).max() < 5e-3

@@ -1,0 +1,160 @@
+"""End-to-end workflow tests on the real datasets — the TPU equivalent of the
+reference's OpWorkflowTest / helloworld OpTitanicSimple, OpIrisSimple,
+OpBostonSimple flows (README.md:33-56)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.features import features_from_schema
+from transmogrifai_tpu.models.linear import (OpLinearRegression,
+                                             OpLogisticRegression)
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.readers.csv import CSVReader
+from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                        ModelCandidate,
+                                        MultiClassificationModelSelector,
+                                        RegressionModelSelector, grid)
+from transmogrifai_tpu.workflow import Workflow, WorkflowModel
+
+DATA = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "data")
+
+
+TITANIC_HEADERS = ["id", "survived", "pClass", "name", "sex", "age", "sibSp",
+                   "parCh", "ticket", "fare", "cabin", "embarked"]
+TITANIC_SCHEMA = {
+    "survived": T.RealNN, "pClass": T.PickList, "name": T.Text,
+    "sex": T.PickList, "age": T.Real, "sibSp": T.Integral,
+    "parCh": T.Integral, "ticket": T.PickList, "fare": T.Real,
+    "cabin": T.PickList, "embarked": T.PickList,
+}
+
+
+def titanic_workflow(tmp_path=None):
+    reader = CSVReader(os.path.join(DATA, "titanic/TitanicPassengersTrainData.csv"),
+                       headers=TITANIC_HEADERS, schema=TITANIC_SCHEMA,
+                       key_field="id")
+    survived, predictors = features_from_schema(TITANIC_SCHEMA, response="survived")
+    fv = transmogrify(predictors)
+    checked = survived.sanity_check(fv, remove_bad_features=True)
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(),
+                       grid(reg_param=[0.01, 0.1], elastic_net_param=[0.1]),
+                       "OpLogisticRegression")])
+    sel.set_input(survived, checked)
+    pred = sel.get_output()
+    wf = Workflow().set_reader(reader).set_result_features(pred)
+    return wf, reader, pred, survived
+
+
+@pytest.fixture(scope="module")
+def titanic_model():
+    wf, reader, pred, survived = titanic_workflow()
+    model = wf.train()
+    return model, reader, pred, survived
+
+
+def test_titanic_train_quality(titanic_model):
+    model, _, _, _ = titanic_model
+    m = model.evaluate(Evaluators.BinaryClassification.auROC())
+    # reference holdout AuROC = 0.8822 (README.md:82-96); train-set should beat it
+    assert m["AuROC"] > 0.85
+    assert m["AuPR"] > 0.80
+
+
+def test_titanic_selector_summary(titanic_model):
+    model, _, _, _ = titanic_model
+    sm = model.selected_model
+    assert sm is not None
+    s = sm.summary
+    assert s.validation_type == "CrossValidation"
+    assert s.best_model_name == "OpLogisticRegression"
+    assert len(s.validation_results) == 2  # grid points
+    assert s.evaluation_metric == "AuPR"
+    assert "binEval" in s.train_evaluation
+
+
+def test_titanic_score_shape(titanic_model):
+    model, _, pred, _ = titanic_model
+    scored = model.score()
+    assert pred.name in scored
+    col = scored[pred.name]
+    assert set(col.values) >= {"prediction", "probability"}
+    assert len(col) == 891
+
+
+def test_titanic_save_load_roundtrip(titanic_model, tmp_path):
+    model, reader, pred, _ = titanic_model
+    p1 = np.asarray(model.score()[pred.name].values["prediction"])
+    path = str(tmp_path / "titanic_model")
+    model.save(path)
+    m2 = WorkflowModel.load(path)
+    m2.set_reader(reader)
+    p2 = np.asarray(m2.score()[pred.name].values["prediction"])
+    np.testing.assert_allclose(p1, p2)
+    # loaded model evaluates identically
+    ev1 = model.evaluate(Evaluators.BinaryClassification.auROC())["AuROC"]
+    ev2 = m2.evaluate(Evaluators.BinaryClassification.auROC())["AuROC"]
+    assert abs(ev1 - ev2) < 1e-9
+
+
+def test_titanic_sanity_checker_dropped_features(titanic_model):
+    model, _, _, _ = titanic_model
+    from transmogrifai_tpu.preparators.sanity_checker import SanityCheckerModel
+    sc = next(s for s in model.stages if isinstance(s, SanityCheckerModel))
+    summary = sc.metadata["summary"]
+    assert summary["sampleSize"] == 891
+    assert len(summary["names"]) == len(summary["correlationsWithLabel"])
+
+
+def test_iris_multiclass():
+    headers = ["id", "sepalLength", "sepalWidth", "petalLength", "petalWidth",
+               "irisClass"]
+    schema = {"sepalLength": T.Real, "sepalWidth": T.Real,
+              "petalLength": T.Real, "petalWidth": T.Real,
+              "irisClass": T.PickList}
+    reader = CSVReader(os.path.join(DATA, "iris/iris.csv"), headers=headers,
+                       schema=schema, key_field="id")
+    # index the string label → RealNN
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.ops.categorical import StringIndexer
+    label_raw = FeatureBuilder.PickList("irisClass").as_response()
+    indexer = StringIndexer()
+    indexer.set_input(label_raw)
+    label = indexer.get_output()
+    predictors = [FeatureBuilder.Real(n).as_predictor()
+                  for n in ["sepalLength", "sepalWidth", "petalLength", "petalWidth"]]
+    fv = transmogrify(predictors)
+    sel = MultiClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]),
+                       "OpLogisticRegression")])
+    sel.set_input(label, fv)
+    pred = sel.get_output()
+    model = Workflow().set_reader(reader).set_result_features(pred).train()
+    m = model.evaluate(Evaluators.MultiClassification.error(),
+                       label_feature=label)
+    assert m["Error"] < 0.1  # iris is easy
+    assert np.asarray(model.score()[pred.name].values["probability"]).shape[1] == 3
+
+
+def test_boston_regression():
+    headers = ["rowId", "crim", "zn", "indus", "chas", "nox", "rm", "age",
+               "dis", "rad", "tax", "ptratio", "b", "lstat", "medv"]
+    schema = {h: T.Real for h in headers if h not in ("rowId", "medv", "chas", "rad")}
+    schema.update({"chas": T.PickList, "rad": T.Integral, "medv": T.RealNN})
+    reader = CSVReader(os.path.join(DATA, "boston/housingData.csv"),
+                       headers=headers, schema=schema, key_field="rowId")
+    medv, predictors = features_from_schema(schema, response="medv")
+    fv = transmogrify(predictors)
+    sel = RegressionModelSelector(models=[
+        ModelCandidate(OpLinearRegression(),
+                       grid(reg_param=[0.01, 0.1]), "OpLinearRegression")])
+    sel.set_input(medv, fv)
+    pred = sel.get_output()
+    model = Workflow().set_reader(reader).set_result_features(pred).train()
+    m = model.evaluate(Evaluators.Regression.rmse())
+    assert m["R2"] > 0.6
+    assert m["RootMeanSquaredError"] < 6.0

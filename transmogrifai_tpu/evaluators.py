@@ -1,0 +1,402 @@
+"""Evaluators — the TPU-native re-design of the reference's evaluator family
+(core/src/main/scala/com/salesforce/op/evaluators/OpEvaluatorBase.scala:113,
+OpBinaryClassificationEvaluator.scala:67-185, OpMultiClassificationEvaluator
+.scala, OpRegressionEvaluator.scala, OpBinScoreEvaluator.scala,
+OpForecastEvaluator.scala, factory Evaluators.scala:40).
+
+Metrics are vectorised array reductions (sort-based AUC, one-hot confusion
+counts) rather than Spark RDD passes; everything takes (y [N], pred dict of
+arrays) and returns a plain-dict metrics object.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+DEFAULT_THRESHOLDS = np.linspace(0.0, 1.0, 101)
+
+
+# --------------------------------------------------------------------------
+# metric primitives
+# --------------------------------------------------------------------------
+
+def _scores_from_pred(pred: Dict[str, np.ndarray]) -> np.ndarray:
+    """Positive-class score: probability_1 if present else rawPrediction_1
+    else the prediction itself."""
+    if pred.get("probability") is not None:
+        p = np.asarray(pred["probability"])
+        return p[:, 1] if p.ndim == 2 else p
+    if pred.get("rawPrediction") is not None:
+        r = np.asarray(pred["rawPrediction"])
+        return r[:, 1] if r.ndim == 2 else r
+    return np.asarray(pred["prediction"], dtype=np.float64)
+
+
+def auroc(y: np.ndarray, scores: np.ndarray) -> float:
+    """Area under ROC via the rank-sum (Mann-Whitney) identity with midrank
+    tie handling."""
+    y = np.asarray(y) > 0.5
+    n_pos = int(y.sum())
+    n_neg = len(y) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return 0.0
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty(len(scores), dtype=np.float64)
+    sorted_scores = scores[order]
+    i = 0
+    r = np.arange(1, len(scores) + 1, dtype=np.float64)
+    # midranks for ties
+    while i < len(scores):
+        j = i
+        while j + 1 < len(scores) and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        ranks[order[i:j + 1]] = 0.5 * (r[i] + r[j])
+        i = j + 1
+    rank_sum = ranks[y].sum()
+    return float((rank_sum - n_pos * (n_pos + 1) / 2.0) / (n_pos * n_neg))
+
+
+def aupr(y: np.ndarray, scores: np.ndarray) -> float:
+    """Area under the precision-recall curve (step-wise, as MLlib computes)."""
+    y = np.asarray(y) > 0.5
+    n_pos = int(y.sum())
+    if n_pos == 0:
+        return 0.0
+    order = np.argsort(-scores, kind="mergesort")
+    ys = y[order].astype(np.float64)
+    tp = np.cumsum(ys)
+    fp = np.cumsum(1.0 - ys)
+    scores_sorted = scores[order]
+    # keep only threshold boundaries (last index of each distinct score)
+    distinct = np.r_[scores_sorted[1:] != scores_sorted[:-1], True]
+    tp, fp = tp[distinct], fp[distinct]
+    precision = tp / np.maximum(tp + fp, 1e-12)
+    recall = tp / n_pos
+    # MLlib prepends (0, p[0]) and integrates with trapezoids over recall
+    recall = np.r_[0.0, recall]
+    precision = np.r_[1.0, precision]
+    return float(np.trapz(precision, recall))
+
+
+def binary_confusion(y: np.ndarray, yhat: np.ndarray) -> Dict[str, float]:
+    y = np.asarray(y) > 0.5
+    yhat = np.asarray(yhat) > 0.5
+    tp = float(np.sum(y & yhat))
+    tn = float(np.sum(~y & ~yhat))
+    fp = float(np.sum(~y & yhat))
+    fn = float(np.sum(y & ~yhat))
+    precision = tp / (tp + fp) if tp + fp > 0 else 0.0
+    recall = tp / (tp + fn) if tp + fn > 0 else 0.0
+    f1 = 2 * precision * recall / (precision + recall) if precision + recall > 0 else 0.0
+    n = max(len(y), 1)
+    return {"TP": tp, "TN": tn, "FP": fp, "FN": fn,
+            "Precision": precision, "Recall": recall, "F1": f1,
+            "Error": (fp + fn) / n}
+
+
+def threshold_metrics(y: np.ndarray, scores: np.ndarray,
+                      thresholds: np.ndarray = DEFAULT_THRESHOLDS) -> Dict[str, List[float]]:
+    """Per-threshold confusion counts in one vectorised pass
+    (≙ OpBinaryClassificationEvaluator thresholds output)."""
+    y = (np.asarray(y) > 0.5)[None, :]
+    pred = scores[None, :] >= thresholds[:, None]
+    tp = np.sum(y & pred, axis=1).astype(float)
+    fp = np.sum(~y & pred, axis=1).astype(float)
+    fn = np.sum(y & ~pred, axis=1).astype(float)
+    tn = np.sum(~y & ~pred, axis=1).astype(float)
+    precision = tp / np.maximum(tp + fp, 1.0)
+    recall = tp / np.maximum(tp + fn, 1.0)
+    return {"thresholds": thresholds.tolist(),
+            "precisionByThreshold": precision.tolist(),
+            "recallByThreshold": recall.tolist(),
+            "truePositivesByThreshold": tp.tolist(),
+            "falsePositivesByThreshold": fp.tolist(),
+            "trueNegativesByThreshold": tn.tolist(),
+            "falseNegativesByThreshold": fn.tolist()}
+
+
+# --------------------------------------------------------------------------
+# evaluator stages
+# --------------------------------------------------------------------------
+
+@dataclass
+class EvaluationMetrics:
+    metrics: Dict[str, Any] = field(default_factory=dict)
+
+    def __getattr__(self, name):
+        try:
+            return self.metrics[name]
+        except KeyError:
+            raise AttributeError(name)
+
+    def __getitem__(self, name):
+        return self.metrics[name]
+
+    def to_json(self) -> Dict[str, Any]:
+        return dict(self.metrics)
+
+
+class OpEvaluatorBase:
+    """≙ OpEvaluatorBase.evaluateAll.  ``default_metric`` picks the scalar
+    used by the ModelSelector; ``is_larger_better`` its direction."""
+
+    name: str = "evaluator"
+    default_metric: str = ""
+    is_larger_better: bool = True
+
+    def __init__(self, default_metric: Optional[str] = None,
+                 is_larger_better: Optional[bool] = None):
+        if default_metric is not None:
+            self.default_metric = default_metric
+        if is_larger_better is not None:
+            self.is_larger_better = is_larger_better
+
+    def evaluate_all(self, y: np.ndarray, pred: Dict[str, np.ndarray]) -> EvaluationMetrics:
+        raise NotImplementedError
+
+    def evaluate(self, y: np.ndarray, pred: Dict[str, np.ndarray]) -> float:
+        return float(self.evaluate_all(y, pred)[self.default_metric])
+
+
+class OpBinaryClassificationEvaluator(OpEvaluatorBase):
+    """≙ OpBinaryClassificationEvaluator.scala:67-185."""
+
+    name = "binEval"
+    default_metric = "AuPR"
+
+    def __init__(self, thresholds: Optional[np.ndarray] = None, **kw):
+        super().__init__(**kw)
+        self.thresholds = DEFAULT_THRESHOLDS if thresholds is None else np.asarray(thresholds)
+
+    def evaluate_all(self, y, pred) -> EvaluationMetrics:
+        y = np.asarray(y, dtype=np.float64)
+        scores = _scores_from_pred(pred)
+        yhat = np.asarray(pred["prediction"], dtype=np.float64)
+        m = binary_confusion(y, yhat)
+        m["AuROC"] = auroc(y, scores)
+        m["AuPR"] = aupr(y, scores)
+        m.update(threshold_metrics(y, scores, self.thresholds))
+        return EvaluationMetrics(m)
+
+
+class OpMultiClassificationEvaluator(OpEvaluatorBase):
+    """≙ OpMultiClassificationEvaluator: weighted precision/recall/F1/error +
+    top-N correctness-by-threshold (calculateThresholdMetrics:153)."""
+
+    name = "multiEval"
+    default_metric = "F1"
+
+    def __init__(self, top_ns: Sequence[int] = (1, 3), n_bins: int = 10, **kw):
+        super().__init__(**kw)
+        self.top_ns = tuple(top_ns)
+        self.n_bins = n_bins
+
+    def evaluate_all(self, y, pred) -> EvaluationMetrics:
+        y = np.asarray(y, dtype=np.int64)
+        yhat = np.asarray(pred["prediction"], dtype=np.int64)
+        C = int(max(y.max(initial=0), yhat.max(initial=0))) + 1
+        conf = np.zeros((C, C), dtype=np.float64)
+        np.add.at(conf, (y, yhat), 1.0)
+        support = conf.sum(axis=1)
+        tp = np.diag(conf)
+        pred_count = conf.sum(axis=0)
+        prec_c = np.divide(tp, pred_count, out=np.zeros(C), where=pred_count > 0)
+        rec_c = np.divide(tp, support, out=np.zeros(C), where=support > 0)
+        f1_c = np.divide(2 * prec_c * rec_c, prec_c + rec_c,
+                         out=np.zeros(C), where=(prec_c + rec_c) > 0)
+        wts = support / max(support.sum(), 1.0)
+        m: Dict[str, Any] = {
+            "Precision": float(wts @ prec_c), "Recall": float(wts @ rec_c),
+            "F1": float(wts @ f1_c),
+            "Error": 1.0 - float(tp.sum() / max(support.sum(), 1.0)),
+            "confusionMatrix": conf.tolist(),
+        }
+        prob = pred.get("probability")
+        if prob is not None:
+            prob = np.asarray(prob, dtype=np.float64)
+            order = np.argsort(-prob, axis=1)
+            maxprob = prob[np.arange(len(y)), order[:, 0]]
+            bins = np.clip((maxprob * self.n_bins).astype(int), 0, self.n_bins - 1)
+            topns = {}
+            for n in self.top_ns:
+                correct = (order[:, :n] == y[:, None]).any(axis=1)
+                counts = np.zeros(self.n_bins)
+                corr = np.zeros(self.n_bins)
+                np.add.at(counts, bins, 1.0)
+                np.add.at(corr, bins, correct.astype(np.float64))
+                topns[str(n)] = {
+                    "topNCorrectByBin": corr.tolist(),
+                    "topNCountByBin": counts.tolist(),
+                }
+            m["ThresholdMetrics"] = {
+                "topNs": list(self.top_ns), "nBins": self.n_bins, "byTopN": topns}
+        return EvaluationMetrics(m)
+
+
+class OpRegressionEvaluator(OpEvaluatorBase):
+    """≙ OpRegressionEvaluator: RMSE/MSE/R2/MAE + signed-error histogram."""
+
+    name = "regEval"
+    default_metric = "RootMeanSquaredError"
+    is_larger_better = False
+
+    def __init__(self, hist_bins: int = 20, **kw):
+        super().__init__(**kw)
+        self.hist_bins = hist_bins
+
+    def evaluate_all(self, y, pred) -> EvaluationMetrics:
+        y = np.asarray(y, dtype=np.float64)
+        yhat = np.asarray(pred["prediction"], dtype=np.float64)
+        err = yhat - y
+        mse = float(np.mean(err ** 2)) if len(y) else 0.0
+        var = float(np.var(y)) if len(y) else 0.0
+        counts, edges = np.histogram(err, bins=self.hist_bins)
+        return EvaluationMetrics({
+            "RootMeanSquaredError": float(np.sqrt(mse)),
+            "MeanSquaredError": mse,
+            "MeanAbsoluteError": float(np.mean(np.abs(err))) if len(y) else 0.0,
+            "R2": 1.0 - mse / var if var > 0 else 0.0,
+            "SignedPercentageErrorHistogram": {
+                "counts": counts.tolist(), "bins": edges.tolist()},
+        })
+
+
+class OpForecastEvaluator(OpEvaluatorBase):
+    """≙ OpForecastEvaluator: SMAPE / seasonal MASE."""
+
+    name = "forecastEval"
+    default_metric = "SMAPE"
+    is_larger_better = False
+
+    def __init__(self, seasonal_window: int = 1, **kw):
+        super().__init__(**kw)
+        self.seasonal_window = seasonal_window
+
+    def evaluate_all(self, y, pred) -> EvaluationMetrics:
+        y = np.asarray(y, dtype=np.float64)
+        yhat = np.asarray(pred["prediction"], dtype=np.float64)
+        denom = np.abs(y) + np.abs(yhat)
+        smape = float(2.0 * np.mean(
+            np.divide(np.abs(y - yhat), denom, out=np.zeros_like(denom),
+                      where=denom > 0)))
+        m = self.seasonal_window
+        naive = np.abs(y[m:] - y[:-m]).mean() if len(y) > m else 0.0
+        mase = float(np.mean(np.abs(y - yhat)) / naive) if naive > 0 else 0.0
+        return EvaluationMetrics({"SMAPE": smape, "MASE": mase})
+
+
+class OpBinScoreEvaluator(OpEvaluatorBase):
+    """≙ OpBinScoreEvaluator: score-decile calibration (Brier score + per-bin
+    average score vs conversion rate)."""
+
+    name = "binScoreEval"
+    default_metric = "BrierScore"
+    is_larger_better = False
+
+    def __init__(self, num_bins: int = 100, **kw):
+        super().__init__(**kw)
+        self.num_bins = num_bins
+
+    def evaluate_all(self, y, pred) -> EvaluationMetrics:
+        y = np.asarray(y, dtype=np.float64)
+        scores = _scores_from_pred(pred)
+        bins = np.clip((scores * self.num_bins).astype(int), 0, self.num_bins - 1)
+        counts = np.zeros(self.num_bins)
+        ssum = np.zeros(self.num_bins)
+        ysum = np.zeros(self.num_bins)
+        np.add.at(counts, bins, 1.0)
+        np.add.at(ssum, bins, scores)
+        np.add.at(ysum, bins, y)
+        nz = counts > 0
+        avg_score = np.divide(ssum, counts, out=np.zeros_like(ssum), where=nz)
+        conv_rate = np.divide(ysum, counts, out=np.zeros_like(ysum), where=nz)
+        return EvaluationMetrics({
+            "BrierScore": float(np.mean((scores - y) ** 2)) if len(y) else 0.0,
+            "binCenters": ((np.arange(self.num_bins) + 0.5) / self.num_bins).tolist(),
+            "numberOfDataPoints": counts.tolist(),
+            "averageScore": avg_score.tolist(),
+            "averageConversionRate": conv_rate.tolist(),
+        })
+
+
+# --------------------------------------------------------------------------
+# factory (≙ Evaluators.scala:40)
+# --------------------------------------------------------------------------
+
+class Evaluators:
+    class BinaryClassification:
+        @staticmethod
+        def auPR() -> OpBinaryClassificationEvaluator:
+            return OpBinaryClassificationEvaluator(default_metric="AuPR")
+
+        @staticmethod
+        def auROC() -> OpBinaryClassificationEvaluator:
+            return OpBinaryClassificationEvaluator(default_metric="AuROC")
+
+        @staticmethod
+        def precision() -> OpBinaryClassificationEvaluator:
+            return OpBinaryClassificationEvaluator(default_metric="Precision")
+
+        @staticmethod
+        def recall() -> OpBinaryClassificationEvaluator:
+            return OpBinaryClassificationEvaluator(default_metric="Recall")
+
+        @staticmethod
+        def f1() -> OpBinaryClassificationEvaluator:
+            return OpBinaryClassificationEvaluator(default_metric="F1")
+
+        @staticmethod
+        def error() -> OpBinaryClassificationEvaluator:
+            return OpBinaryClassificationEvaluator(
+                default_metric="Error", is_larger_better=False)
+
+        @staticmethod
+        def brierScore() -> OpBinScoreEvaluator:
+            return OpBinScoreEvaluator()
+
+    class MultiClassification:
+        @staticmethod
+        def precision() -> OpMultiClassificationEvaluator:
+            return OpMultiClassificationEvaluator(default_metric="Precision")
+
+        @staticmethod
+        def recall() -> OpMultiClassificationEvaluator:
+            return OpMultiClassificationEvaluator(default_metric="Recall")
+
+        @staticmethod
+        def f1() -> OpMultiClassificationEvaluator:
+            return OpMultiClassificationEvaluator(default_metric="F1")
+
+        @staticmethod
+        def error() -> OpMultiClassificationEvaluator:
+            return OpMultiClassificationEvaluator(
+                default_metric="Error", is_larger_better=False)
+
+    class Regression:
+        @staticmethod
+        def rmse() -> OpRegressionEvaluator:
+            return OpRegressionEvaluator(default_metric="RootMeanSquaredError")
+
+        @staticmethod
+        def mse() -> OpRegressionEvaluator:
+            return OpRegressionEvaluator(default_metric="MeanSquaredError")
+
+        @staticmethod
+        def mae() -> OpRegressionEvaluator:
+            return OpRegressionEvaluator(default_metric="MeanAbsoluteError")
+
+        @staticmethod
+        def r2() -> OpRegressionEvaluator:
+            return OpRegressionEvaluator(default_metric="R2", is_larger_better=True)
+
+    class Forecast:
+        @staticmethod
+        def smape() -> OpForecastEvaluator:
+            return OpForecastEvaluator(default_metric="SMAPE")
+
+        @staticmethod
+        def mase() -> OpForecastEvaluator:
+            return OpForecastEvaluator(default_metric="MASE")

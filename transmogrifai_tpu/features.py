@@ -137,9 +137,8 @@ class FeatureBuilder:
 
     def _build(self, is_response: bool) -> Feature:
         from .stages.generator import FeatureGeneratorStage
-        extract = self._extract or (lambda r, _n=self.name: r.get(_n))
         stage = FeatureGeneratorStage(
-            name=self.name, kind=self.kind, extract_fn=extract,
+            name=self.name, kind=self.kind, extract_fn=self._extract,
             aggregator=self._aggregator, extract_source=self._extract_source)
         feat = Feature(self.name, self.kind, is_response, stage, parents=())
         stage._output = feat

@@ -1,0 +1,31 @@
+"""Model stages (≙ core/.../stages/impl/{classification,regression} and the
+sparkwrappers.specific OpPredictorWrapper machinery)."""
+
+from .base import (PredictionModel, PredictorEstimator, extract_xy,
+                   prediction_column)
+from .linear import (LinearPredictionModel, MLPClassificationModel,
+                     NaiveBayesModel, OpGeneralizedLinearRegression,
+                     OpLinearRegression, OpLinearSVC, OpLogisticRegression,
+                     OpMultilayerPerceptronClassifier, OpNaiveBayes)
+from .trees import (OpDecisionTreeClassifier, OpDecisionTreeRegressor,
+                    OpGBTClassifier, OpGBTRegressor, OpRandomForestClassifier,
+                    OpRandomForestRegressor, OpXGBoostClassifier,
+                    OpXGBoostRegressor, TreeEnsembleModel)
+
+MODEL_REGISTRY = {
+    cls.__name__: cls for cls in [
+        LinearPredictionModel, NaiveBayesModel, MLPClassificationModel,
+        TreeEnsembleModel,
+        OpLogisticRegression, OpLinearSVC, OpLinearRegression, OpNaiveBayes,
+        OpGeneralizedLinearRegression, OpMultilayerPerceptronClassifier,
+        OpRandomForestClassifier, OpRandomForestRegressor,
+        OpDecisionTreeClassifier, OpDecisionTreeRegressor,
+        OpGBTClassifier, OpGBTRegressor, OpXGBoostClassifier,
+        OpXGBoostRegressor,
+    ]
+}
+
+__all__ = list(MODEL_REGISTRY) + [
+    "PredictionModel", "PredictorEstimator", "extract_xy", "prediction_column",
+    "MODEL_REGISTRY",
+]

@@ -1,0 +1,92 @@
+"""Predictor stage bases — the TPU-native re-design of OpPredictorWrapper /
+OpPredictionModel (reference: core/.../stages/sparkwrappers/specific/
+OpPredictorWrapper.scala:67).
+
+Every model estimator takes (label: RealNN, features: OPVector) and produces a
+``Prediction`` column.  The split between *array-level* fit/predict functions
+(pure, jittable, vmappable) and the *stage* wrappers is deliberate: the
+ModelSelector's CV grid calls the array-level functions directly so that
+(fold × candidate) training vectorises on the device mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Type
+
+import numpy as np
+
+from ..columns import Column, ColumnBatch
+from ..stages.base import Estimator, TransformerModel
+from ..types import OPVector, Prediction, RealNN
+
+
+def prediction_column(prediction: np.ndarray,
+                      probability: Optional[np.ndarray] = None,
+                      raw_prediction: Optional[np.ndarray] = None) -> Column:
+    values: Dict[str, Any] = {"prediction": prediction}
+    if probability is not None:
+        values["probability"] = probability
+    if raw_prediction is not None:
+        values["rawPrediction"] = raw_prediction
+    return Column(Prediction, values)
+
+
+def extract_xy(batch: ColumnBatch, label_feature, features_feature
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Pull (X [N,D] float32, y [N] float32) out of a batch."""
+    ycol = batch[label_feature.name]
+    xcol = batch[features_feature.name]
+    y = np.asarray(ycol.values, dtype=np.float32)
+    X = np.asarray(xcol.values, dtype=np.float32)
+    return X, y
+
+
+class PredictionModel(TransformerModel):
+    """Base fitted model: ``predict_arrays`` on the feature matrix →
+    Prediction column (≙ OpPredictionModel/OpProbabilisticClassifierModel)."""
+
+    in_kinds = (RealNN, OPVector)
+    out_kind = Prediction
+    allow_label_as_input = True
+
+    def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        feats = self.input_features[1]
+        X = np.asarray(batch[feats.name].values, dtype=np.float32)
+        out = self.predict_arrays(X)
+        return prediction_column(
+            np.asarray(out["prediction"]),
+            None if out.get("probability") is None else np.asarray(out["probability"]),
+            None if out.get("rawPrediction") is None else np.asarray(out["rawPrediction"]))
+
+
+class PredictorEstimator(Estimator):
+    """Base model estimator (label, features) → PredictionModel."""
+
+    in_kinds = (RealNN, OPVector)
+    out_kind = Prediction
+    allow_label_as_input = True
+    model_cls: Type[PredictionModel] = PredictionModel
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray,
+                   sample_weight: Optional[np.ndarray] = None) -> Dict[str, Any]:
+        """Array-level fit → the ``fitted`` dict of the model.  Pure; the CV
+        grid calls this (or its vectorised variant) directly."""
+        raise NotImplementedError
+
+    def fit(self, batch: ColumnBatch) -> PredictionModel:
+        label, feats = self.input_features
+        X, y = extract_xy(batch, label, feats)
+        fitted = self.fit_arrays(X, y)
+        model = self.model_cls(fitted=fitted, **self._params)
+        return self._finalize_model(model)
+
+    @property
+    def label_feature(self):
+        return self.input_features[0]
+
+    @property
+    def features_feature(self):
+        return self.input_features[1]

@@ -1,0 +1,308 @@
+"""Linear-family models — the TPU-native re-design of the reference's Spark
+MLlib wrappers (core/.../stages/impl/classification/OpLogisticRegression.scala:46,
+OpLinearSVC.scala, OpNaiveBayes.scala, OpMultilayerPerceptronClassifier.scala,
+core/.../impl/regression/OpLinearRegression.scala,
+OpGeneralizedLinearRegression.scala).
+
+Each estimator's hyper-parameters mirror the Spark ML params that the
+reference's DefaultSelectorParams grids sweep (DefaultSelectorParams.scala:36-68).
+The fits are single fused XLA programs (see models/solvers.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import PredictionModel, PredictorEstimator
+from .solvers import (FitResult, fista_fit, naive_bayes_fit, ridge_fit,
+                      standardize, unscale_params)
+
+
+def _n_classes(y: np.ndarray) -> int:
+    return int(np.max(y)) + 1 if len(y) else 2
+
+
+def _binary_outputs(margin: np.ndarray) -> Dict[str, np.ndarray]:
+    p1 = jax.nn.sigmoid(jnp.asarray(margin))
+    prob = jnp.stack([1.0 - p1, p1], axis=1)
+    raw = jnp.stack([-jnp.asarray(margin), jnp.asarray(margin)], axis=1)
+    return {"prediction": np.asarray(p1 > 0.5, dtype=np.float32),
+            "probability": np.asarray(prob),
+            "rawPrediction": np.asarray(raw)}
+
+
+class LinearPredictionModel(PredictionModel):
+    """Fitted linear model.  ``fitted``: coef [D] or [D,C], intercept,
+    kind ∈ {binary, multinomial, regression, svc}."""
+
+    def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        coef = np.asarray(self.fitted["coef"], dtype=np.float32)
+        intercept = np.asarray(self.fitted["intercept"], dtype=np.float32)
+        kind = self.fitted["kind"]
+        if kind == "multinomial":
+            logits = X @ coef + intercept
+            prob = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+            return {"prediction": np.argmax(logits, axis=1).astype(np.float32),
+                    "probability": prob, "rawPrediction": logits}
+        margin = X @ coef + (intercept[0] if intercept.ndim else intercept)
+        if kind == "binary":
+            return _binary_outputs(margin)
+        if kind == "svc":
+            raw = np.stack([-margin, margin], axis=1)
+            return {"prediction": (margin > 0).astype(np.float32),
+                    "probability": None, "rawPrediction": raw}
+        return {"prediction": margin.astype(np.float32)}
+
+
+class OpLogisticRegression(PredictorEstimator):
+    """≙ OpLogisticRegression (elastic-net logistic; binary or multinomial)."""
+
+    model_cls = LinearPredictionModel
+
+    def __init__(self, reg_param: float = 0.0, elastic_net_param: float = 0.0,
+                 max_iter: int = 100, tol: float = 1e-6,
+                 fit_intercept: bool = True, standardization: bool = True, **kw):
+        super().__init__(reg_param=reg_param, elastic_net_param=elastic_net_param,
+                         max_iter=max_iter, tol=tol, fit_intercept=fit_intercept,
+                         standardization=standardization, **kw)
+
+    def fit_arrays(self, X, y, sample_weight=None) -> Dict[str, Any]:
+        n, d = X.shape
+        w = jnp.ones(n, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight)
+        C = _n_classes(y)
+        reg = float(self.get("reg_param", 0.0))
+        en = float(self.get("elastic_net_param", 0.0))
+        l1, l2 = reg * en, reg * (1.0 - en)
+        Xj = jnp.asarray(X)
+        if self.get("standardization", True):
+            Xs, mean, scale = standardize(Xj, w, center=self.get("fit_intercept", True))
+        else:
+            Xs, mean, scale = Xj, jnp.zeros(d), jnp.ones(d)
+        loss = "logistic" if C <= 2 else "softmax"
+        nc = 1 if C <= 2 else C
+        res = fista_fit(Xs, jnp.asarray(y), w, jnp.float32(l2), jnp.float32(l1),
+                        loss=loss, fit_intercept=self.get("fit_intercept", True),
+                        max_iter=int(self.get("max_iter", 100)),
+                        tol=float(self.get("tol", 1e-6)), n_classes=nc)
+        res = unscale_params(res, mean, scale, nc)
+        return {"coef": np.asarray(res.coef), "intercept": np.asarray(res.intercept),
+                "kind": "binary" if C <= 2 else "multinomial",
+                "n_classes": C, "n_iter": int(res.n_iter)}
+
+
+class OpLinearSVC(PredictorEstimator):
+    """≙ OpLinearSVC (squared-hinge linear SVM; binary, no probabilities)."""
+
+    model_cls = LinearPredictionModel
+
+    def __init__(self, reg_param: float = 0.0, max_iter: int = 100,
+                 tol: float = 1e-6, fit_intercept: bool = True,
+                 standardization: bool = True, **kw):
+        super().__init__(reg_param=reg_param, max_iter=max_iter, tol=tol,
+                         fit_intercept=fit_intercept, standardization=standardization, **kw)
+
+    def fit_arrays(self, X, y, sample_weight=None) -> Dict[str, Any]:
+        n, d = X.shape
+        w = jnp.ones(n, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight)
+        Xj = jnp.asarray(X)
+        if self.get("standardization", True):
+            Xs, mean, scale = standardize(Xj, w, center=self.get("fit_intercept", True))
+        else:
+            Xs, mean, scale = Xj, jnp.zeros(d), jnp.ones(d)
+        res = fista_fit(Xs, jnp.asarray(y), w,
+                        jnp.float32(self.get("reg_param", 0.0)), jnp.float32(0.0),
+                        loss="squared_hinge",
+                        fit_intercept=self.get("fit_intercept", True),
+                        max_iter=int(self.get("max_iter", 100)),
+                        tol=float(self.get("tol", 1e-6)))
+        res = unscale_params(res, mean, scale, 1)
+        return {"coef": np.asarray(res.coef), "intercept": np.asarray(res.intercept),
+                "kind": "svc", "n_classes": 2, "n_iter": int(res.n_iter)}
+
+
+class OpLinearRegression(PredictorEstimator):
+    """≙ OpLinearRegression (elastic-net least squares; closed-form ridge when
+    l1 = 0)."""
+
+    model_cls = LinearPredictionModel
+
+    def __init__(self, reg_param: float = 0.0, elastic_net_param: float = 0.0,
+                 max_iter: int = 100, tol: float = 1e-6,
+                 fit_intercept: bool = True, standardization: bool = True, **kw):
+        super().__init__(reg_param=reg_param, elastic_net_param=elastic_net_param,
+                         max_iter=max_iter, tol=tol, fit_intercept=fit_intercept,
+                         standardization=standardization, **kw)
+
+    def fit_arrays(self, X, y, sample_weight=None) -> Dict[str, Any]:
+        n, d = X.shape
+        w = jnp.ones(n, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight)
+        reg = float(self.get("reg_param", 0.0))
+        en = float(self.get("elastic_net_param", 0.0))
+        l1, l2 = reg * en, reg * (1.0 - en)
+        Xj, yj = jnp.asarray(X), jnp.asarray(y)
+        if self.get("standardization", True):
+            Xs, mean, scale = standardize(Xj, w, center=self.get("fit_intercept", True))
+        else:
+            Xs, mean, scale = Xj, jnp.zeros(d), jnp.ones(d)
+        if l1 == 0.0:
+            res = ridge_fit(Xs, yj, w, jnp.float32(l2),
+                            fit_intercept=self.get("fit_intercept", True))
+        else:
+            res = fista_fit(Xs, yj, w, jnp.float32(l2), jnp.float32(l1),
+                            loss="squared",
+                            fit_intercept=self.get("fit_intercept", True),
+                            max_iter=int(self.get("max_iter", 100)),
+                            tol=float(self.get("tol", 1e-6)))
+        res = unscale_params(res, mean, scale, 1)
+        return {"coef": np.asarray(res.coef), "intercept": np.asarray(res.intercept),
+                "kind": "regression", "n_iter": int(res.n_iter)}
+
+
+class OpGeneralizedLinearRegression(PredictorEstimator):
+    """≙ OpGeneralizedLinearRegression: families gaussian/binomial/poisson/gamma
+    (log/identity/logit links as in the reference grid
+    BinaryClassificationModelSelector.scala / DefaultSelectorParams.scala:56-65)."""
+
+    model_cls = LinearPredictionModel
+
+    def __init__(self, family: str = "gaussian", link: Optional[str] = None,
+                 reg_param: float = 0.0, max_iter: int = 50, tol: float = 1e-6,
+                 fit_intercept: bool = True, **kw):
+        super().__init__(family=family, link=link, reg_param=reg_param,
+                         max_iter=max_iter, tol=tol, fit_intercept=fit_intercept, **kw)
+
+    def fit_arrays(self, X, y, sample_weight=None) -> Dict[str, Any]:
+        n, d = X.shape
+        w = jnp.ones(n, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight)
+        family = self.get("family", "gaussian")
+        loss = {"gaussian": "squared", "binomial": "logistic",
+                "poisson": "poisson", "gamma": "gamma"}.get(family)
+        if loss is None:
+            raise ValueError(f"unsupported GLM family {family!r}")
+        Xj, yj = jnp.asarray(X), jnp.asarray(y)
+        Xs, mean, scale = standardize(Xj, w, center=self.get("fit_intercept", True))
+        res = fista_fit(Xs, yj, w, jnp.float32(self.get("reg_param", 0.0)),
+                        jnp.float32(0.0), loss=loss,
+                        fit_intercept=self.get("fit_intercept", True),
+                        max_iter=int(self.get("max_iter", 50)),
+                        tol=float(self.get("tol", 1e-6)))
+        res = unscale_params(res, mean, scale, 1)
+        return {"coef": np.asarray(res.coef), "intercept": np.asarray(res.intercept),
+                "kind": "glm", "family": family, "n_iter": int(res.n_iter)}
+
+
+class GLMPredictionModel(LinearPredictionModel):
+    pass
+
+
+class NaiveBayesModel(PredictionModel):
+    """Fitted multinomial NB: log_prior [C], log_prob [C,D]."""
+
+    def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        log_prior = np.asarray(self.fitted["log_prior"])
+        log_prob = np.asarray(self.fitted["log_prob"])
+        logits = np.maximum(X, 0.0) @ log_prob.T + log_prior
+        prob = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+        return {"prediction": np.argmax(logits, axis=1).astype(np.float32),
+                "probability": prob, "rawPrediction": logits}
+
+
+class OpNaiveBayes(PredictorEstimator):
+    """≙ OpNaiveBayes (multinomial, smoothing=1.0 default)."""
+
+    model_cls = NaiveBayesModel
+
+    def __init__(self, smoothing: float = 1.0, **kw):
+        super().__init__(smoothing=smoothing, **kw)
+
+    def fit_arrays(self, X, y, sample_weight=None) -> Dict[str, Any]:
+        n = X.shape[0]
+        w = jnp.ones(n, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight)
+        C = _n_classes(y)
+        log_prior, log_prob = naive_bayes_fit(
+            jnp.asarray(X), jnp.asarray(y), w,
+            jnp.float32(self.get("smoothing", 1.0)), n_classes=C)
+        return {"log_prior": np.asarray(log_prior), "log_prob": np.asarray(log_prob),
+                "kind": "naive_bayes", "n_classes": C}
+
+
+class MLPClassificationModel(PredictionModel):
+    """Fitted MLP: list of (W, b) per layer."""
+
+    def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        h = jnp.asarray(X)
+        n_layers = self.fitted["n_layers"]
+        for i in range(n_layers):
+            W = jnp.asarray(self.fitted[f"W{i}"])
+            b = jnp.asarray(self.fitted[f"b{i}"])
+            h = h @ W + b
+            if i < n_layers - 1:
+                h = jax.nn.relu(h)
+        logits = np.asarray(h)
+        prob = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+        return {"prediction": np.argmax(logits, axis=1).astype(np.float32),
+                "probability": prob, "rawPrediction": logits}
+
+
+class OpMultilayerPerceptronClassifier(PredictorEstimator):
+    """≙ OpMultilayerPerceptronClassifier: small feed-forward net, full-batch
+    Adam (the reference uses L-BFGS on a sigmoid net; relu+adam is the
+    TPU-idiomatic equivalent)."""
+
+    model_cls = MLPClassificationModel
+
+    def __init__(self, hidden_layers=(10,), max_iter: int = 200,
+                 step_size: float = 0.05, seed: int = 42, **kw):
+        super().__init__(hidden_layers=tuple(hidden_layers), max_iter=max_iter,
+                         step_size=step_size, seed=seed, **kw)
+
+    def fit_arrays(self, X, y, sample_weight=None) -> Dict[str, Any]:
+        import optax
+        n, d = X.shape
+        C = _n_classes(y)
+        sizes = [d] + list(self.get("hidden_layers", (10,))) + [C]
+        key = jax.random.PRNGKey(int(self.get("seed", 42)))
+        params = []
+        for i in range(len(sizes) - 1):
+            key, k1 = jax.random.split(key)
+            W = jax.random.normal(k1, (sizes[i], sizes[i + 1]),
+                                  jnp.float32) * jnp.sqrt(2.0 / sizes[i])
+            params.append((W, jnp.zeros(sizes[i + 1], jnp.float32)))
+        Xj = jnp.asarray(X)
+        yj = jnp.asarray(y, dtype=jnp.int32)
+        w = jnp.ones(n, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight)
+
+        def forward(params, x):
+            h = x
+            for i, (W, b) in enumerate(params):
+                h = h @ W + b
+                if i < len(params) - 1:
+                    h = jax.nn.relu(h)
+            return h
+
+        def loss_fn(params):
+            logits = forward(params, Xj)
+            ls = optax.softmax_cross_entropy_with_integer_labels(logits, yj)
+            return jnp.sum(w * ls) / jnp.sum(w)
+
+        opt = optax.adam(float(self.get("step_size", 0.05)))
+        state = opt.init(params)
+
+        @jax.jit
+        def step(params, state):
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, state = opt.update(grads, state)
+            return optax.apply_updates(params, updates), state, loss
+
+        for _ in range(int(self.get("max_iter", 200))):
+            params, state, loss = step(params, state)
+        fitted: Dict[str, Any] = {"kind": "mlp", "n_layers": len(params),
+                                  "n_classes": C}
+        for i, (W, b) in enumerate(params):
+            fitted[f"W{i}"] = np.asarray(W)
+            fitted[f"b{i}"] = np.asarray(b)
+        return fitted

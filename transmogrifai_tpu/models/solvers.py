@@ -1,0 +1,255 @@
+"""Pure, jittable full-batch solvers for linear-family models.
+
+The reference trains its linear models through Spark MLlib's distributed
+L-BFGS/OWLQN (wrapped at core/.../impl/classification/OpLogisticRegression.scala:46
+etc.).  On TPU the whole design changes: the data matrix lives in HBM, the
+gradient is one [N,D]x[D,C] matmul on the MXU, and we run an accelerated
+proximal-gradient (FISTA) loop under ``lax.while_loop`` — fully jittable and
+``vmap``-able over hyper-parameter grids and CV folds, which is what makes the
+ModelSelector grid data-parallel (SURVEY.md §2.6 P3).
+
+All solvers share the signature convention::
+
+    fit_*(X, y, sample_weight, l2, l1, ...) -> params dict of arrays
+
+with static shapes only, so a grid of (fold, reg, elastic-net) candidates can
+be trained as one ``vmap``'d XLA program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+# --------------------------------------------------------------------------
+# losses: value-and-grad of the smooth part, given margins/logits
+# --------------------------------------------------------------------------
+
+def _logistic_loss_grad(logits: jnp.ndarray, y01: jnp.ndarray, w: jnp.ndarray):
+    """Binary logistic.  logits [N], y01 [N] in {0,1}, w [N] sample weights.
+    Returns (mean loss, dloss/dlogits [N])."""
+    ls = jax.nn.softplus(jnp.where(y01 > 0.5, -logits, logits))
+    p = jax.nn.sigmoid(logits)
+    wsum = jnp.sum(w)
+    return jnp.sum(w * ls) / wsum, w * (p - y01) / wsum
+
+
+def _softmax_loss_grad(logits: jnp.ndarray, yoh: jnp.ndarray, w: jnp.ndarray):
+    """Multinomial.  logits [N,C], yoh one-hot [N,C]."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    p = jnp.exp(logp)
+    wsum = jnp.sum(w)
+    loss = -jnp.sum(w * jnp.sum(yoh * logp, axis=-1)) / wsum
+    return loss, (w[:, None] * (p - yoh)) / wsum
+
+
+def _squared_loss_grad(pred: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray):
+    r = pred - y
+    wsum = jnp.sum(w)
+    return 0.5 * jnp.sum(w * r * r) / wsum, w * r / wsum
+
+
+def _squared_hinge_loss_grad(margin: jnp.ndarray, ypm: jnp.ndarray, w: jnp.ndarray):
+    """Squared hinge for linear SVC.  ypm [N] in {-1,+1}."""
+    viol = jnp.maximum(0.0, 1.0 - ypm * margin)
+    wsum = jnp.sum(w)
+    return jnp.sum(w * viol * viol) / wsum, w * (-2.0 * viol * ypm) / wsum
+
+
+def _poisson_loss_grad(eta: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray):
+    """Poisson deviance with log link: loss = mean(exp(eta) - y*eta)."""
+    mu = jnp.exp(jnp.clip(eta, -30.0, 30.0))
+    wsum = jnp.sum(w)
+    return jnp.sum(w * (mu - y * eta)) / wsum, w * (mu - y) / wsum
+
+
+def _gamma_loss_grad(eta: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray):
+    """Gamma deviance with log link: loss = mean(y*exp(-eta) + eta)."""
+    inv_mu = jnp.exp(jnp.clip(-eta, -30.0, 30.0))
+    wsum = jnp.sum(w)
+    return jnp.sum(w * (y * inv_mu + eta)) / wsum, w * (1.0 - y * inv_mu) / wsum
+
+
+LOSSES = {
+    "logistic": _logistic_loss_grad,
+    "softmax": _softmax_loss_grad,
+    "squared": _squared_loss_grad,
+    "squared_hinge": _squared_hinge_loss_grad,
+    "poisson": _poisson_loss_grad,
+    "gamma": _gamma_loss_grad,
+}
+
+# Lipschitz constant of d²loss/dlogits² (per-row bound), used for the FISTA
+# step size together with the spectral norm of X.
+_LOSS_CURVATURE = {
+    "logistic": 0.25,
+    "softmax": 0.5,
+    "squared": 1.0,
+    "squared_hinge": 2.0,
+    "poisson": 1.0,   # heuristic; adaptive backtracking below compensates
+    "gamma": 1.0,
+}
+
+
+class FitResult(NamedTuple):
+    coef: jnp.ndarray       # [D, C]
+    intercept: jnp.ndarray  # [C]
+    n_iter: jnp.ndarray     # scalar int
+    objective: jnp.ndarray  # final objective value
+
+
+def _spectral_norm_sq(Xw: jnp.ndarray, iters: int = 16) -> jnp.ndarray:
+    """Largest eigenvalue of (Xw^T Xw) via power iteration (static iters)."""
+    d = Xw.shape[1]
+    v = jnp.full((d,), 1.0 / jnp.sqrt(d), Xw.dtype)
+
+    def body(_, v):
+        u = Xw.T @ (Xw @ v)
+        return u / (jnp.linalg.norm(u) + 1e-12)
+
+    v = jax.lax.fori_loop(0, iters, body, v)
+    return jnp.vdot(v, Xw.T @ (Xw @ v))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("loss", "fit_intercept", "max_iter", "n_classes"))
+def fista_fit(X: jnp.ndarray, y: jnp.ndarray, sample_weight: jnp.ndarray,
+              l2: jnp.ndarray, l1: jnp.ndarray, *, loss: str = "logistic",
+              fit_intercept: bool = True, max_iter: int = 100,
+              tol: float = 1e-6, n_classes: int = 1) -> FitResult:
+    """Accelerated proximal gradient with adaptive restart.
+
+    minimises  mean_loss(Xw + b) + l2/2 ||w||² + l1 ||w||₁   (no penalty on b).
+
+    ``l2``/``l1`` may be traced scalars → vmap over a regularisation grid.
+    """
+    n, d = X.shape
+    C = n_classes
+    loss_fn = LOSSES[loss]
+    w = sample_weight.astype(X.dtype)
+
+    if loss == "softmax":
+        target = jax.nn.one_hot(y.astype(jnp.int32), C, dtype=X.dtype)
+    elif loss == "squared_hinge":
+        target = jnp.where(y > 0.5, 1.0, -1.0).astype(X.dtype)
+    else:
+        target = y.astype(X.dtype)
+
+    # step size from Lipschitz bound: c * sigma_max(X_w)^2 (+ l2)
+    sw = jnp.sqrt(w / jnp.sum(w))
+    L = _LOSS_CURVATURE[loss] * _spectral_norm_sq(X * sw[:, None]) + l2
+    step = 1.0 / jnp.maximum(L, 1e-12)
+
+    shape = (d, C) if C > 1 else (d,)
+    b_shape = (C,) if C > 1 else ()
+
+    def objective_grad(coef, intercept):
+        lin = X @ coef
+        lin = lin + intercept if C > 1 else lin + intercept
+        lval, glin = loss_fn(lin, target, w)
+        gcoef = X.T @ glin + l2 * coef
+        gint = (jnp.sum(glin, axis=0) if C > 1 else jnp.sum(glin))
+        obj = lval + 0.5 * l2 * jnp.sum(coef * coef) + l1 * jnp.sum(jnp.abs(coef))
+        return obj, gcoef, gint
+
+    def prox(u):
+        return jnp.sign(u) * jnp.maximum(jnp.abs(u) - step * l1, 0.0)
+
+    def cond(state):
+        k, _, _, _, _, _, delta = state
+        return jnp.logical_and(k < max_iter, delta > tol)
+
+    def body(state):
+        k, coef, intercept, z_c, z_i, t, _ = state
+        obj, g_c, g_i = objective_grad(z_c, z_i)
+        new_c = prox(z_c - step * g_c)
+        new_i = z_i - step * g_i if fit_intercept else z_i
+        t_new = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * t * t))
+        beta = (t - 1.0) / t_new
+        # adaptive restart on non-descent direction
+        restart = jnp.sum((z_c - new_c) * (new_c - coef)) > 0.0
+        beta = jnp.where(restart, 0.0, beta)
+        t_new = jnp.where(restart, 1.0, t_new)
+        zc_next = new_c + beta * (new_c - coef)
+        zi_next = new_i + beta * (new_i - intercept)
+        delta = jnp.max(jnp.abs(new_c - coef)) + jnp.max(
+            jnp.abs(jnp.atleast_1d(new_i - intercept)))
+        return k + 1, new_c, new_i, zc_next, zi_next, t_new, delta
+
+    init = (jnp.zeros((), jnp.int32), jnp.zeros(shape, X.dtype),
+            jnp.zeros(b_shape, X.dtype), jnp.zeros(shape, X.dtype),
+            jnp.zeros(b_shape, X.dtype), jnp.ones((), X.dtype),
+            jnp.full((), jnp.inf, X.dtype))
+    k, coef, intercept, *_ = jax.lax.while_loop(cond, body, init)
+    obj, _, _ = objective_grad(coef, intercept)
+    return FitResult(coef, jnp.atleast_1d(intercept), k, obj)
+
+
+@functools.partial(jax.jit, static_argnames=("fit_intercept",))
+def ridge_fit(X: jnp.ndarray, y: jnp.ndarray, sample_weight: jnp.ndarray,
+              l2: jnp.ndarray, *, fit_intercept: bool = True) -> FitResult:
+    """Closed-form weighted ridge regression via normal equations (the l1=0
+    fast path for OpLinearRegression): one X^T X matmul on the MXU + a [D,D]
+    Cholesky solve."""
+    n, d = X.shape
+    w = sample_weight.astype(X.dtype)
+    wsum = jnp.sum(w)
+    if fit_intercept:
+        xm = (w @ X) / wsum
+        ym = jnp.sum(w * y) / wsum
+        Xc = X - xm
+        yc = y - ym
+    else:
+        Xc, yc = X, y
+    Xw = Xc * w[:, None]
+    A = (Xc.T @ Xw) / wsum + l2 * jnp.eye(d, dtype=X.dtype)
+    b = (Xw.T @ yc) / wsum
+    coef = jax.scipy.linalg.solve(A, b, assume_a="pos")
+    intercept = (ym - xm @ coef) if fit_intercept else jnp.zeros((), X.dtype)
+    resid = yc - Xc @ coef
+    obj = 0.5 * jnp.sum(w * resid * resid) / wsum + 0.5 * l2 * jnp.sum(coef * coef)
+    return FitResult(coef, jnp.atleast_1d(intercept), jnp.zeros((), jnp.int32), obj)
+
+
+@functools.partial(jax.jit, static_argnames=("n_classes",))
+def naive_bayes_fit(X: jnp.ndarray, y: jnp.ndarray, sample_weight: jnp.ndarray,
+                    smoothing: jnp.ndarray, *, n_classes: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Multinomial naive Bayes (≙ OpNaiveBayes): class-conditional log
+    likelihoods from per-class feature sums.  Expects non-negative features.
+    Returns (log_prior [C], log_prob [C, D])."""
+    yoh = jax.nn.one_hot(y.astype(jnp.int32), n_classes, dtype=X.dtype)  # [N,C]
+    w = sample_weight.astype(X.dtype)
+    cls_count = (w @ yoh)                                 # [C]
+    feat_count = (yoh * w[:, None]).T @ jnp.maximum(X, 0.0)  # [C,D]
+    log_prior = jnp.log(cls_count + 1e-12) - jnp.log(jnp.sum(cls_count) + 1e-12)
+    sm = feat_count + smoothing
+    log_prob = jnp.log(sm) - jnp.log(jnp.sum(sm, axis=1, keepdims=True))
+    return log_prior, log_prob
+
+
+def standardize(X: jnp.ndarray, sample_weight: jnp.ndarray,
+                center: bool) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Weighted feature standardisation (Spark ML standardizes internally and
+    un-scales the coefficients; we do the same).  Returns (Xs, mean, scale)."""
+    w = sample_weight / jnp.sum(sample_weight)
+    mean = w @ X
+    var = w @ (X * X) - mean * mean
+    scale = jnp.sqrt(jnp.maximum(var, 1e-12))
+    mu = mean if center else jnp.zeros_like(mean)
+    return (X - mu) / scale, mu, scale
+
+
+def unscale_params(res: FitResult, mean: jnp.ndarray, scale: jnp.ndarray,
+                   n_classes: int) -> FitResult:
+    if n_classes > 1:
+        coef = res.coef / scale[:, None]
+        intercept = res.intercept - mean @ coef
+    else:
+        coef = res.coef / scale
+        intercept = res.intercept - jnp.atleast_1d(mean @ coef)
+    return FitResult(coef, intercept, res.n_iter, res.objective)

@@ -1,0 +1,527 @@
+"""Tree ensembles — the TPU-native re-design of the reference's Spark MLlib
+tree wrappers (core/.../impl/classification/OpRandomForestClassifier.scala:58,
+OpGBTClassifier.scala, OpDecisionTreeClassifier.scala, impl/regression/
+OpRandomForestRegressor.scala, OpGBTRegressor.scala, OpXGBoostClassifier.scala:47).
+
+Architecture (LightGBM-style, built for the MXU/HBM rather than translated
+from Spark's per-partition `findBestSplits`):
+
+* features are quantile-binned once into an int32 matrix ``B [N, D]`` held in
+  HBM — every tree/round reuses it;
+* trees grow level-wise with **static shapes**: level ``l`` has ``2^l`` nodes,
+  per-(node, feature, bin) statistics are built with ``jax.ops.segment_sum``
+  scanned over feature chunks (bounded memory), split gains for all bins come
+  from one cumulative sum;
+* a whole random forest trains as a single XLA program — ``vmap`` over trees
+  with Poisson-bootstrap row weights and random feature masks (the TPU
+  equivalent of Spark's distributed per-tree jobs, SURVEY.md §2.6 P3);
+* gradient boosting scans rounds, computing grad/hess on device and fitting
+  each tree to them (XGBoost-style second-order gains).
+
+Trees are stored as perfect-heap arrays (feature, threshold, is_leaf,
+leaf_value), so batch prediction is ``max_depth`` gathers — no recursion.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import PredictionModel, PredictorEstimator
+
+MAX_BINS_DEFAULT = 32
+
+
+# --------------------------------------------------------------------------
+# binning
+# --------------------------------------------------------------------------
+
+def build_bin_splits(X: np.ndarray, max_bins: int = MAX_BINS_DEFAULT) -> np.ndarray:
+    """Per-feature quantile split points → [D, max_bins-1] float32, padded
+    with +inf (≙ Spark's findSplits quantile sketch)."""
+    n, d = X.shape
+    qs = np.linspace(0, 1, max_bins + 1)[1:-1]
+    splits = np.quantile(X, qs, axis=0).T.astype(np.float32)  # [D, max_bins-1]
+    # dedupe per row; pad with +inf so empty bins are harmless
+    out = np.full((d, max_bins - 1), np.inf, dtype=np.float32)
+    for j in range(d):
+        u = np.unique(splits[j])
+        u = u[np.isfinite(u)]
+        out[j, :len(u)] = u
+    return out
+
+
+@jax.jit
+def bin_data(X: jnp.ndarray, splits: jnp.ndarray) -> jnp.ndarray:
+    """bin b of x = number of split points < x  → int32 [N, D]."""
+    return jnp.sum(X[:, :, None] > splits[None, :, :], axis=-1).astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------
+# single-tree fit (jittable, vmappable over trees)
+# --------------------------------------------------------------------------
+
+class TreeArrays(NamedTuple):
+    feature: jnp.ndarray    # [T] int32 (split feature; -1 at pure leaves)
+    threshold: jnp.ndarray  # [T] float32 (raw split threshold)
+    is_leaf: jnp.ndarray    # [T] bool
+    leaf: jnp.ndarray       # [T, V] float32 leaf values
+
+
+def _gain_variance(left, right, parent, lam):
+    """Variance-impurity gain (Spark 'variance'); stats = [count, wy, wy2]."""
+    def sse(s):
+        cnt = jnp.maximum(s[..., 0], 1e-12)
+        return s[..., 2] - s[..., 1] ** 2 / cnt
+    return sse(parent) - sse(left) - sse(right)
+
+
+def _gain_gini(left, right, parent, lam):
+    """Gini-impurity gain; stats = [count, class_0 .. class_{C-1}]."""
+    def wgini(s):
+        cnt = jnp.maximum(s[..., 0], 1e-12)
+        return cnt * (1.0 - jnp.sum((s[..., 1:] / cnt[..., None]) ** 2, axis=-1))
+    return wgini(parent) - wgini(left) - wgini(right)
+
+
+def _gain_xgb(left, right, parent, lam):
+    """Second-order gain; stats = [count, G, H]."""
+    def score(s):
+        return s[..., 1] ** 2 / (s[..., 2] + lam)
+    return 0.5 * (score(left) + score(right) - score(parent))
+
+
+_GAINS = {"variance": _gain_variance, "gini": _gain_gini, "xgb": _gain_xgb}
+
+
+def _leaf_variance(s):
+    return (s[..., 1:2] / jnp.maximum(s[..., 0:1], 1e-12))
+
+
+def _leaf_gini(s):
+    return s[..., 1:] / jnp.maximum(s[..., 0:1], 1e-12)
+
+
+def _leaf_xgb(s, lam=1.0):
+    return -(s[..., 1:2] / (s[..., 2:3] + lam))
+
+
+def fit_tree(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
+             feature_mask: jnp.ndarray, *, impurity: str, max_depth: int,
+             n_bins: int, min_instances: jnp.ndarray, min_gain: jnp.ndarray,
+             lam: jnp.ndarray, chunk: int = 32) -> TreeArrays:
+    """Grow one tree level-wise on binned data.
+
+    B [N, D] int32; stats [N, S] pre-weighted per-row statistics (col 0 must be
+    the row weight/count); feature_mask [D] 0/1.  Returns perfect-heap arrays
+    with ``T = 2^(max_depth+1) - 1`` nodes.
+    """
+    N, D = B.shape
+    S = stats.shape[1]
+    gain_fn = _GAINS[impurity]
+    leaf_fn = {"variance": _leaf_variance, "gini": _leaf_gini,
+               "xgb": lambda s: _leaf_xgb(s, lam)}[impurity]
+    V = {"variance": 1, "gini": S - 1, "xgb": 1}[impurity]
+    T = 2 ** (max_depth + 1) - 1
+
+    n_chunks = math.ceil(D / chunk)
+    D_pad = n_chunks * chunk
+    pad = D_pad - D
+    B_pad = jnp.pad(B, ((0, 0), (0, pad)))                   # [N, D_pad]
+    fmask = jnp.pad(feature_mask, (0, pad))                  # [D_pad]
+    # feature-chunk views: [n_chunks, chunk, N]
+    B_chunks = B_pad.T.reshape(n_chunks, chunk, N)
+    m_chunks = fmask.reshape(n_chunks, chunk)
+
+    feat_arr = jnp.full((T,), -1, jnp.int32)
+    thr_arr = jnp.full((T,), jnp.inf, jnp.float32)
+    leaf_flag = jnp.zeros((T,), bool)
+    leaf_val = jnp.zeros((T, V), jnp.float32)
+
+    row_node = jnp.zeros((N,), jnp.int32)
+    parent_dead = jnp.zeros((1,), bool)  # nodes whose ancestor is a leaf
+
+    for level in range(max_depth + 1):
+        n_l = 2 ** level
+        offset = n_l - 1
+        node_stats = jax.ops.segment_sum(stats, row_node, num_segments=n_l)
+        lv = leaf_fn(node_stats)
+        leaf_val = jax.lax.dynamic_update_slice(leaf_val, lv.astype(jnp.float32),
+                                                (offset, 0))
+        if level == max_depth:
+            leaf_flag = jax.lax.dynamic_update_slice(
+                leaf_flag, jnp.ones((n_l,), bool), (offset,))
+            break
+
+        def scan_chunk(carry, xs):
+            best_gain, best_feat, best_bin = carry
+            bc, mc, base_idx = xs           # [chunk, N], [chunk], scalar
+
+            def one_feature(bcol):
+                seg = row_node * n_bins + bcol
+                return jax.ops.segment_sum(stats, seg,
+                                           num_segments=n_l * n_bins)
+
+            hist = jax.vmap(one_feature)(bc)                 # [chunk, n_l*n_bins, S]
+            hist = hist.reshape(chunk, n_l, n_bins, S)
+            left = jnp.cumsum(hist, axis=2)                  # [chunk, n_l, n_bins, S]
+            right = node_stats[None, :, None, :] - left
+            gains = gain_fn(left, right, node_stats[None, :, None, :], lam)
+            ok = ((left[..., 0] >= min_instances) &
+                  (right[..., 0] >= min_instances) &
+                  mc[:, None, None] &
+                  (jnp.arange(n_bins)[None, None, :] < n_bins - 1))
+            gains = jnp.where(ok, gains, -jnp.inf)           # [chunk, n_l, n_bins]
+            cg = jnp.max(gains, axis=2)                      # [chunk, n_l]
+            cb = jnp.argmax(gains, axis=2).astype(jnp.int32)
+            fg = jnp.max(cg, axis=0)                         # [n_l]
+            fi = jnp.argmax(cg, axis=0)                      # [n_l] chunk-local feat
+            fb = jnp.take_along_axis(cb, fi[None, :], axis=0)[0]
+            better = fg > best_gain
+            best_gain = jnp.where(better, fg, best_gain)
+            best_feat = jnp.where(better, base_idx + fi.astype(jnp.int32), best_feat)
+            best_bin = jnp.where(better, fb, best_bin)
+            return (best_gain, best_feat, best_bin), None
+
+        init = (jnp.full((n_l,), -jnp.inf, jnp.float32),
+                jnp.zeros((n_l,), jnp.int32), jnp.zeros((n_l,), jnp.int32))
+        base_idxs = jnp.arange(n_chunks, dtype=jnp.int32) * chunk
+        (best_gain, best_feat, best_bin), _ = jax.lax.scan(
+            scan_chunk, init, (B_chunks, m_chunks, base_idxs))
+
+        node_is_leaf = (best_gain <= min_gain) | (~jnp.isfinite(best_gain)) | parent_dead
+        splits_pad = jnp.pad(splits, ((0, pad), (0, 0)),
+                             constant_values=np.inf) if pad else splits
+        thr = splits_pad[best_feat, jnp.clip(best_bin, 0, splits.shape[1] - 1)]
+        feat_arr = jax.lax.dynamic_update_slice(
+            feat_arr, jnp.where(node_is_leaf, -1, best_feat), (offset,))
+        thr_arr = jax.lax.dynamic_update_slice(thr_arr, thr, (offset,))
+        leaf_flag = jax.lax.dynamic_update_slice(leaf_flag, node_is_leaf, (offset,))
+
+        # route rows: bin(feature of my node) > split bin → right child
+        f_of_row = best_feat[row_node]                       # [N]
+        b_of_row = jnp.take_along_axis(B_pad, f_of_row[:, None], axis=1)[:, 0]
+        go_right = b_of_row > best_bin[row_node]
+        row_node = 2 * row_node + go_right.astype(jnp.int32)
+        parent_dead = jnp.repeat(node_is_leaf, 2)
+
+    return TreeArrays(feat_arr, thr_arr, leaf_flag, leaf_val)
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def predict_trees_raw(X: jnp.ndarray, feature: jnp.ndarray, threshold: jnp.ndarray,
+                      is_leaf: jnp.ndarray, leaf: jnp.ndarray,
+                      max_depth: int) -> jnp.ndarray:
+    """Batch prediction over an ensemble on raw features.
+    feature/threshold/is_leaf: [Tr, T]; leaf: [Tr, T, V].
+    Returns [N, Tr, V] leaf values (caller aggregates)."""
+    N = X.shape[0]
+    Tr = feature.shape[0]
+    node = jnp.zeros((N, Tr), jnp.int32)
+    for _ in range(max_depth):
+        f = feature[jnp.arange(Tr)[None, :], node]            # [N, Tr]
+        th = threshold[jnp.arange(Tr)[None, :], node]
+        lf = is_leaf[jnp.arange(Tr)[None, :], node]
+        xf = jnp.take_along_axis(X, jnp.maximum(f, 0), axis=1)  # [N, Tr]
+        nxt = 2 * node + 1 + (xf > th).astype(jnp.int32)
+        node = jnp.where(lf, node, nxt)
+    return leaf[jnp.arange(Tr)[None, :], node]                # [N, Tr, V]
+
+
+# --------------------------------------------------------------------------
+# forest / boosting drivers
+# --------------------------------------------------------------------------
+
+def _feature_masks(key, n_trees: int, d: int, strategy: str) -> jnp.ndarray:
+    if strategy == "all" or n_trees == 1:
+        return jnp.ones((n_trees, d), jnp.float32) > 0
+    k = {"sqrt": max(1, int(math.sqrt(d))),
+         "onethird": max(1, d // 3)}.get(strategy, d)
+    if k >= d:
+        return jnp.ones((n_trees, d), jnp.float32) > 0
+    keys = jax.random.split(key, n_trees)
+
+    def one(k_):
+        scores = jax.random.uniform(k_, (d,))
+        thresh = jnp.sort(scores)[k - 1]
+        return scores <= thresh
+
+    return jax.vmap(one)(keys)
+
+
+@functools.lru_cache(maxsize=None)
+def _forest_fitter(impurity: str, max_depth: int, n_bins: int, use_vmap: bool):
+    """Jitted whole-forest fit, cached on the static tree shape so CV-grid
+    candidates sharing a config reuse the compiled executable."""
+
+    def fn(B, splits, base_stats, boot, masks, min_instances, min_gain, lam):
+        def fit_one(args):
+            bw, fm = args
+            stats = base_stats * bw[:, None]
+            return fit_tree(B, splits, stats, fm, impurity=impurity,
+                            max_depth=max_depth, n_bins=n_bins,
+                            min_instances=min_instances, min_gain=min_gain,
+                            lam=lam)
+
+        # memory heuristic: deep trees → sequential lax.map, shallow → vmap
+        if use_vmap:
+            return jax.vmap(fit_one)((boot, masks))
+        return jax.lax.map(fit_one, (boot, masks))
+
+    return jax.jit(fn)
+
+
+def fit_forest(X: np.ndarray, y: np.ndarray, *, task: str, n_classes: int,
+               n_trees: int, max_depth: int, max_bins: int,
+               min_instances: float, min_gain: float, subsample: float,
+               feature_strategy: str, seed: int,
+               sample_weight: Optional[np.ndarray] = None) -> Dict[str, Any]:
+    """Random forest: all trees in one vmapped XLA program (chunked via
+    lax.map when deep trees would blow HBM)."""
+    N, D = X.shape
+    splits = build_bin_splits(X, max_bins)
+    Xj = jnp.asarray(X, jnp.float32)
+    B = bin_data(Xj, jnp.asarray(splits))
+    w0 = jnp.ones(N, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight)
+    yj = jnp.asarray(y, jnp.float32)
+    key = jax.random.PRNGKey(seed)
+    k_boot, k_feat = jax.random.split(key)
+    boot = jax.random.poisson(k_boot, subsample, (n_trees, N)).astype(jnp.float32)
+    masks = _feature_masks(k_feat, n_trees, D, feature_strategy)
+
+    if task == "classification":
+        impurity = "gini"
+        yoh = jax.nn.one_hot(yj.astype(jnp.int32), n_classes, dtype=jnp.float32)
+        base_stats = jnp.concatenate([jnp.ones((N, 1)), yoh], axis=1)
+    else:
+        impurity = "variance"
+        base_stats = jnp.stack([jnp.ones(N), yj, yj * yj], axis=1)
+    base_stats = base_stats * w0[:, None]
+
+    use_vmap = max_depth <= 8 and n_trees <= 64
+    fitter = _forest_fitter(impurity, max_depth, max_bins, use_vmap)
+    trees = fitter(B, jnp.asarray(splits), base_stats, boot, masks,
+                   jnp.float32(min_instances), jnp.float32(min_gain),
+                   jnp.float32(1.0))
+    return {"kind": "forest", "task": task, "n_classes": n_classes,
+            "max_depth": max_depth,
+            "feature": np.asarray(trees.feature),
+            "threshold": np.asarray(trees.threshold),
+            "is_leaf": np.asarray(trees.is_leaf),
+            "leaf": np.asarray(trees.leaf),
+            "bin_splits": splits}
+
+
+@functools.lru_cache(maxsize=None)
+def _gbt_round_fitter(task: str, max_depth: int, n_bins: int):
+    """Jitted single boosting round, cached on static config."""
+
+    def fn(B, splits, X, y, w0, margin, fmask, min_instances, min_gain,
+           lam, eta):
+        if task == "classification":
+            p = jax.nn.sigmoid(margin)
+            g, h = p - y, jnp.maximum(p * (1 - p), 1e-6)
+        else:
+            g, h = margin - y, jnp.ones_like(margin)
+        stats = jnp.stack([jnp.ones_like(g), g * w0, h * w0], axis=1)
+        tree = fit_tree(B, splits, stats, fmask, impurity="xgb",
+                        max_depth=max_depth, n_bins=n_bins,
+                        min_instances=min_instances, min_gain=min_gain, lam=lam)
+        pred = predict_trees_raw(X, tree.feature[None], tree.threshold[None],
+                                 tree.is_leaf[None], tree.leaf[None],
+                                 max_depth + 1)[:, 0, 0]
+        return margin + eta * pred, tree
+
+    return jax.jit(fn)
+
+
+def fit_gbt(X: np.ndarray, y: np.ndarray, *, task: str, n_rounds: int,
+            max_depth: int, max_bins: int, min_instances: float,
+            min_gain: float, eta: float, lam: float, seed: int,
+            min_child_weight: float = 0.0,
+            sample_weight: Optional[np.ndarray] = None) -> Dict[str, Any]:
+    """Gradient boosting (XGBoost-style second-order): Python loop over rounds
+    around a jitted tree fit; grad/hess computed on device."""
+    N, D = X.shape
+    splits = build_bin_splits(X, max_bins)
+    splits_j = jnp.asarray(splits)
+    Xj = jnp.asarray(X, jnp.float32)
+    B = bin_data(Xj, splits_j)
+    w0 = jnp.ones(N, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight)
+    yj = jnp.asarray(y, jnp.float32)
+    fmask = jnp.ones((D,), jnp.float32) > 0
+    base = jnp.float32(0.0) if task == "classification" else jnp.mean(yj)
+    mi = max(float(min_instances), float(min_child_weight))
+    fit_round = _gbt_round_fitter(task, max_depth, max_bins)
+
+    margin = jnp.full((N,), base)
+    trees = []
+    for _ in range(n_rounds):
+        margin, tree = fit_round(B, splits_j, Xj, yj, w0, margin, fmask,
+                                 jnp.float32(mi), jnp.float32(min_gain),
+                                 jnp.float32(lam), jnp.float32(eta))
+        trees.append(tree)
+    feature = np.stack([np.asarray(t.feature) for t in trees])
+    threshold = np.stack([np.asarray(t.threshold) for t in trees])
+    is_leaf = np.stack([np.asarray(t.is_leaf) for t in trees])
+    leaf = np.stack([np.asarray(t.leaf) for t in trees])
+    return {"kind": "gbt", "task": task, "n_classes": 2,
+            "max_depth": max_depth, "eta": eta, "base": float(base),
+            "feature": feature, "threshold": threshold,
+            "is_leaf": is_leaf, "leaf": leaf, "bin_splits": splits}
+
+
+# --------------------------------------------------------------------------
+# prediction models + estimator stages
+# --------------------------------------------------------------------------
+
+class TreeEnsembleModel(PredictionModel):
+    def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        f = self.fitted
+        leaves = np.asarray(predict_trees_raw(
+            jnp.asarray(X, jnp.float32), jnp.asarray(f["feature"]),
+            jnp.asarray(f["threshold"]), jnp.asarray(f["is_leaf"]),
+            jnp.asarray(f["leaf"]), int(f["max_depth"]) + 1))  # [N, Tr, V]
+        if f["kind"] == "forest":
+            if f["task"] == "classification":
+                prob = leaves.mean(axis=1)                     # [N, C]
+                prob = prob / np.maximum(prob.sum(axis=1, keepdims=True), 1e-12)
+                return {"prediction": np.argmax(prob, axis=1).astype(np.float32),
+                        "probability": prob,
+                        "rawPrediction": np.log(np.maximum(prob, 1e-12))}
+            return {"prediction": leaves.mean(axis=1)[:, 0].astype(np.float32)}
+        # gbt
+        margin = f["base"] + f["eta"] * leaves[:, :, 0].sum(axis=1)
+        if f["task"] == "classification":
+            p1 = 1.0 / (1.0 + np.exp(-margin))
+            prob = np.stack([1 - p1, p1], axis=1)
+            return {"prediction": (p1 > 0.5).astype(np.float32),
+                    "probability": prob,
+                    "rawPrediction": np.stack([-margin, margin], axis=1)}
+        return {"prediction": margin.astype(np.float32)}
+
+
+class _ForestEstimatorBase(PredictorEstimator):
+    model_cls = TreeEnsembleModel
+    task = "classification"
+    default_feature_strategy = "sqrt"
+
+    def __init__(self, num_trees: int = 20, max_depth: int = 5,
+                 max_bins: int = MAX_BINS_DEFAULT, min_instances_per_node: int = 1,
+                 min_info_gain: float = 0.0, subsampling_rate: float = 1.0,
+                 feature_subset_strategy: str = "auto", seed: int = 42, **kw):
+        super().__init__(num_trees=num_trees, max_depth=max_depth,
+                         max_bins=max_bins,
+                         min_instances_per_node=min_instances_per_node,
+                         min_info_gain=min_info_gain,
+                         subsampling_rate=subsampling_rate,
+                         feature_subset_strategy=feature_subset_strategy,
+                         seed=seed, **kw)
+
+    def fit_arrays(self, X, y, sample_weight=None) -> Dict[str, Any]:
+        strategy = self.get("feature_subset_strategy", "auto")
+        if strategy == "auto":
+            strategy = (self.default_feature_strategy
+                        if self.get("num_trees", 20) > 1 else "all")
+        n_classes = (int(np.max(y)) + 1 if self.task == "classification" else 0)
+        return fit_forest(
+            X, y, task=self.task, n_classes=max(n_classes, 2),
+            n_trees=int(self.get("num_trees", 20)),
+            max_depth=int(self.get("max_depth", 5)),
+            max_bins=int(self.get("max_bins", MAX_BINS_DEFAULT)),
+            min_instances=float(self.get("min_instances_per_node", 1)),
+            min_gain=float(self.get("min_info_gain", 0.0)),
+            subsample=float(self.get("subsampling_rate", 1.0)),
+            feature_strategy=strategy, seed=int(self.get("seed", 42)),
+            sample_weight=sample_weight)
+
+
+class OpRandomForestClassifier(_ForestEstimatorBase):
+    """≙ OpRandomForestClassifier.scala:58."""
+    task = "classification"
+    default_feature_strategy = "sqrt"
+
+
+class OpRandomForestRegressor(_ForestEstimatorBase):
+    """≙ OpRandomForestRegressor."""
+    task = "regression"
+    default_feature_strategy = "onethird"
+
+
+class OpDecisionTreeClassifier(_ForestEstimatorBase):
+    """≙ OpDecisionTreeClassifier: single unbootstrapped tree."""
+    task = "classification"
+
+    def __init__(self, max_depth: int = 5, **kw):
+        kw.setdefault("num_trees", 1)
+        kw.setdefault("feature_subset_strategy", "all")
+        kw.setdefault("subsampling_rate", 1.0)
+        super().__init__(max_depth=max_depth, **kw)
+
+    def fit_arrays(self, X, y, sample_weight=None):
+        # single tree: no bootstrap → deterministic weights
+        fitted = super().fit_arrays(X, y, sample_weight)
+        return fitted
+
+
+class OpDecisionTreeRegressor(OpDecisionTreeClassifier):
+    task = "regression"
+
+
+class _GBTEstimatorBase(PredictorEstimator):
+    model_cls = TreeEnsembleModel
+    task = "classification"
+
+    def __init__(self, max_iter: int = 20, max_depth: int = 5,
+                 max_bins: int = MAX_BINS_DEFAULT, min_instances_per_node: int = 1,
+                 min_info_gain: float = 0.0, step_size: float = 0.1,
+                 reg_lambda: float = 1.0, seed: int = 42, **kw):
+        super().__init__(max_iter=max_iter, max_depth=max_depth, max_bins=max_bins,
+                         min_instances_per_node=min_instances_per_node,
+                         min_info_gain=min_info_gain, step_size=step_size,
+                         reg_lambda=reg_lambda, seed=seed, **kw)
+
+    def fit_arrays(self, X, y, sample_weight=None) -> Dict[str, Any]:
+        return fit_gbt(
+            X, y, task=self.task,
+            n_rounds=int(self.get("max_iter", 20)),
+            max_depth=int(self.get("max_depth", 5)),
+            max_bins=int(self.get("max_bins", MAX_BINS_DEFAULT)),
+            min_instances=float(self.get("min_instances_per_node", 1)),
+            min_gain=float(self.get("min_info_gain", 0.0)),
+            eta=float(self.get("step_size", 0.1)),
+            lam=float(self.get("reg_lambda", 1.0)),
+            min_child_weight=float(self.get("min_child_weight", 0.0)),
+            seed=int(self.get("seed", 42)), sample_weight=sample_weight)
+
+
+class OpGBTClassifier(_GBTEstimatorBase):
+    """≙ OpGBTClassifier (binary only, like Spark's GBTClassifier)."""
+    task = "classification"
+
+
+class OpGBTRegressor(_GBTEstimatorBase):
+    """≙ OpGBTRegressor."""
+    task = "regression"
+
+
+class OpXGBoostClassifier(_GBTEstimatorBase):
+    """≙ OpXGBoostClassifier.scala:47 — same boosted-tree engine with XGBoost
+    parameter names/defaults (eta, numRound, minChildWeight, lambda)."""
+    task = "classification"
+
+    def __init__(self, num_round: int = 100, eta: float = 0.3,
+                 max_depth: int = 6, min_child_weight: float = 1.0,
+                 reg_lambda: float = 1.0, seed: int = 42, **kw):
+        super().__init__(max_iter=num_round, max_depth=max_depth,
+                         step_size=eta, reg_lambda=reg_lambda, seed=seed,
+                         min_child_weight=min_child_weight, **kw)
+
+
+class OpXGBoostRegressor(OpXGBoostClassifier):
+    task = "regression"

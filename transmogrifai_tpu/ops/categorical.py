@@ -17,7 +17,7 @@ import numpy as np
 
 from ..columns import Column, ColumnBatch
 from ..stages.base import Estimator, Transformer, TransformerModel
-from ..types import Integral, OPVector, Real, Text
+from ..types import Integral, OPVector, Real, RealNN, Text
 from ..vector_meta import (NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMeta,
                            VectorMeta)
 
@@ -52,17 +52,22 @@ class OneHotModel(TransformerModel):
 
     def transform(self, batch: ColumnBatch) -> Column:
         outs = []
+        track_other = self.get("track_other", True)
+        track_nulls = self.get("track_nulls", True)
         for f in self.input_features:
             vocab: Dict[str, int] = self.fitted["vocabs"][f.name]
             other_id = len(vocab)
             ids = encode_with_vocab(_col_strings(batch[f.name]), vocab, other_id)
-            width = other_id + (1 if self.get("track_other", True) else 0) \
-                + (1 if self.get("track_nulls", True) else 0)
-            onehot = jnp.asarray(ids[:, None] == np.arange(width)[None, :],
-                                 jnp.float32) if width else jnp.zeros((len(ids), 0))
-            # columns beyond vocab: OTHER then null — clip ids that have no slot
-            keep = min(width, other_id + 2)
-            onehot = onehot[:, :keep]
+            # full encoding always has [vocab..., OTHER, NULL]; select only the
+            # slots this model tracks so columns stay aligned with the meta
+            cols = list(range(other_id))
+            if track_other:
+                cols.append(other_id)
+            if track_nulls:
+                cols.append(other_id + 1)
+            onehot = (jnp.asarray(ids[:, None] == np.asarray(cols)[None, :],
+                                  jnp.float32) if cols
+                      else jnp.zeros((len(ids), 0), jnp.float32))
             outs.append(onehot)
         return Column(OPVector, jnp.concatenate(outs, axis=1) if outs else
                       jnp.zeros((len(batch), 0)), meta=self.fitted["meta"])
@@ -106,7 +111,7 @@ class OneHotEstimator(Estimator):
 
 
 class StringIndexerModel(TransformerModel):
-    out_kind = Integral
+    out_kind = RealNN
     is_device_op = False
 
     def transform(self, batch: ColumnBatch) -> Column:
@@ -124,14 +129,14 @@ class StringIndexerModel(TransformerModel):
                 ids[i] = unseen
             else:
                 ids[i] = vocab[v]
-        return Column(Integral, ids, mask=mask)
+        return Column(RealNN, ids.astype(np.float32))
 
 
 class StringIndexer(Estimator):
     """Text → ordinal index by descending frequency (≙ OpStringIndexer;
     'NoFilter' variant maps unseen to an extra bucket)."""
 
-    out_kind = Integral
+    out_kind = RealNN
 
     def __init__(self, handle_invalid: str = "noFilter", **params):
         super().__init__(handle_invalid=handle_invalid, **params)

@@ -27,35 +27,41 @@ _EPOCH_DOW_SHIFT = 3 * _MS_DAY
 _MS_YEAR = int(365.2425 * _MS_DAY)
 
 
-def _period_fraction(ms, period: str):
-    """Fraction in [0, 1) of the given circular period."""
-    ms = jnp.asarray(ms, jnp.float64) if hasattr(ms, "dtype") else jnp.asarray(ms)
+def _period_fraction(ms: np.ndarray, period: str) -> np.ndarray:
+    """Fraction in [0, 1) of the given circular period.
+
+    The modulo runs on host in int64: epoch-milliseconds (~1.5e12) overflow
+    int32 and lose ~131 s of resolution in float32, so only the small
+    remainder is converted to float32 for the device sin/cos."""
+    ms = np.asarray(ms, np.int64)
     if period == "HourOfDay":
-        return (ms % _MS_DAY) / _MS_DAY
-    if period == "DayOfWeek":
-        return ((ms + _EPOCH_DOW_SHIFT) % _MS_WEEK) / _MS_WEEK
-    if period == "DayOfMonth":
+        shift, per = 0, _MS_DAY
+    elif period == "DayOfWeek":
+        shift, per = _EPOCH_DOW_SHIFT, _MS_WEEK
+    elif period == "DayOfMonth":
         # approximate month as 30.44 days (exact calendar month needs host calc)
-        month_ms = 30.44 * _MS_DAY
-        return (ms % month_ms) / month_ms
-    if period == "DayOfYear":
-        return (ms % _MS_YEAR) / _MS_YEAR
-    raise ValueError(f"unknown time period {period}")
+        shift, per = 0, int(30.44 * _MS_DAY)
+    elif period == "DayOfYear":
+        shift, per = 0, _MS_YEAR
+    else:
+        raise ValueError(f"unknown time period {period}")
+    return (((ms + shift) % per) / per).astype(np.float32)
 
 
 class DateToUnitCircleModel(TransformerModel):
     out_kind = OPVector
+    is_device_op = False  # int64 host modulo pre-pass, then device sin/cos
 
     def transform(self, batch: ColumnBatch) -> Column:
         periods = self.get("periods")
         outs = []
         for f in self.input_features:
             col = batch[f.name]
-            v = jnp.asarray(col.values, jnp.float64)
+            v = np.asarray(col.values, np.int64)
             m = (jnp.ones(v.shape[0], bool) if col.mask is None
                  else jnp.asarray(col.mask))
             for p in periods:
-                frac = _period_fraction(v, p)
+                frac = jnp.asarray(_period_fraction(v, p))
                 ang = 2 * jnp.pi * frac
                 outs.append(jnp.where(m, jnp.sin(ang), 0.0).astype(jnp.float32)[:, None])
                 outs.append(jnp.where(m, jnp.cos(ang), 0.0).astype(jnp.float32)[:, None])

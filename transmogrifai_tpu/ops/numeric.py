@@ -180,7 +180,9 @@ class StandardScalerModel(TransformerModel):
         v = jnp.asarray(col.values, jnp.float32)
         if v.ndim == 1:
             v = v[:, None]
-        out = (v - self.fitted["mean"]) / self.fitted["std"]
+        out = (jnp.nan_to_num(v) - self.fitted["mean"]) / self.fitted["std"]
+        if col.mask is not None:
+            out = jnp.where(jnp.asarray(col.mask)[:, None], out, 0.0)
         return Column(OPVector, out, meta=col.meta or self.fitted["meta"])
 
 
@@ -198,8 +200,20 @@ class StandardScaler(Estimator):
         v = jnp.asarray(col.values, jnp.float32)
         if v.ndim == 1:
             v = v[:, None]
-        mean = v.mean(axis=0) if self.get("with_mean", True) else jnp.zeros(v.shape[1])
-        std = v.std(axis=0) if self.get("with_std", True) else jnp.ones(v.shape[1])
+        # masked moments: missing entries (mask=False, stored as NaN/0) must
+        # not poison the statistics
+        if col.mask is not None:
+            m = jnp.asarray(col.mask)[:, None].astype(jnp.float32)
+            vz = jnp.nan_to_num(v) * m
+            cnt = jnp.maximum(m.sum(axis=0), 1.0)
+            mean_all = vz.sum(axis=0) / cnt
+            var_all = (vz * vz).sum(axis=0) / cnt - mean_all ** 2
+            std_all = jnp.sqrt(jnp.maximum(var_all, 0.0))
+        else:
+            mean_all = v.mean(axis=0)
+            std_all = v.std(axis=0)
+        mean = mean_all if self.get("with_mean", True) else jnp.zeros(v.shape[1])
+        std = std_all if self.get("with_std", True) else jnp.ones(v.shape[1])
         std = jnp.where(std == 0, 1.0, std)
         meta = col.meta or VectorMeta(self.output_name(), [
             VectorColumnMeta(f.name, f.kind.__name__)])

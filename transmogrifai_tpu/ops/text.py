@@ -26,8 +26,7 @@ from ..vector_meta import (NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMeta,
                            VectorMeta)
 from .categorical import _col_strings, encode_with_vocab
 
-_TOKEN_RE = re.compile(r"[^\s\p{P}]+") if hasattr(re, "Pattern") and False else \
-    re.compile(r"[A-Za-z0-9_']+")
+_TOKEN_RE = re.compile(r"[A-Za-z0-9_']+")
 
 def fnv1a_32(s: str) -> int:
     """Stable 32-bit FNV-1a string hash (host-side hashing-trick backbone)."""

@@ -139,8 +139,6 @@ def transmogrify(features: Sequence[Feature],
         st.set_input(*feats)
         blocks.append(st.get_output())
 
-    if len(blocks) == 1 and blocks[0].kind is OPVector and not label:
-        pass
     combiner = VectorsCombiner()
     combiner.set_input(*blocks)
     return combiner.get_output()

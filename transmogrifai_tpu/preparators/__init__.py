@@ -1,0 +1,5 @@
+from .sanity_checker import SanityChecker, SanityCheckerModel, SanityCheckerSummary
+from .prediction_deindexer import PredictionDeIndexer
+
+__all__ = ["SanityChecker", "SanityCheckerModel", "SanityCheckerSummary",
+           "PredictionDeIndexer"]

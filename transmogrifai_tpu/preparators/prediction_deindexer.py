@@ -1,0 +1,33 @@
+"""PredictionDeIndexer (reference: core/.../impl/preparators/
+PredictionDeIndexer.scala): maps an indexed prediction back to the original
+string labels recorded by a fitted StringIndexer."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..columns import Column, ColumnBatch
+from ..stages.base import Transformer
+from ..types import Prediction, Text
+
+
+class PredictionDeIndexer(Transformer):
+    """(response_indexed, prediction) → Text column of original labels."""
+
+    in_kinds = None
+    out_kind = Text
+    is_device_op = False
+
+    def __init__(self, labels: Sequence[str] = (), **params):
+        super().__init__(labels=list(labels), **params)
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        pred_col = batch[self.input_features[-1].name]
+        labels = list(self.get("labels", []))
+        pred = np.asarray(pred_col.values["prediction"]).astype(np.int64)
+        out = np.array(
+            [labels[p] if 0 <= p < len(labels) else str(p) for p in pred],
+            dtype=object)
+        return Column(Text, out)

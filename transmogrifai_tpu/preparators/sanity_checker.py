@@ -1,0 +1,292 @@
+"""SanityChecker — automatic feature validation / leakage detection
+(reference: core/src/main/scala/com/salesforce/op/stages/impl/preparators/
+SanityChecker.scala:535-640 fitFn, SanityCheckerModel:695,
+SanityCheckerMetadata.scala; stats from utils/stats/OpStatistics.scala:71,188,234).
+
+On TPU the whole fit is a handful of fused XLA reductions over the HBM-resident
+feature matrix: moments + label correlations are one [D+1]-wide matmul pass,
+Cramér's V contingency tables are one-hot outer-product matmuls per categorical
+group, and the model is a gather of the kept column indices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columns import Column, ColumnBatch
+from ..stages.base import Estimator, TransformerModel
+from ..types import OPVector, RealNN
+from ..vector_meta import VectorMeta
+
+DEFAULT_MAX_CORRELATION = 0.95
+DEFAULT_MIN_CORRELATION = 0.0
+DEFAULT_MIN_VARIANCE = 1e-5
+DEFAULT_MAX_CRAMERS_V = 0.95
+DEFAULT_MAX_RULE_CONFIDENCE = 1.0
+DEFAULT_MIN_REQUIRED_RULE_SUPPORT = 1.0
+DEFAULT_SAMPLE_UPPER_LIMIT = 1_000_000
+DEFAULT_CORRELATION_TYPE = "pearson"
+
+
+@jax.jit
+def _col_stats(X: jnp.ndarray, y: jnp.ndarray):
+    """Single fused pass: per-column count/mean/var/min/max + Pearson corr with
+    the label (≙ Statistics.colStats + computeCorrelationsWithLabel,
+    OpStatistics.scala:71)."""
+    n = X.shape[0]
+    mean = jnp.mean(X, axis=0)
+    var = jnp.var(X, axis=0, ddof=1)
+    mn = jnp.min(X, axis=0)
+    mx = jnp.max(X, axis=0)
+    ym = jnp.mean(y)
+    yc = y - ym
+    ysd = jnp.sqrt(jnp.sum(yc * yc))
+    Xc = X - mean
+    cov = yc @ Xc
+    xsd = jnp.sqrt(jnp.sum(Xc * Xc, axis=0))
+    corr = cov / jnp.maximum(xsd * ysd, 1e-12)
+    return mean, var, mn, mx, corr
+
+
+def _rank_transform(a: np.ndarray) -> np.ndarray:
+    """Average-rank transform per column for Spearman correlation."""
+    order = np.argsort(a, axis=0, kind="mergesort")
+    ranks = np.empty_like(a, dtype=np.float64)
+    n = a.shape[0]
+    rng = np.arange(n, dtype=np.float64)
+    for j in range(a.shape[1] if a.ndim == 2 else 1):
+        col = a[:, j] if a.ndim == 2 else a
+        o = order[:, j] if a.ndim == 2 else order
+        r = np.empty(n)
+        r[o] = rng
+        # average ties
+        sorted_vals = col[o]
+        i = 0
+        while i < n:
+            k = i
+            while k + 1 < n and sorted_vals[k + 1] == sorted_vals[i]:
+                k += 1
+            if k > i:
+                r[o[i:k + 1]] = 0.5 * (i + k)
+            i = k + 1
+        if a.ndim == 2:
+            ranks[:, j] = r
+        else:
+            ranks = r
+    return ranks
+
+
+def cramers_v(contingency: np.ndarray) -> float:
+    """Cramér's V from a contingency matrix (≙ OpStatistics.chiSquaredTest,
+    OpStatistics.scala:188)."""
+    obs = np.asarray(contingency, dtype=np.float64)
+    # drop empty rows/cols
+    obs = obs[obs.sum(axis=1) > 0][:, obs.sum(axis=0) > 0]
+    if obs.size == 0 or min(obs.shape) < 2:
+        return float("nan")
+    n = obs.sum()
+    expected = np.outer(obs.sum(axis=1), obs.sum(axis=0)) / n
+    chi2 = float(((obs - expected) ** 2 / np.maximum(expected, 1e-12)).sum())
+    k = min(obs.shape) - 1
+    return float(np.sqrt(chi2 / (n * max(k, 1))))
+
+
+@dataclass
+class SanityCheckerSummary:
+    """≙ SanityCheckerSummary metadata."""
+
+    correlation_type: str = DEFAULT_CORRELATION_TYPE
+    names: List[str] = field(default_factory=list)
+    correlations_with_label: List[float] = field(default_factory=list)
+    variances: List[float] = field(default_factory=list)
+    means: List[float] = field(default_factory=list)
+    mins: List[float] = field(default_factory=list)
+    maxs: List[float] = field(default_factory=list)
+    cramers_v_by_group: Dict[str, float] = field(default_factory=dict)
+    dropped: List[str] = field(default_factory=list)
+    drop_reasons: Dict[str, List[str]] = field(default_factory=dict)
+    sample_size: int = 0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "correlationType": self.correlation_type,
+            "names": self.names,
+            "correlationsWithLabel": self.correlations_with_label,
+            "variances": self.variances,
+            "means": self.means,
+            "mins": self.mins,
+            "maxs": self.maxs,
+            "categoricalStats": {
+                "cramersV": self.cramers_v_by_group},
+            "dropped": self.dropped,
+            "dropReasons": self.drop_reasons,
+            "sampleSize": self.sample_size,
+        }
+
+
+class SanityCheckerModel(TransformerModel):
+    """Keeps the surviving column slice (≙ SanityCheckerModel:695)."""
+
+    in_kinds = (RealNN, OPVector)
+    out_kind = OPVector
+    allow_label_as_input = True
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        vec = batch[self.input_features[1].name]
+        idx = np.asarray(self.fitted["indices_to_keep"], dtype=np.int64)
+        values = jnp.asarray(vec.values)[:, idx]
+        meta = vec.meta.select(idx.tolist(), name=self.output_features[0].name) \
+            if vec.meta is not None else None
+        return Column(OPVector, values, meta=meta)
+
+
+class SanityChecker(Estimator):
+    """≙ SanityChecker estimator on (label, featureVector)."""
+
+    in_kinds = (RealNN, OPVector)
+    out_kind = OPVector
+    allow_label_as_input = True
+
+    def __init__(self, max_correlation: float = DEFAULT_MAX_CORRELATION,
+                 min_correlation: float = DEFAULT_MIN_CORRELATION,
+                 min_variance: float = DEFAULT_MIN_VARIANCE,
+                 max_cramers_v: float = DEFAULT_MAX_CRAMERS_V,
+                 max_rule_confidence: float = DEFAULT_MAX_RULE_CONFIDENCE,
+                 min_required_rule_support: float = DEFAULT_MIN_REQUIRED_RULE_SUPPORT,
+                 remove_bad_features: bool = True,
+                 correlation_type: str = DEFAULT_CORRELATION_TYPE,
+                 check_sample_fraction: float = 1.0,
+                 sample_upper_limit: int = DEFAULT_SAMPLE_UPPER_LIMIT,
+                 seed: int = 42, **kw):
+        super().__init__(max_correlation=max_correlation,
+                         min_correlation=min_correlation,
+                         min_variance=min_variance,
+                         max_cramers_v=max_cramers_v,
+                         max_rule_confidence=max_rule_confidence,
+                         min_required_rule_support=min_required_rule_support,
+                         remove_bad_features=remove_bad_features,
+                         correlation_type=correlation_type,
+                         check_sample_fraction=check_sample_fraction,
+                         sample_upper_limit=sample_upper_limit, seed=seed, **kw)
+
+    def output_name(self) -> str:
+        return f"{self.input_features[1].name}_sanityChecked_{self.uid[-6:]}"
+
+    def fit(self, batch: ColumnBatch) -> SanityCheckerModel:
+        label_f, vec_f = self.input_features
+        y = np.asarray(batch[label_f.name].values, dtype=np.float32)
+        vec = batch[vec_f.name]
+        X = np.asarray(vec.values, dtype=np.float32)
+        n, d = X.shape
+        meta = vec.meta or VectorMeta(vec_f.name, [])
+        names = (meta.column_names() if meta.size == d
+                 else [f"f_{i}" for i in range(d)])
+
+        # sampling (≙ SanityChecker sample fraction:524)
+        frac = float(self.get("check_sample_fraction", 1.0))
+        limit = int(self.get("sample_upper_limit", DEFAULT_SAMPLE_UPPER_LIMIT))
+        if frac < 1.0 or n > limit:
+            m = min(int(n * frac) if frac < 1.0 else n, limit)
+            rng = np.random.default_rng(int(self.get("seed", 42)))
+            idx = rng.choice(n, size=m, replace=False)
+            Xs, ys = X[idx], y[idx]
+        else:
+            Xs, ys = X, y
+
+        corr_type = self.get("correlation_type", DEFAULT_CORRELATION_TYPE)
+        if corr_type == "spearman":
+            mean, var, mn, mx, _ = _col_stats(jnp.asarray(Xs), jnp.asarray(ys))
+            corr_arr = np.asarray(_col_stats(
+                jnp.asarray(_rank_transform(Xs).astype(np.float32)),
+                jnp.asarray(_rank_transform(ys).astype(np.float32)))[4])
+        else:
+            mean, var, mn, mx, corr = _col_stats(jnp.asarray(Xs), jnp.asarray(ys))
+            corr_arr = np.asarray(corr)
+        mean, var, mn, mx = (np.asarray(a) for a in (mean, var, mn, mx))
+
+        # Cramér's V + association rules per categorical indicator group
+        # (≙ categoricalTests): group = columns with an indicatorValue sharing
+        # (parentFeatureName, grouping)
+        groups: Dict[Tuple[str, Optional[str]], List[int]] = {}
+        if meta.size == d:
+            for c in meta.columns:
+                if c.indicator_value is not None:
+                    groups.setdefault((c.parent_feature_name, c.grouping), []
+                                      ).append(c.index)
+        y_classes = np.unique(ys)
+        yoh = (ys[:, None] == y_classes[None, :]).astype(np.float32)  # [N, C]
+        cramers: Dict[str, float] = {}
+        group_fail: Dict[int, List[str]] = {}
+        max_rule_conf = float(self.get("max_rule_confidence", 1.0))
+        min_rule_supp = float(self.get("min_required_rule_support", 1.0))
+        for (parent, grouping), idxs in groups.items():
+            G = Xs[:, idxs]                              # [N, k] 0/1 indicators
+            contingency = yoh.T @ G                      # [C, k]
+            v = cramers_v(contingency)
+            gname = parent if grouping is None else f"{parent}({grouping})"
+            cramers[gname] = v
+            reasons = []
+            if np.isfinite(v) and v > float(self.get("max_cramers_v", 1.0)):
+                reasons.append(f"CramersV {v:.4f} > max")
+            # association rule confidence (leakage): P(label=c | col=1)
+            col_count = contingency.sum(axis=0)          # [k]
+            conf = contingency.max(axis=0) / np.maximum(col_count, 1e-12)
+            supp = col_count / max(len(ys), 1)
+            if max_rule_conf < 1.0 or min_rule_supp < 1.0:
+                bad = (conf >= max_rule_conf) & (supp >= min_rule_supp)
+                if bad.any():
+                    reasons.append("rule confidence leakage")
+            if reasons:
+                for i in idxs:
+                    group_fail.setdefault(i, []).extend(reasons)
+
+        # per-column drop rules
+        max_corr = float(self.get("max_correlation", DEFAULT_MAX_CORRELATION))
+        min_corr = float(self.get("min_correlation", DEFAULT_MIN_CORRELATION))
+        min_var = float(self.get("min_variance", DEFAULT_MIN_VARIANCE))
+        reasons_by_col: Dict[int, List[str]] = {i: list(r) for i, r in group_fail.items()}
+        for i in range(d):
+            c = abs(corr_arr[i])
+            if np.isfinite(c):
+                if c > max_corr:
+                    reasons_by_col.setdefault(i, []).append(
+                        f"correlation {c:.4f} > maxCorrelation")
+                elif c < min_corr:
+                    reasons_by_col.setdefault(i, []).append(
+                        f"correlation {c:.4f} < minCorrelation")
+            if var[i] < min_var:
+                reasons_by_col.setdefault(i, []).append(
+                    f"variance {var[i]:.2e} < minVariance")
+
+        remove = bool(self.get("remove_bad_features", True))
+        drop_idx = sorted(reasons_by_col) if remove else []
+        keep = [i for i in range(d) if i not in set(drop_idx)]
+        if not keep:  # never drop everything
+            keep = list(range(d))
+            drop_idx = []
+
+        summary = SanityCheckerSummary(
+            correlation_type=corr_type, names=names,
+            correlations_with_label=[float(c) for c in corr_arr],
+            variances=[float(v) for v in var], means=[float(m) for m in mean],
+            mins=[float(v) for v in mn], maxs=[float(v) for v in mx],
+            cramers_v_by_group=cramers,
+            dropped=[names[i] for i in drop_idx],
+            drop_reasons={names[i]: r for i, r in reasons_by_col.items()},
+            sample_size=len(ys))
+
+        model = SanityCheckerModel(
+            fitted={"indices_to_keep": np.asarray(keep, dtype=np.int64)},
+            **self._params)
+        model.metadata["summary"] = summary.to_json()
+        model.summary = summary
+        return self._finalize_model(model)
+
+
+class PredictionDeIndexer:
+    pass

@@ -89,8 +89,16 @@ class PipelineStage:
         base = "-".join(f.name for f in self.input_features[:3]) or "out"
         return f"{base}_{self.operation_name}_{self.uid[-6:]}"
 
+    # stages that legitimately consume the label (models, sanity checker)
+    # mark their outputs as predictors (≙ AllowLabelAsInput trait,
+    # OpPipelineStages.scala); everything else propagates response-ness
+    allow_label_as_input: bool = False
+
     def output_is_response(self) -> bool:
-        return False
+        # ≙ reference default outputIsResponse = inputs.exists(_.isResponse)
+        if self.allow_label_as_input:
+            return False
+        return any(f.is_response for f in self.input_features)
 
     def make_output_features(self) -> Any:
         feats = tuple(
@@ -124,6 +132,15 @@ class PipelineStage:
     def to_json(self) -> Dict[str, Any]:
         from .serialization import stage_to_json
         return stage_to_json(self)
+
+    def save_extra(self) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Hook for stages with nested state (e.g. SelectedModel's wrapped
+        best model): return (json_dict, named arrays) persisted alongside the
+        stage. Counterpart of ``load_extra``."""
+        return {}, {}
+
+    def load_extra(self, extra_json: Dict[str, Any], arrays: Dict[str, Any]) -> None:
+        pass
 
     def __repr__(self):
         return f"{self.operation_name}({self.uid})"

@@ -18,13 +18,15 @@ from .base import PipelineStage
 
 class FeatureGeneratorStage(PipelineStage):
     def __init__(self, name: str, kind: Type[FeatureType],
-                 extract_fn: Callable[[Dict[str, Any]], Any],
+                 extract_fn: Optional[Callable[[Dict[str, Any]], Any]] = None,
                  aggregator=None, extract_source: Optional[str] = None, **params):
         super().__init__(**params)
         self.name = name
         self.kind = kind
         self.out_kind = kind
-        self.extract_fn = extract_fn
+        # default extractor = by-name lookup (what a reloaded model uses: the
+        # reference serializes the extract source text, FeatureBuilderMacros)
+        self.extract_fn = extract_fn or (lambda r, _n=name: r.get(_n))
         self.extract_source = extract_source
         from ..aggregators import default_aggregator
         self.aggregator = aggregator or default_aggregator(kind)

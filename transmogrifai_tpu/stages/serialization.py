@@ -1,0 +1,150 @@
+"""Stage / workflow-model serialization — the TPU-native re-design of
+OpPipelineStageReaderWriter + OpWorkflowModelWriter (reference:
+features/.../stages/OpPipelineStageReaderWriter.scala,
+core/.../OpWorkflowModelWriter.scala:53-171, OpWorkflowModelReader.scala).
+
+Format: one ``op-model.json`` manifest (uid, features, stages with ctor params,
+result features, train params) + one ``params.npz`` holding every fitted array
+keyed ``<stage_uid>/<name>`` — the orbax-style "pytree + manifest" layout
+(SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..features import Feature
+from ..types import FEATURE_TYPES, FeatureType
+from ..vector_meta import VectorMeta
+from .base import PipelineStage, TransformerModel
+
+
+def _is_array(v: Any) -> bool:
+    if isinstance(v, (np.ndarray, np.generic)):
+        return True
+    import jax
+    return isinstance(v, jax.Array)
+
+# modules searched for stage classes on load (≙ ReflectionUtils.classForName)
+_STAGE_MODULES = [
+    "transmogrifai_tpu.stages.transformers",
+    "transmogrifai_tpu.stages.generator",
+    "transmogrifai_tpu.ops.numeric",
+    "transmogrifai_tpu.ops.categorical",
+    "transmogrifai_tpu.ops.text",
+    "transmogrifai_tpu.ops.dates",
+    "transmogrifai_tpu.ops.geo",
+    "transmogrifai_tpu.ops.maps",
+    "transmogrifai_tpu.ops.collections",
+    "transmogrifai_tpu.ops.combiner",
+    "transmogrifai_tpu.models.linear",
+    "transmogrifai_tpu.models.trees",
+    "transmogrifai_tpu.preparators.sanity_checker",
+    "transmogrifai_tpu.preparators.prediction_deindexer",
+    "transmogrifai_tpu.selector",
+]
+
+
+def resolve_stage_class(class_name: str):
+    for mod_name in _STAGE_MODULES:
+        mod = importlib.import_module(mod_name)
+        cls = getattr(mod, class_name, None)
+        if cls is not None:
+            return cls
+    raise ValueError(f"unknown stage class {class_name!r}")
+
+
+def _json_safe(v: Any) -> Any:
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    return None  # unserializable param (e.g. callable) — dropped, like the
+    # reference drops non-ctor state
+
+
+def stage_to_json(stage: PipelineStage) -> Dict[str, Any]:
+    d: Dict[str, Any] = {
+        "uid": stage.uid,
+        "className": type(stage).__name__,
+        "params": {k: _json_safe(v) for k, v in stage.ctor_args().items()
+                   if not callable(v)},
+        "inputFeatures": [f.uid for f in stage.input_features],
+    }
+    if isinstance(stage, TransformerModel):
+        fitted_json = {}
+        for k, v in stage.fitted.items():
+            if _is_array(v):
+                continue  # arrays go to params.npz
+            if isinstance(v, VectorMeta):
+                fitted_json[k] = {"__vector_meta__": v.to_json()}
+            else:
+                fitted_json[k] = _json_safe(v)
+        d["fittedJson"] = fitted_json
+        d["metadata"] = _json_safe(stage.metadata)
+    extra_json, _ = stage.save_extra()
+    if extra_json:
+        d["extra"] = extra_json
+    return d
+
+
+def stage_fitted_arrays(stage: PipelineStage) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(stage, TransformerModel):
+        out.update({f"{stage.uid}/{k}": np.asarray(v)
+                    for k, v in stage.fitted.items() if _is_array(v)})
+    _, extra_arrays = stage.save_extra()
+    out.update({f"{stage.uid}/{k}": np.asarray(v)
+                for k, v in extra_arrays.items()})
+    return out
+
+
+def stage_from_json(d: Dict[str, Any], arrays: Dict[str, np.ndarray]) -> PipelineStage:
+    cls = resolve_stage_class(d["className"])
+    params = dict(d.get("params") or {})
+    params["uid"] = d["uid"]
+    stage = cls(**params)
+    if isinstance(stage, TransformerModel):
+        fitted: Dict[str, Any] = {}
+        for k, v in (d.get("fittedJson") or {}).items():
+            if isinstance(v, dict) and "__vector_meta__" in v:
+                fitted[k] = VectorMeta.from_json(v["__vector_meta__"])
+            else:
+                fitted[k] = v
+        prefix = d["uid"] + "/"
+        for k, v in arrays.items():
+            if k.startswith(prefix):
+                fitted[k[len(prefix):]] = v
+        stage.fitted = fitted
+        stage.metadata = dict(d.get("metadata") or {})
+    if d.get("extra"):
+        prefix = d["uid"] + "/"
+        extra_arrays = {k[len(prefix):]: v for k, v in arrays.items()
+                        if k.startswith(prefix)}
+        stage.load_extra(d["extra"], extra_arrays)
+    return stage
+
+
+def feature_to_json(f: Feature) -> Dict[str, Any]:
+    return {"name": f.name, "uid": f.uid, "type": f.kind.__name__,
+            "isResponse": f.is_response,
+            "originStage": f.origin_stage.uid if f.origin_stage else None,
+            "parents": [p.uid for p in f.parents]}
+
+
+def kind_by_name(name: str):
+    for k, v in FEATURE_TYPES.items():
+        if k == name or v.__name__ == name:
+            return v
+    raise ValueError(f"unknown feature type {name!r}")

@@ -1,0 +1,324 @@
+"""Splitters and validators — the TPU-native re-design of the reference tuning
+package (core/src/main/scala/com/salesforce/op/stages/impl/tuning/:
+DataSplitter.scala, DataBalancer.scala, DataCutter.scala, OpValidator.scala:91,
+OpCrossValidation.scala:42, OpTrainValidationSplit.scala).
+
+Where the reference fan-outs k × Σ|grid| Spark jobs over a JVM thread pool
+(OpValidator.scala:320-349), here each candidate fit is a compiled XLA program
+over HBM-resident fold slices; homogeneous hyper-parameter grids additionally
+vectorise via the models' array-level fit functions (SURVEY.md §2.6 P3).
+Reference defaults preserved: NumFolds=3, Parallelism=8, stratify=false
+(OpValidator.scala:372-378).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .columns import ColumnBatch
+from .evaluators import OpEvaluatorBase
+
+
+# --------------------------------------------------------------------------
+# splitters
+# --------------------------------------------------------------------------
+
+@dataclass
+class SplitterSummary:
+    """Metadata recorded by preValidationPrepare (≙ SplitterSummary)."""
+    splitter: str = ""
+    info: Dict[str, Any] = field(default_factory=dict)
+
+
+class Splitter:
+    """≙ tuning/Splitter.scala: optional test-holdout + per-class preparation."""
+
+    def __init__(self, seed: int = 42, reserve_test_fraction: float = 0.0):
+        self.seed = int(seed)
+        self.reserve_test_fraction = float(reserve_test_fraction)
+        self.summary: Optional[SplitterSummary] = None
+
+    def split(self, batch: ColumnBatch, label: str) -> Tuple[ColumnBatch, ColumnBatch]:
+        n = len(batch)
+        rng = np.random.default_rng(self.seed)
+        perm = rng.permutation(n)
+        n_test = int(round(n * self.reserve_test_fraction))
+        return batch.take_rows(perm[n_test:]), batch.take_rows(perm[:n_test])
+
+    def pre_validation_prepare(self, batch: ColumnBatch, label: str) -> ColumnBatch:
+        self.summary = SplitterSummary(type(self).__name__)
+        return batch
+
+    def validation_prepare(self, batch: ColumnBatch, label: str) -> ColumnBatch:
+        return batch
+
+
+class DataSplitter(Splitter):
+    """≙ DataSplitter: plain random split, no rebalancing."""
+
+
+class DataBalancer(Splitter):
+    """≙ DataBalancer.scala: up/down-sample a binary label towards
+    ``sample_fraction`` positives, capped at ``max_training_sample`` rows."""
+
+    def __init__(self, sample_fraction: float = 0.1,
+                 max_training_sample: int = 1_000_000, seed: int = 42,
+                 reserve_test_fraction: float = 0.0):
+        super().__init__(seed, reserve_test_fraction)
+        self.sample_fraction = float(sample_fraction)
+        self.max_training_sample = int(max_training_sample)
+
+    def pre_validation_prepare(self, batch, label):
+        y = np.asarray(batch[label].values, dtype=np.float64)
+        pos = float((y > 0.5).sum())
+        n = len(y)
+        self.summary = SplitterSummary("DataBalancer", {
+            "positiveFraction": pos / max(n, 1), "n": n})
+        return batch
+
+    def validation_prepare(self, batch, label):
+        y = np.asarray(batch[label].values, dtype=np.float64)
+        n = len(y)
+        pos_idx = np.flatnonzero(y > 0.5)
+        neg_idx = np.flatnonzero(y <= 0.5)
+        small, big = (pos_idx, neg_idx) if len(pos_idx) <= len(neg_idx) else (neg_idx, pos_idx)
+        frac = len(small) / max(n, 1)
+        rng = np.random.default_rng(self.seed)
+        if 0 < frac < self.sample_fraction:
+            # down-sample the majority class to reach the target fraction
+            target_big = int(len(small) * (1.0 - self.sample_fraction) / self.sample_fraction)
+            big = rng.choice(big, size=max(min(target_big, len(big)), 1), replace=False)
+        idx = np.concatenate([small, big])
+        if len(idx) > self.max_training_sample:
+            idx = rng.choice(idx, size=self.max_training_sample, replace=False)
+        rng.shuffle(idx)
+        if self.summary is not None:
+            self.summary.info["downSampleFraction"] = len(idx) / max(n, 1)
+        return batch.take_rows(np.sort(idx) if False else idx)
+
+
+class DataCutter(Splitter):
+    """≙ DataCutter.scala: multiclass — keep at most ``max_label_categories``
+    labels each with fraction ≥ ``min_label_fraction``; drop other rows and
+    record dropped labels."""
+
+    def __init__(self, max_label_categories: int = 100,
+                 min_label_fraction: float = 0.0, seed: int = 42,
+                 reserve_test_fraction: float = 0.0):
+        super().__init__(seed, reserve_test_fraction)
+        self.max_label_categories = int(max_label_categories)
+        self.min_label_fraction = float(min_label_fraction)
+        self.labels_kept: List[float] = []
+        self.labels_dropped: List[float] = []
+
+    def pre_validation_prepare(self, batch, label):
+        y = np.asarray(batch[label].values, dtype=np.float64)
+        vals, counts = np.unique(y, return_counts=True)
+        frac = counts / max(len(y), 1)
+        order = np.argsort(-counts, kind="mergesort")
+        keep = [v for i, v in zip(order, vals[order])
+                if frac[i] >= self.min_label_fraction][:self.max_label_categories]
+        keep_set = set(keep)
+        self.labels_kept = sorted(keep_set)
+        self.labels_dropped = sorted(set(vals.tolist()) - keep_set)
+        self.summary = SplitterSummary("DataCutter", {
+            "labelsKept": self.labels_kept, "labelsDropped": self.labels_dropped})
+        return batch
+
+    def validation_prepare(self, batch, label):
+        if not self.labels_dropped:
+            return batch
+        y = np.asarray(batch[label].values, dtype=np.float64)
+        mask = np.isin(y, np.asarray(self.labels_kept))
+        return batch.take_rows(np.flatnonzero(mask))
+
+
+# --------------------------------------------------------------------------
+# validators
+# --------------------------------------------------------------------------
+
+@dataclass
+class ModelCandidate:
+    """One estimator + its hyper-parameter grid (≙ (estimator, Array[ParamMap]))."""
+    estimator: Any                      # PredictorEstimator (unwired is fine)
+    grid: List[Dict[str, Any]] = field(default_factory=lambda: [{}])
+    name: Optional[str] = None
+
+    @property
+    def model_name(self) -> str:
+        return self.name or type(self.estimator).__name__
+
+
+@dataclass
+class ValidatedCandidate:
+    model_name: str
+    params: Dict[str, Any]
+    metric_values: List[float]
+
+    @property
+    def mean_metric(self) -> float:
+        vals = [v for v in self.metric_values if np.isfinite(v)]
+        return float(np.mean(vals)) if vals else float("nan")
+
+
+@dataclass
+class ValidationResult:
+    best: ModelCandidate                 # winning estimator with params applied
+    best_params: Dict[str, Any]
+    best_metric: float
+    all_results: List[ValidatedCandidate]
+    validation_type: str
+    metric_name: str
+    is_larger_better: bool
+
+
+class OpValidator:
+    """Base validator (≙ OpValidator.scala:91).
+
+    ``validate`` fits every (candidate × grid-point) on each train split and
+    scores on the held-out split with ``evaluator``; individual fit failures
+    are tolerated (CHANGELOG 0.6.x: "robust to failing models") — a failed fit
+    contributes NaN for that split and the candidate is skipped if it never
+    succeeds.
+    """
+
+    validation_type = "validator"
+
+    def __init__(self, evaluator: OpEvaluatorBase, seed: int = 42,
+                 stratify: bool = False, parallelism: int = 8):
+        self.evaluator = evaluator
+        self.seed = int(seed)
+        self.stratify = bool(stratify)
+        self.parallelism = int(parallelism)
+
+    # -- split generation -------------------------------------------------
+    def splits(self, y: np.ndarray) -> List[Tuple[np.ndarray, np.ndarray]]:
+        raise NotImplementedError
+
+    def _stratified_perm(self, y: np.ndarray, rng) -> np.ndarray:
+        """Interleave per-class shuffled indices so every contiguous cut is
+        label-balanced (≙ stratifyKFolds, OpCrossValidation.scala:184)."""
+        order = []
+        for v in np.unique(y):
+            idx = np.flatnonzero(y == v)
+            rng.shuffle(idx)
+            order.append(idx)
+        # round-robin interleave
+        out = []
+        iters = [iter(ix) for ix in order]
+        while iters:
+            nxt = []
+            for it in iters:
+                try:
+                    out.append(next(it))
+                    nxt.append(it)
+                except StopIteration:
+                    pass
+            iters = nxt
+        return np.asarray(out, dtype=np.int64)
+
+    # -- main entry -------------------------------------------------------
+    def validate(self, candidates: Sequence[ModelCandidate], batch: ColumnBatch,
+                 label: str, features: str,
+                 in_fold_dag: Optional[List[List[Any]]] = None) -> ValidationResult:
+        import copy
+
+        from .dag import apply_dag, fit_dag
+
+        y_all = np.asarray(batch[label].values, dtype=np.float64)
+        results: Dict[Tuple[str, int], ValidatedCandidate] = {}
+        for tr_idx, va_idx in self.splits(y_all):
+            tr_batch = batch.take_rows(tr_idx)
+            va_batch = batch.take_rows(va_idx)
+            if in_fold_dag:
+                # refit feature-engineering stages inside the fold to avoid
+                # leakage (≙ OpCrossValidation.validate:87-147 DAG copy+refit)
+                dag_copy = [[copy.deepcopy(s) for s in layer] for layer in in_fold_dag]
+                tr_batch, fitted = fit_dag(tr_batch, dag_copy)
+                va_batch = apply_dag(va_batch, fitted)
+            X_tr = np.asarray(tr_batch[features].values, dtype=np.float32)
+            y_tr = np.asarray(tr_batch[label].values, dtype=np.float32)
+            X_va = np.asarray(va_batch[features].values, dtype=np.float32)
+            y_va = np.asarray(va_batch[label].values, dtype=np.float32)
+            for ci, cand in enumerate(candidates):
+                for gi, params in enumerate(cand.grid):
+                    key = (cand.model_name, ci * 10000 + gi)
+                    if key not in results:
+                        results[key] = ValidatedCandidate(cand.model_name, dict(params), [])
+                    try:
+                        est = copy.deepcopy(cand.estimator)
+                        for k, v in params.items():
+                            est.set(k, v)
+                        fitted_params = est.fit_arrays(X_tr, y_tr)
+                        model = est.model_cls(fitted=fitted_params, **est._params)
+                        pred = model.predict_arrays(X_va)
+                        metric = self.evaluator.evaluate(y_va, pred)
+                    except Exception:  # noqa: BLE001 — candidate robustness
+                        metric = float("nan")
+                    results[key].metric_values.append(float(metric))
+
+        all_results = list(results.values())
+        sign = 1.0 if self.evaluator.is_larger_better else -1.0
+        scored = [(sign * r.mean_metric, r) for r in all_results
+                  if np.isfinite(r.mean_metric)]
+        if not scored:
+            raise RuntimeError("all model candidates failed validation")
+        best_score, best_res = max(scored, key=lambda t: t[0])
+        best_cand = next(c for c in candidates if c.model_name == best_res.model_name)
+        import copy as _c
+        best_est = _c.deepcopy(best_cand.estimator)
+        for k, v in best_res.params.items():
+            best_est.set(k, v)
+        return ValidationResult(
+            best=ModelCandidate(best_est, [dict(best_res.params)], best_res.model_name),
+            best_params=dict(best_res.params),
+            best_metric=best_res.mean_metric,
+            all_results=all_results,
+            validation_type=self.validation_type,
+            metric_name=self.evaluator.default_metric,
+            is_larger_better=self.evaluator.is_larger_better)
+
+
+class OpCrossValidation(OpValidator):
+    """k-fold CV (≙ OpCrossValidation.scala:42); default 3 folds."""
+
+    validation_type = "CrossValidation"
+
+    def __init__(self, num_folds: int = 3, evaluator: Optional[OpEvaluatorBase] = None,
+                 seed: int = 42, stratify: bool = False, parallelism: int = 8):
+        super().__init__(evaluator, seed, stratify, parallelism)
+        self.num_folds = int(num_folds)
+
+    def splits(self, y: np.ndarray):
+        n = len(y)
+        rng = np.random.default_rng(self.seed)
+        perm = self._stratified_perm(y, rng) if self.stratify else rng.permutation(n)
+        folds = np.array_split(perm, self.num_folds)
+        out = []
+        for i in range(self.num_folds):
+            va = folds[i]
+            tr = np.concatenate([folds[j] for j in range(self.num_folds) if j != i])
+            out.append((tr, va))
+        return out
+
+
+class OpTrainValidationSplit(OpValidator):
+    """single split (≙ OpTrainValidationSplit); default 75/25."""
+
+    validation_type = "TrainValidationSplit"
+
+    def __init__(self, train_ratio: float = 0.75,
+                 evaluator: Optional[OpEvaluatorBase] = None, seed: int = 42,
+                 stratify: bool = False, parallelism: int = 8):
+        super().__init__(evaluator, seed, stratify, parallelism)
+        self.train_ratio = float(train_ratio)
+
+    def splits(self, y: np.ndarray):
+        n = len(y)
+        rng = np.random.default_rng(self.seed)
+        perm = self._stratified_perm(y, rng) if self.stratify else rng.permutation(n)
+        n_tr = int(round(n * self.train_ratio))
+        return [(perm[:n_tr], perm[n_tr:])]

@@ -1,0 +1,428 @@
+"""Workflow — the user-facing DAG container and fitted model (reference:
+core/src/main/scala/com/salesforce/op/OpWorkflow.scala:207,234,344,382-458,
+OpWorkflowCore.scala:52, OpWorkflowModel.scala:184-394,
+OpWorkflowModelWriter.scala:76, OpWorkflowModelReader.scala).
+
+``train`` reconstructs the stage DAG from the result features, generates raw
+data through the reader (optionally filtered by RawFeatureFilter), fits the
+DAG layer-by-layer, and returns a ``WorkflowModel`` whose transformer DAG is a
+pure column program (the reference's persist-every-K Catalyst hacks are
+unnecessary — HBM residency + XLA fusion replace them, SURVEY.md §2.6 P5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .columns import Column, ColumnBatch
+from .dag import apply_dag, compute_dag, cut_dag, dag_stages, fit_dag, fit_layer
+from .features import Feature
+from .readers.base import DataReader, Reader
+from .stages.base import Estimator, PipelineStage, Transformer, TransformerModel
+from .stages.generator import FeatureGeneratorStage
+from .stages.serialization import (feature_to_json, kind_by_name,
+                                   stage_fitted_arrays, stage_from_json,
+                                   stage_to_json)
+from .types import Prediction
+
+MODEL_JSON = "op-model.json"
+PARAMS_NPZ = "params.npz"
+
+
+class _WorkflowCore:
+    """Shared between Workflow and WorkflowModel (≙ OpWorkflowCore.scala:52)."""
+
+    def __init__(self):
+        self.reader: Optional[Reader] = None
+        self.result_features: Tuple[Feature, ...] = ()
+        self.raw_features: List[Feature] = []
+        self.blacklisted: List[Feature] = []
+        self.parameters: Dict[str, Any] = {}
+        self._input_batch: Optional[ColumnBatch] = None
+
+    # -- input wiring ------------------------------------------------------
+    def set_reader(self, reader: Reader):
+        self.reader = reader
+        return self
+
+    def set_input_records(self, records: Sequence[Dict[str, Any]],
+                          key_fn=None):
+        self.reader = DataReader(records=list(records), key_fn=key_fn)
+        return self
+
+    def set_input_batch(self, batch: ColumnBatch):
+        self._input_batch = batch
+        return self
+
+    def set_parameters(self, params: Dict[str, Any]):
+        self.parameters = dict(params)
+        return self
+
+    # -- raw data ----------------------------------------------------------
+    def generate_raw_data(self) -> ColumnBatch:
+        """≙ OpWorkflow.generateRawData:234."""
+        if self._input_batch is not None:
+            return self._input_batch
+        if self.reader is None:
+            raise ValueError("no reader or input batch set — call set_reader/"
+                             "set_input_records/set_input_batch first")
+        raw = [f for f in self.raw_features
+               if f.name not in {b.name for b in self.blacklisted}]
+        return self.reader.generate_batch(raw)
+
+    def _collect_features(self):
+        feats: Dict[str, Feature] = {}
+        for rf in self.result_features:
+            for f in rf.all_features():
+                feats[f.uid] = f
+        self.raw_features = sorted(
+            (f for f in feats.values() if f.is_raw), key=lambda f: f.name)
+        return feats
+
+
+class Workflow(_WorkflowCore):
+    """≙ OpWorkflow."""
+
+    def __init__(self):
+        super().__init__()
+        self._workflow_cv = False
+        self._raw_feature_filter = None
+        self._model_stages: Dict[str, TransformerModel] = {}
+
+    def set_result_features(self, *features: Feature) -> "Workflow":
+        """≙ setResultFeatures: reconstruct the stage DAG (OpWorkflow.scala:207)."""
+        self.result_features = tuple(features)
+        self._collect_features()
+        self._validate_stages()
+        return self
+
+    def with_workflow_cv(self) -> "Workflow":
+        """≙ withWorkflowCV (OpWorkflowCore.scala:104): refit the feature
+        stages feeding the model selector inside each CV fold."""
+        self._workflow_cv = True
+        return self
+
+    def with_raw_feature_filter(self, **kw) -> "Workflow":
+        """≙ withRawFeatureFilter (OpWorkflow.scala:538)."""
+        from .filters import RawFeatureFilter
+        self._raw_feature_filter = RawFeatureFilter(**kw)
+        return self
+
+    def with_model_stages(self, model: "WorkflowModel") -> "Workflow":
+        """≙ withModelStages (OpWorkflow.scala:471): reuse fitted stages with
+        matching uids for partial retraining."""
+        for layer in model.fitted_dag:
+            for st in layer:
+                self._model_stages[st.uid.replace("_model", "")] = st
+        return self
+
+    def _validate_stages(self):
+        """≙ OpWorkflow stage validation :277-335 — distinct uids and
+        stage-type sanity."""
+        dag = compute_dag(self.result_features)
+        seen = set()
+        for st in dag_stages(dag):
+            if st.uid in seen:
+                raise ValueError(f"duplicate stage uid {st.uid}")
+            seen.add(st.uid)
+            if not isinstance(st, (Transformer, Estimator)):
+                raise TypeError(f"stage {st} is neither Transformer nor Estimator")
+
+    # -- training ----------------------------------------------------------
+    def train(self) -> "WorkflowModel":
+        """≙ OpWorkflow.train:344."""
+        batch = self.generate_raw_data()
+        rff_results = None
+        if self._raw_feature_filter is not None:
+            batch, dropped, rff_results = self._raw_feature_filter.filter_batch(
+                batch, self.raw_features)
+            self.blacklisted = dropped
+        dag = compute_dag(self.result_features)
+        if self._workflow_cv:
+            batch, fitted_dag = self._fit_with_workflow_cv(batch, dag)
+        else:
+            batch, fitted_dag = self._fit_plain(batch, dag)
+        model = WorkflowModel(
+            result_features=self.result_features,
+            fitted_dag=fitted_dag,
+            raw_features=self.raw_features,
+            blacklisted=self.blacklisted,
+            parameters=self.parameters,
+            rff_results=rff_results)
+        model.reader = self.reader
+        model.train_batch = batch
+        return model
+
+    def _fit_plain(self, batch, dag):
+        fitted_dag = []
+        for layer in dag:
+            new_layer = []
+            for st in layer:
+                if st.uid in self._model_stages:
+                    new_layer.append(self._model_stages[st.uid])
+                else:
+                    new_layer.append(st)
+            batch, fitted = fit_layer(batch, new_layer)
+            fitted_dag.append(fitted)
+        return batch, fitted_dag
+
+    def _fit_with_workflow_cv(self, batch, dag):
+        """≙ OpWorkflow.fitStages workflow-CV branch :411-457: cut the DAG at
+        the model selector, fit 'before' once, refit 'during' inside each fold."""
+        from .selector import ModelSelector
+        selector = None
+        for st in dag_stages(dag):
+            if isinstance(st, ModelSelector):
+                selector = st
+                break
+        if selector is None:
+            return self._fit_plain(batch, dag)
+        before, during, after = cut_dag(dag, selector)
+        fitted_dag = []
+        for layer in before:
+            batch, fitted = fit_layer(batch, layer)
+            fitted_dag.append(fitted)
+        # 'during' estimators are refit per fold by the validator, then once
+        # on the full data for the final model
+        for layer in after:
+            new_layer = []
+            for st in layer:
+                if st is selector:
+                    # fit remaining 'during' stages on the full data first
+                    b2 = batch
+                    during_fitted = []
+                    for dl in during:
+                        b2, f2 = fit_layer(b2, dl)
+                        during_fitted.append(f2)
+                    model = selector.fit(b2, in_fold_dag=during)
+                    fitted_dag.extend(during_fitted)
+                    batch = b2
+                    new_layer.append(model)
+                    batch = model.transform_batch(batch)
+                else:
+                    if isinstance(st, Estimator):
+                        m = st.fit(batch)
+                    else:
+                        m = st
+                    batch = m.transform_batch(batch)
+                    new_layer.append(m)
+            fitted_dag.append(new_layer)
+        return batch, fitted_dag
+
+    # -- loading -----------------------------------------------------------
+    @staticmethod
+    def load_model(path: str) -> "WorkflowModel":
+        return WorkflowModel.load(path)
+
+
+class WorkflowModel(_WorkflowCore):
+    """≙ OpWorkflowModel: the fitted DAG."""
+
+    def __init__(self, result_features: Sequence[Feature] = (),
+                 fitted_dag: Optional[List[List[Transformer]]] = None,
+                 raw_features: Sequence[Feature] = (),
+                 blacklisted: Sequence[Feature] = (),
+                 parameters: Optional[Dict[str, Any]] = None,
+                 rff_results=None):
+        super().__init__()
+        self.result_features = tuple(result_features)
+        self.fitted_dag = fitted_dag or []
+        self.raw_features = list(raw_features)
+        self.blacklisted = list(blacklisted)
+        self.parameters = dict(parameters or {})
+        self.rff_results = rff_results
+        self.train_batch: Optional[ColumnBatch] = None
+
+    # -- access ------------------------------------------------------------
+    @property
+    def stages(self) -> List[Transformer]:
+        return [s for layer in self.fitted_dag for s in layer]
+
+    def get_stage(self, uid: str) -> Transformer:
+        for s in self.stages:
+            if s.uid == uid or s.uid == uid + "_model":
+                return s
+        raise KeyError(uid)
+
+    @property
+    def selected_model(self):
+        from .selector import SelectedModel
+        for s in self.stages:
+            if isinstance(s, SelectedModel):
+                return s
+        return None
+
+    # -- scoring -----------------------------------------------------------
+    def score(self, batch: Optional[ColumnBatch] = None,
+              keep_raw_features: bool = False,
+              keep_intermediate_features: bool = False) -> ColumnBatch:
+        """≙ OpWorkflowModel.score:255 — apply the whole fitted transformer
+        DAG and return the result-feature columns."""
+        if batch is None:
+            batch = self.generate_raw_data()
+        scored = apply_dag(batch, self.fitted_dag)
+        names = [f.name for f in self.result_features if f.name in scored]
+        if keep_intermediate_features:
+            return scored
+        keep = list(names)
+        if keep_raw_features:
+            keep = [f.name for f in self.raw_features if f.name in scored] + keep
+        if "key" in scored:
+            keep = ["key"] + keep
+        return scored.select([n for n in dict.fromkeys(keep)])
+
+    def score_fn(self):
+        """≙ scoreFn: returns a callable batch → scored batch with the DAG
+        precomputed."""
+        return lambda batch: self.score(batch)
+
+    def evaluate(self, evaluator, label_feature: Optional[Feature] = None,
+                 batch: Optional[ColumnBatch] = None) -> Dict[str, Any]:
+        """≙ OpWorkflowModel.evaluate:320."""
+        if batch is None:
+            batch = self.generate_raw_data()
+        scored = apply_dag(batch, self.fitted_dag)
+        label = label_feature or next(
+            f for f in self.raw_features if f.is_response)
+        pred_f = next(f for f in self.result_features
+                      if f.kind is Prediction or
+                      (f.name in scored and isinstance(scored[f.name].values, dict)))
+        y = np.asarray(scored[label.name].values, dtype=np.float64)
+        pred_col = scored[pred_f.name]
+        pred = {k: np.asarray(v) for k, v in pred_col.values.items()}
+        for opt in ("probability", "rawPrediction"):
+            pred.setdefault(opt, None)
+        return evaluator.evaluate_all(y, pred).to_json()
+
+    def score_and_evaluate(self, evaluator, **kw):
+        return self.score(**kw), self.evaluate(evaluator)
+
+    def compute_data_up_to(self, feature: Feature,
+                           batch: Optional[ColumnBatch] = None) -> ColumnBatch:
+        """≙ computeDataUpTo (OpWorkflowCore.scala:299)."""
+        if batch is None:
+            batch = self.generate_raw_data()
+        return apply_dag(batch, self.fitted_dag, up_to_feature=feature)
+
+    # -- insights ----------------------------------------------------------
+    def summary(self) -> Dict[str, Any]:
+        """≙ OpWorkflowModel.summary: ModelInsights JSON."""
+        from .insights import ModelInsights
+        return ModelInsights.extract(self).to_json()
+
+    def summary_pretty(self) -> str:
+        from .insights import ModelInsights
+        return ModelInsights.extract(self).pretty()
+
+    # -- persistence (≙ OpWorkflowModelWriter.toJson) -----------------------
+    def save(self, path: str, overwrite: bool = True):
+        os.makedirs(path, exist_ok=True)
+        all_feats: Dict[str, Feature] = {}
+        for rf in self.result_features:
+            for f in rf.all_features():
+                all_feats[f.uid] = f
+        stages_json, arrays = [], {}
+        for layer_i, layer in enumerate(self.fitted_dag):
+            for st in layer:
+                d = stage_to_json(st)
+                d["layer"] = layer_i
+                d["outputFeatures"] = [f.uid for f in st.output_features]
+                stages_json.append(d)
+                arrays.update(stage_fitted_arrays(st))
+        # raw generator stages (for schema/lineage)
+        raw_json = []
+        for f in self.raw_features:
+            st = f.origin_stage
+            if isinstance(st, FeatureGeneratorStage):
+                raw_json.append({"uid": st.uid, "name": st.name,
+                                 "type": f.kind.__name__,
+                                 "isResponse": f.is_response,
+                                 "outputFeature": f.uid})
+        manifest = {
+            "uid": "OpWorkflowModel",
+            "resultFeaturesUids": [f.uid for f in self.result_features],
+            "blacklistedFeaturesUids": [f.uid for f in self.blacklisted],
+            "rawFeatures": raw_json,
+            "allFeatures": [feature_to_json(f) for f in all_feats.values()],
+            "stages": stages_json,
+            "parameters": self.parameters,
+            "rawFeatureFilterResults": (
+                self.rff_results.to_json() if self.rff_results is not None else None),
+        }
+        with open(os.path.join(path, MODEL_JSON), "w") as fh:
+            json.dump(manifest, fh, indent=2, default=str)
+        np.savez_compressed(os.path.join(path, PARAMS_NPZ), **arrays)
+
+    @staticmethod
+    def load(path: str) -> "WorkflowModel":
+        """≙ OpWorkflowModelReader: stages → features → model."""
+        with open(os.path.join(path, MODEL_JSON)) as fh:
+            manifest = json.load(fh)
+        npz_path = os.path.join(path, PARAMS_NPZ)
+        arrays = dict(np.load(npz_path, allow_pickle=False)) \
+            if os.path.exists(npz_path) else {}
+
+        # 1. rebuild stages
+        stages_by_uid: Dict[str, PipelineStage] = {}
+        layers: Dict[int, List[PipelineStage]] = {}
+        for d in manifest["stages"]:
+            st = stage_from_json(d, arrays)
+            stages_by_uid[d["uid"]] = st
+            layers.setdefault(d["layer"], []).append(st)
+        # raw feature generators
+        raw_gens: Dict[str, FeatureGeneratorStage] = {}
+        for d in manifest["rawFeatures"]:
+            gen = FeatureGeneratorStage(
+                name=d["name"], kind=kind_by_name(d["type"]), uid=d["uid"])
+            raw_gens[d["uid"]] = gen
+
+        # 2. rebuild features
+        feats: Dict[str, Feature] = {}
+        feat_json = {d["uid"]: d for d in manifest["allFeatures"]}
+
+        def build_feature(uid: str) -> Feature:
+            if uid in feats:
+                return feats[uid]
+            d = feat_json[uid]
+            parents = tuple(build_feature(p) for p in d.get("parents", ()))
+            origin = None
+            if d.get("originStage"):
+                origin = (stages_by_uid.get(d["originStage"])
+                          or raw_gens.get(d["originStage"]))
+            f = Feature(d["name"], kind_by_name(d["type"]), d["isResponse"],
+                        origin, parents, uid=uid)
+            feats[uid] = f
+            return f
+
+        for uid in feat_json:
+            build_feature(uid)
+
+        # 3. wire stage inputs/outputs
+        for d in manifest["stages"]:
+            st = stages_by_uid[d["uid"]]
+            st.input_features = tuple(feats[u] for u in d["inputFeatures"])
+            outs = tuple(feats[u] for u in d.get("outputFeatures", ()))
+            if outs:
+                st._output = outs[0] if len(outs) == 1 else outs
+                for f in outs:
+                    f.origin_stage = st
+        for d in manifest["rawFeatures"]:
+            gen = raw_gens[d["uid"]]
+            f = feats[d["outputFeature"]]
+            gen._output = f
+            f.origin_stage = gen
+
+        fitted_dag = [layers[i] for i in sorted(layers)]
+        model = WorkflowModel(
+            result_features=tuple(feats[u] for u in manifest["resultFeaturesUids"]),
+            fitted_dag=fitted_dag,
+            raw_features=[f for f in feats.values() if f.is_raw and
+                          f.origin_stage is not None],
+            blacklisted=[feats[u] for u in manifest.get("blacklistedFeaturesUids", ())
+                         if u in feats],
+            parameters=manifest.get("parameters") or {})
+        return model

@@ -1,0 +1,125 @@
+"""Headline benchmark: AutoML ModelSelector CV-grid training wall-clock on a
+HIGGS-like synthetic binary task (BASELINE.md north star).
+
+Workload (fixed across rounds for comparability):
+  N=1,000,000 rows x D=28 features (HIGGS dimensionality), 3-fold CV over
+  {4 logistic-regression, 1 random-forest, 1 GBT} candidates through the real
+  Workflow/ModelSelector API, then final refit + train evaluation — i.e. the
+  equivalent of the reference's ``OpWorkflow.train()`` with
+  BinaryClassificationModelSelector (README.md:33-64).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": ratio}
+
+vs_baseline: ratio of the reference baseline wall to ours (>1 = we are
+faster).  The reference publishes no numbers (BASELINE.md); until a measured
+Spark-local wall exists in BASELINE.json["published"]["higgs1m_train_wall_s"],
+vs_baseline is reported as 1.0.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def make_data(n: int, d: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    # nonlinear decision surface so trees have something to find
+    logits = X @ w + 0.8 * (X[:, 0] * X[:, 1]) - 0.5 * (X[:, 2] ** 2) + 0.3
+    y = (logits + rng.normal(size=n).astype(np.float32) > 0).astype(np.float32)
+    return X, y
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    N = 1_000_000 if on_accel else 100_000
+    D = 28
+
+    from transmogrifai_tpu.columns import Column, ColumnBatch
+    from transmogrifai_tpu.evaluators import Evaluators
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.models.trees import OpGBTClassifier, OpRandomForestClassifier
+    from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                            ModelCandidate, grid)
+    from transmogrifai_tpu.types import OPVector, RealNN
+    from transmogrifai_tpu.vector_meta import VectorColumnMeta, VectorMeta
+    from transmogrifai_tpu.workflow import Workflow
+
+    X, y = make_data(N, D)
+
+    label = FeatureBuilder.RealNN("label").as_response()
+    feats = [FeatureBuilder.RealNN(f"f{i}").as_predictor() for i in range(D)]
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    fv = transmogrify(feats)
+    checked = label.sanity_check(fv, remove_bad_features=True)
+
+    models = [
+        ModelCandidate(OpLogisticRegression(),
+                       grid(reg_param=[0.001, 0.01, 0.1, 0.2],
+                            elastic_net_param=[0.1], max_iter=[50]),
+                       "OpLogisticRegression"),
+        ModelCandidate(OpRandomForestClassifier(),
+                       grid(num_trees=[20], max_depth=[6],
+                            min_instances_per_node=[10]),
+                       "OpRandomForestClassifier"),
+        ModelCandidate(OpGBTClassifier(),
+                       grid(max_iter=[20], max_depth=[3],
+                            min_instances_per_node=[10]),
+                       "OpGBTClassifier"),
+    ]
+    selector = BinaryClassificationModelSelector(models=models)
+    selector.set_input(label, checked)
+    pred = selector.get_output()
+
+    cols = {"label": Column(RealNN, y)}
+    for i in range(D):
+        cols[f"f{i}"] = Column(RealNN, X[:, i])
+    batch = ColumnBatch(cols, N)
+
+    wf = Workflow().set_input_batch(batch).set_result_features(pred)
+
+    t0 = time.time()
+    model = wf.train()
+    wall = time.time() - t0
+
+    metrics = model.evaluate(Evaluators.BinaryClassification.auROC(),
+                             batch=batch)
+
+    baseline = None
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BASELINE.json")) as fh:
+            baseline = (json.load(fh).get("published") or {}).get(
+                "higgs1m_train_wall_s")
+    except Exception:
+        pass
+    vs = (baseline / wall) if baseline else 1.0
+
+    result = {
+        "metric": f"OpWorkflow.train wall (HIGGS-like {N}x{D}, 3-fold CV, "
+                  f"6 candidates, {platform})",
+        "value": round(wall, 2),
+        "unit": "s",
+        "vs_baseline": round(vs, 3),
+        "aux": {
+            "train_auroc": round(float(metrics["AuROC"]), 4),
+            "best_model": model.selected_model.summary.best_model_name,
+            "rows": N, "features": D, "platform": platform,
+            "cv_fits": 3 * 6,
+            "cv_fit_rows_per_s": round(3 * 6 * (2 * N / 3) / wall),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,21 +1,35 @@
 """Test environment: run the full XLA stack on a host-simulated 8-device CPU
-mesh (≙ the reference's local[2] Spark sessions in TestSparkContext.scala:50 —
-real engine, small local cluster)."""
+mesh (≙ the reference's local[2] Spark sessions in TestSparkContext.scala:36,50 —
+real engine, small local cluster).
+
+The container's sitecustomize registers the axon TPU plugin and forces
+``jax_platforms="axon,cpu"``; a plain JAX_PLATFORMS env var is overridden, so
+we update the config explicitly after import."""
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
-import pytest
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def eight_device_mesh():
+    from transmogrifai_tpu.parallel import make_mesh
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return make_mesh(8, model_parallel=2)

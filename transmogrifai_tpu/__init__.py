@@ -22,16 +22,38 @@ __all__ = [
     "features_from_schema", "PipelineStage", "Transformer", "Estimator",
     "TransformerModel", "FeatureGeneratorStage", "VectorMeta",
     "VectorColumnMeta", "MonoidAggregator", "CustomMonoidAggregator",
+    # lazy (heavy) exports, see __getattr__:
+    "Workflow", "WorkflowModel", "BinaryClassificationModelSelector",
+    "MultiClassificationModelSelector", "RegressionModelSelector",
+    "Evaluators", "OpParams", "OpWorkflowRunner", "OpApp", "RunType",
+    "ModelInsights", "RecordInsightsLOCO", "RawFeatureFilter",
+    "score_function", "transmogrify",
 ]
+
+_LAZY = {
+    "Workflow": ("workflow", "Workflow"),
+    "WorkflowModel": ("workflow", "WorkflowModel"),
+    "BinaryClassificationModelSelector": ("selector", "BinaryClassificationModelSelector"),
+    "MultiClassificationModelSelector": ("selector", "MultiClassificationModelSelector"),
+    "RegressionModelSelector": ("selector", "RegressionModelSelector"),
+    "Evaluators": ("evaluators", "Evaluators"),
+    "OpParams": ("params", "OpParams"),
+    "OpWorkflowRunner": ("runner", "OpWorkflowRunner"),
+    "OpApp": ("runner", "OpApp"),
+    "RunType": ("runner", "RunType"),
+    "ModelInsights": ("insights", "ModelInsights"),
+    "RecordInsightsLOCO": ("record_insights", "RecordInsightsLOCO"),
+    "RawFeatureFilter": ("filters", "RawFeatureFilter"),
+    "score_function": ("local", "score_function"),
+    "transmogrify": ("ops.transmogrify", "transmogrify"),
+}
 
 
 def __getattr__(name):
     # Lazy imports of heavier submodules to keep `import transmogrifai_tpu` fast.
-    if name in ("Workflow", "WorkflowModel"):
-        from .workflow import Workflow, WorkflowModel
-        return {"Workflow": Workflow, "WorkflowModel": WorkflowModel}[name]
-    if name in ("BinaryClassificationModelSelector",
-                "MultiClassificationModelSelector", "RegressionModelSelector"):
-        from . import selector
-        return getattr(selector, name)
+    if name in _LAZY:
+        import importlib
+        mod_name, attr = _LAZY[name]
+        mod = importlib.import_module(f".{mod_name}", __name__)
+        return getattr(mod, attr)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

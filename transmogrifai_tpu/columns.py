@@ -130,6 +130,20 @@ def column_from_values(kind: Type[FeatureType], values: Iterable) -> Column:
         return numeric_column(kind, values)
     if is_text_kind(kind):
         return text_column(kind, values)
+    if issubclass(kind, OPVector):
+        vals = [np.asarray(v.value if isinstance(v, OPVector) else v,
+                           dtype=np.float32)
+                for v in values if v is not None and not (
+                    isinstance(v, (list, tuple)) and len(v) == 0)]
+        rows = list(values)
+        dim = len(vals[0]) if vals else 0
+        arr = np.zeros((len(rows), dim), dtype=np.float32)
+        for i, v in enumerate(rows):
+            data = v.value if isinstance(v, OPVector) else v
+            if data is None or len(data) == 0:
+                continue  # missing vector → zero row (lenient, like fills)
+            arr[i, :] = np.asarray(data, dtype=np.float32)
+        return Column(OPVector, arr)
     return object_column(kind, values)
 
 

@@ -1,0 +1,235 @@
+"""RawFeatureFilter — pre-training data hygiene (reference:
+core/src/main/scala/com/salesforce/op/filters/RawFeatureFilter.scala:137-486,
+FeatureDistribution.scala:58 with fillRate:94, jsDivergence,
+relativeFillRate/Ratio; results in RawFeatureFilterResults.scala).
+
+Computes per-raw-feature fill rates and value histograms on the training data
+(and optionally a scoring set), then drops features whose fill rate is too
+low, whose train/score fill rates diverge, whose distributions diverge
+(Jensen-Shannon), or whose null pattern correlates with the label.  Histogram
+reductions are vectorised; text features hash into bins like the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .columns import Column, ColumnBatch
+from .features import Feature
+from .types import is_map_kind, is_numeric_kind, is_text_kind
+
+
+@dataclass
+class FeatureDistribution:
+    """≙ FeatureDistribution.scala:58."""
+
+    name: str
+    key: Optional[str] = None           # map key (map features expand per key)
+    count: int = 0
+    nulls: int = 0
+    distribution: np.ndarray = field(default_factory=lambda: np.zeros(0))
+    summary: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def fill_rate(self) -> float:
+        """≙ fillRate:94."""
+        return 0.0 if self.count == 0 else 1.0 - self.nulls / self.count
+
+    def relative_fill_rate(self, other: "FeatureDistribution") -> float:
+        return abs(self.fill_rate - other.fill_rate)
+
+    def relative_fill_ratio(self, other: "FeatureDistribution") -> float:
+        a, b = self.fill_rate, other.fill_rate
+        mn, mx = min(a, b), max(a, b)
+        return float("inf") if mn == 0 else mx / mn
+
+    def js_divergence(self, other: "FeatureDistribution") -> float:
+        """Jensen-Shannon divergence of the binned distributions."""
+        p, q = self.distribution, other.distribution
+        if p.size == 0 or q.size == 0 or p.size != q.size:
+            return 0.0
+        ps, qs = p.sum(), q.sum()
+        if ps == 0 or qs == 0:
+            return 0.0
+        p = p / ps
+        q = q / qs
+        m = 0.5 * (p + q)
+
+        def kl(a, b):
+            mask = a > 0
+            return float(np.sum(a[mask] * np.log2(a[mask] / b[mask])))
+
+        return 0.5 * kl(p, m) + 0.5 * kl(q, m)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"name": self.name, "key": self.key, "count": self.count,
+                "nulls": self.nulls, "fillRate": self.fill_rate,
+                "distribution": self.distribution.tolist(),
+                "summary": self.summary}
+
+
+def _value_presence(col: Column) -> np.ndarray:
+    if col.is_host_object():
+        return np.array([v is not None and v != "" and v != [] and v != {}
+                         for v in col.values])
+    if col.mask is not None:
+        return np.asarray(col.mask)
+    return np.ones(len(col), dtype=bool)
+
+
+def compute_distribution(feature: Feature, col: Column, bins: int,
+                         text_bins: int) -> List[FeatureDistribution]:
+    """Per-feature histogram(s).  Maps expand per key (≙ PreparedFeatures)."""
+    n = len(col)
+    present = _value_presence(col)
+    out = []
+    kind = feature.kind
+    if is_map_kind(kind):
+        keys = sorted({k for m in col.values if m for k in m})
+        for k in keys:
+            vals = [m.get(k) if m else None for m in col.values]
+            sub_present = np.array([v is not None for v in vals])
+            dist = _histogram_of(vals, sub_present, kind, bins, text_bins)
+            out.append(FeatureDistribution(
+                feature.name, key=k, count=n,
+                nulls=int((~sub_present).sum()), distribution=dist))
+        if not keys:
+            out.append(FeatureDistribution(feature.name, count=n, nulls=n,
+                                           distribution=np.zeros(bins)))
+        return out
+    dist = _histogram_of(list(np.asarray(col.values, dtype=object))
+                         if col.is_host_object() else np.asarray(col.values),
+                         present, kind, bins, text_bins)
+    out.append(FeatureDistribution(feature.name, count=n,
+                                   nulls=int((~present).sum()),
+                                   distribution=dist))
+    return out
+
+
+def _histogram_of(vals, present: np.ndarray, kind, bins: int,
+                  text_bins: int) -> np.ndarray:
+    if is_numeric_kind(kind):
+        arr = np.asarray(
+            [float(v) if (v is not None and not isinstance(v, str)) else np.nan
+             for v in vals] if isinstance(vals, list) else vals,
+            dtype=np.float64)
+        arr = arr[present & np.isfinite(arr)]
+        if arr.size == 0:
+            return np.zeros(bins)
+        lo, hi = float(arr.min()), float(arr.max())
+        if lo == hi:
+            hi = lo + 1.0
+        h, _ = np.histogram(arr, bins=bins, range=(lo, hi))
+        return h.astype(np.float64)
+    # text-ish: hash values into text_bins (≙ text hashed into bins)
+    h = np.zeros(text_bins)
+    for v, p in zip(vals, present):
+        if not p or v is None:
+            continue
+        for item in (v if isinstance(v, (list, set, tuple)) else [v]):
+            h[hash(str(item)) % text_bins] += 1.0
+    return h
+
+
+@dataclass
+class RawFeatureFilterResults:
+    """≙ RawFeatureFilterResults."""
+
+    train_distributions: List[FeatureDistribution] = field(default_factory=list)
+    score_distributions: List[FeatureDistribution] = field(default_factory=list)
+    dropped: List[str] = field(default_factory=list)
+    dropped_map_keys: Dict[str, List[str]] = field(default_factory=dict)
+    reasons: Dict[str, List[str]] = field(default_factory=dict)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "rawFeatureDistributions": [d.to_json() for d in self.train_distributions],
+            "scoringFeatureDistributions": [d.to_json() for d in self.score_distributions],
+            "featuresDropped": self.dropped,
+            "mapKeysDropped": self.dropped_map_keys,
+            "exclusionReasons": self.reasons,
+        }
+
+
+class RawFeatureFilter:
+    """≙ RawFeatureFilter.scala: configurable thresholds, train + optional
+    scoring reader."""
+
+    def __init__(self, min_fill_rate: float = 0.001,
+                 max_fill_difference: float = 0.9,
+                 max_fill_ratio_diff: float = 20.0,
+                 max_js_divergence: float = 0.9,
+                 max_correlation: float = 0.95,
+                 bins: int = 100, text_bins: int = 255,
+                 score_reader=None, protected_features: Sequence[str] = ()):
+        self.min_fill_rate = float(min_fill_rate)
+        self.max_fill_difference = float(max_fill_difference)
+        self.max_fill_ratio_diff = float(max_fill_ratio_diff)
+        self.max_js_divergence = float(max_js_divergence)
+        self.max_correlation = float(max_correlation)
+        self.bins = int(bins)
+        self.text_bins = int(text_bins)
+        self.score_reader = score_reader
+        self.protected = set(protected_features)
+
+    def filter_batch(self, batch: ColumnBatch, raw_features: Sequence[Feature]
+                     ) -> Tuple[ColumnBatch, List[Feature], RawFeatureFilterResults]:
+        """≙ generateFilteredRaw:486: returns (clean batch, dropped features,
+        results)."""
+        results = RawFeatureFilterResults()
+        dists: Dict[str, List[FeatureDistribution]] = {}
+        label_values: Optional[np.ndarray] = None
+        label_name = next((f.name for f in raw_features if f.is_response), None)
+        if label_name and label_name in batch:
+            label_values = np.asarray(batch[label_name].values, dtype=np.float64)
+
+        score_batch = None
+        if self.score_reader is not None:
+            score_batch = self.score_reader.generate_batch(
+                [f for f in raw_features if not f.is_response])
+
+        for f in raw_features:
+            if f.name not in batch or f.is_response:
+                continue
+            fdists = compute_distribution(f, batch[f.name], self.bins, self.text_bins)
+            dists[f.name] = fdists
+            results.train_distributions.extend(fdists)
+            reasons: List[str] = []
+            if f.name in self.protected:
+                continue
+            train_d = fdists[0]
+            # minimum fill rate (≙ minFill)
+            if all(d.fill_rate < self.min_fill_rate for d in fdists):
+                reasons.append(
+                    f"fill rate {train_d.fill_rate:.4f} < minFillRate")
+            # null-label correlation (leakage through missingness)
+            if label_values is not None and len(np.unique(label_values)) > 1:
+                presence = _value_presence(batch[f.name]).astype(np.float64)
+                if presence.std() > 0:
+                    corr = float(np.corrcoef(presence, label_values)[0, 1])
+                    if np.isfinite(corr) and abs(corr) > self.max_correlation:
+                        reasons.append(
+                            f"null-label correlation {corr:.4f} > max")
+            # train-vs-score distribution shift
+            if score_batch is not None and f.name in score_batch:
+                sdists = compute_distribution(
+                    f, score_batch[f.name], self.bins, self.text_bins)
+                results.score_distributions.extend(sdists)
+                sd = sdists[0]
+                if train_d.relative_fill_rate(sd) > self.max_fill_difference:
+                    reasons.append("fill rate difference train/score too large")
+                if train_d.relative_fill_ratio(sd) > self.max_fill_ratio_diff:
+                    reasons.append("fill rate ratio train/score too large")
+                js = train_d.js_divergence(sd)
+                if js > self.max_js_divergence:
+                    reasons.append(f"JS divergence {js:.4f} > max")
+            if reasons:
+                results.dropped.append(f.name)
+                results.reasons[f.name] = reasons
+
+        dropped_features = [f for f in raw_features if f.name in set(results.dropped)]
+        clean = batch.drop(results.dropped)
+        return clean, dropped_features, results

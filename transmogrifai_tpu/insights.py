@@ -1,0 +1,196 @@
+"""ModelInsights — the model explainability report (reference:
+core/src/main/scala/com/salesforce/op/ModelInsights.scala:74-392,
+extractFromStages:440) and the ASCII ``summaryPretty`` rendering
+(utils/table/Table.scala).
+
+Walks the fitted DAG, collecting per-derived-feature contributions,
+label correlations / variances from the SanityChecker metadata, the selected
+model summary + validation results, and the label profile.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .utils.table import render_table
+
+
+@dataclass
+class FeatureInsights:
+    feature_name: str
+    feature_type: str = ""
+    derived_columns: List[Dict[str, Any]] = field(default_factory=list)
+
+    def max_contribution(self) -> float:
+        vals = [abs(c.get("contribution") or 0.0) for c in self.derived_columns]
+        return max(vals) if vals else 0.0
+
+    def max_abs_correlation(self) -> float:
+        vals = [abs(c["corr"]) for c in self.derived_columns
+                if c.get("corr") is not None and np.isfinite(c["corr"])]
+        return max(vals) if vals else float("nan")
+
+
+@dataclass
+class ModelInsights:
+    """≙ ModelInsights.scala:74."""
+
+    label: Dict[str, Any] = field(default_factory=dict)
+    features: List[FeatureInsights] = field(default_factory=list)
+    selected_model: Dict[str, Any] = field(default_factory=dict)
+    problem_type: str = ""
+    stage_info: Dict[str, Any] = field(default_factory=dict)
+
+    # -- extraction (≙ extractFromStages:440) -----------------------------
+    @staticmethod
+    def extract(workflow_model) -> "ModelInsights":
+        from .preparators.sanity_checker import SanityCheckerModel
+        from .selector import SelectedModel
+
+        ins = ModelInsights()
+        sel: Optional[SelectedModel] = workflow_model.selected_model
+        checker = next((s for s in workflow_model.stages
+                        if isinstance(s, SanityCheckerModel)), None)
+
+        # label profile
+        resp = next((f for f in workflow_model.raw_features if f.is_response), None)
+        if resp is not None:
+            ins.label = {"labelName": resp.name, "rawFeatureName": [resp.name],
+                         "rawFeatureType": [resp.kind.__name__]}
+            if workflow_model.train_batch is not None and resp.name in workflow_model.train_batch:
+                y = np.asarray(workflow_model.train_batch[resp.name].values,
+                               dtype=np.float64)
+                vals, counts = np.unique(y, return_counts=True)
+                ins.label.update({
+                    "sampleSize": int(len(y)),
+                    "distinctCount": int(len(vals)),
+                    "mean": float(y.mean()) if len(y) else 0.0,
+                })
+                if len(vals) <= 30:
+                    ins.label["distribution"] = {
+                        str(v): int(c) for v, c in zip(vals, counts)}
+
+        # per-derived-column insights from SanityChecker summary + model coefs
+        contributions = _model_contributions(sel)
+        by_parent: Dict[str, FeatureInsights] = {}
+        if checker is not None and "summary" in checker.metadata:
+            s = checker.metadata["summary"]
+            names = s.get("names", [])
+            corrs = s.get("correlationsWithLabel", [])
+            variances = s.get("variances", [])
+            dropped = set(s.get("dropped", []))
+            reasons = s.get("dropReasons", {})
+            # the checker records its input vector meta for lineage
+            meta = None
+            if "input_vector_meta" in checker.metadata:
+                from .vector_meta import VectorMeta
+                meta = VectorMeta.from_json(checker.metadata["input_vector_meta"])
+            kept_pos = 0
+            for i, name in enumerate(names):
+                col_meta = (meta.columns[i] if meta is not None
+                            and i < len(meta.columns) else None)
+                parent = col_meta.parent_feature_name if col_meta else name.rsplit("_", 1)[0]
+                fi = by_parent.setdefault(parent, FeatureInsights(
+                    parent, col_meta.parent_feature_type if col_meta else ""))
+                is_dropped = name in dropped
+                contribution = None
+                if not is_dropped and kept_pos < len(contributions):
+                    contribution = contributions[kept_pos]
+                if not is_dropped:
+                    kept_pos += 1
+                fi.derived_columns.append({
+                    "name": name,
+                    "corr": corrs[i] if i < len(corrs) else None,
+                    "variance": variances[i] if i < len(variances) else None,
+                    "dropped": is_dropped,
+                    "dropReasons": reasons.get(name, []),
+                    "contribution": contribution,
+                    "indicatorValue": col_meta.indicator_value if col_meta else None,
+                    "grouping": col_meta.grouping if col_meta else None,
+                })
+        ins.features = sorted(by_parent.values(),
+                              key=lambda f: -f.max_contribution())
+
+        if sel is not None:
+            if sel.summary is not None:
+                ins.selected_model = sel.summary.to_json()
+                ins.problem_type = sel.summary.problem_type
+            elif "summary" in sel.metadata:  # reloaded model: summary persisted
+                ins.selected_model = sel.metadata["summary"]
+                ins.problem_type = ins.selected_model.get("problemType", "")
+        return ins
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "label": self.label,
+            "features": [{
+                "featureName": f.feature_name,
+                "featureType": f.feature_type,
+                "derivedFeatures": f.derived_columns,
+            } for f in self.features],
+            "selectedModelInfo": self.selected_model,
+            "problemType": self.problem_type,
+            "stageInfo": self.stage_info,
+        }
+
+    def pretty(self) -> str:
+        """≙ summaryPretty: ASCII tables of model evaluation + top features."""
+        out = []
+        sm = self.selected_model
+        if sm:
+            out.append(f"Selected model: {sm.get('bestModelName')} "
+                       f"({sm.get('validationType')}, metric "
+                       f"{sm.get('evaluationMetric')})")
+            rows = []
+            for r in sm.get("validationResults", [])[:20]:
+                mv = r.get("metricValues", {})
+                metric = next(iter(mv.values())) if mv else float("nan")
+                rows.append([r.get("modelName"),
+                             json.dumps(r.get("modelParameters", {}))[:48],
+                             f"{metric:.4f}" if isinstance(metric, float) else metric])
+            out.append(render_table(
+                ["Model", "Parameters", sm.get("evaluationMetric", "metric")],
+                rows, title="Model Evaluation Metrics"))
+        if self.features:
+            rows = []
+            for f in self.features[:25]:
+                rows.append([
+                    f.feature_name,
+                    f"{f.max_contribution():.4f}",
+                    ("%.4f" % f.max_abs_correlation()
+                     if np.isfinite(f.max_abs_correlation()) else "-"),
+                    str(sum(1 for c in f.derived_columns if c["dropped"])),
+                ])
+            out.append(render_table(
+                ["Top Raw Feature", "Max Contribution", "Max |Corr|", "Dropped"],
+                rows, title="Top Model Contributions"))
+        return "\n".join(out)
+
+
+def _model_contributions(sel) -> List[float]:
+    """Per-kept-column contribution of the winning model: |coef| for linear
+    models, split-gain-free occupancy for trees (feature usage counts)."""
+    if sel is None or sel.best_model is None:
+        return []
+    fitted = sel.best_model.fitted
+    if "coef" in fitted:
+        coef = np.asarray(fitted["coef"])
+        if coef.ndim == 2:
+            return np.abs(coef).max(axis=1).tolist()
+        return np.abs(coef).tolist()
+    if "feature" in fitted:  # tree ensemble: usage frequency per feature
+        feats = np.asarray(fitted["feature"]).ravel()
+        feats = feats[feats >= 0]
+        if feats.size == 0:
+            return []
+        d = int(feats.max()) + 1
+        counts = np.bincount(feats, minlength=d).astype(np.float64)
+        return (counts / counts.sum()).tolist()
+    if "log_prob" in fitted:  # naive bayes: spread of class log-probs
+        lp = np.asarray(fitted["log_prob"])
+        return np.abs(lp - lp.mean(axis=0)).max(axis=0).tolist()
+    return []

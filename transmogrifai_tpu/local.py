@@ -1,0 +1,61 @@
+"""Local scoring — engine-free single-record serving (reference: the `local`
+module, local/src/main/scala/com/salesforce/op/local/OpWorkflowModelLocal.scala:61-199,
+score function at :93; MLeap replaced by direct row-level stage application —
+our stages are their own runtime, no bundle conversion needed).
+
+``score_function(model)`` returns a closure ``dict → dict`` that applies the
+fitted DAG row-by-row with no batch engine involved: the TPU framework's
+equivalent of Spark-free MLeap serving.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+from .columns import Column, ColumnBatch, column_from_values
+from .stages.generator import FeatureGeneratorStage
+from .types import FeatureType, Prediction
+
+
+def score_function(workflow_model) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+    """≙ OpWorkflowModelLocal.scoreFunction."""
+    stages = workflow_model.stages
+    raw_features = list(workflow_model.raw_features)
+    result_names = {f.name for f in workflow_model.result_features}
+
+    def score(record: Dict[str, Any]) -> Dict[str, Any]:
+        # stage 0: raw extraction (≙ FeatureGeneratorStage extract)
+        row: Dict[str, FeatureType] = {}
+        for f in raw_features:
+            gen = f.origin_stage
+            val = (gen.extract_fn(record)
+                   if isinstance(gen, FeatureGeneratorStage)
+                   else record.get(f.name))
+            if isinstance(val, FeatureType):
+                row[f.name] = val
+            elif val is None and f.kind.non_nullable:
+                row[f.name] = f.kind(0.0)  # monoid zero (unlabeled scoring)
+            else:
+                row[f.name] = f.kind(val)
+        # fold the fitted transformer DAG row-wise (≙ transformKeyValue fold)
+        for st in stages:
+            out = st.transform_row(row)
+            feats = st.output_features
+            if isinstance(out, dict) and not isinstance(out, FeatureType):
+                row.update(out)
+            else:
+                row[feats[0].name] = out
+        result: Dict[str, Any] = {}
+        for name in result_names:
+            v = row.get(name)
+            if isinstance(v, Prediction):
+                result[name] = dict(v.value)
+            elif isinstance(v, FeatureType):
+                result[name] = v.value
+            else:
+                result[name] = v
+        return result
+
+    return score

@@ -279,7 +279,7 @@ def _forest_fitter(impurity: str, max_depth: int, n_bins: int, use_vmap: bool):
 def fit_forest(X: np.ndarray, y: np.ndarray, *, task: str, n_classes: int,
                n_trees: int, max_depth: int, max_bins: int,
                min_instances: float, min_gain: float, subsample: float,
-               feature_strategy: str, seed: int,
+               feature_strategy: str, seed: int, bootstrap: bool = True,
                sample_weight: Optional[np.ndarray] = None) -> Dict[str, Any]:
     """Random forest: all trees in one vmapped XLA program (chunked via
     lax.map when deep trees would blow HBM)."""
@@ -291,7 +291,8 @@ def fit_forest(X: np.ndarray, y: np.ndarray, *, task: str, n_classes: int,
     yj = jnp.asarray(y, jnp.float32)
     key = jax.random.PRNGKey(seed)
     k_boot, k_feat = jax.random.split(key)
-    boot = jax.random.poisson(k_boot, subsample, (n_trees, N)).astype(jnp.float32)
+    boot = (jax.random.poisson(k_boot, subsample, (n_trees, N)).astype(jnp.float32)
+            if bootstrap else jnp.ones((n_trees, N), jnp.float32))
     masks = _feature_masks(k_feat, n_trees, D, feature_strategy)
 
     if task == "classification":
@@ -414,14 +415,15 @@ class _ForestEstimatorBase(PredictorEstimator):
     def __init__(self, num_trees: int = 20, max_depth: int = 5,
                  max_bins: int = MAX_BINS_DEFAULT, min_instances_per_node: int = 1,
                  min_info_gain: float = 0.0, subsampling_rate: float = 1.0,
-                 feature_subset_strategy: str = "auto", seed: int = 42, **kw):
+                 feature_subset_strategy: str = "auto", seed: int = 42,
+                 bootstrap: bool = True, **kw):
         super().__init__(num_trees=num_trees, max_depth=max_depth,
                          max_bins=max_bins,
                          min_instances_per_node=min_instances_per_node,
                          min_info_gain=min_info_gain,
                          subsampling_rate=subsampling_rate,
                          feature_subset_strategy=feature_subset_strategy,
-                         seed=seed, **kw)
+                         seed=seed, bootstrap=bootstrap, **kw)
 
     def fit_arrays(self, X, y, sample_weight=None) -> Dict[str, Any]:
         strategy = self.get("feature_subset_strategy", "auto")
@@ -438,6 +440,7 @@ class _ForestEstimatorBase(PredictorEstimator):
             min_gain=float(self.get("min_info_gain", 0.0)),
             subsample=float(self.get("subsampling_rate", 1.0)),
             feature_strategy=strategy, seed=int(self.get("seed", 42)),
+            bootstrap=bool(self.get("bootstrap", True)),
             sample_weight=sample_weight)
 
 
@@ -454,19 +457,16 @@ class OpRandomForestRegressor(_ForestEstimatorBase):
 
 
 class OpDecisionTreeClassifier(_ForestEstimatorBase):
-    """≙ OpDecisionTreeClassifier: single unbootstrapped tree."""
+    """≙ OpDecisionTreeClassifier: a single deterministic tree — no
+    bootstrap, all features (like Spark's DecisionTreeClassifier)."""
     task = "classification"
 
     def __init__(self, max_depth: int = 5, **kw):
         kw.setdefault("num_trees", 1)
         kw.setdefault("feature_subset_strategy", "all")
         kw.setdefault("subsampling_rate", 1.0)
+        kw.setdefault("bootstrap", False)
         super().__init__(max_depth=max_depth, **kw)
-
-    def fit_arrays(self, X, y, sample_weight=None):
-        # single tree: no bootstrap → deterministic weights
-        fitted = super().fit_arrays(X, y, sample_weight)
-        return fitted
 
 
 class OpDecisionTreeRegressor(OpDecisionTreeClassifier):

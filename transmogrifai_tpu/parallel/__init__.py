@@ -1,0 +1,19 @@
+"""Distributed execution on the TPU mesh — the re-expression of the
+reference's parallelism mechanisms (SURVEY.md §2.6):
+
+  P1 row data-parallelism (Spark RDD maps)      → batch sharding over 'data'
+  P2 monoid stat reductions (Algebird)          → psum over ICI
+  P3 (model × paramMap × fold) task parallelism → vmap over candidate axis,
+                                                  sharded over 'model'
+  P7 Spark shuffle/broadcast                    → XLA collectives via GSPMD
+"""
+
+from .mesh import (candidate_sharding, data_sharding, make_mesh,
+                   replicated_sharding)
+from .dist_fit import (fit_logreg_grid_sharded, sharded_col_stats,
+                       sharded_train_step)
+
+__all__ = [
+    "make_mesh", "data_sharding", "candidate_sharding", "replicated_sharding",
+    "fit_logreg_grid_sharded", "sharded_col_stats", "sharded_train_step",
+]

@@ -1,0 +1,59 @@
+"""Device-mesh construction and sharding helpers.
+
+The mesh has two logical axes:
+  * ``data``  — rows of the feature matrix (SURVEY §2.6 P1); stat reductions
+    become psum/reduce-scatter over ICI (P2);
+  * ``model`` — CV-grid candidates (fold × hyper-parameter), the TPU
+    re-expression of the reference's thread-pool fit fan-out
+    (OpValidator.scala:320-349, P3).
+
+Multi-host: `jax.distributed` initialises the runtime; `jax.devices()` then
+spans hosts and the same mesh code rides DCN across slices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              model_parallel: int = 1,
+              axis_names: Tuple[str, str] = (DATA_AXIS, MODEL_AXIS)) -> Mesh:
+    """Build a (data × model) mesh over the first ``n_devices`` devices.
+
+    ``model_parallel`` devices are assigned to the candidate axis; the rest to
+    the data axis.  With a single device both axes have extent 1 and every
+    sharding degenerates to fully-replicated — the same program runs anywhere.
+    """
+    devs = jax.devices()
+    n = len(devs) if n_devices is None else min(n_devices, len(devs))
+    devs = devs[:n]
+    if n % model_parallel != 0:
+        raise ValueError(f"n_devices {n} not divisible by model_parallel "
+                         f"{model_parallel}")
+    arr = np.array(devs).reshape(n // model_parallel, model_parallel)
+    return Mesh(arr, axis_names)
+
+
+def data_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Shard axis 0 (rows) over 'data', replicate the rest."""
+    spec = P(DATA_AXIS, *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def candidate_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard axis 0 (grid candidates) over 'model'."""
+    spec = P(MODEL_AXIS, *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

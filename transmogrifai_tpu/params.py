@@ -1,0 +1,80 @@
+"""OpParams — JSON-loadable run configuration (reference:
+features/src/main/scala/com/salesforce/op/OpParams.scala:81, ReaderParams;
+per-stage injection OpWorkflow.setStageParameters, OpWorkflow.scala:178-199).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ReaderParams:
+    """≙ ReaderParams: per-reader path + custom params."""
+    path: Optional[str] = None
+    partitions: Optional[int] = None
+    custom: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class OpParams:
+    """≙ OpParams.scala:81."""
+
+    stage_params: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    reader_params: Dict[str, ReaderParams] = field(default_factory=dict)
+    model_location: Optional[str] = None
+    write_location: Optional[str] = None
+    metrics_location: Optional[str] = None
+    batch_size: Optional[int] = None
+    custom_tag_name: Optional[str] = None
+    custom_params: Dict[str, Any] = field(default_factory=dict)
+    collect_metrics: bool = False
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "OpParams":
+        readers = {k: ReaderParams(path=v.get("path"),
+                                   partitions=v.get("partitions"),
+                                   custom=v.get("customParams") or {})
+                   for k, v in (d.get("readerParams") or {}).items()}
+        return OpParams(
+            stage_params=d.get("stageParams") or {},
+            reader_params=readers,
+            model_location=d.get("modelLocation"),
+            write_location=d.get("writeLocation"),
+            metrics_location=d.get("metricsLocation"),
+            batch_size=d.get("batchSize"),
+            custom_tag_name=d.get("customTagName"),
+            custom_params=d.get("customParams") or {},
+            collect_metrics=bool(d.get("collectMetrics", False)))
+
+    @staticmethod
+    def load(path: str) -> "OpParams":
+        with open(path) as fh:
+            return OpParams.from_json(json.load(fh))
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "stageParams": self.stage_params,
+            "readerParams": {k: {"path": v.path, "partitions": v.partitions,
+                                 "customParams": v.custom}
+                             for k, v in self.reader_params.items()},
+            "modelLocation": self.model_location,
+            "writeLocation": self.write_location,
+            "metricsLocation": self.metrics_location,
+            "batchSize": self.batch_size,
+            "customTagName": self.custom_tag_name,
+            "customParams": self.custom_params,
+            "collectMetrics": self.collect_metrics,
+        }
+
+    def apply_stage_params(self, stages) -> None:
+        """≙ OpWorkflow.setStageParameters: match stage class simple name →
+        stage.set(param, value)."""
+        for st in stages:
+            cls_name = type(st).__name__
+            for match, params in self.stage_params.items():
+                if cls_name == match or cls_name.startswith(match):
+                    for k, v in params.items():
+                        st.set(k, v)

@@ -1,0 +1,98 @@
+"""Profiling / observability — the OpSparkListener equivalent (reference:
+utils/src/main/scala/com/salesforce/op/utils/spark/OpSparkListener.scala:62:
+per-stage executor run time, GC time, IO bytes, cumulative metrics, and
+AppMetrics delivered to completion handlers).
+
+TPU translation (SURVEY §5): per-phase wall-clock + device memory stats from
+``jax.local_devices()[0].memory_stats()``, optional ``jax.profiler`` trace
+capture, all emitted as structured JSON.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class PhaseMetrics:
+    """≙ StageMetrics (OpSparkListener.scala)."""
+    name: str
+    wall_s: float
+    device_bytes_in_use: Optional[int] = None
+    peak_bytes_in_use: Optional[int] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"name": self.name, "wallSeconds": round(self.wall_s, 4),
+                "deviceBytesInUse": self.device_bytes_in_use,
+                "peakBytesInUse": self.peak_bytes_in_use}
+
+
+@dataclass
+class AppMetrics:
+    """≙ AppMetrics (OpSparkListener.scala:146 MetricJsonLike)."""
+    app_tag: Optional[str]
+    total_wall_s: float
+    phases: List[PhaseMetrics] = field(default_factory=list)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"appTag": self.app_tag,
+                "totalWallSeconds": round(self.total_wall_s, 4),
+                "phases": [p.to_json() for p in self.phases]}
+
+    def log_pretty(self) -> str:
+        lines = [f"App metrics{f' [{self.app_tag}]' if self.app_tag else ''}: "
+                 f"{self.total_wall_s:.2f}s total"]
+        for p in self.phases:
+            mem = (f", {p.peak_bytes_in_use / 2**20:.0f} MiB peak"
+                   if p.peak_bytes_in_use else "")
+            lines.append(f"  {p.name}: {p.wall_s:.2f}s{mem}")
+        return "\n".join(lines)
+
+
+def _device_memory() -> Dict[str, Optional[int]]:
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return {"bytes_in_use": stats.get("bytes_in_use"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use")}
+    except Exception:
+        return {"bytes_in_use": None, "peak_bytes_in_use": None}
+
+
+class PhaseTimer:
+    """Collects per-phase timings; nested phases are recorded flat."""
+
+    def __init__(self):
+        self.phases: List[PhaseMetrics] = []
+        self._t0 = time.time()
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            mem = _device_memory()
+            self.phases.append(PhaseMetrics(
+                name, time.time() - t0,
+                device_bytes_in_use=mem["bytes_in_use"],
+                peak_bytes_in_use=mem["peak_bytes_in_use"]))
+
+    def app_metrics(self, tag: Optional[str] = None) -> AppMetrics:
+        return AppMetrics(tag, time.time() - self._t0, list(self.phases))
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str):
+    """Wrap a block in a jax.profiler trace (≙ the listener's event capture);
+    view with tensorboard or xprof."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -2,8 +2,9 @@ from .base import (AggregateParams, AggregateReader, ConditionalParams,
                    ConditionalReader, DataReader, JoinedReader, Reader)
 from .csv import CSVReader, infer_schema_from_records, read_csv_records
 from .factory import DataReaders
+from .streaming import StreamingReader, StreamingReaders
 
 __all__ = ["Reader", "DataReader", "AggregateReader", "ConditionalReader",
            "JoinedReader", "AggregateParams", "ConditionalParams",
            "CSVReader", "DataReaders", "infer_schema_from_records",
-           "read_csv_records"]
+           "read_csv_records", "StreamingReader", "StreamingReaders"]

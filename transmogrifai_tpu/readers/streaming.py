@@ -1,0 +1,46 @@
+"""Streaming readers — micro-batch scoring input (reference:
+readers/src/main/scala/com/salesforce/op/readers/StreamingReaders.scala and
+the DStream loop in OpWorkflowRunner.scala:225-263).
+
+``stream()`` yields raw ``ColumnBatch``es; the runner feeds each to the
+compiled score function (SURVEY §2.6 P6: host loop + async device dispatch
+replaces DStream micro-batches).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence
+
+from ..columns import ColumnBatch
+from ..features import Feature
+from .base import DataReader
+
+
+class StreamingReader(DataReader):
+    """Wraps an iterator of record micro-batches (lists of dicts)."""
+
+    def __init__(self, batches: Optional[Iterable[List[Dict[str, Any]]]] = None,
+                 batch_fn: Optional[Callable[[], Iterable[List[Dict[str, Any]]]]] = None,
+                 key_fn=None, raw_features: Sequence[Feature] = ()):
+        super().__init__(records=None, read_fn=lambda: [], key_fn=key_fn)
+        self._batches = batches
+        self._batch_fn = batch_fn
+        self.raw_features = list(raw_features)
+
+    def set_raw_features(self, feats: Sequence[Feature]) -> "StreamingReader":
+        self.raw_features = list(feats)
+        return self
+
+    def stream(self) -> Iterator[ColumnBatch]:
+        source = self._batches if self._batches is not None else self._batch_fn()
+        for records in source:
+            reader = DataReader(records=list(records), key_fn=self.key_fn)
+            yield reader.generate_batch(self.raw_features)
+
+
+class StreamingReaders:
+    """≙ StreamingReaders factory."""
+
+    @staticmethod
+    def custom(batches=None, batch_fn=None, key_fn=None) -> StreamingReader:
+        return StreamingReader(batches=batches, batch_fn=batch_fn, key_fn=key_fn)

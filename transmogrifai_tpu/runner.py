@@ -1,0 +1,240 @@
+"""OpWorkflowRunner / OpApp — run-type dispatch and CLI harness (reference:
+core/src/main/scala/com/salesforce/op/OpWorkflowRunner.scala:296-365 and
+OpApp.scala:130-213).
+
+Run types: Train / Score / StreamingScore / Features / Evaluate — the same
+five (OpWorkflowRunner.scala:358-365).  Profiling hooks replace
+OpSparkListener: per-phase wall-clock + device memory stats collected into
+``AppMetrics`` and delivered to completion callbacks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from .params import OpParams
+from .profiling import AppMetrics, PhaseTimer
+from .workflow import Workflow, WorkflowModel
+
+
+class RunType:
+    TRAIN = "train"
+    SCORE = "score"
+    STREAMING_SCORE = "streamingScore"
+    FEATURES = "features"
+    EVALUATE = "evaluate"
+
+    ALL = (TRAIN, SCORE, STREAMING_SCORE, FEATURES, EVALUATE)
+
+
+@dataclass
+class OpWorkflowRunnerResult:
+    """≙ OpWorkflowRunnerResult variants."""
+    run_type: str
+    model_summary: Optional[Dict[str, Any]] = None
+    metrics: Optional[Dict[str, Any]] = None
+    scores_location: Optional[str] = None
+    app_metrics: Optional[AppMetrics] = None
+
+
+class OpWorkflowRunner:
+    """≙ OpWorkflowRunner.scala:296."""
+
+    def __init__(self, workflow: Workflow,
+                 train_reader=None, score_reader=None,
+                 evaluator=None, evaluation_feature=None,
+                 features_to_compute=None):
+        self.workflow = workflow
+        self.train_reader = train_reader
+        self.score_reader = score_reader
+        self.evaluator = evaluator
+        self.evaluation_feature = evaluation_feature
+        self.features_to_compute = features_to_compute
+        self._completion_callbacks: List[Callable[[AppMetrics], None]] = []
+
+    def add_application_completion_handler(self, fn: Callable[[AppMetrics], None]):
+        """≙ addApplicationCompletionHandler (OpWorkflowRunner.scala:300)."""
+        self._completion_callbacks.append(fn)
+
+    # -- dispatch (≙ run:296-316) -----------------------------------------
+    def run(self, run_type: str, params: OpParams) -> OpWorkflowRunnerResult:
+        timer = PhaseTimer()
+        with timer.phase(f"run:{run_type}"):
+            if run_type == RunType.TRAIN:
+                result = self._train(params, timer)
+            elif run_type == RunType.SCORE:
+                result = self._score(params, timer)
+            elif run_type == RunType.STREAMING_SCORE:
+                result = self._streaming_score(params, timer)
+            elif run_type == RunType.FEATURES:
+                result = self._features(params, timer)
+            elif run_type == RunType.EVALUATE:
+                result = self._evaluate(params, timer)
+            else:
+                raise ValueError(f"unknown run type {run_type!r}; "
+                                 f"expected one of {RunType.ALL}")
+        metrics = timer.app_metrics(tag=params.custom_tag_name)
+        result.app_metrics = metrics
+        for cb in self._completion_callbacks:
+            cb(metrics)
+        return result
+
+    # -- run types --------------------------------------------------------
+    def _train(self, params: OpParams, timer: PhaseTimer) -> OpWorkflowRunnerResult:
+        """≙ :163-196: train, save model + summary."""
+        if self.train_reader is not None:
+            self.workflow.set_reader(self.train_reader)
+        with timer.phase("train"):
+            model = self.workflow.train()
+        summary = None
+        if params.model_location:
+            with timer.phase("save"):
+                model.save(params.model_location)
+        with timer.phase("summary"):
+            summary = model.summary()
+            if params.model_location:
+                with open(os.path.join(params.model_location,
+                                       "model-summary.json"), "w") as fh:
+                    json.dump(summary, fh, indent=2, default=str)
+        return OpWorkflowRunnerResult(RunType.TRAIN, model_summary=summary)
+
+    def _load_model(self, params: OpParams) -> WorkflowModel:
+        if not params.model_location:
+            raise ValueError("model_location is required")
+        model = WorkflowModel.load(params.model_location)
+        if self.score_reader is not None:
+            model.set_reader(self.score_reader)
+        return model
+
+    def _score(self, params: OpParams, timer: PhaseTimer) -> OpWorkflowRunnerResult:
+        """≙ :204-223: load model, score, optionally evaluate, write scores."""
+        model = self._load_model(params)
+        with timer.phase("score"):
+            scored = model.score()
+        metrics = None
+        if self.evaluator is not None:
+            with timer.phase("evaluate"):
+                metrics = model.evaluate(self.evaluator)
+        loc = params.write_location
+        if loc:
+            with timer.phase("write"):
+                os.makedirs(loc, exist_ok=True)
+                _write_scores(scored, os.path.join(loc, "scores.jsonl"))
+        if metrics is not None and params.metrics_location:
+            os.makedirs(params.metrics_location, exist_ok=True)
+            with open(os.path.join(params.metrics_location, "metrics.json"),
+                      "w") as fh:
+                json.dump(metrics, fh, indent=2, default=str)
+        return OpWorkflowRunnerResult(RunType.SCORE, metrics=metrics,
+                                      scores_location=loc)
+
+    def _streaming_score(self, params: OpParams, timer: PhaseTimer) -> OpWorkflowRunnerResult:
+        """≙ :225-263: micro-batch scoring loop over a streaming reader
+        (host loop feeding the compiled score fn, SURVEY §2.6 P6)."""
+        model = self._load_model(params)
+        if self.score_reader is None or not hasattr(self.score_reader, "stream"):
+            raise ValueError("streaming score requires a StreamingReader")
+        if hasattr(self.score_reader, "set_raw_features"):
+            self.score_reader.set_raw_features(
+                [f for f in model.raw_features if not f.is_response])
+        score_fn = model.score_fn()
+        loc = params.write_location
+        n_batches = 0
+        for i, batch in enumerate(self.score_reader.stream()):
+            with timer.phase(f"batch_{i}"):
+                scored = score_fn(batch)
+                if loc:
+                    os.makedirs(loc, exist_ok=True)
+                    _write_scores(scored, os.path.join(loc, f"scores_{i}.jsonl"))
+            n_batches += 1
+        return OpWorkflowRunnerResult(RunType.STREAMING_SCORE,
+                                      scores_location=loc,
+                                      metrics={"batches": n_batches})
+
+    def _features(self, params: OpParams, timer: PhaseTimer) -> OpWorkflowRunnerResult:
+        """≙ :265: computeDataUpTo a feature and write it."""
+        model = self._load_model(params)
+        feature = self.features_to_compute
+        with timer.phase("features"):
+            batch = model.compute_data_up_to(feature)
+        loc = params.write_location
+        if loc:
+            os.makedirs(loc, exist_ok=True)
+            _write_scores(batch, os.path.join(loc, "features.jsonl"))
+        return OpWorkflowRunnerResult(RunType.FEATURES, scores_location=loc)
+
+    def _evaluate(self, params: OpParams, timer: PhaseTimer) -> OpWorkflowRunnerResult:
+        """≙ :272-285."""
+        model = self._load_model(params)
+        with timer.phase("evaluate"):
+            metrics = model.evaluate(self.evaluator, self.evaluation_feature)
+        if params.metrics_location:
+            os.makedirs(params.metrics_location, exist_ok=True)
+            with open(os.path.join(params.metrics_location, "metrics.json"),
+                      "w") as fh:
+                json.dump(metrics, fh, indent=2, default=str)
+        return OpWorkflowRunnerResult(RunType.EVALUATE, metrics=metrics)
+
+
+def _write_scores(batch, path: str):
+    n = len(batch)
+    with open(path, "w") as fh:
+        for i in range(n):
+            row = {}
+            for name, col in batch.items():
+                if isinstance(col.values, dict):
+                    row[name] = {k: np.asarray(v)[i].tolist()
+                                 for k, v in col.values.items()}
+                else:
+                    v = np.asarray(col.values)[i]
+                    row[name] = v.tolist() if hasattr(v, "tolist") else v
+            fh.write(json.dumps(row, default=str) + "\n")
+
+
+class OpApp:
+    """≙ OpApp.scala: CLI arg parsing → runner dispatch.
+
+    Subclasses implement ``build_workflow()`` and optionally the readers.
+    """
+
+    def build_workflow(self) -> Workflow:
+        raise NotImplementedError
+
+    def make_runner(self) -> OpWorkflowRunner:
+        return OpWorkflowRunner(self.build_workflow())
+
+    def parse_args(self, argv: Optional[List[str]] = None):
+        """≙ OpApp.parseArgs (scopt, OpApp.scala:130-176)."""
+        p = argparse.ArgumentParser(description=type(self).__name__)
+        p.add_argument("--run-type", required=True, choices=RunType.ALL)
+        p.add_argument("--model-location")
+        p.add_argument("--read-location")
+        p.add_argument("--write-location")
+        p.add_argument("--metrics-location")
+        p.add_argument("--param-location",
+                       help="json file of OpParams")
+        return p.parse_args(argv)
+
+    def main(self, argv: Optional[List[str]] = None) -> OpWorkflowRunnerResult:
+        args = self.parse_args(argv)
+        params = (OpParams.load(args.param_location)
+                  if args.param_location else OpParams())
+        if args.model_location:
+            params.model_location = args.model_location
+        if args.write_location:
+            params.write_location = args.write_location
+        if args.metrics_location:
+            params.metrics_location = args.metrics_location
+        if args.read_location:
+            from .params import ReaderParams
+            params.reader_params.setdefault("default", ReaderParams()).path = \
+                args.read_location
+        runner = self.make_runner()
+        return runner.run(args.run_type, params)

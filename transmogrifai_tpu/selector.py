@@ -246,9 +246,9 @@ class ModelSelector(Estimator):
                    {"trainRatio": self.validator.train_ratio}
                    if isinstance(self.validator, OpTrainValidationSplit) else {})},
             data_prep_parameters=(
-                {} if self.splitter is None else dict(vars(self.splitter).items() and {
+                {} if self.splitter is None else {
                     k: v for k, v in vars(self.splitter).items()
-                    if isinstance(v, (int, float, str, bool))})),
+                    if isinstance(v, (int, float, str, bool))}),
             data_prep_results=(
                 {} if self.splitter is None or self.splitter.summary is None
                 else self.splitter.summary.info),
@@ -426,16 +426,23 @@ class SelectedModelCombiner(Estimator):
         self.selector2.set_input(label_f, feats_f)
         m1 = self.selector1.fit(batch)
         m2 = self.selector2.fit(batch)
-        sign = 1.0 if self.selector1.validator.evaluator.is_larger_better else -1.0
-        w1 = sign * m1.summary.validation_results[0].metric_values.get(
-            m1.summary.evaluation_metric, 0.5) if m1.summary.validation_results else 0.5
-        # weight by each selector's best validation metric
+        larger_better = self.selector1.validator.evaluator.is_larger_better
+
+        # weight by each selector's best validation metric; for
+        # smaller-is-better metrics (RMSE, Error) weight inversely
         def _best_metric(m):
             vals = [r.metric_values.get(m.summary.evaluation_metric, np.nan)
                     for r in m.summary.validation_results]
             vals = [v for v in vals if np.isfinite(v)]
-            return (max(vals) if sign > 0 else min(vals)) if vals else 0.5
-        w1, w2 = abs(_best_metric(m1)), abs(_best_metric(m2))
+            if not vals:
+                return 0.5
+            return max(vals) if larger_better else min(vals)
+
+        b1, b2 = _best_metric(m1), _best_metric(m2)
+        if larger_better:
+            w1, w2 = abs(b1), abs(b2)
+        else:
+            w1, w2 = 1.0 / max(abs(b1), 1e-12), 1.0 / max(abs(b2), 1e-12)
         tot = (w1 + w2) or 1.0
         model = CombinedModel(model1=m1, model2=m2, w1=w1 / tot, w2=w2 / tot)
         return self._finalize_model(model)

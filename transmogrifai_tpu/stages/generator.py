@@ -40,6 +40,12 @@ class FeatureGeneratorStage(PipelineStage):
 
     def extract_column(self, records: Iterable[Dict[str, Any]]) -> Column:
         vals = [self.extract_fn(r) for r in records]
+        if self.kind.non_nullable:
+            # non-nullable features absent at scoring time (e.g. the response
+            # on unlabeled data) take the monoid zero, matching the
+            # reference's empty-aggregation semantics
+            zero = 0.0
+            vals = [zero if v is None else v for v in vals]
         return column_from_values(self.kind, vals)
 
     def extract_aggregated(self, grouped: Dict[Any, Sequence[Dict[str, Any]]],

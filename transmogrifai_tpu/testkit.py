@@ -1,0 +1,197 @@
+"""testkit — typed random data generators with controlled emptiness
+(reference: testkit/src/main/scala/com/salesforce/op/testkit/: RandomReal,
+RandomText, RandomBinary, RandomIntegral, RandomList, RandomMap, RandomSet,
+RandomVector, RandomStream, DataSources).
+
+Each generator is an infinite iterator of typed values; ``limit(n)`` takes n,
+``with_probability_of_empty`` injects Nones — the same API shape the
+reference's test suites rely on.
+"""
+
+from __future__ import annotations
+
+import itertools
+import string
+from typing import Any, Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class RandomData:
+    """Base infinite generator."""
+
+    def __init__(self, sample: Callable[[np.random.Generator], Any],
+                 seed: int = 42):
+        self._sample = sample
+        self._rng = np.random.default_rng(seed)
+        self._p_empty = 0.0
+
+    def with_probability_of_empty(self, p: float) -> "RandomData":
+        self._p_empty = float(p)
+        return self
+
+    def reset(self, seed: int) -> "RandomData":
+        self._rng = np.random.default_rng(seed)
+        return self
+
+    def __iter__(self) -> Iterator[Any]:
+        while True:
+            if self._p_empty and self._rng.random() < self._p_empty:
+                yield None
+            else:
+                yield self._sample(self._rng)
+
+    def limit(self, n: int) -> List[Any]:
+        return list(itertools.islice(iter(self), n))
+
+    def streams(self, n_streams: int, n: int) -> List[List[Any]]:
+        return [self.limit(n) for _ in range(n_streams)]
+
+
+class RandomReal(RandomData):
+    """≙ RandomReal: normal/uniform/poisson/exponential/gamma/log-normal."""
+
+    @staticmethod
+    def normal(mean: float = 0.0, sigma: float = 1.0, seed: int = 42) -> "RandomReal":
+        return RandomReal(lambda r: float(r.normal(mean, sigma)), seed)
+
+    @staticmethod
+    def uniform(low: float = 0.0, high: float = 1.0, seed: int = 42) -> "RandomReal":
+        return RandomReal(lambda r: float(r.uniform(low, high)), seed)
+
+    @staticmethod
+    def poisson(lam: float = 1.0, seed: int = 42) -> "RandomReal":
+        return RandomReal(lambda r: float(r.poisson(lam)), seed)
+
+    @staticmethod
+    def exponential(scale: float = 1.0, seed: int = 42) -> "RandomReal":
+        return RandomReal(lambda r: float(r.exponential(scale)), seed)
+
+    @staticmethod
+    def gamma(shape: float = 2.0, scale: float = 1.0, seed: int = 42) -> "RandomReal":
+        return RandomReal(lambda r: float(r.gamma(shape, scale)), seed)
+
+    @staticmethod
+    def lognormal(mean: float = 0.0, sigma: float = 1.0, seed: int = 42) -> "RandomReal":
+        return RandomReal(lambda r: float(r.lognormal(mean, sigma)), seed)
+
+
+class RandomIntegral(RandomData):
+    @staticmethod
+    def integers(low: int = 0, high: int = 100, seed: int = 42) -> "RandomIntegral":
+        return RandomIntegral(lambda r: int(r.integers(low, high)), seed)
+
+    @staticmethod
+    def dates(start_ms: int = 1400000000000, step_ms: int = 86400000,
+              seed: int = 42) -> "RandomIntegral":
+        return RandomIntegral(
+            lambda r: int(start_ms + r.integers(0, 1000) * step_ms), seed)
+
+
+class RandomBinary(RandomData):
+    def __init__(self, p_true: float = 0.5, seed: int = 42):
+        super().__init__(lambda r: bool(r.random() < p_true), seed)
+
+
+class RandomText(RandomData):
+    """≙ RandomText: random strings / picklists / emails / urls / countries."""
+
+    _WORDS = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta",
+              "theta", "iota", "kappa", "lambda", "mu"]
+
+    @staticmethod
+    def strings(min_len: int = 3, max_len: int = 10, seed: int = 42) -> "RandomText":
+        chars = string.ascii_lowercase
+
+        def sample(r):
+            n = int(r.integers(min_len, max_len + 1))
+            return "".join(r.choice(list(chars)) for _ in range(n))
+
+        return RandomText(sample, seed)
+
+    @staticmethod
+    def words(n_words: int = 3, seed: int = 42) -> "RandomText":
+        def sample(r):
+            return " ".join(r.choice(RandomText._WORDS)
+                            for _ in range(n_words))
+        return RandomText(sample, seed)
+
+    @staticmethod
+    def picklists(domain: Sequence[str], seed: int = 42) -> "RandomText":
+        domain = list(domain)
+        return RandomText(lambda r: str(r.choice(domain)), seed)
+
+    @staticmethod
+    def emails(domain: str = "example.com", seed: int = 42) -> "RandomText":
+        base = RandomText.strings(4, 8, seed)
+        return RandomText(lambda r: base._sample(r) + "@" + domain, seed)
+
+    @staticmethod
+    def urls(seed: int = 42) -> "RandomText":
+        base = RandomText.strings(4, 8, seed)
+        return RandomText(lambda r: f"https://{base._sample(r)}.example.com", seed)
+
+    @staticmethod
+    def countries(seed: int = 42) -> "RandomText":
+        return RandomText.picklists(
+            ["USA", "France", "Germany", "Japan", "Brazil", "India"], seed)
+
+    @staticmethod
+    def phones(seed: int = 42) -> "RandomText":
+        return RandomText(
+            lambda r: "+1" + "".join(str(r.integers(0, 10)) for _ in range(10)),
+            seed)
+
+
+class RandomList(RandomData):
+    @staticmethod
+    def of(element: RandomData, min_len: int = 0, max_len: int = 5,
+           seed: int = 42) -> "RandomList":
+        def sample(r):
+            n = int(r.integers(min_len, max_len + 1))
+            return [element._sample(r) for _ in range(n)]
+        return RandomList(sample, seed)
+
+
+class RandomSet(RandomData):
+    @staticmethod
+    def of(domain: Sequence[str], min_len: int = 0, max_len: int = 3,
+           seed: int = 42) -> "RandomSet":
+        domain = list(domain)
+
+        def sample(r):
+            n = int(r.integers(min_len, min(max_len, len(domain)) + 1))
+            return set(r.choice(domain, size=n, replace=False).tolist())
+        return RandomSet(sample, seed)
+
+
+class RandomMap(RandomData):
+    @staticmethod
+    def of(value_gen: RandomData, keys: Sequence[str], seed: int = 42) -> "RandomMap":
+        keys = list(keys)
+
+        def sample(r):
+            return {k: value_gen._sample(r) for k in keys
+                    if r.random() > 0.3}
+        return RandomMap(sample, seed)
+
+
+class RandomVector(RandomData):
+    @staticmethod
+    def dense(dim: int, seed: int = 42) -> "RandomVector":
+        return RandomVector(lambda r: r.normal(size=dim).astype(np.float32).tolist(),
+                            seed)
+
+
+class RandomGeolocation(RandomData):
+    def __init__(self, seed: int = 42):
+        super().__init__(
+            lambda r: [float(r.uniform(-90, 90)), float(r.uniform(-180, 180)),
+                       float(r.integers(1, 10))], seed)
+
+
+def random_records(n: int, generators: dict, seed: int = 42) -> List[dict]:
+    """Build n records from a name → RandomData mapping (≙ DataSources)."""
+    cols = {name: gen.reset(seed + i).limit(n)
+            for i, (name, gen) in enumerate(generators.items())}
+    return [{k: cols[k][i] for k in cols} for i in range(n)]

@@ -97,7 +97,7 @@ class DataBalancer(Splitter):
         rng.shuffle(idx)
         if self.summary is not None:
             self.summary.info["downSampleFraction"] = len(idx) / max(n, 1)
-        return batch.take_rows(np.sort(idx) if False else idx)
+        return batch.take_rows(idx)
 
 
 class DataCutter(Splitter):
@@ -157,6 +157,7 @@ class ValidatedCandidate:
     model_name: str
     params: Dict[str, Any]
     metric_values: List[float]
+    candidate_index: int = 0   # identity: two candidates may share a name
 
     @property
     def mean_metric(self) -> float:
@@ -247,7 +248,8 @@ class OpValidator:
                 for gi, params in enumerate(cand.grid):
                     key = (cand.model_name, ci * 10000 + gi)
                     if key not in results:
-                        results[key] = ValidatedCandidate(cand.model_name, dict(params), [])
+                        results[key] = ValidatedCandidate(
+                            cand.model_name, dict(params), [], candidate_index=ci)
                     try:
                         est = copy.deepcopy(cand.estimator)
                         for k, v in params.items():
@@ -267,7 +269,7 @@ class OpValidator:
         if not scored:
             raise RuntimeError("all model candidates failed validation")
         best_score, best_res = max(scored, key=lambda t: t[0])
-        best_cand = next(c for c in candidates if c.model_name == best_res.model_name)
+        best_cand = candidates[best_res.candidate_index]
         import copy as _c
         best_est = _c.deepcopy(best_cand.estimator)
         for k, v in best_res.params.items():

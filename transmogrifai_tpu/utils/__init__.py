@@ -1,0 +1,1 @@
+"""Generic helpers (≙ the reference utils module)."""

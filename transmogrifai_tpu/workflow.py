@@ -120,6 +120,34 @@ class Workflow(_WorkflowCore):
                 self._model_stages[st.uid.replace("_model", "")] = st
         return self
 
+    def _apply_blacklist(self):
+        """≙ setBlacklist (OpWorkflow.scala:117): remove blacklisted raw
+        features from every stage's inputs; stages that lose all inputs die
+        and their outputs cascade to downstream consumers."""
+        dead = {f.uid for f in self.blacklisted}
+        if not dead:
+            return
+        dag = compute_dag(self.result_features)
+        for layer in dag:  # deepest-first = closest to raw data
+            for st in layer:
+                if not st.input_features:
+                    continue
+                new_inputs = tuple(f for f in st.input_features
+                                   if f.uid not in dead)
+                if not new_inputs:
+                    for out in st.output_features:
+                        dead.add(out.uid)
+                    continue
+                if len(new_inputs) != len(st.input_features):
+                    st.input_features = new_inputs
+                    for out in st.output_features:
+                        out.parents = new_inputs
+        lost = [f.name for f in self.result_features if f.uid in dead]
+        if lost:
+            raise ValueError(
+                f"RawFeatureFilter removed all inputs of result feature(s) "
+                f"{lost}; relax the filter thresholds or protect features")
+
     def _validate_stages(self):
         """≙ OpWorkflow stage validation :277-335 — distinct uids and
         stage-type sanity."""
@@ -141,6 +169,7 @@ class Workflow(_WorkflowCore):
             batch, dropped, rff_results = self._raw_feature_filter.filter_batch(
                 batch, self.raw_features)
             self.blacklisted = dropped
+            self._apply_blacklist()
         dag = compute_dag(self.result_features)
         if self._workflow_cv:
             batch, fitted_dag = self._fit_with_workflow_cv(batch, dag)
@@ -333,9 +362,12 @@ class WorkflowModel(_WorkflowCore):
                 d["outputFeatures"] = [f.uid for f in st.output_features]
                 stages_json.append(d)
                 arrays.update(stage_fitted_arrays(st))
-        # raw generator stages (for schema/lineage)
+        # raw generator stages (for schema/lineage); blacklisted raw features
+        # were rewired out of the DAG and have no lineage to persist
         raw_json = []
         for f in self.raw_features:
+            if f.uid not in all_feats:
+                continue
             st = f.origin_stage
             if isinstance(st, FeatureGeneratorStage):
                 raw_json.append({"uid": st.uid, "name": st.name,

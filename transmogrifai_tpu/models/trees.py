@@ -114,12 +114,18 @@ def _leaf_xgb(s, lam=1.0):
 def fit_tree(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
              feature_mask: jnp.ndarray, *, impurity: str, max_depth: int,
              n_bins: int, min_instances: jnp.ndarray, min_gain: jnp.ndarray,
-             lam: jnp.ndarray, chunk: int = 32) -> TreeArrays:
+             lam: jnp.ndarray, chunk: "Optional[int]" = None) -> TreeArrays:
     """Grow one tree level-wise on binned data.
 
     B [N, D] int32; stats [N, S] pre-weighted per-row statistics (col 0 must be
     the row weight/count); feature_mask [D] 0/1.  Returns perfect-heap arrays
     with ``T = 2^(max_depth+1) - 1`` nodes.
+
+    Histogram strategy (the TPU-critical choice): for shallow levels
+    (``n_l * S <= 256``) the per-(node, feature, bin) stats come from one bf16
+    matmul on the MXU — ``(onehot_node x stats)^T @ onehot_bins`` — instead of
+    scatter-adds, which XLA lowers to sorts on TPU.  Deep levels (only
+    ``max_depth > 7``-ish trees reach them) fall back to per-stat segment-sums.
     """
     N, D = B.shape
     S = stats.shape[1]
@@ -129,6 +135,9 @@ def fit_tree(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
     V = {"variance": 1, "gini": S - 1, "xgb": 1}[impurity]
     T = 2 ** (max_depth + 1) - 1
 
+    if chunk is None:
+        # bound the one-hot working set (~chunk * N * n_bins bf16) to ~512MB
+        chunk = max(1, min(32, (512 << 20) // max(N * n_bins * 2, 1)))
     n_chunks = math.ceil(D / chunk)
     D_pad = n_chunks * chunk
     pad = D_pad - D
@@ -158,17 +167,39 @@ def fit_tree(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
                 leaf_flag, jnp.ones((n_l,), bool), (offset,))
             break
 
+        use_matmul = n_l * S <= 256
+        if use_matmul:
+            # P [N, n_l*S] bf16: each row's stats routed to its node's slot;
+            # the histogram then is one MXU matmul against one-hot bins
+            oh_node = row_node[:, None] == jnp.arange(n_l)[None, :]
+            P = (oh_node[:, :, None] * stats[:, None, :]).reshape(
+                N, n_l * S).astype(jnp.bfloat16)
+
+        def chunk_hist(bc):
+            """[chunk, N] bins → [chunk, n_l, n_bins, S] histogram."""
+            if use_matmul:
+                oh = (bc[:, :, None] == jnp.arange(n_bins)[None, None, :]
+                      ).astype(jnp.bfloat16)                 # [chunk, N, n_bins]
+                hist = jnp.einsum("cnb,nk->ckb", oh, P,
+                                  preferred_element_type=jnp.float32)
+                return hist.reshape(chunk, n_l, S, n_bins).transpose(0, 1, 3, 2)
+            seg = row_node[None, :] * n_bins + bc            # [chunk, N]
+
+            # one 1-D segment-sum per stat component: every large tensor here
+            # is [chunk, N] (N minormost), never [.., S] — a small-S minormost
+            # dim would be padded to the 128-lane TPU tile (42x HBM blowup)
+            def hist_for_stat(srow):
+                return jax.vmap(lambda ids: jax.ops.segment_sum(
+                    srow, ids, num_segments=n_l * n_bins))(seg)  # [chunk, nlb]
+
+            hist = jnp.stack([hist_for_stat(stats[:, s]) for s in range(S)],
+                             axis=-1)                        # [chunk, nlb, S]
+            return hist.reshape(chunk, n_l, n_bins, S)
+
         def scan_chunk(carry, xs):
             best_gain, best_feat, best_bin = carry
             bc, mc, base_idx = xs           # [chunk, N], [chunk], scalar
-
-            def one_feature(bcol):
-                seg = row_node * n_bins + bcol
-                return jax.ops.segment_sum(stats, seg,
-                                           num_segments=n_l * n_bins)
-
-            hist = jax.vmap(one_feature)(bc)                 # [chunk, n_l*n_bins, S]
-            hist = hist.reshape(chunk, n_l, n_bins, S)
+            hist = chunk_hist(bc)
             left = jnp.cumsum(hist, axis=2)                  # [chunk, n_l, n_bins, S]
             right = node_stats[None, :, None, :] - left
             gains = gain_fn(left, right, node_stats[None, :, None, :], lam)
@@ -304,7 +335,11 @@ def fit_forest(X: np.ndarray, y: np.ndarray, *, task: str, n_classes: int,
         base_stats = jnp.stack([jnp.ones(N), yj, yj * yj], axis=1)
     base_stats = base_stats * w0[:, None]
 
-    use_vmap = max_depth <= 8 and n_trees <= 64
+    # tree-vmap multiplies every per-row intermediate by n_trees; cap the
+    # broadcast working set (~chunk * N * S * n_trees floats) at ~2 GiB
+    S = base_stats.shape[1]
+    est_bytes = 32 * N * max(S, 4) * 4 * n_trees
+    use_vmap = max_depth <= 8 and n_trees <= 64 and est_bytes < 2 << 30
     fitter = _forest_fitter(impurity, max_depth, max_bins, use_vmap)
     trees = fitter(B, jnp.asarray(splits), base_stats, boot, masks,
                    jnp.float32(min_instances), jnp.float32(min_gain),

@@ -1,0 +1,105 @@
+"""Measure the baseline for BASELINE.md item 5 (HIGGS-like 1M CV-grid train).
+
+The reference is Spark-local `OpWorkflow.train()` (Scala/JVM). No JVM exists
+in this image, so the documented proxy is **sklearn local** on the same
+machine, same workload as bench.py: 1M x 28 synthetic HIGGS-like binary data,
+3-fold CV over {4 logistic-regression, 1 random-forest, 1 GBT} candidates with
+the same hyper-parameters, AuPR selection, then a final refit — i.e. the exact
+flow of the reference's BinaryClassificationModelSelector
+(core/.../impl/tuning/OpCrossValidation.scala:87, ModelSelector.scala:143)
+executed by a classical CPU ML stack.
+
+Approximations vs Spark MLlib (documented, not hidden):
+- LogisticRegression uses lbfgs with l2 only (Spark's elasticNetParam=0.1
+  would need saga, which is far slower single-core — l2-only *favors* the
+  baseline).
+- GradientBoostingClassifier uses exact splits (Spark uses the same
+  sort-based split search).
+
+Writes BASELINE_MEASURED.json next to this script's repo root.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_data(n: int, d: int, seed: int = 0):
+    # identical to bench.py
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    logits = X @ w + 0.8 * (X[:, 0] * X[:, 1]) - 0.5 * (X[:, 2] ** 2) + 0.3
+    y = (logits + rng.normal(size=n).astype(np.float32) > 0).astype(np.float32)
+    return X, y
+
+
+def main():
+    from sklearn.ensemble import (GradientBoostingClassifier,
+                                  RandomForestClassifier)
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.metrics import average_precision_score
+
+    N, D = 1_000_000, 28
+    X, y = make_data(N, D)
+
+    def lr(reg):
+        # Spark regParam r on mean loss == sklearn C = 1 / (n_train * r);
+        # sklearn's C multiplies the *sum* loss, so C = 1/(N*r) matches scale
+        return LogisticRegression(C=1.0 / (len(y) * reg), solver="lbfgs",
+                                  max_iter=50, tol=1e-6)
+
+    candidates = (
+        [(f"LR(reg={r})", lambda r=r: lr(r)) for r in (0.001, 0.01, 0.1, 0.2)]
+        + [("RF(20x6)", lambda: RandomForestClassifier(
+            n_estimators=20, max_depth=6, min_samples_leaf=10, n_jobs=1))]
+        + [("GBT(20x3)", lambda: GradientBoostingClassifier(
+            n_estimators=20, max_depth=3, min_samples_leaf=10))]
+    )
+
+    rng = np.random.default_rng(42)
+    perm = rng.permutation(N)
+    folds = np.array_split(perm, 3)
+
+    t0 = time.time()
+    mean_aupr = {}
+    per_fit = {}
+    for name, make in candidates:
+        scores = []
+        for i in range(3):
+            va = folds[i]
+            tr = np.concatenate([folds[j] for j in range(3) if j != i])
+            tf = time.time()
+            m = make().fit(X[tr], y[tr])
+            per_fit.setdefault(name, []).append(round(time.time() - tf, 1))
+            s = (m.predict_proba(X[va])[:, 1]
+                 if hasattr(m, "predict_proba") else m.decision_function(X[va]))
+            scores.append(average_precision_score(y[va], s))
+        mean_aupr[name] = float(np.mean(scores))
+        print(f"{name}: mean AuPR {mean_aupr[name]:.4f} "
+              f"fits {per_fit[name]}s", flush=True)
+    best = max(mean_aupr, key=mean_aupr.get)
+    make = dict((n, m) for n, m in candidates)[best]
+    final = make().fit(X, y)
+    wall = time.time() - t0
+
+    out = {
+        "higgs1m_train_wall_s": round(wall, 1),
+        "proxy": "sklearn-1.9.0 local (single core; no JVM/Spark in image)",
+        "workload": "1Mx28 HIGGS-like, 3-fold CV, 4xLR + RF(20x6) + GBT(20x3),"
+                    " AuPR selection + final refit (= bench.py workload)",
+        "best_model": best,
+        "mean_aupr": mean_aupr,
+        "per_fit_seconds": per_fit,
+    }
+    with open(os.path.join(ROOT, "BASELINE_MEASURED.json"), "w") as fh:
+        json.dump(out, fh, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

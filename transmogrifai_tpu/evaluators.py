@@ -78,7 +78,7 @@ def aupr(y: np.ndarray, scores: np.ndarray) -> float:
     # MLlib prepends (0, p[0]) and integrates with trapezoids over recall
     recall = np.r_[0.0, recall]
     precision = np.r_[1.0, precision]
-    return float(np.trapz(precision, recall))
+    return float(np.trapezoid(precision, recall))
 
 
 def binary_confusion(y: np.ndarray, yhat: np.ndarray) -> Dict[str, float]:

@@ -76,6 +76,32 @@ class PredictorEstimator(Estimator):
         grid calls this (or its vectorised variant) directly."""
         raise NotImplementedError
 
+    def fit_arrays_grid(self, X: np.ndarray, y: np.ndarray,
+                        fold_weights: np.ndarray, grids) -> list:
+        """Batched (fold × grid-point) training for the CV grid: returns
+        fitted dicts indexed ``[fold][grid_point]``.
+
+        ``fold_weights`` [F, N] are per-fold row weights over the SAME data
+        matrix (weight 0 == row held out of training) — CV keeps one
+        HBM-resident X with static shapes instead of slicing per fold.
+
+        This default loops host-side (every estimator honours
+        ``sample_weight``, so it is still slice- and recompile-free); the
+        linear and tree families override it with single batched XLA programs
+        (≙ OpValidator.scala:320-349's thread-pool fan-out, SURVEY §2.6 P3).
+        """
+        import copy as _copy
+        out = []
+        for k in range(fold_weights.shape[0]):
+            row = []
+            for params in grids:
+                est = _copy.deepcopy(self)
+                for pk, pv in params.items():
+                    est.set(pk, pv)
+                row.append(est.fit_arrays(X, y, sample_weight=fold_weights[k]))
+            out.append(row)
+        return out
+
     def fit(self, batch: ColumnBatch) -> PredictionModel:
         label, feats = self.input_features
         X, y = extract_xy(batch, label, feats)

@@ -18,12 +18,53 @@ import jax.numpy as jnp
 import numpy as np
 
 from .base import PredictionModel, PredictorEstimator
-from .solvers import (FitResult, fista_fit, naive_bayes_fit, ridge_fit,
-                      standardize, unscale_params)
+from .solvers import (FitResult, fista_fit, linear_grid_fit, naive_bayes_fit,
+                      ridge_fit, ridge_grid_fit, standardize, unscale_params)
 
 
 def _n_classes(y: np.ndarray) -> int:
     return int(np.max(y)) + 1 if len(y) else 2
+
+
+def _grouped_grid_fit(est, X, y, fold_weights, grids, *, loss: str,
+                      n_classes: int, l2l1, fitted_extra: Dict[str, Any]):
+    """Shared (fold × grid) batched fit for the linear family: grid points are
+    grouped by their static config (max_iter/intercept/standardization/tol)
+    and each group trains as one nested-vmap XLA program over
+    (fold_weights [F,N]) × (l2s, l1s [G]).  Returns fitted dicts [F][G]."""
+    from collections import defaultdict
+    K, G = fold_weights.shape[0], len(grids)
+    out: list = [[None] * G for _ in range(K)]
+    groups = defaultdict(list)
+    for gi, p in enumerate(grids):
+        m = {**est._params, **p}
+        groups[(int(m.get("max_iter", 100)), bool(m.get("fit_intercept", True)),
+                bool(m.get("standardization", True)),
+                float(m.get("tol", 1e-6)))].append(gi)
+    Xj = jnp.asarray(X, jnp.float32)
+    yj = jnp.asarray(y, jnp.float32)
+    Wj = jnp.asarray(fold_weights, jnp.float32)
+    nc = 1 if n_classes <= 2 else n_classes
+    for (max_iter, fit_intercept, standardization, tol), gidx in groups.items():
+        pens = [l2l1({**est._params, **grids[gi]}) for gi in gidx]
+        l2s = jnp.asarray([p[0] for p in pens], jnp.float32)
+        l1s = jnp.asarray([p[1] for p in pens], jnp.float32)
+        if loss == "squared" and all(p[1] == 0.0 for p in pens):
+            res = ridge_grid_fit(Xj, yj, Wj, l2s, fit_intercept=fit_intercept,
+                                 standardization=standardization)
+        else:
+            res = linear_grid_fit(Xj, yj, Wj, l2s, l1s, loss=loss,
+                                  fit_intercept=fit_intercept,
+                                  standardization=standardization,
+                                  max_iter=max_iter, tol=tol, n_classes=nc)
+        coef = np.asarray(res.coef)
+        inter = np.asarray(res.intercept)
+        n_it = np.asarray(res.n_iter)
+        for j, gi in enumerate(gidx):
+            for k in range(K):
+                out[k][gi] = {"coef": coef[k, j], "intercept": inter[k, j],
+                              "n_iter": int(n_it[k, j]), **fitted_extra}
+    return out
 
 
 def _binary_outputs(margin: np.ndarray) -> Dict[str, np.ndarray]:
@@ -93,6 +134,20 @@ class OpLogisticRegression(PredictorEstimator):
                 "kind": "binary" if C <= 2 else "multinomial",
                 "n_classes": C, "n_iter": int(res.n_iter)}
 
+    def fit_arrays_grid(self, X, y, fold_weights, grids):
+        C = _n_classes(y)
+
+        def l2l1(m):
+            reg = float(m.get("reg_param", 0.0))
+            en = float(m.get("elastic_net_param", 0.0))
+            return reg * (1.0 - en), reg * en
+
+        return _grouped_grid_fit(
+            self, X, y, fold_weights, grids,
+            loss="logistic" if C <= 2 else "softmax", n_classes=C, l2l1=l2l1,
+            fitted_extra={"kind": "binary" if C <= 2 else "multinomial",
+                          "n_classes": C})
+
 
 class OpLinearSVC(PredictorEstimator):
     """≙ OpLinearSVC (squared-hinge linear SVM; binary, no probabilities)."""
@@ -122,6 +177,12 @@ class OpLinearSVC(PredictorEstimator):
         res = unscale_params(res, mean, scale, 1)
         return {"coef": np.asarray(res.coef), "intercept": np.asarray(res.intercept),
                 "kind": "svc", "n_classes": 2, "n_iter": int(res.n_iter)}
+
+    def fit_arrays_grid(self, X, y, fold_weights, grids):
+        return _grouped_grid_fit(
+            self, X, y, fold_weights, grids, loss="squared_hinge", n_classes=2,
+            l2l1=lambda m: (float(m.get("reg_param", 0.0)), 0.0),
+            fitted_extra={"kind": "svc", "n_classes": 2})
 
 
 class OpLinearRegression(PredictorEstimator):
@@ -161,13 +222,21 @@ class OpLinearRegression(PredictorEstimator):
         return {"coef": np.asarray(res.coef), "intercept": np.asarray(res.intercept),
                 "kind": "regression", "n_iter": int(res.n_iter)}
 
+    def fit_arrays_grid(self, X, y, fold_weights, grids):
+        def l2l1(m):
+            reg = float(m.get("reg_param", 0.0))
+            en = float(m.get("elastic_net_param", 0.0))
+            return reg * (1.0 - en), reg * en
+
+        return _grouped_grid_fit(
+            self, X, y, fold_weights, grids, loss="squared", n_classes=2,
+            l2l1=l2l1, fitted_extra={"kind": "regression"})
+
 
 class OpGeneralizedLinearRegression(PredictorEstimator):
     """≙ OpGeneralizedLinearRegression: families gaussian/binomial/poisson/gamma
     (log/identity/logit links as in the reference grid
     BinaryClassificationModelSelector.scala / DefaultSelectorParams.scala:56-65)."""
-
-    model_cls = LinearPredictionModel
 
     def __init__(self, family: str = "gaussian", link: Optional[str] = None,
                  reg_param: float = 0.0, max_iter: int = 50, tol: float = 1e-6,
@@ -194,9 +263,37 @@ class OpGeneralizedLinearRegression(PredictorEstimator):
         return {"coef": np.asarray(res.coef), "intercept": np.asarray(res.intercept),
                 "kind": "glm", "family": family, "n_iter": int(res.n_iter)}
 
+    def fit_arrays_grid(self, X, y, fold_weights, grids):
+        family = self.get("family", "gaussian")
+        loss = {"gaussian": "squared", "binomial": "logistic",
+                "poisson": "poisson", "gamma": "gamma"}[family]
+        return _grouped_grid_fit(
+            self, X, y, fold_weights, grids, loss=loss, n_classes=2,
+            l2l1=lambda m: (float(m.get("reg_param", 0.0)), 0.0),
+            fitted_extra={"kind": "glm", "family": family})
+
 
 class GLMPredictionModel(LinearPredictionModel):
-    pass
+    """≙ GeneralizedLinearRegressionModel.predict: apply the family's inverse
+    link g⁻¹(η) to the linear predictor (exp for poisson/gamma log link,
+    sigmoid for binomial logit; identity for gaussian)."""
+
+    _INVERSE_LINK = {
+        "poisson": lambda eta: np.exp(np.clip(eta, -30.0, 30.0)),
+        "gamma": lambda eta: np.exp(np.clip(eta, -30.0, 30.0)),
+        "binomial": lambda eta: 1.0 / (1.0 + np.exp(-np.clip(eta, -30.0, 30.0))),
+        "gaussian": lambda eta: eta,
+    }
+
+    def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        coef = np.asarray(self.fitted["coef"], dtype=np.float32)
+        intercept = np.asarray(self.fitted["intercept"], dtype=np.float32)
+        eta = X @ coef + (intercept[0] if intercept.ndim else intercept)
+        inv = self._INVERSE_LINK[self.fitted.get("family", "gaussian")]
+        return {"prediction": inv(eta).astype(np.float32)}
+
+
+OpGeneralizedLinearRegression.model_cls = GLMPredictionModel
 
 
 class NaiveBayesModel(PredictionModel):

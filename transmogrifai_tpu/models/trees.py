@@ -37,6 +37,15 @@ from .base import PredictionModel, PredictorEstimator
 MAX_BINS_DEFAULT = 32
 
 
+@functools.lru_cache(maxsize=None)
+def _mxu_dtype():
+    """One-hot histogram matmuls run in bf16 to hit the MXU on TPU; the CPU
+    backend (the 8-virtual-device test mesh) lacks BF16xBF16=F32 dot support,
+    so fall back to f32 there."""
+    return (jnp.bfloat16 if jax.devices()[0].platform not in ("cpu",)
+            else jnp.float32)
+
+
 # --------------------------------------------------------------------------
 # binning
 # --------------------------------------------------------------------------
@@ -168,18 +177,19 @@ def fit_tree(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
             break
 
         use_matmul = n_l * S <= 256
+        mxu = _mxu_dtype()
         if use_matmul:
-            # P [N, n_l*S] bf16: each row's stats routed to its node's slot;
+            # P [N, n_l*S]: each row's stats routed to its node's slot;
             # the histogram then is one MXU matmul against one-hot bins
             oh_node = row_node[:, None] == jnp.arange(n_l)[None, :]
             P = (oh_node[:, :, None] * stats[:, None, :]).reshape(
-                N, n_l * S).astype(jnp.bfloat16)
+                N, n_l * S).astype(mxu)
 
         def chunk_hist(bc):
             """[chunk, N] bins → [chunk, n_l, n_bins, S] histogram."""
             if use_matmul:
                 oh = (bc[:, :, None] == jnp.arange(n_bins)[None, None, :]
-                      ).astype(jnp.bfloat16)                 # [chunk, N, n_bins]
+                      ).astype(mxu)                          # [chunk, N, n_bins]
                 hist = jnp.einsum("cnb,nk->ckb", oh, P,
                                   preferred_element_type=jnp.float32)
                 return hist.reshape(chunk, n_l, S, n_bins).transpose(0, 1, 3, 2)
@@ -364,7 +374,9 @@ def _gbt_round_fitter(task: str, max_depth: int, n_bins: int):
             g, h = p - y, jnp.maximum(p * (1 - p), 1e-6)
         else:
             g, h = margin - y, jnp.ones_like(margin)
-        stats = jnp.stack([jnp.ones_like(g), g * w0, h * w0], axis=1)
+        # weight ALL stat columns (incl. count) so zero-weight rows are fully
+        # excluded from min_instances feasibility, matching the grid path
+        stats = jnp.stack([jnp.ones_like(g), g, h], axis=1) * w0[:, None]
         tree = fit_tree(B, splits, stats, fmask, impurity="xgb",
                         max_depth=max_depth, n_bins=n_bins,
                         min_instances=min_instances, min_gain=min_gain, lam=lam)
@@ -410,6 +422,85 @@ def fit_gbt(X: np.ndarray, y: np.ndarray, *, task: str, n_rounds: int,
             "max_depth": max_depth, "eta": eta, "base": float(base),
             "feature": feature, "threshold": threshold,
             "is_leaf": is_leaf, "leaf": leaf, "bin_splits": splits}
+
+
+# --------------------------------------------------------------------------
+# batched (fold × grid) CV fitters — shared binned matrix, one dispatch per
+# static config (≙ OpValidator.scala:320-349 thread-pool fan-out, SURVEY §2.6 P3)
+# --------------------------------------------------------------------------
+
+def _tree_batch_budget(N: int, n_bins: int) -> Tuple[int, int]:
+    """(chunk, batch_size) so the one-hot working set of the trees running
+    concurrently under ``lax.map(batch_size=...)`` stays ≲1 GiB."""
+    per_feat = max(N * n_bins * 2, 1)              # bf16 one-hot per feature col
+    total = max(1, (1 << 30) // per_feat)
+    batch_size = max(1, min(8, total))
+    chunk = max(1, min(32, total // batch_size))
+    return chunk, batch_size
+
+
+@functools.lru_cache(maxsize=None)
+def _forest_grid_fitter(impurity: str, max_depth: int, n_bins: int,
+                        bootstrap: bool, chunk: int, batch_size: int):
+    """Jitted fit of ALL trees of a (fold × grid-point) forest group.
+
+    Per-tree traced inputs: fold id (row-weight mask row), PRNG key (Poisson
+    bootstrap drawn on device — no [Kt, N] boot matrix in HBM), min_instances,
+    min_gain, subsample rate, feature mask.  ``lax.map(batch_size=...)`` bounds
+    the histogram working set while still vmapping ``batch_size`` trees onto
+    the MXU at once."""
+
+    def fn(B, splits, base_stats, fold_w, fold_ids, keys, mis, mgs, subs,
+           masks, lam):
+        N = B.shape[0]
+
+        def fit_one(args):
+            fid, key, mi, mg, sub, fm = args
+            w = fold_w[fid]
+            if bootstrap:
+                bw = jax.random.poisson(key, sub, (N,)).astype(jnp.float32) * w
+            else:
+                bw = w
+            stats = base_stats * bw[:, None]
+            return fit_tree(B, splits, stats, fm, impurity=impurity,
+                            max_depth=max_depth, n_bins=n_bins,
+                            min_instances=mi, min_gain=mg, lam=lam,
+                            chunk=chunk)
+
+        return jax.lax.map(fit_one, (fold_ids, keys, mis, mgs, subs, masks),
+                           batch_size=batch_size)
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _gbt_grid_round_fitter(task: str, max_depth: int, n_bins: int, chunk: int,
+                           batch_size: int):
+    """Jitted single boosting round over all (fold × grid-point) candidates:
+    margins/weights [K, N], per-candidate traced (min_instances, min_gain,
+    lambda, eta)."""
+
+    def fn(B, splits, X, y, margins, weights, fmask, mis, mgs, lams, etas):
+        def one(args):
+            margin, w, mi, mg, lam, eta = args
+            if task == "classification":
+                p = jax.nn.sigmoid(margin)
+                g, h = p - y, jnp.maximum(p * (1 - p), 1e-6)
+            else:
+                g, h = margin - y, jnp.ones_like(margin)
+            stats = jnp.stack([jnp.ones_like(g), g, h], axis=1) * w[:, None]
+            tree = fit_tree(B, splits, stats, fmask, impurity="xgb",
+                            max_depth=max_depth, n_bins=n_bins,
+                            min_instances=mi, min_gain=mg, lam=lam, chunk=chunk)
+            pred = predict_trees_raw(X, tree.feature[None], tree.threshold[None],
+                                     tree.is_leaf[None], tree.leaf[None],
+                                     max_depth + 1)[:, 0, 0]
+            return margin + eta * pred, tree
+
+        return jax.lax.map(one, (margins, weights, mis, mgs, lams, etas),
+                           batch_size=batch_size)
+
+    return jax.jit(fn)
 
 
 # --------------------------------------------------------------------------
@@ -479,6 +570,95 @@ class _ForestEstimatorBase(PredictorEstimator):
             sample_weight=sample_weight)
 
 
+    def fit_arrays_grid(self, X, y, fold_weights, grids):
+        """All (fold × grid-point × tree) fits of this candidate family share
+        ONE binned matrix and dispatch once per static config — the reference
+        re-bins and re-launches a Spark job per (fold, paramMap)
+        (OpCrossValidation.scala:114-137).  Quantile split candidates are
+        computed from the full matrix (label-free, standard CV practice)."""
+        from collections import defaultdict
+        K, G = fold_weights.shape[0], len(grids)
+        out: list = [[None] * G for _ in range(K)]
+        N, D = X.shape
+        n_classes = (int(np.max(y)) + 1 if self.task == "classification" else 0)
+        n_classes = max(n_classes, 2)
+
+        groups = defaultdict(list)
+        for gi, p in enumerate(grids):
+            m = {**self._params, **p}
+            strategy = m.get("feature_subset_strategy", "auto")
+            if strategy == "auto":
+                strategy = (self.default_feature_strategy
+                            if int(m.get("num_trees", 20)) > 1 else "all")
+            groups[(int(m.get("num_trees", 20)), int(m.get("max_depth", 5)),
+                    int(m.get("max_bins", MAX_BINS_DEFAULT)), strategy,
+                    bool(m.get("bootstrap", True)),
+                    int(m.get("seed", 42)))].append(gi)
+
+        yj = jnp.asarray(y, jnp.float32)
+        if self.task == "classification":
+            impurity = "gini"
+            yoh = jax.nn.one_hot(yj.astype(jnp.int32), n_classes,
+                                 dtype=jnp.float32)
+            base_stats = jnp.concatenate([jnp.ones((N, 1)), yoh], axis=1)
+        else:
+            impurity = "variance"
+            base_stats = jnp.stack([jnp.ones(N), yj, yj * yj], axis=1)
+        fold_w = jnp.asarray(fold_weights, jnp.float32)
+        Xj = jnp.asarray(X, jnp.float32)
+        splits_cache: dict = {}
+
+        def mval(gi, name, default):
+            return float({**self._params, **grids[gi]}.get(name, default))
+
+        for (n_trees, max_depth, max_bins, strategy, bootstrap,
+             seed), gidx in groups.items():
+            if max_bins not in splits_cache:
+                sp = build_bin_splits(X, max_bins)
+                splits_cache[max_bins] = (sp, bin_data(Xj, jnp.asarray(sp)))
+            splits, B = splits_cache[max_bins]
+            Gg = len(gidx)
+            Kt = K * Gg * n_trees
+            k_boot, k_feat = jax.random.split(jax.random.PRNGKey(seed))
+            masks = jnp.tile(_feature_masks(k_feat, n_trees, D, strategy),
+                             (K * Gg, 1))
+            # one bootstrap key per TREE INDEX, shared across folds and grid
+            # points — grid points differing only in traced params see
+            # identical draws (candidates are ranked by hyper-parameters, not
+            # bootstrap noise), mirroring fit_forest's fixed-seed draws
+            keys_one = jax.random.split(k_boot, n_trees)
+            keys = jax.random.wrap_key_data(
+                jnp.tile(jax.random.key_data(keys_one), (K * Gg, 1)))
+            fold_ids = jnp.asarray(
+                np.repeat(np.arange(K, dtype=np.int32), Gg * n_trees))
+            per_tree = lambda vals: jnp.asarray(
+                np.tile(np.repeat(np.asarray(vals, np.float32), n_trees), K))
+            mis = per_tree([mval(gi, "min_instances_per_node", 1) for gi in gidx])
+            mgs = per_tree([mval(gi, "min_info_gain", 0.0) for gi in gidx])
+            subs = per_tree([mval(gi, "subsampling_rate", 1.0) for gi in gidx])
+            chunk, batch_size = _tree_batch_budget(N, max_bins)
+            fitter = _forest_grid_fitter(impurity, max_depth, max_bins,
+                                         bootstrap, chunk, batch_size)
+            trees = fitter(B, jnp.asarray(splits), base_stats, fold_w,
+                           fold_ids, keys, mis, mgs, subs, masks,
+                           jnp.float32(1.0))
+            feature = np.asarray(trees.feature)
+            threshold = np.asarray(trees.threshold)
+            is_leaf = np.asarray(trees.is_leaf)
+            leaf = np.asarray(trees.leaf)
+            for k in range(K):
+                for j, gi in enumerate(gidx):
+                    s = (k * Gg + j) * n_trees
+                    out[k][gi] = {
+                        "kind": "forest", "task": self.task,
+                        "n_classes": n_classes, "max_depth": max_depth,
+                        "feature": feature[s:s + n_trees],
+                        "threshold": threshold[s:s + n_trees],
+                        "is_leaf": is_leaf[s:s + n_trees],
+                        "leaf": leaf[s:s + n_trees], "bin_splits": splits}
+        return out
+
+
 class OpRandomForestClassifier(_ForestEstimatorBase):
     """≙ OpRandomForestClassifier.scala:58."""
     task = "classification"
@@ -533,6 +713,81 @@ class _GBTEstimatorBase(PredictorEstimator):
             lam=float(self.get("reg_lambda", 1.0)),
             min_child_weight=float(self.get("min_child_weight", 0.0)),
             seed=int(self.get("seed", 42)), sample_weight=sample_weight)
+
+
+    def fit_arrays_grid(self, X, y, fold_weights, grids):
+        """Batched GBT grid: one jitted dispatch per boosting round fits that
+        round's tree for ALL (fold × grid-point) candidates at once over a
+        shared binned matrix (margins/weights [K·G, N] in HBM)."""
+        from collections import defaultdict
+        K, G = fold_weights.shape[0], len(grids)
+        out: list = [[None] * G for _ in range(K)]
+        N, D = X.shape
+
+        groups = defaultdict(list)
+        for gi, p in enumerate(grids):
+            m = {**self._params, **p}
+            groups[(int(m.get("max_iter", 20)), int(m.get("max_depth", 5)),
+                    int(m.get("max_bins", MAX_BINS_DEFAULT)))].append(gi)
+
+        Xj = jnp.asarray(X, jnp.float32)
+        yj = jnp.asarray(y, jnp.float32)
+        fold_w = jnp.asarray(fold_weights, jnp.float32)
+        fmask = jnp.ones((D,), jnp.float32) > 0
+        splits_cache: dict = {}
+
+        def mval(gi, name, default):
+            return float({**self._params, **grids[gi]}.get(name, default))
+
+        for (n_rounds, max_depth, max_bins), gidx in groups.items():
+            if max_bins not in splits_cache:
+                sp = build_bin_splits(X, max_bins)
+                splits_cache[max_bins] = (sp, bin_data(Xj, jnp.asarray(sp)))
+            splits, B = splits_cache[max_bins]
+            Gg = len(gidx)
+            Kc = K * Gg
+            # candidate kc = k*Gg + j
+            W = jnp.repeat(fold_w, Gg, axis=0)                 # [Kc, N]
+            if self.task == "classification":
+                base = jnp.zeros((Kc,), jnp.float32)
+            else:
+                base = (fold_w @ yj) / jnp.maximum(
+                    jnp.sum(fold_w, axis=1), 1e-12)            # [K]
+                base = jnp.repeat(base, Gg)
+            margins = jnp.broadcast_to(base[:, None], (Kc, N)).astype(jnp.float32)
+            per_cand = lambda vals: jnp.asarray(
+                np.tile(np.asarray(vals, np.float32), K))
+            mis = per_cand([max(mval(gi, "min_instances_per_node", 1),
+                                mval(gi, "min_child_weight", 0.0))
+                            for gi in gidx])
+            mgs = per_cand([mval(gi, "min_info_gain", 0.0) for gi in gidx])
+            lams = per_cand([mval(gi, "reg_lambda", 1.0) for gi in gidx])
+            etas = per_cand([mval(gi, "step_size", 0.1) for gi in gidx])
+            chunk, batch_size = _tree_batch_budget(N, max_bins)
+            fit_round = _gbt_grid_round_fitter(self.task, max_depth, max_bins,
+                                               chunk, batch_size)
+            rounds = []
+            for _ in range(n_rounds):
+                margins, trees = fit_round(B, jnp.asarray(splits), Xj, yj,
+                                           margins, W, fmask, mis, mgs, lams,
+                                           etas)
+                rounds.append(trees)
+            feature = np.stack([np.asarray(t.feature) for t in rounds], axis=1)
+            threshold = np.stack([np.asarray(t.threshold) for t in rounds], axis=1)
+            is_leaf = np.stack([np.asarray(t.is_leaf) for t in rounds], axis=1)
+            leaf = np.stack([np.asarray(t.leaf) for t in rounds], axis=1)
+            base_np = np.asarray(base)
+            for k in range(K):
+                for j, gi in enumerate(gidx):
+                    kc = k * Gg + j
+                    out[k][gi] = {
+                        "kind": "gbt", "task": self.task, "n_classes": 2,
+                        "max_depth": max_depth,
+                        "eta": float(etas[kc]), "base": float(base_np[kc]),
+                        "feature": feature[kc], "threshold": threshold[kc],
+                        "is_leaf": is_leaf[kc], "leaf": leaf[kc],
+                        "bin_splits": splits}
+        return out
 
 
 class OpGBTClassifier(_GBTEstimatorBase):

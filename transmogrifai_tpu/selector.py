@@ -214,12 +214,18 @@ class ModelSelector(Estimator):
         label = self.input_features[0].name
         features = self.input_features[1].name
         return self.validator.validate(self.models, batch, label, features,
-                                       in_fold_dag=in_fold_dag)
+                                       in_fold_dag=in_fold_dag,
+                                       splitter=self.splitter)
 
     def fit(self, batch: ColumnBatch, in_fold_dag=None) -> SelectedModel:
         label_f, feats_f = self.input_features
         label = label_f.name
+        holdout = None
         if self.splitter is not None:
+            if self.splitter.reserve_test_fraction > 0:
+                # reserve a test holdout before any CV/preparation; the winner
+                # is evaluated on it (≙ Splitter.split + holdoutEvaluation)
+                batch, holdout = self.splitter.split(batch, label)
             batch = self.splitter.pre_validation_prepare(batch, label)
         result = self.find_best_estimator(batch, in_fold_dag=in_fold_dag)
         train_batch = batch
@@ -235,6 +241,14 @@ class ModelSelector(Estimator):
         train_eval: Dict[str, Any] = {}
         for ev in self.evaluators:
             train_eval[ev.name] = ev.evaluate_all(y, pred).to_json()
+
+        holdout_eval = None
+        if holdout is not None and len(holdout):
+            Xh, yh = extract_xy(holdout, label_f, feats_f)
+            ph = best_model.predict_arrays(Xh)
+            holdout_eval = {ev.name: ev.evaluate_all(yh, ph).to_json()
+                            for ev in self.evaluators}
+            self.holdout_eval = holdout_eval
 
         summary = ModelSelectorSummary(
             validation_type=result.validation_type,
@@ -262,6 +276,7 @@ class ModelSelector(Estimator):
                                 {result.metric_name: r.mean_metric})
                 for r in result.all_results],
             train_evaluation=train_eval,
+            holdout_evaluation=holdout_eval,
         )
 
         model = SelectedModel(best_model=best_model, **self._params)

@@ -55,6 +55,14 @@ class Splitter:
     def validation_prepare(self, batch: ColumnBatch, label: str) -> ColumnBatch:
         return batch
 
+    def validation_prepare_weights(self, y: np.ndarray,
+                                   w: np.ndarray) -> np.ndarray:
+        """Weight-space variant of ``validation_prepare`` for the static-shape
+        CV path: adjust per-row training weights (0 == excluded) instead of
+        materialising a resampled batch — keeps one HBM-resident X with no
+        per-fold reshapes."""
+        return w
+
 
 class DataSplitter(Splitter):
     """≙ DataSplitter: plain random split, no rebalancing."""
@@ -79,25 +87,42 @@ class DataBalancer(Splitter):
             "positiveFraction": pos / max(n, 1), "n": n})
         return batch
 
-    def validation_prepare(self, batch, label):
-        y = np.asarray(batch[label].values, dtype=np.float64)
-        n = len(y)
-        pos_idx = np.flatnonzero(y > 0.5)
-        neg_idx = np.flatnonzero(y <= 0.5)
-        small, big = (pos_idx, neg_idx) if len(pos_idx) <= len(neg_idx) else (neg_idx, pos_idx)
-        frac = len(small) / max(n, 1)
-        rng = np.random.default_rng(self.seed)
+    def _balance_keep(self, y: np.ndarray, idx: np.ndarray, rng) -> np.ndarray:
+        """Indices (subset of ``idx``) kept after majority-class down-sampling
+        towards ``sample_fraction`` + the ``max_training_sample`` cap."""
+        pos_idx = idx[y[idx] > 0.5]
+        neg_idx = idx[y[idx] <= 0.5]
+        small, big = ((pos_idx, neg_idx) if len(pos_idx) <= len(neg_idx)
+                      else (neg_idx, pos_idx))
+        frac = len(small) / max(len(idx), 1)
         if 0 < frac < self.sample_fraction:
             # down-sample the majority class to reach the target fraction
             target_big = int(len(small) * (1.0 - self.sample_fraction) / self.sample_fraction)
             big = rng.choice(big, size=max(min(target_big, len(big)), 1), replace=False)
-        idx = np.concatenate([small, big])
-        if len(idx) > self.max_training_sample:
-            idx = rng.choice(idx, size=self.max_training_sample, replace=False)
-        rng.shuffle(idx)
+        keep = np.concatenate([small, big])
+        if len(keep) > self.max_training_sample:
+            keep = rng.choice(keep, size=self.max_training_sample, replace=False)
+        return keep
+
+    def validation_prepare(self, batch, label):
+        y = np.asarray(batch[label].values, dtype=np.float64)
+        n = len(y)
+        rng = np.random.default_rng(self.seed)
+        keep = self._balance_keep(y, np.arange(n), rng)
+        rng.shuffle(keep)
         if self.summary is not None:
-            self.summary.info["downSampleFraction"] = len(idx) / max(n, 1)
-        return batch.take_rows(idx)
+            self.summary.info["downSampleFraction"] = len(keep) / max(n, 1)
+        return batch.take_rows(keep)
+
+    def validation_prepare_weights(self, y, w):
+        rng = np.random.default_rng(self.seed)
+        idx = np.flatnonzero(w > 0)
+        if not len(idx):
+            return w
+        keep = self._balance_keep(y, idx, rng)
+        out = np.zeros_like(w)
+        out[keep] = w[keep]
+        return out
 
 
 class DataCutter(Splitter):
@@ -134,6 +159,12 @@ class DataCutter(Splitter):
         y = np.asarray(batch[label].values, dtype=np.float64)
         mask = np.isin(y, np.asarray(self.labels_kept))
         return batch.take_rows(np.flatnonzero(mask))
+
+    def validation_prepare_weights(self, y, w):
+        if not self.labels_dropped:
+            return w
+        mask = np.isin(y, np.asarray(self.labels_kept))
+        return np.where(mask, w, 0.0).astype(w.dtype)
 
 
 # --------------------------------------------------------------------------
@@ -224,43 +255,100 @@ class OpValidator:
     # -- main entry -------------------------------------------------------
     def validate(self, candidates: Sequence[ModelCandidate], batch: ColumnBatch,
                  label: str, features: str,
-                 in_fold_dag: Optional[List[List[Any]]] = None) -> ValidationResult:
+                 in_fold_dag: Optional[List[List[Any]]] = None,
+                 splitter: Optional[Splitter] = None) -> ValidationResult:
+        """Run the CV/TVS grid.
+
+        The fast path (no in-fold DAG) keeps ONE data matrix in HBM and turns
+        folds into per-row weight masks, so each candidate family trains its
+        whole (fold × grid) block as a single batched XLA program
+        (``fit_arrays_grid``) with zero fold-shape recompiles — the TPU
+        re-design of the reference's k×Σ|grid| Spark-job fan-out
+        (OpValidator.scala:320-349).  ``splitter.validation_prepare_weights``
+        applies Balancer/Cutter preparation to each fold's *training* rows
+        (scoring stays on the untouched validation slice), matching the
+        reference flow.
+        """
         import copy
 
         from .dag import apply_dag, fit_dag
 
         y_all = np.asarray(batch[label].values, dtype=np.float64)
+        splits = self.splits(y_all)
         results: Dict[Tuple[str, int], ValidatedCandidate] = {}
-        for tr_idx, va_idx in self.splits(y_all):
-            tr_batch = batch.take_rows(tr_idx)
-            va_batch = batch.take_rows(va_idx)
+
+        def record(cand, ci, gi, params, fitted, X_va, y_va):
+            key = (cand.model_name, ci * 10000 + gi)
+            if key not in results:
+                results[key] = ValidatedCandidate(
+                    cand.model_name, dict(params), [], candidate_index=ci)
+            if fitted is None:
+                results[key].metric_values.append(float("nan"))
+                return
+            try:
+                est = cand.estimator
+                model = est.model_cls(fitted=fitted, **{**est._params, **params})
+                pred = model.predict_arrays(X_va)
+                metric = self.evaluator.evaluate(y_va, pred)
+            except Exception:  # noqa: BLE001 — candidate robustness
+                metric = float("nan")
+            results[key].metric_values.append(float(metric))
+
+        # (X, fold splits) groups: shared X across folds normally; per-fold X
+        # when feature stages must be refit inside the fold (leakage guard,
+        # ≙ OpCrossValidation.validate:87-147 DAG copy+refit).  A generator so
+        # only one fold's full-size matrix is resident at a time.
+        def fold_groups():
             if in_fold_dag:
-                # refit feature-engineering stages inside the fold to avoid
-                # leakage (≙ OpCrossValidation.validate:87-147 DAG copy+refit)
-                dag_copy = [[copy.deepcopy(s) for s in layer] for layer in in_fold_dag]
-                tr_batch, fitted = fit_dag(tr_batch, dag_copy)
-                va_batch = apply_dag(va_batch, fitted)
-            X_tr = np.asarray(tr_batch[features].values, dtype=np.float32)
-            y_tr = np.asarray(tr_batch[label].values, dtype=np.float32)
-            X_va = np.asarray(va_batch[features].values, dtype=np.float32)
-            y_va = np.asarray(va_batch[label].values, dtype=np.float32)
+                for tr_idx, va_idx in splits:
+                    dag_copy = [[copy.deepcopy(s) for s in layer]
+                                for layer in in_fold_dag]
+                    _, fitted_dag = fit_dag(batch.take_rows(tr_idx), dag_copy)
+                    full = apply_dag(batch, fitted_dag)
+                    yield (np.asarray(full[features].values, dtype=np.float32),
+                           [(tr_idx, va_idx)])
+            else:
+                yield (np.asarray(batch[features].values, dtype=np.float32),
+                       splits)
+
+        y32 = np.asarray(y_all, dtype=np.float32)
+        for X, fsplits in fold_groups():
+            N = X.shape[0]
+            W = np.zeros((len(fsplits), N), np.float32)
+            va_slices = []
+            for f, (tr_idx, va_idx) in enumerate(fsplits):
+                w = np.zeros(N, np.float32)
+                w[tr_idx] = 1.0
+                if splitter is not None:
+                    w = splitter.validation_prepare_weights(y_all, w)
+                W[f] = w
+                va_slices.append(va_idx)
             for ci, cand in enumerate(candidates):
-                for gi, params in enumerate(cand.grid):
-                    key = (cand.model_name, ci * 10000 + gi)
-                    if key not in results:
-                        results[key] = ValidatedCandidate(
-                            cand.model_name, dict(params), [], candidate_index=ci)
-                    try:
-                        est = copy.deepcopy(cand.estimator)
-                        for k, v in params.items():
-                            est.set(k, v)
-                        fitted_params = est.fit_arrays(X_tr, y_tr)
-                        model = est.model_cls(fitted=fitted_params, **est._params)
-                        pred = model.predict_arrays(X_va)
-                        metric = self.evaluator.evaluate(y_va, pred)
-                    except Exception:  # noqa: BLE001 — candidate robustness
-                        metric = float("nan")
-                    results[key].metric_values.append(float(metric))
+                try:
+                    fitted_grid = cand.estimator.fit_arrays_grid(
+                        X, y32, W, cand.grid)
+                except Exception:  # noqa: BLE001
+                    # batched fit failed as a block — retry per point so one
+                    # bad candidate can't take down the family (≙ Try-wrapped
+                    # fits in OpValidator.getSummary)
+                    fitted_grid = []
+                    for f in range(len(fsplits)):
+                        row = []
+                        for params in cand.grid:
+                            try:
+                                est = copy.deepcopy(cand.estimator)
+                                for k, v in params.items():
+                                    est.set(k, v)
+                                row.append(est.fit_arrays(
+                                    X, y32, sample_weight=W[f]))
+                            except Exception:  # noqa: BLE001
+                                row.append(None)
+                        fitted_grid.append(row)
+                for f, va_idx in enumerate(va_slices):
+                    X_va, y_va = X[va_idx], y32[va_idx]
+                    for gi, params in enumerate(cand.grid):
+                        record(cand, ci, gi, params, fitted_grid[f][gi],
+                               X_va, y_va)
 
         all_results = list(results.values())
         sign = 1.0 if self.evaluator.is_larger_better else -1.0

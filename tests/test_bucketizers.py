@@ -1,0 +1,186 @@
+"""Bucketizer/calibrator/scaler tests with golden values (reference suites:
+NumericBucketizerTest, DecisionTreeNumericBucketizerTest,
+PercentileCalibratorTest, ScalerTransformerTest,
+IsotonicRegressionCalibratorTest)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.columns import Column, ColumnBatch, numeric_column, object_column
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.ops.bucketizers import (
+    DecisionTreeNumericBucketizer, DecisionTreeNumericMapBucketizer,
+    DescalerTransformer, IsotonicRegressionCalibrator, NumericBucketizer,
+    PercentileCalibrator, ScalerTransformer, pav_fit)
+
+
+def _real(name):
+    return FeatureBuilder.Real(name).as_predictor()
+
+
+def _realnn(name, response=False):
+    fb = FeatureBuilder.RealNN(name)
+    return fb.as_response() if response else fb.as_predictor()
+
+
+def test_numeric_bucketizer_golden():
+    f = _real("x")
+    st = NumericBucketizer(splits=[0.0, 5.0, 10.0], track_nulls=True,
+                           track_invalid=True)
+    st.set_input(f)
+    batch = ColumnBatch({"x": numeric_column(T.Real, [1.0, 7.0, -3.0, None])}, 4)
+    col = st.transform(batch)
+    out = np.asarray(col.values)
+    # columns: [0-5), [5-10), invalid, null
+    assert out.shape == (4, 4)
+    assert out[0].tolist() == [1, 0, 0, 0]
+    assert out[1].tolist() == [0, 1, 0, 0]
+    assert out[2].tolist() == [0, 0, 1, 0]   # below range -> invalid
+    assert out[3].tolist() == [0, 0, 0, 1]   # missing -> null
+    labels = [c.indicator_value for c in col.meta.columns]
+    assert labels == ["[0.0-5.0)", "[5.0-10.0)", "OTHER", "NullIndicatorValue"]
+
+
+def test_numeric_bucketizer_validates_splits():
+    with pytest.raises(ValueError):
+        NumericBucketizer(splits=[1.0, 0.0, 2.0])
+    with pytest.raises(ValueError):
+        NumericBucketizer(splits=[0.0, 1.0])
+
+
+def test_decision_tree_bucketizer_finds_label_split():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 10, size=500)
+    y = (x > 3.0).astype(np.float64)
+    label = _realnn("label", response=True)
+    f = _real("x")
+    st = DecisionTreeNumericBucketizer()
+    st.set_input(label, f)
+    batch = ColumnBatch({"label": numeric_column(T.RealNN, y),
+                         "x": numeric_column(T.Real, x)}, 500)
+    model = st.fit(batch)
+    splits = np.asarray(model.fitted["splits"])
+    assert model.fitted["should_split"]
+    inner = splits[np.isfinite(splits)]
+    assert any(abs(s - 3.0) < 0.5 for s in inner), inner
+    out = np.asarray(model.transform(batch).values)
+    # buckets must separate the classes nearly perfectly
+    low_bucket = out[:, 0] > 0.5
+    assert (low_bucket == (y < 0.5)).mean() > 0.95
+
+
+def test_decision_tree_bucketizer_no_split_on_noise():
+    rng = np.random.default_rng(1)
+    x = rng.uniform(0, 1, size=300)
+    y = rng.integers(0, 2, size=300).astype(np.float64)  # label independent
+    st = DecisionTreeNumericBucketizer(min_info_gain=0.05)
+    st.set_input(_realnn("label", True), _real("x"))
+    batch = ColumnBatch({"label": numeric_column(T.RealNN, y),
+                         "x": numeric_column(T.Real, x)}, 300)
+    model = st.fit(batch)
+    if not model.fitted["should_split"]:
+        out = np.asarray(model.transform(batch).values)
+        assert out.shape == (300, 1)  # null-indicator only
+        assert out.sum() == 0.0
+
+
+def test_decision_tree_map_bucketizer():
+    rng = np.random.default_rng(2)
+    n = 400
+    a = rng.uniform(0, 10, size=n)
+    y = (a > 6.0).astype(np.float64)
+    maps = [{"a": float(a[i]), "b": float(rng.uniform())} for i in range(n)]
+    st = DecisionTreeNumericMapBucketizer(min_info_gain=0.05)
+    st.set_input(_realnn("label", True),
+                 FeatureBuilder.RealMap("m").as_predictor())
+    batch = ColumnBatch({"label": numeric_column(T.RealNN, y),
+                         "m": object_column(T.RealMap, maps)}, n)
+    model = st.fit(batch)
+    assert model.fitted["keys"] == ["a", "b"]
+    assert len(model.fitted["splits_by_key"]["a"]) >= 3
+    out = np.asarray(model.transform(batch).values)
+    assert out.shape[0] == n and out.shape[1] >= 2
+    groups = {c.grouping for c in model.transform(batch).meta.columns}
+    assert groups == {"a", "b"}
+
+
+def test_percentile_calibrator():
+    v = np.arange(1000, dtype=np.float64)
+    st = PercentileCalibrator(expected_num_buckets=100)
+    st.set_input(_realnn("score"))
+    batch = ColumnBatch({"score": numeric_column(T.RealNN, v)}, 1000)
+    model = st.fit(batch)
+    out = np.asarray(model.transform(batch).values)
+    assert out.min() == 0.0 and out.max() == 99.0
+    # monotone non-decreasing over sorted input
+    assert (np.diff(out) >= 0).all()
+    # value at the median lands mid-range
+    assert 45 <= out[500] <= 55
+
+
+def test_scaler_descaler_roundtrip():
+    f = _real("x")
+    scaled_f = f.scale("Linear", {"slope": 2.0, "intercept": 3.0})
+    st = scaled_f.origin_stage
+    v = np.asarray([1.0, -2.0, 0.5])
+    batch = ColumnBatch({"x": numeric_column(T.Real, v)}, 3)
+    scaled = st.transform(batch)
+    assert np.allclose(np.asarray(scaled.values), 2.0 * v + 3.0)
+    # descale back through the scaler metadata on the scaled feature
+    desc = DescalerTransformer()
+    desc.set_input(scaled_f, scaled_f)
+    b2 = ColumnBatch({scaled_f.name: scaled}, 3)
+    back = desc.transform(b2)
+    assert np.allclose(np.asarray(back.values), v, atol=1e-5)
+
+
+def test_log_scaler():
+    f = _real("x")
+    st = ScalerTransformer(scaling_type="Logarithmic")
+    st.set_input(f)
+    v = np.asarray([1.0, np.e, np.e ** 2])
+    batch = ColumnBatch({"x": numeric_column(T.Real, v)}, 3)
+    out = np.asarray(st.transform(batch).values)
+    assert np.allclose(out, [0.0, 1.0, 2.0], atol=1e-5)
+    with pytest.raises(ValueError):
+        ScalerTransformer(scaling_type="Linear", scaling_args={"slope": 0.0})
+
+
+def test_pav_golden():
+    x = np.asarray([1.0, 2.0, 3.0, 4.0])
+    y = np.asarray([1.0, 3.0, 2.0, 4.0])
+    bounds, vals = pav_fit(x, y)
+    # adjacent violators 3,2 pool to 2.5
+    assert np.interp(1.0, bounds, vals) == 1.0
+    assert np.interp(2.0, bounds, vals) == 2.5
+    assert np.interp(3.0, bounds, vals) == 2.5
+    assert np.interp(4.0, bounds, vals) == 4.0
+    # interpolation between boundaries (Spark contract)
+    assert 1.0 < np.interp(1.5, bounds, vals) < 2.5
+
+
+def test_isotonic_calibrator_stage():
+    rng = np.random.default_rng(3)
+    n = 500
+    score = rng.uniform(0, 1, size=n)
+    y = (rng.uniform(size=n) < score).astype(np.float64)  # calibrated-ish
+    st = IsotonicRegressionCalibrator()
+    st.set_input(_realnn("label", True), _realnn("score"))
+    batch = ColumnBatch({"label": numeric_column(T.RealNN, y),
+                         "score": numeric_column(T.RealNN, score)}, n)
+    model = st.fit(batch)
+    out = np.asarray(model.transform(batch).values)
+    # monotone in score
+    order = np.argsort(score)
+    assert (np.diff(out[order]) >= -1e-6).all()
+    assert 0.0 <= out.min() and out.max() <= 1.0
+    # save/load roundtrip via stage contract
+    from transmogrifai_tpu.stages.serialization import (
+        stage_fitted_arrays, stage_from_json, stage_to_json)
+    j = stage_to_json(model)
+    m2 = stage_from_json(j, stage_fitted_arrays(model))
+    m2.input_features = model.input_features
+    m2._output = model._output
+    out2 = np.asarray(m2.transform(batch).values)
+    assert np.allclose(out, out2)

@@ -7,6 +7,25 @@ selection over linear and tree-ensemble models trained data-parallel on the
 TPU mesh, evaluators, model insights, and a serializable workflow model.
 """
 
+import os as _os
+
+# Persistent XLA compilation cache: fitted-grid / tree programs are large and
+# their compiles dominate cold-start wall time; caching them on disk makes
+# every run after the first pay execution cost only (the TPU analog of the
+# JVM/Spark warm-start the reference relies on).  Opt out with
+# TRANSMOGRIFAI_COMPILATION_CACHE=0.
+if _os.environ.get("TRANSMOGRIFAI_COMPILATION_CACHE", "1") != "0":
+    try:
+        import jax as _jax
+
+        _jax.config.update(
+            "jax_compilation_cache_dir",
+            _os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                            "/tmp/transmogrifai_tpu_jax_cache"))
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # pragma: no cover — cache is best-effort
+        pass
+
 from . import types
 from .aggregators import CustomMonoidAggregator, MonoidAggregator
 from .columns import Column, ColumnBatch

@@ -37,24 +37,21 @@ def _scores_from_pred(pred: Dict[str, np.ndarray]) -> np.ndarray:
 
 def auroc(y: np.ndarray, scores: np.ndarray) -> float:
     """Area under ROC via the rank-sum (Mann-Whitney) identity with midrank
-    tie handling."""
+    tie handling (fully vectorised — one sort + group cumsums)."""
     y = np.asarray(y) > 0.5
     n_pos = int(y.sum())
     n_neg = len(y) - n_pos
     if n_pos == 0 or n_neg == 0:
         return 0.0
     order = np.argsort(scores, kind="mergesort")
-    ranks = np.empty(len(scores), dtype=np.float64)
-    sorted_scores = scores[order]
-    i = 0
-    r = np.arange(1, len(scores) + 1, dtype=np.float64)
-    # midranks for ties
-    while i < len(scores):
-        j = i
-        while j + 1 < len(scores) and sorted_scores[j + 1] == sorted_scores[i]:
-            j += 1
-        ranks[order[i:j + 1]] = 0.5 * (r[i] + r[j])
-        i = j + 1
+    s = scores[order]
+    boundary = np.r_[True, s[1:] != s[:-1]]
+    gid = np.cumsum(boundary) - 1                  # tie-group id per sorted row
+    counts = np.bincount(gid)
+    cum = np.cumsum(counts).astype(np.float64)     # last 1-based rank in group
+    mid = cum - (counts - 1) / 2.0                 # average rank of the group
+    ranks = np.empty(len(s), dtype=np.float64)
+    ranks[order] = mid[gid]
     rank_sum = ranks[y].sum()
     return float((rank_sum - n_pos * (n_pos + 1) / 2.0) / (n_pos * n_neg))
 
@@ -160,6 +157,14 @@ class OpEvaluatorBase:
     def evaluate(self, y: np.ndarray, pred: Dict[str, np.ndarray]) -> float:
         return float(self.evaluate_all(y, pred)[self.default_metric])
 
+    def evaluate_masked(self, y_dev, device_out: Dict[str, Any],
+                        w_dev) -> Optional[float]:
+        """Device fast path for the CV loop: score ``device_out`` (a model's
+        ``device_scores`` result) over the 0/1 row mask ``w_dev`` without any
+        bulk device→host transfer.  Returns None when this evaluator/metric
+        has no device implementation (caller falls back to the host path)."""
+        return None
+
 
 class OpBinaryClassificationEvaluator(OpEvaluatorBase):
     """≙ OpBinaryClassificationEvaluator.scala:67-185."""
@@ -180,6 +185,52 @@ class OpBinaryClassificationEvaluator(OpEvaluatorBase):
         m["AuPR"] = aupr(y, scores)
         m.update(threshold_metrics(y, scores, self.thresholds))
         return EvaluationMetrics(m)
+
+    def evaluate(self, y, pred) -> float:
+        # fast single-metric path for the CV loop — skips the per-threshold
+        # panel the selector never reads
+        y = np.asarray(y, dtype=np.float64)
+        m = self.default_metric
+        if m == "AuROC":
+            return auroc(y, _scores_from_pred(pred))
+        if m == "AuPR":
+            return aupr(y, _scores_from_pred(pred))
+        if m in ("Precision", "Recall", "F1", "Error"):
+            return binary_confusion(
+                y, np.asarray(pred["prediction"], dtype=np.float64))[m]
+        return super().evaluate(y, pred)
+
+    def evaluate_masked(self, y_dev, device_out, w_dev) -> Optional[float]:
+        from .metrics_device import (masked_aupr, masked_auroc,
+                                     masked_binary_confusion)
+        m = self.default_metric
+        if m in ("AuROC", "AuPR"):
+            s = device_out.get("scores")
+            if s is None:
+                p = device_out.get("probability")
+                if p is not None and getattr(p, "ndim", 0) == 2 and p.shape[1] == 2:
+                    s = p[:, 1]
+            if s is None:
+                return None
+            fn = masked_auroc if m == "AuROC" else masked_aupr
+            return float(fn(y_dev, s, w_dev))
+        if m in ("Precision", "Recall", "F1", "Error"):
+            pred = device_out.get("prediction")
+            if pred is None:
+                return None
+            tp, fp, tn, fn_ = (float(v) for v in np.asarray(
+                masked_binary_confusion(y_dev, pred, w_dev)))
+            precision = tp / (tp + fp) if tp + fp > 0 else 0.0
+            recall = tp / (tp + fn_) if tp + fn_ > 0 else 0.0
+            if m == "Precision":
+                return precision
+            if m == "Recall":
+                return recall
+            if m == "F1":
+                return (2 * precision * recall / (precision + recall)
+                        if precision + recall > 0 else 0.0)
+            return (fp + fn_) / max(tp + fp + tn + fn_, 1.0)
+        return None
 
 
 class OpMultiClassificationEvaluator(OpEvaluatorBase):
@@ -235,6 +286,38 @@ class OpMultiClassificationEvaluator(OpEvaluatorBase):
                 "topNs": list(self.top_ns), "nBins": self.n_bins, "byTopN": topns}
         return EvaluationMetrics(m)
 
+    def evaluate(self, y, pred) -> float:
+        # confusion-only fast path for the CV loop (skips top-N-by-bin panel)
+        if self.default_metric not in ("Precision", "Recall", "F1", "Error"):
+            return super().evaluate(y, pred)
+        fast = {"prediction": pred["prediction"]}
+        return float(self.evaluate_all(y, fast)[self.default_metric])
+
+    def evaluate_masked(self, y_dev, device_out, w_dev) -> Optional[float]:
+        if self.default_metric not in ("Precision", "Recall", "F1", "Error"):
+            return None
+        pred = device_out.get("prediction")
+        if pred is None:
+            return None
+        import jax.numpy as jnp
+
+        from .metrics_device import masked_multiclass_confusion
+        C = int(jnp.maximum(jnp.max(y_dev), jnp.max(pred))) + 1
+        conf = np.asarray(masked_multiclass_confusion(
+            y_dev, pred, w_dev, n_classes=C), dtype=np.float64)
+        support = conf.sum(axis=1)
+        tp = np.diag(conf)
+        pred_count = conf.sum(axis=0)
+        prec_c = np.divide(tp, pred_count, out=np.zeros(C), where=pred_count > 0)
+        rec_c = np.divide(tp, support, out=np.zeros(C), where=support > 0)
+        f1_c = np.divide(2 * prec_c * rec_c, prec_c + rec_c,
+                         out=np.zeros(C), where=(prec_c + rec_c) > 0)
+        wts = support / max(support.sum(), 1.0)
+        return {"Precision": float(wts @ prec_c), "Recall": float(wts @ rec_c),
+                "F1": float(wts @ f1_c),
+                "Error": 1.0 - float(tp.sum() / max(support.sum(), 1.0)),
+                }[self.default_metric]
+
 
 class OpRegressionEvaluator(OpEvaluatorBase):
     """≙ OpRegressionEvaluator: RMSE/MSE/R2/MAE + signed-error histogram."""
@@ -262,6 +345,31 @@ class OpRegressionEvaluator(OpEvaluatorBase):
             "SignedPercentageErrorHistogram": {
                 "counts": counts.tolist(), "bins": edges.tolist()},
         })
+
+    def evaluate(self, y, pred) -> float:
+        y = np.asarray(y, dtype=np.float64)
+        yhat = np.asarray(pred["prediction"], dtype=np.float64)
+        err = yhat - y
+        m = self.default_metric
+        if m == "RootMeanSquaredError":
+            return float(np.sqrt(np.mean(err ** 2))) if len(y) else 0.0
+        if m == "MeanSquaredError":
+            return float(np.mean(err ** 2)) if len(y) else 0.0
+        if m == "MeanAbsoluteError":
+            return float(np.mean(np.abs(err))) if len(y) else 0.0
+        return super().evaluate(y, pred)
+
+    def evaluate_masked(self, y_dev, device_out, w_dev) -> Optional[float]:
+        pred = device_out.get("prediction")
+        if pred is None or self.default_metric not in (
+                "RootMeanSquaredError", "MeanSquaredError", "MeanAbsoluteError"):
+            return None
+        from .metrics_device import masked_reg_errors
+        mse, mae = (float(v) for v in np.asarray(
+            masked_reg_errors(y_dev, pred, w_dev)))
+        return {"RootMeanSquaredError": float(np.sqrt(mse)),
+                "MeanSquaredError": mse,
+                "MeanAbsoluteError": mae}[self.default_metric]
 
 
 class OpForecastEvaluator(OpEvaluatorBase):

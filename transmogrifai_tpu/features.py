@@ -109,6 +109,28 @@ class Feature:
         st.set_input(self, feature_vector)
         return st.get_output()
 
+    def auto_bucketize(self, label: "Feature", **kw) -> "Feature":
+        """Label-driven decision-tree bucketization
+        (≙ RichNumericFeature.autoBucketize)."""
+        from .ops.bucketizers import DecisionTreeNumericBucketizer
+        st = DecisionTreeNumericBucketizer(**kw)
+        st.set_input(label, self)
+        return st.get_output()
+
+    def bucketize(self, splits, **kw) -> "Feature":
+        """Fixed-split bucketization (≙ RichNumericFeature.bucketize)."""
+        from .ops.bucketizers import NumericBucketizer
+        return self.transform_with(NumericBucketizer(splits=splits, **kw))
+
+    def scale(self, scaling_type: str = "Linear", scaling_args=None, **kw) -> "Feature":
+        from .ops.bucketizers import ScalerTransformer
+        return self.transform_with(ScalerTransformer(
+            scaling_type=scaling_type, scaling_args=scaling_args, **kw))
+
+    def descale(self, scaled: "Feature", **kw) -> "Feature":
+        from .ops.bucketizers import DescalerTransformer
+        return self.transform_with(DescalerTransformer(**kw), scaled)
+
 
 class FeatureBuilder:
     """Typed feature declaration (≙ FeatureBuilder.scala:48).

@@ -1,0 +1,100 @@
+"""Masked on-device metrics for the CV loop.
+
+Why this exists: on real TPU hardware the host link can be orders of magnitude
+slower than HBM (observed ~13 MB/s h2d / ~4 MB/s d2h through the axon tunnel),
+so pulling per-candidate prediction vectors to the host to score them — the
+obvious port of the reference's evaluator.evaluateAll(Dataset) — costs more
+than all the training matmuls combined.  Instead every validation metric is a
+jitted reduction over the FULL row set with a 0/1 validation mask, so fold
+slicing never changes array shapes (one compile covers every fold) and only
+the final scalar crosses the link.
+
+Ties are handled exactly (midranks for AuROC, threshold grouping for AuPR)
+via the sorted-searchsorted trick: for sorted scores, searchsorted(s, s,
+"left"/"right") gives each row's tie-group boundaries without dynamic shapes.
+
+≙ reference evaluators OpBinaryClassificationEvaluator.scala:67-185 /
+OpRegressionEvaluator / OpMultiClassificationEvaluator semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def masked_auroc(y: jnp.ndarray, scores: jnp.ndarray, w: jnp.ndarray):
+    """Weighted Mann-Whitney AUC with exact tie handling.  ``w`` is a 0/1 (or
+    weighted) row mask; rows with w=0 are ignored."""
+    order = jnp.argsort(scores)
+    ss = scores[order]
+    yy = y[order]
+    ww = w[order]
+    wpos = ww * (yy > 0.5)
+    wneg = ww * (yy <= 0.5)
+    prefix_neg = jnp.concatenate([jnp.zeros(1, wneg.dtype), jnp.cumsum(wneg)])
+    left = jnp.searchsorted(ss, ss, side="left")
+    right = jnp.searchsorted(ss, ss, side="right")
+    below = prefix_neg[left]                   # neg weight strictly below
+    same = prefix_neg[right] - prefix_neg[left]  # neg weight in tie group
+    num = jnp.sum(wpos * (below + 0.5 * same))
+    n_pos = jnp.sum(wpos)
+    n_neg = jnp.sum(wneg)
+    return jnp.where(n_pos * n_neg > 0, num / jnp.maximum(n_pos * n_neg, 1e-12), 0.0)
+
+
+@jax.jit
+def masked_aupr(y: jnp.ndarray, scores: jnp.ndarray, w: jnp.ndarray):
+    """Weighted area under the PR curve, MLlib-style (threshold-grouped,
+    trapezoid over recall with a prepended (0, 1) point)."""
+    order = jnp.argsort(-scores)
+    ss = scores[order]
+    yy = y[order]
+    ww = w[order]
+    tp_run = jnp.cumsum(ww * (yy > 0.5))
+    fp_run = jnp.cumsum(ww * (yy <= 0.5))
+    # group rows by distinct threshold: every row reads its tie-group's LAST
+    # cumsum (the value at the threshold boundary); duplicated points then
+    # contribute zero width to the trapezoid
+    neg = -ss  # ascending for searchsorted
+    right = jnp.searchsorted(neg, neg, side="right") - 1
+    tp = tp_run[right]
+    fp = fp_run[right]
+    n_pos = jnp.maximum(tp_run[-1], 1e-12)
+    precision = tp / jnp.maximum(tp + fp, 1e-12)
+    recall = tp / n_pos
+    recall = jnp.concatenate([jnp.zeros(1, recall.dtype), recall])
+    precision = jnp.concatenate([jnp.ones(1, precision.dtype), precision])
+    return jnp.where(tp_run[-1] > 0,
+                     jnp.trapezoid(precision, recall), 0.0)
+
+
+@jax.jit
+def masked_binary_confusion(y: jnp.ndarray, yhat: jnp.ndarray, w: jnp.ndarray):
+    """Returns [tp, fp, tn, fn] weighted counts as ONE stacked array (a single
+    scalar-block transfer over the host link)."""
+    yp = y > 0.5
+    hp = yhat > 0.5
+    return jnp.stack([jnp.sum(w * (yp & hp)), jnp.sum(w * (~yp & hp)),
+                      jnp.sum(w * (~yp & ~hp)), jnp.sum(w * (yp & ~hp))])
+
+
+@jax.jit
+def masked_reg_errors(y: jnp.ndarray, yhat: jnp.ndarray, w: jnp.ndarray):
+    """Returns [mse, mae] over masked rows as one stacked array."""
+    wsum = jnp.maximum(jnp.sum(w), 1e-12)
+    err = yhat - y
+    return jnp.stack([jnp.sum(w * err * err) / wsum,
+                      jnp.sum(w * jnp.abs(err)) / wsum])
+
+
+@functools.partial(jax.jit, static_argnames=("n_classes",))
+def masked_multiclass_confusion(y: jnp.ndarray, yhat: jnp.ndarray,
+                                w: jnp.ndarray, *, n_classes: int):
+    """Weighted [C, C] confusion matrix via one-hot matmul on the MXU."""
+    yo = jax.nn.one_hot(y.astype(jnp.int32), n_classes, dtype=jnp.float32)
+    ho = jax.nn.one_hot(yhat.astype(jnp.int32), n_classes, dtype=jnp.float32)
+    return (yo * w[:, None]).T @ ho

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional, Tuple, Type
 
+import jax.numpy as jnp
 import numpy as np
 
 from ..columns import Column, ColumnBatch
@@ -33,11 +34,19 @@ def prediction_column(prediction: np.ndarray,
 
 def extract_xy(batch: ColumnBatch, label_feature, features_feature
                ) -> Tuple[np.ndarray, np.ndarray]:
-    """Pull (X [N,D] float32, y [N] float32) out of a batch."""
+    """Pull (X [N,D] float32, y [N] float32) out of a batch.  Device-resident
+    feature matrices are returned AS-IS — fits consume them on device, and
+    forcing a host copy here would cross the (slow) accelerator link twice."""
+    import jax
+
     ycol = batch[label_feature.name]
     xcol = batch[features_feature.name]
     y = np.asarray(ycol.values, dtype=np.float32)
-    X = np.asarray(xcol.values, dtype=np.float32)
+    xv = xcol.values
+    if isinstance(xv, jax.Array):
+        X = xv if xv.dtype == jnp.float32 else xv.astype(jnp.float32)
+    else:
+        X = np.asarray(xv, dtype=np.float32)
     return X, y
 
 

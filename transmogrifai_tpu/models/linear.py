@@ -67,18 +67,58 @@ def _grouped_grid_fit(est, X, y, fold_weights, grids, *, loss: str,
     return out
 
 
+def _np_sigmoid(z: np.ndarray) -> np.ndarray:
+    return 1.0 / (1.0 + np.exp(-np.clip(z, -60.0, 60.0)))
+
+
+def _np_softmax(z: np.ndarray) -> np.ndarray:
+    z = z - np.max(z, axis=-1, keepdims=True)
+    e = np.exp(z)
+    return e / np.sum(e, axis=-1, keepdims=True)
+
+
 def _binary_outputs(margin: np.ndarray) -> Dict[str, np.ndarray]:
-    p1 = jax.nn.sigmoid(jnp.asarray(margin))
-    prob = jnp.stack([1.0 - p1, p1], axis=1)
-    raw = jnp.stack([-jnp.asarray(margin), jnp.asarray(margin)], axis=1)
-    return {"prediction": np.asarray(p1 > 0.5, dtype=np.float32),
-            "probability": np.asarray(prob),
-            "rawPrediction": np.asarray(raw)}
+    """Prediction triple from binary margins.  Pure numpy on purpose: scoring
+    is elementwise host work; eager JAX dispatch here costs device round-trips
+    per CV candidate (the fits are the device programs, not this)."""
+    margin = np.asarray(margin, dtype=np.float32)
+    p1 = _np_sigmoid(margin)
+    prob = np.stack([1.0 - p1, p1], axis=1)
+    raw = np.stack([-margin, margin], axis=1)
+    return {"prediction": (p1 > 0.5).astype(np.float32),
+            "probability": prob, "rawPrediction": raw}
 
 
 class LinearPredictionModel(PredictionModel):
     """Fitted linear model.  ``fitted``: coef [D] or [D,C], intercept,
     kind ∈ {binary, multinomial, regression, svc}."""
+
+    def device_scores(self, Xd) -> Dict[str, Any]:
+        """Device-resident scoring for the CV loop: returns small per-row
+        device arrays ({'prediction', 'scores'|'probability'}) so only
+        scalars/metric results ever cross the (slow) host link."""
+        coef = jnp.asarray(self.fitted["coef"])
+        intercept = jnp.asarray(self.fitted["intercept"])
+        kind = self.fitted["kind"]
+        if kind == "multinomial":
+            logits = Xd @ coef + intercept
+            return {"prediction": jnp.argmax(logits, axis=1).astype(jnp.float32),
+                    "probability": jax.nn.softmax(logits, axis=-1)}
+        margin = Xd @ coef + (intercept[0] if intercept.ndim else intercept)
+        if kind == "binary":
+            return {"prediction": (margin > 0).astype(jnp.float32),
+                    "scores": jax.nn.sigmoid(margin)}
+        if kind == "svc":
+            return {"prediction": (margin > 0).astype(jnp.float32),
+                    "scores": margin}
+        if kind == "glm":
+            family = self.fitted.get("family", "gaussian")
+            eta = jnp.clip(margin, -30.0, 30.0)
+            pred = {"poisson": jnp.exp, "gamma": jnp.exp,
+                    "binomial": jax.nn.sigmoid,
+                    "gaussian": lambda e: e}[family](eta)
+            return {"prediction": pred}
+        return {"prediction": margin}
 
     def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
         coef = np.asarray(self.fitted["coef"], dtype=np.float32)
@@ -86,7 +126,7 @@ class LinearPredictionModel(PredictionModel):
         kind = self.fitted["kind"]
         if kind == "multinomial":
             logits = X @ coef + intercept
-            prob = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+            prob = _np_softmax(logits)
             return {"prediction": np.argmax(logits, axis=1).astype(np.float32),
                     "probability": prob, "rawPrediction": logits}
         margin = X @ coef + (intercept[0] if intercept.ndim else intercept)
@@ -299,11 +339,21 @@ OpGeneralizedLinearRegression.model_cls = GLMPredictionModel
 class NaiveBayesModel(PredictionModel):
     """Fitted multinomial NB: log_prior [C], log_prob [C,D]."""
 
+    def device_scores(self, Xd) -> Dict[str, Any]:
+        logits = (jnp.maximum(Xd, 0.0) @ jnp.asarray(self.fitted["log_prob"]).T
+                  + jnp.asarray(self.fitted["log_prior"]))
+        prob = jax.nn.softmax(logits, axis=-1)
+        out = {"prediction": jnp.argmax(logits, axis=1).astype(jnp.float32),
+               "probability": prob}
+        if prob.shape[1] == 2:
+            out["scores"] = prob[:, 1]
+        return out
+
     def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
         log_prior = np.asarray(self.fitted["log_prior"])
         log_prob = np.asarray(self.fitted["log_prob"])
         logits = np.maximum(X, 0.0) @ log_prob.T + log_prior
-        prob = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+        prob = _np_softmax(logits)
         return {"prediction": np.argmax(logits, axis=1).astype(np.float32),
                 "probability": prob, "rawPrediction": logits}
 
@@ -330,17 +380,31 @@ class OpNaiveBayes(PredictorEstimator):
 class MLPClassificationModel(PredictionModel):
     """Fitted MLP: list of (W, b) per layer."""
 
-    def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
-        h = jnp.asarray(X)
+    def device_scores(self, Xd) -> Dict[str, Any]:
+        h = Xd
         n_layers = self.fitted["n_layers"]
         for i in range(n_layers):
-            W = jnp.asarray(self.fitted[f"W{i}"])
-            b = jnp.asarray(self.fitted[f"b{i}"])
-            h = h @ W + b
+            h = h @ jnp.asarray(self.fitted[f"W{i}"]) + jnp.asarray(self.fitted[f"b{i}"])
             if i < n_layers - 1:
                 h = jax.nn.relu(h)
-        logits = np.asarray(h)
-        prob = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+        prob = jax.nn.softmax(h, axis=-1)
+        out = {"prediction": jnp.argmax(h, axis=1).astype(jnp.float32),
+               "probability": prob}
+        if prob.shape[1] == 2:
+            out["scores"] = prob[:, 1]
+        return out
+
+    def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        h = np.asarray(X, dtype=np.float32)
+        n_layers = self.fitted["n_layers"]
+        for i in range(n_layers):
+            W = np.asarray(self.fitted[f"W{i}"])
+            b = np.asarray(self.fitted[f"b{i}"])
+            h = h @ W + b
+            if i < n_layers - 1:
+                h = np.maximum(h, 0.0)
+        logits = h
+        prob = _np_softmax(logits)
         return {"prediction": np.argmax(logits, axis=1).astype(np.float32),
                 "probability": prob, "rawPrediction": logits}
 

@@ -52,10 +52,15 @@ def _mxu_dtype():
 
 def build_bin_splits(X: np.ndarray, max_bins: int = MAX_BINS_DEFAULT) -> np.ndarray:
     """Per-feature quantile split points → [D, max_bins-1] float32, padded
-    with +inf (≙ Spark's findSplits quantile sketch)."""
+    with +inf (≙ Spark's findSplits quantile sketch).  Device-resident inputs
+    are quantiled on device — only the tiny [D, B] result crosses the link."""
     n, d = X.shape
     qs = np.linspace(0, 1, max_bins + 1)[1:-1]
-    splits = np.quantile(X, qs, axis=0).T.astype(np.float32)  # [D, max_bins-1]
+    if isinstance(X, jax.Array):
+        splits = np.asarray(jnp.quantile(
+            X, jnp.asarray(qs, jnp.float32), axis=0)).T.astype(np.float32)
+    else:
+        splits = np.quantile(X, qs, axis=0).T.astype(np.float32)  # [D, max_bins-1]
     # dedupe per row; pad with +inf so empty bins are harmless
     out = np.full((d, max_bins - 1), np.inf, dtype=np.float32)
     for j in range(d):
@@ -507,13 +512,77 @@ def _gbt_grid_round_fitter(task: str, max_depth: int, n_bins: int, chunk: int,
 # prediction models + estimator stages
 # --------------------------------------------------------------------------
 
+def _predict_trees_np(X: np.ndarray, feature: np.ndarray, threshold: np.ndarray,
+                      is_leaf: np.ndarray, leaf: np.ndarray,
+                      max_depth: int) -> np.ndarray:
+    """Numpy twin of ``predict_trees_raw`` — scoring is gather-bound host work;
+    running it here avoids a fresh XLA compile per validation-slice shape in
+    the CV loop.  Returns [N, Tr, V]."""
+    N = X.shape[0]
+    Tr = feature.shape[0]
+    node = np.zeros((N, Tr), np.int32)
+    ar = np.arange(Tr)[None, :]
+    for _ in range(max_depth):
+        f = feature[ar, node]
+        th = threshold[ar, node]
+        lf = is_leaf[ar, node]
+        xf = np.take_along_axis(X, np.maximum(f, 0), axis=1)
+        nxt = 2 * node + 1 + (xf > th).astype(np.int32)
+        node = np.where(lf, node, nxt)
+    return leaf[ar, node]
+
+
 class TreeEnsembleModel(PredictionModel):
+    def device_scores(self, Xd) -> Dict[str, Any]:
+        """Device-resident scoring: leaves are aggregated in HBM and only
+        [N]/[N,C]-sized results exist afterwards — never transfer the
+        [N, Tr, V] leaf tensor over the (slow) host link."""
+        f = self.fitted
+        leaves = predict_trees_raw(
+            Xd, jnp.asarray(f["feature"]), jnp.asarray(f["threshold"]),
+            jnp.asarray(f["is_leaf"]), jnp.asarray(f["leaf"]),
+            int(f["max_depth"]) + 1)                           # [N, Tr, V]
+        if f["kind"] == "forest":
+            if f["task"] == "classification":
+                prob = jnp.mean(leaves, axis=1)
+                prob = prob / jnp.maximum(
+                    jnp.sum(prob, axis=1, keepdims=True), 1e-12)
+                out = {"prediction": jnp.argmax(prob, axis=1).astype(jnp.float32),
+                       "probability": prob}
+                if prob.shape[1] == 2:
+                    out["scores"] = prob[:, 1]
+                return out
+            return {"prediction": jnp.mean(leaves[:, :, 0], axis=1)}
+        margin = f["base"] + f["eta"] * jnp.sum(leaves[:, :, 0], axis=1)
+        if f["task"] == "classification":
+            p1 = jax.nn.sigmoid(margin)
+            return {"prediction": (p1 > 0.5).astype(jnp.float32),
+                    "scores": p1, "margin": margin}
+        return {"prediction": margin}
+
     def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
         f = self.fitted
-        leaves = np.asarray(predict_trees_raw(
-            jnp.asarray(X, jnp.float32), jnp.asarray(f["feature"]),
-            jnp.asarray(f["threshold"]), jnp.asarray(f["is_leaf"]),
-            jnp.asarray(f["leaf"]), int(f["max_depth"]) + 1))  # [N, Tr, V]
+        depth_iters = int(f["max_depth"]) + 1
+        if isinstance(X, jax.Array) and _mxu_dtype() != jnp.float32:
+            # X already lives on a real accelerator: score there and pull only
+            # the per-row results
+            out = self.device_scores(X)
+            if f["kind"] == "forest" and f["task"] == "classification":
+                prob = np.asarray(out["probability"])
+                return {"prediction": np.asarray(out["prediction"]),
+                        "probability": prob,
+                        "rawPrediction": np.log(np.maximum(prob, 1e-12))}
+            if f["kind"] == "gbt" and f["task"] == "classification":
+                margin = np.asarray(out["margin"])
+                p1 = np.asarray(out["scores"])
+                return {"prediction": np.asarray(out["prediction"]),
+                        "probability": np.stack([1 - p1, p1], axis=1),
+                        "rawPrediction": np.stack([-margin, margin], axis=1)}
+            return {"prediction": np.asarray(out["prediction"])}
+        X32 = np.asarray(X, np.float32)
+        leaves = _predict_trees_np(
+            X32, np.asarray(f["feature"]), np.asarray(f["threshold"]),
+            np.asarray(f["is_leaf"]), np.asarray(f["leaf"]), depth_iters)
         if f["kind"] == "forest":
             if f["task"] == "classification":
                 prob = leaves.mean(axis=1)                     # [N, C]
@@ -642,10 +711,13 @@ class _ForestEstimatorBase(PredictorEstimator):
             trees = fitter(B, jnp.asarray(splits), base_stats, fold_w,
                            fold_ids, keys, mis, mgs, subs, masks,
                            jnp.float32(1.0))
-            feature = np.asarray(trees.feature)
-            threshold = np.asarray(trees.threshold)
-            is_leaf = np.asarray(trees.is_leaf)
-            leaf = np.asarray(trees.leaf)
+            # keep the tree arrays device-resident: candidates slice views of
+            # the [Kt, ...] stacks; they only cross the host link if a model
+            # is serialized or scored on host data
+            feature = trees.feature
+            threshold = trees.threshold
+            is_leaf = trees.is_leaf
+            leaf = trees.leaf
             for k in range(K):
                 for j, gi in enumerate(gidx):
                     s = (k * Gg + j) * n_trees
@@ -755,8 +827,7 @@ class _GBTEstimatorBase(PredictorEstimator):
                     jnp.sum(fold_w, axis=1), 1e-12)            # [K]
                 base = jnp.repeat(base, Gg)
             margins = jnp.broadcast_to(base[:, None], (Kc, N)).astype(jnp.float32)
-            per_cand = lambda vals: jnp.asarray(
-                np.tile(np.asarray(vals, np.float32), K))
+            per_cand = lambda vals: np.tile(np.asarray(vals, np.float32), K)
             mis = per_cand([max(mval(gi, "min_instances_per_node", 1),
                                 mval(gi, "min_child_weight", 0.0))
                             for gi in gidx])
@@ -766,16 +837,19 @@ class _GBTEstimatorBase(PredictorEstimator):
             chunk, batch_size = _tree_batch_budget(N, max_bins)
             fit_round = _gbt_grid_round_fitter(self.task, max_depth, max_bins,
                                                chunk, batch_size)
+            mis_d, mgs_d, lams_d, etas_d = (jnp.asarray(a) for a in
+                                            (mis, mgs, lams, etas))
             rounds = []
             for _ in range(n_rounds):
                 margins, trees = fit_round(B, jnp.asarray(splits), Xj, yj,
-                                           margins, W, fmask, mis, mgs, lams,
-                                           etas)
+                                           margins, W, fmask, mis_d, mgs_d,
+                                           lams_d, etas_d)
                 rounds.append(trees)
-            feature = np.stack([np.asarray(t.feature) for t in rounds], axis=1)
-            threshold = np.stack([np.asarray(t.threshold) for t in rounds], axis=1)
-            is_leaf = np.stack([np.asarray(t.is_leaf) for t in rounds], axis=1)
-            leaf = np.stack([np.asarray(t.leaf) for t in rounds], axis=1)
+            # device-resident [Kc, R, T] stacks; sliced per candidate below
+            feature = jnp.stack([t.feature for t in rounds], axis=1)
+            threshold = jnp.stack([t.threshold for t in rounds], axis=1)
+            is_leaf = jnp.stack([t.is_leaf for t in rounds], axis=1)
+            leaf = jnp.stack([t.leaf for t in rounds], axis=1)
             base_np = np.asarray(base)
             for k in range(K):
                 for j, gi in enumerate(gidx):

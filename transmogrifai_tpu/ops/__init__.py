@@ -1,5 +1,10 @@
 from .numeric import (BinaryVectorizer, IntegralVectorizer, RealNNVectorizer,
                       RealVectorizer)
+from .bucketizers import (DecisionTreeNumericBucketizer,
+                          DecisionTreeNumericMapBucketizer,
+                          DescalerTransformer, IsotonicRegressionCalibrator,
+                          NumericBucketizer, PercentileCalibrator,
+                          ScalerTransformer)
 from .categorical import OneHotEstimator, StringIndexer, IndexToString
 from .combiner import VectorsCombiner
 from .transmogrify import transmogrify, TransmogrifierDefaults
@@ -7,4 +12,7 @@ from .transmogrify import transmogrify, TransmogrifierDefaults
 __all__ = ["RealVectorizer", "RealNNVectorizer", "IntegralVectorizer",
            "BinaryVectorizer", "OneHotEstimator", "StringIndexer",
            "IndexToString", "VectorsCombiner", "transmogrify",
-           "TransmogrifierDefaults"]
+           "TransmogrifierDefaults", "NumericBucketizer",
+           "DecisionTreeNumericBucketizer", "DecisionTreeNumericMapBucketizer",
+           "PercentileCalibrator", "ScalerTransformer", "DescalerTransformer",
+           "IsotonicRegressionCalibrator"]

@@ -1,0 +1,522 @@
+"""Bucketizers, calibrators and scalers (reference: core/.../stages/impl/
+feature/NumericBucketizer.scala, DecisionTreeNumericBucketizer.scala:60,74,
+DecisionTreeNumericMapBucketizer.scala, PercentileCalibrator.scala,
+ScalerTransformer.scala, DescalerTransformer.scala and
+impl/regression/IsotonicRegressionCalibrator.scala).
+
+TPU design notes: bucketization is a ``searchsorted`` + one-hot — pure array
+ops; the decision-tree bucketizer reuses the framework's own histogram tree
+trainer (models/trees.fit_tree) on a single feature instead of spinning up a
+Spark DecisionTreeClassifier; isotonic calibration is pool-adjacent-violators
+on the sorted scores with linear interpolation at predict time, exactly
+Spark's IsotonicRegressionModel contract.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columns import Column, ColumnBatch
+from ..stages.base import Estimator, Transformer, TransformerModel
+from ..types import OPNumeric, OPVector, Real, RealNN
+from ..vector_meta import NULL_INDICATOR, VectorColumnMeta, VectorMeta
+
+# reference defaults (DecisionTreeNumericBucketizer.scala:293-300)
+DT_BUCKETIZER_MAX_DEPTH = 5
+DT_BUCKETIZER_MAX_BINS = 32
+DT_BUCKETIZER_MIN_INSTANCES = 1
+DT_BUCKETIZER_MIN_INFO_GAIN = 0.01
+INVALID_INDICATOR = "OTHER"  # reference tracks invalid values under "OTHER"
+
+
+def splits_to_bucket_labels(splits: Sequence[float],
+                            inclusion: str = "Left") -> List[str]:
+    """≙ NumericBucketizer.splitsToBucketLabels: human-readable range labels."""
+    lo, hi = ("[", ")") if inclusion == "Left" else ("(", "]")
+    return [f"{lo}{splits[i]}-{splits[i + 1]}{hi}"
+            for i in range(len(splits) - 1)]
+
+
+def bucketize_values(v: np.ndarray, mask: Optional[np.ndarray],
+                     splits: np.ndarray, *, inclusion: str = "Left",
+                     track_nulls: bool = True,
+                     track_invalid: bool = False) -> np.ndarray:
+    """One-hot bucket matrix for values ``v`` against ``splits`` (len B+1,
+    usually bracketed by ±inf).  Columns: B buckets [+ invalid] [+ null].
+    ≙ NumericBucketizer.bucketize."""
+    v = np.asarray(v, dtype=np.float64)
+    n = len(v)
+    B = len(splits) - 1
+    present = np.ones(n, bool) if mask is None else np.asarray(mask, bool)
+    finite = np.isfinite(np.nan_to_num(v, nan=np.inf)) & ~np.isnan(v)
+    side = "right" if inclusion == "Left" else "left"
+    idx = np.searchsorted(splits, v, side=side) - 1
+    valid = present & finite & (idx >= 0) & (idx < B)
+    cols = B + (1 if track_invalid else 0) + (1 if track_nulls else 0)
+    out = np.zeros((n, cols), np.float32)
+    rows = np.flatnonzero(valid)
+    out[rows, np.clip(idx[rows], 0, B - 1)] = 1.0
+    c = B
+    if track_invalid:
+        out[present & ~valid, c] = 1.0
+        c += 1
+    if track_nulls:
+        out[~present, c] = 1.0
+    return out
+
+
+def _bucket_meta(feature_name: str, kind_name: str, out_name: str,
+                 labels: Sequence[str], track_nulls: bool,
+                 track_invalid: bool) -> VectorMeta:
+    cols = [VectorColumnMeta(feature_name, kind_name, indicator_value=lbl)
+            for lbl in labels]
+    if track_invalid:
+        cols.append(VectorColumnMeta(feature_name, kind_name,
+                                     indicator_value=INVALID_INDICATOR))
+    if track_nulls:
+        cols.append(VectorColumnMeta(feature_name, kind_name,
+                                     indicator_value=NULL_INDICATOR))
+    return VectorMeta(out_name, cols)
+
+
+class NumericBucketizer(Transformer):
+    """Fixed-split bucketization of a numeric feature into a one-hot vector
+    (≙ NumericBucketizer.scala).  ``splits`` must be monotonically increasing;
+    values outside the range are invalid (tracked if ``track_invalid``)."""
+
+    in_kinds = (OPNumeric,)
+    out_kind = OPVector
+    is_device_op = False
+
+    def __init__(self, splits: Sequence[float] = (-np.inf, 0.0, np.inf),
+                 bucket_labels: Optional[Sequence[str]] = None,
+                 split_inclusion: str = "Left", track_nulls: bool = True,
+                 track_invalid: bool = False, **params):
+        splits = [float(s) for s in splits]
+        if sorted(splits) != splits or len(set(splits)) != len(splits):
+            raise ValueError("splits must be strictly increasing")
+        if len(splits) < 3:
+            raise ValueError("at least 3 split points required")
+        super().__init__(splits=splits, bucket_labels=list(bucket_labels or []),
+                         split_inclusion=split_inclusion,
+                         track_nulls=track_nulls, track_invalid=track_invalid,
+                         **params)
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        (f,) = self.input_features
+        col = batch[f.name]
+        splits = np.asarray(self.get("splits"), np.float64)
+        labels = (self.get("bucket_labels")
+                  or splits_to_bucket_labels(splits, self.get("split_inclusion")))
+        out = bucketize_values(
+            np.asarray(col.values, np.float64), col.mask, splits,
+            inclusion=self.get("split_inclusion", "Left"),
+            track_nulls=self.get("track_nulls", True),
+            track_invalid=self.get("track_invalid", False))
+        meta = _bucket_meta(f.name, f.kind.__name__, self.output_name(), labels,
+                            self.get("track_nulls", True),
+                            self.get("track_invalid", False))
+        return Column(OPVector, out, meta=meta)
+
+
+def tree_splits_for_feature(x: np.ndarray, y: np.ndarray, *,
+                            max_depth: int = DT_BUCKETIZER_MAX_DEPTH,
+                            max_bins: int = DT_BUCKETIZER_MAX_BINS,
+                            min_instances: int = DT_BUCKETIZER_MIN_INSTANCES,
+                            min_gain: float = DT_BUCKETIZER_MIN_INFO_GAIN
+                            ) -> np.ndarray:
+    """Split thresholds of a single-feature gini decision tree fit against the
+    label — the reference's trick of using DecisionTreeClassifier.rootNode
+    .splits as bucket boundaries (DecisionTreeNumericBucketizer.scala:253-275).
+    Reuses the framework's histogram tree trainer."""
+    from ..models.trees import bin_data, build_bin_splits, fit_tree
+
+    if len(x) == 0:
+        return np.asarray([], np.float64)
+    X = np.asarray(x, np.float32)[:, None]
+    classes, y_idx = np.unique(np.asarray(y), return_inverse=True)
+    n_classes = max(len(classes), 2)
+    splits = build_bin_splits(X, max_bins)
+    B = bin_data(jnp.asarray(X), jnp.asarray(splits))
+    yoh = np.zeros((len(x), n_classes), np.float32)
+    yoh[np.arange(len(x)), y_idx] = 1.0
+    stats = jnp.asarray(
+        np.concatenate([np.ones((len(x), 1), np.float32), yoh], axis=1))
+    tree = fit_tree(B, jnp.asarray(splits), stats,
+                    jnp.ones((1,), jnp.float32) > 0, impurity="gini",
+                    max_depth=max_depth, n_bins=max_bins,
+                    min_instances=jnp.float32(min_instances),
+                    min_gain=jnp.float32(min_gain), lam=jnp.float32(1.0))
+    feat = np.asarray(tree.feature)
+    thr = np.asarray(tree.threshold)
+    used = np.unique(thr[(feat >= 0) & np.isfinite(thr)])
+    return used.astype(np.float64)
+
+
+class DecisionTreeNumericBucketizerModel(TransformerModel):
+    out_kind = OPVector
+    allow_label_as_input = True
+    is_device_op = False
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        f = self.input_features[1]
+        col = batch[f.name]
+        track_nulls = self.get("track_nulls", True)
+        track_invalid = self.get("track_invalid", False)
+        should_split = bool(self.fitted["should_split"])
+        splits = np.asarray(self.fitted["splits"], np.float64)
+        n = len(col)
+        if should_split:
+            out = bucketize_values(
+                np.asarray(col.values, np.float64), col.mask, splits,
+                inclusion="Right", track_nulls=track_nulls,
+                track_invalid=track_invalid)
+            labels = splits_to_bucket_labels(splits, "Right")
+        else:
+            # no usable splits: emit the null indicator only (reference emits
+            # an empty vector + optional null tracking)
+            present = (np.ones(n, bool) if col.mask is None
+                       else np.asarray(col.mask, bool))
+            out = ((~present).astype(np.float32)[:, None] if track_nulls
+                   else np.zeros((n, 0), np.float32))
+            labels = []
+        meta = _bucket_meta(f.name, f.kind.__name__, self.output_name(),
+                            labels, track_nulls,
+                            should_split and track_invalid)
+        return Column(OPVector, out, meta=meta)
+
+
+class DecisionTreeNumericBucketizer(Estimator):
+    """Smart bucketizer: buckets a numeric feature at the split points of a
+    single-feature decision tree trained against the label
+    (≙ DecisionTreeNumericBucketizer.scala:60,74).  Inputs (label: RealNN,
+    feature: numeric)."""
+
+    in_kinds = (RealNN, OPNumeric)
+    out_kind = OPVector
+    allow_label_as_input = True
+
+    def __init__(self, max_depth: int = DT_BUCKETIZER_MAX_DEPTH,
+                 max_bins: int = DT_BUCKETIZER_MAX_BINS,
+                 min_instances_per_node: int = DT_BUCKETIZER_MIN_INSTANCES,
+                 min_info_gain: float = DT_BUCKETIZER_MIN_INFO_GAIN,
+                 track_nulls: bool = True, track_invalid: bool = True,
+                 **params):
+        super().__init__(max_depth=max_depth, max_bins=max_bins,
+                         min_instances_per_node=min_instances_per_node,
+                         min_info_gain=min_info_gain, track_nulls=track_nulls,
+                         track_invalid=track_invalid, **params)
+
+    def output_name(self) -> str:
+        return f"{self.input_features[1].name}_dtBucketized_{self.uid[-6:]}"
+
+    def _compute_splits(self, x: np.ndarray, mask: Optional[np.ndarray],
+                        y: np.ndarray) -> Tuple[bool, np.ndarray]:
+        present = np.ones(len(x), bool) if mask is None else np.asarray(mask, bool)
+        present &= ~np.isnan(np.asarray(x, np.float64))
+        inner = tree_splits_for_feature(
+            np.asarray(x, np.float64)[present], np.asarray(y)[present],
+            max_depth=int(self.get("max_depth", DT_BUCKETIZER_MAX_DEPTH)),
+            max_bins=int(self.get("max_bins", DT_BUCKETIZER_MAX_BINS)),
+            min_instances=int(self.get("min_instances_per_node", 1)),
+            min_gain=float(self.get("min_info_gain", 0.01)))
+        should_split = len(inner) > 0
+        splits = (np.r_[-np.inf, inner, np.inf] if should_split
+                  else np.asarray([], np.float64))
+        return should_split, splits
+
+    def fit(self, batch: ColumnBatch) -> DecisionTreeNumericBucketizerModel:
+        label_f, f = self.input_features
+        y = np.asarray(batch[label_f.name].values, np.float64)
+        col = batch[f.name]
+        should_split, splits = self._compute_splits(
+            np.asarray(col.values, np.float64), col.mask, y)
+        model = DecisionTreeNumericBucketizerModel(
+            fitted={"should_split": should_split, "splits": splits},
+            **self._params)
+        return self._finalize_model(model)
+
+
+class DecisionTreeNumericMapBucketizerModel(TransformerModel):
+    out_kind = OPVector
+    allow_label_as_input = True
+    is_device_op = False
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        f = self.input_features[1]
+        maps = [v if isinstance(v, dict) else {} for v in batch[f.name].values]
+        n = len(maps)
+        track_nulls = self.get("track_nulls", True)
+        track_invalid = self.get("track_invalid", False)
+        blocks, cols_meta = [], []
+        for k in self.fitted["keys"]:
+            ks = self.fitted["splits_by_key"].get(k)
+            vals = np.asarray([float(m[k]) if m.get(k) is not None else np.nan
+                               for m in maps], np.float64)
+            mask = ~np.isnan(vals)
+            if ks is not None and len(ks):
+                splits = np.asarray(ks, np.float64)
+                blocks.append(bucketize_values(
+                    vals, mask, splits, inclusion="Right",
+                    track_nulls=track_nulls, track_invalid=track_invalid))
+                labels = splits_to_bucket_labels(splits, "Right")
+                cols_meta += [VectorColumnMeta(f.name, f.kind.__name__,
+                                               grouping=k, indicator_value=lbl)
+                              for lbl in labels]
+                if track_invalid:
+                    cols_meta.append(VectorColumnMeta(
+                        f.name, f.kind.__name__, grouping=k,
+                        indicator_value=INVALID_INDICATOR))
+            else:
+                blocks.append((~mask).astype(np.float32)[:, None]
+                              if track_nulls else np.zeros((n, 0), np.float32))
+            if track_nulls:
+                if ks is not None and len(ks):
+                    blocks.append((~mask).astype(np.float32)[:, None])
+                cols_meta.append(VectorColumnMeta(
+                    f.name, f.kind.__name__, grouping=k,
+                    indicator_value=NULL_INDICATOR))
+        out = (np.concatenate(blocks, axis=1) if blocks
+               else np.zeros((n, 0), np.float32))
+        return Column(OPVector, out,
+                      meta=VectorMeta(self.output_name(), cols_meta))
+
+
+class DecisionTreeNumericMapBucketizer(Estimator):
+    """Per-key smart bucketization of a numeric map
+    (≙ DecisionTreeNumericMapBucketizer.scala): each key's values are
+    bucketized at its own label-driven tree splits."""
+
+    in_kinds = (RealNN, None)
+    out_kind = OPVector
+    allow_label_as_input = True
+
+    def __init__(self, max_depth: int = DT_BUCKETIZER_MAX_DEPTH,
+                 max_bins: int = DT_BUCKETIZER_MAX_BINS,
+                 min_instances_per_node: int = DT_BUCKETIZER_MIN_INSTANCES,
+                 min_info_gain: float = DT_BUCKETIZER_MIN_INFO_GAIN,
+                 track_nulls: bool = True, track_invalid: bool = False,
+                 max_keys: int = 100, **params):
+        super().__init__(max_depth=max_depth, max_bins=max_bins,
+                         min_instances_per_node=min_instances_per_node,
+                         min_info_gain=min_info_gain, track_nulls=track_nulls,
+                         track_invalid=track_invalid, max_keys=max_keys,
+                         **params)
+
+    def fit(self, batch: ColumnBatch) -> DecisionTreeNumericMapBucketizerModel:
+        label_f, f = self.input_features
+        y = np.asarray(batch[label_f.name].values, np.float64)
+        maps = [v if isinstance(v, dict) else {} for v in batch[f.name].values]
+        keys: List[str] = sorted({k for m in maps for k in m}
+                                 )[:int(self.get("max_keys", 100))]
+        splits_by_key: Dict[str, np.ndarray] = {}
+        for k in keys:
+            vals = np.asarray([float(m[k]) if m.get(k) is not None else np.nan
+                               for m in maps], np.float64)
+            present = ~np.isnan(vals)
+            inner = tree_splits_for_feature(
+                vals[present], y[present],
+                max_depth=int(self.get("max_depth", DT_BUCKETIZER_MAX_DEPTH)),
+                max_bins=int(self.get("max_bins", DT_BUCKETIZER_MAX_BINS)),
+                min_instances=int(self.get("min_instances_per_node", 1)),
+                min_gain=float(self.get("min_info_gain", 0.01))
+            ) if present.any() else np.asarray([])
+            splits_by_key[k] = (np.r_[-np.inf, inner, np.inf]
+                                if len(inner) else np.asarray([]))
+        model = DecisionTreeNumericMapBucketizerModel(
+            fitted={"keys": keys, "splits_by_key": splits_by_key},
+            **self._params)
+        return self._finalize_model(model)
+
+
+class PercentileCalibratorModel(TransformerModel):
+    out_kind = RealNN
+    is_device_op = False
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        (f,) = self.input_features
+        v = np.asarray(batch[f.name].values, np.float64)
+        splits = np.asarray(self.fitted["splits"], np.float64)
+        expected = int(self.get("expected_num_buckets", 100))
+        actual = len(splits)
+        idx = np.searchsorted(splits, v, side="left")
+        if actual >= expected:
+            out = np.maximum(idx - 1, 0)
+        else:
+            # scale the sparser actual bucket range onto [0, expected-1]
+            # (≙ PercentileCalibratorModel.scale)
+            old_max, new_max = max(actual - 1, 1), expected - 1
+            out = np.round(idx * (new_max / old_max))
+        return Column(RealNN, np.clip(out, 0, expected - 1).astype(np.float32))
+
+
+class PercentileCalibrator(Estimator):
+    """Calibrate a real-valued score into [0, expected_num_buckets-1]
+    percentile ranks (≙ PercentileCalibrator.scala; QuantileDiscretizer with
+    relativeError=0)."""
+
+    in_kinds = (RealNN,)
+    out_kind = RealNN
+
+    def __init__(self, expected_num_buckets: int = 100, **params):
+        super().__init__(expected_num_buckets=expected_num_buckets, **params)
+
+    def fit(self, batch: ColumnBatch) -> PercentileCalibratorModel:
+        (f,) = self.input_features
+        v = np.asarray(batch[f.name].values, np.float64)
+        buckets = int(self.get("expected_num_buckets", 100))
+        qs = np.linspace(0.0, 1.0, buckets + 1)[1:-1]
+        inner = np.unique(np.quantile(v, qs)) if len(v) else np.asarray([])
+        splits = np.r_[-np.inf, inner, np.inf]
+        model = PercentileCalibratorModel(
+            fitted={"splits": splits, "actual_num_buckets": len(splits)},
+            **self._params)
+        model.metadata["origSplits"] = [float(s) for s in splits]
+        return self._finalize_model(model)
+
+
+# ---------------------------------------------------------------------------
+# scaler / descaler
+# ---------------------------------------------------------------------------
+
+_SCALERS: Dict[str, Tuple[Any, Any]] = {
+    # scaling_type -> (forward, inverse); args taken from stage params
+    "Linear": (lambda v, a: a.get("slope", 1.0) * v + a.get("intercept", 0.0),
+               lambda v, a: (v - a.get("intercept", 0.0)) / a.get("slope", 1.0)),
+    "Logarithmic": (lambda v, a: np.log(v), lambda v, a: np.exp(v)),
+}
+
+
+class ScalerTransformer(Transformer):
+    """Apply an invertible scaling function, recording its metadata so a
+    DescalerTransformer can undo it (≙ ScalerTransformer.scala, Scaler.scala:
+    LinearScaler/LogScaler)."""
+
+    in_kinds = (Real,)
+    out_kind = Real
+    is_device_op = False
+
+    def __init__(self, scaling_type: str = "Linear",
+                 scaling_args: Optional[Dict[str, float]] = None, **params):
+        if scaling_type not in _SCALERS:
+            raise ValueError(f"unknown scaling type {scaling_type!r}")
+        scaling_args = dict(scaling_args or {})
+        if scaling_type == "Linear" and scaling_args.get("slope", 1.0) == 0.0:
+            raise ValueError("LinearScaler must have a non-zero slope")
+        super().__init__(scaling_type=scaling_type, scaling_args=scaling_args,
+                         **params)
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        (f,) = self.input_features
+        col = batch[f.name]
+        fwd, _ = _SCALERS[self.get("scaling_type")]
+        v = fwd(np.asarray(col.values, np.float64), self.get("scaling_args"))
+        return Column(Real, v.astype(np.float32), mask=col.mask)
+
+
+class DescalerTransformer(Transformer):
+    """Invert the scaling applied by a ScalerTransformer: inputs (value to
+    descale, scaled feature whose origin stage carries the scaler metadata)
+    (≙ DescalerTransformer.scala)."""
+
+    in_kinds = (Real, Real)
+    out_kind = Real
+    is_device_op = False
+
+    def _find_scaler(self):
+        origin = self.input_features[1].origin_stage
+        if not isinstance(origin, ScalerTransformer):
+            raise ValueError(
+                "DescalerTransformer input 2 must be produced by a "
+                f"ScalerTransformer, got {type(origin).__name__}")
+        return origin
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        scaler = self._find_scaler()
+        col = batch[self.input_features[0].name]
+        _, inv = _SCALERS[scaler.get("scaling_type")]
+        v = inv(np.asarray(col.values, np.float64), scaler.get("scaling_args"))
+        return Column(Real, v.astype(np.float32), mask=col.mask)
+
+
+# ---------------------------------------------------------------------------
+# isotonic calibration
+# ---------------------------------------------------------------------------
+
+def pav_fit(x: np.ndarray, y: np.ndarray, w: Optional[np.ndarray] = None,
+            increasing: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """Pool-adjacent-violators on (x, y) → (boundaries, values) of the fitted
+    step function (≙ Spark ml IsotonicRegression; predictions interpolate
+    linearly between boundaries)."""
+    x = np.asarray(x, np.float64)
+    y = np.asarray(y, np.float64)
+    w = np.ones_like(y) if w is None else np.asarray(w, np.float64)
+    order = np.argsort(x, kind="mergesort")
+    xs, ys, ws = x[order], y[order], w[order]
+    if not increasing:
+        ys = -ys
+    # block-merge stack: each block holds (weighted mean, weight, start idx)
+    means: List[float] = []
+    weights: List[float] = []
+    starts: List[int] = []
+    for i in range(len(ys)):
+        means.append(float(ys[i]))
+        weights.append(float(ws[i]))
+        starts.append(i)
+        while len(means) > 1 and means[-2] >= means[-1]:
+            m2, w2 = means.pop(), weights.pop()
+            starts.pop()
+            means[-1] = (means[-1] * weights[-1] + m2 * w2) / (weights[-1] + w2)
+            weights[-1] += w2
+    bounds, vals = [], []
+    starts.append(len(ys))
+    for bi in range(len(means)):
+        lo, hi = starts[bi], starts[bi + 1] - 1
+        v = means[bi] if increasing else -means[bi]
+        bounds.append(xs[lo])
+        vals.append(v)
+        if xs[hi] != xs[lo]:
+            bounds.append(xs[hi])
+            vals.append(v)
+    return np.asarray(bounds), np.asarray(vals)
+
+
+class IsotonicRegressionCalibratorModel(TransformerModel):
+    out_kind = RealNN
+    allow_label_as_input = True
+    is_device_op = False
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        f = self.input_features[1]
+        v = np.asarray(batch[f.name].values, np.float64)
+        out = np.interp(v, np.asarray(self.fitted["boundaries"]),
+                        np.asarray(self.fitted["predictions"]))
+        return Column(RealNN, out.astype(np.float32))
+
+
+class IsotonicRegressionCalibrator(Estimator):
+    """Calibrate scores monotonically against the label: inputs
+    (label: RealNN, score: RealNN) → calibrated RealNN
+    (≙ IsotonicRegressionCalibrator.scala:1 wrapping ml.IsotonicRegression)."""
+
+    in_kinds = (RealNN, RealNN)
+    out_kind = RealNN
+    allow_label_as_input = True
+
+    def __init__(self, isotonic: bool = True, **params):
+        super().__init__(isotonic=isotonic, **params)
+
+    def output_name(self) -> str:
+        return f"{self.input_features[1].name}_calibrated_{self.uid[-6:]}"
+
+    def fit(self, batch: ColumnBatch) -> IsotonicRegressionCalibratorModel:
+        label_f, score_f = self.input_features
+        y = np.asarray(batch[label_f.name].values, np.float64)
+        x = np.asarray(batch[score_f.name].values, np.float64)
+        bounds, vals = pav_fit(x, y, increasing=bool(self.get("isotonic", True)))
+        model = IsotonicRegressionCalibratorModel(
+            fitted={"boundaries": bounds, "predictions": vals}, **self._params)
+        return self._finalize_model(model)

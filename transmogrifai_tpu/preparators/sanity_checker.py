@@ -53,32 +53,23 @@ def _col_stats(X: jnp.ndarray, y: jnp.ndarray):
     return mean, var, mn, mx, corr
 
 
-def _rank_transform(a: np.ndarray) -> np.ndarray:
-    """Average-rank transform per column for Spearman correlation."""
-    order = np.argsort(a, axis=0, kind="mergesort")
-    ranks = np.empty_like(a, dtype=np.float64)
-    n = a.shape[0]
-    rng = np.arange(n, dtype=np.float64)
-    for j in range(a.shape[1] if a.ndim == 2 else 1):
-        col = a[:, j] if a.ndim == 2 else a
-        o = order[:, j] if a.ndim == 2 else order
-        r = np.empty(n)
-        r[o] = rng
-        # average ties
-        sorted_vals = col[o]
-        i = 0
-        while i < n:
-            k = i
-            while k + 1 < n and sorted_vals[k + 1] == sorted_vals[i]:
-                k += 1
-            if k > i:
-                r[o[i:k + 1]] = 0.5 * (i + k)
-            i = k + 1
-        if a.ndim == 2:
-            ranks[:, j] = r
-        else:
-            ranks = r
-    return ranks
+@jax.jit
+def _rank_transform(a: jnp.ndarray) -> jnp.ndarray:
+    """Average-rank transform per column for Spearman correlation — one
+    sort + searchsorted per column, fully on device (ties get the average of
+    their positions, matching scipy's 'average' ranking)."""
+
+    def col_ranks(c):
+        order = jnp.argsort(c)
+        ss = c[order]
+        left = jnp.searchsorted(ss, ss, side="left").astype(jnp.float32)
+        right = jnp.searchsorted(ss, ss, side="right").astype(jnp.float32)
+        avg = 0.5 * (left + right - 1.0)
+        return jnp.zeros_like(avg).at[order].set(avg)
+
+    if a.ndim == 1:
+        return col_ranks(a)
+    return jax.vmap(col_ranks, in_axes=1, out_axes=1)(a)
 
 
 def cramers_v(contingency: np.ndarray) -> float:
@@ -178,11 +169,20 @@ class SanityChecker(Estimator):
         return f"{self.input_features[1].name}_sanityChecked_{self.uid[-6:]}"
 
     def fit(self, batch: ColumnBatch) -> SanityCheckerModel:
+        import jax
+
         label_f, vec_f = self.input_features
         y = np.asarray(batch[label_f.name].values, dtype=np.float32)
         vec = batch[vec_f.name]
-        X = np.asarray(vec.values, dtype=np.float32)
-        n, d = X.shape
+        vals = vec.values
+        # keep the matrix in its native residency — on real TPU hardware the
+        # host link is the bottleneck, so all stats run on device and only the
+        # [D]-sized results transfer (≙ colStats on executors)
+        Xd = (vals if isinstance(vals, jax.Array)
+              else jnp.asarray(np.asarray(vals, np.float32)))
+        if Xd.dtype != jnp.float32:
+            Xd = Xd.astype(jnp.float32)
+        n, d = Xd.shape
         meta = vec.meta or VectorMeta(vec_f.name, [])
         names = (meta.column_names() if meta.size == d
                  else [f"f_{i}" for i in range(d)])
@@ -194,18 +194,18 @@ class SanityChecker(Estimator):
             m = min(int(n * frac) if frac < 1.0 else n, limit)
             rng = np.random.default_rng(int(self.get("seed", 42)))
             idx = rng.choice(n, size=m, replace=False)
-            Xs, ys = X[idx], y[idx]
+            Xs, ys_host = Xd[idx], y[idx]
         else:
-            Xs, ys = X, y
+            Xs, ys_host = Xd, y
+        ys = jnp.asarray(ys_host)
 
         corr_type = self.get("correlation_type", DEFAULT_CORRELATION_TYPE)
         if corr_type == "spearman":
-            mean, var, mn, mx, _ = _col_stats(jnp.asarray(Xs), jnp.asarray(ys))
+            mean, var, mn, mx, _ = _col_stats(Xs, ys)
             corr_arr = np.asarray(_col_stats(
-                jnp.asarray(_rank_transform(Xs).astype(np.float32)),
-                jnp.asarray(_rank_transform(ys).astype(np.float32)))[4])
+                _rank_transform(Xs), _rank_transform(ys))[4])
         else:
-            mean, var, mn, mx, corr = _col_stats(jnp.asarray(Xs), jnp.asarray(ys))
+            mean, var, mn, mx, corr = _col_stats(Xs, ys)
             corr_arr = np.asarray(corr)
         mean, var, mn, mx = (np.asarray(a) for a in (mean, var, mn, mx))
 
@@ -218,15 +218,16 @@ class SanityChecker(Estimator):
                 if c.indicator_value is not None:
                     groups.setdefault((c.parent_feature_name, c.grouping), []
                                       ).append(c.index)
-        y_classes = np.unique(ys)
-        yoh = (ys[:, None] == y_classes[None, :]).astype(np.float32)  # [N, C]
+        y_classes = np.unique(ys_host)
+        yoh = ((ys[:, None] == jnp.asarray(y_classes)[None, :])
+               .astype(jnp.float32) if groups else None)  # [N, C] on device
         cramers: Dict[str, float] = {}
         group_fail: Dict[int, List[str]] = {}
         max_rule_conf = float(self.get("max_rule_confidence", 1.0))
         min_rule_supp = float(self.get("min_required_rule_support", 1.0))
         for (parent, grouping), idxs in groups.items():
-            G = Xs[:, idxs]                              # [N, k] 0/1 indicators
-            contingency = yoh.T @ G                      # [C, k]
+            G = Xs[:, np.asarray(idxs)]                  # [N, k] 0/1 indicators
+            contingency = np.asarray(yoh.T @ G)          # [C, k] — tiny transfer
             v = cramers_v(contingency)
             gname = parent if grouping is None else f"{parent}({grouping})"
             cramers[gname] = v
@@ -236,7 +237,7 @@ class SanityChecker(Estimator):
             # association rule confidence (leakage): P(label=c | col=1)
             col_count = contingency.sum(axis=0)          # [k]
             conf = contingency.max(axis=0) / np.maximum(col_count, 1e-12)
-            supp = col_count / max(len(ys), 1)
+            supp = col_count / max(len(ys_host), 1)
             if max_rule_conf < 1.0 or min_rule_supp < 1.0:
                 bad = (conf >= max_rule_conf) & (supp >= min_rule_supp)
                 if bad.any():
@@ -278,7 +279,7 @@ class SanityChecker(Estimator):
             cramers_v_by_group=cramers,
             dropped=[names[i] for i in drop_idx],
             drop_reasons={names[i]: r for i, r in reasons_by_col.items()},
-            sample_size=len(ys))
+            sample_size=len(ys_host))
 
         model = SanityCheckerModel(
             fitted={"indices_to_keep": np.asarray(keep, dtype=np.int64)},

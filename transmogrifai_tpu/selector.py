@@ -162,15 +162,20 @@ class SelectedModel(PredictionModel):
         if self._best_model is None:
             return {}, {}
         from .models import MODEL_REGISTRY  # ensure class is resolvable
+
+        def _is_arr(v):
+            import jax
+            return isinstance(v, (np.ndarray, np.generic, jax.Array))
+
         inner = self._best_model
         j = {"bestModelClass": type(inner).__name__,
              "bestModelParams": {k: v for k, v in inner._params.items()
                                  if isinstance(v, (str, int, float, bool, list, tuple))
                                  or v is None},
              "bestFittedJson": {k: v for k, v in inner.fitted.items()
-                                if not isinstance(v, (np.ndarray, np.generic))}}
+                                if not _is_arr(v)}}
         arrays = {f"best/{k}": np.asarray(v) for k, v in inner.fitted.items()
-                  if isinstance(v, (np.ndarray, np.generic))}
+                  if _is_arr(v)}
         return j, arrays
 
     def load_extra(self, extra_json, arrays):

@@ -35,6 +35,7 @@ _STAGE_MODULES = [
     "transmogrifai_tpu.stages.transformers",
     "transmogrifai_tpu.stages.generator",
     "transmogrifai_tpu.ops.numeric",
+    "transmogrifai_tpu.ops.bucketizers",
     "transmogrifai_tpu.ops.categorical",
     "transmogrifai_tpu.ops.text",
     "transmogrifai_tpu.ops.dates",

@@ -277,27 +277,49 @@ class OpValidator:
         splits = self.splits(y_all)
         results: Dict[Tuple[str, int], ValidatedCandidate] = {}
 
-        def record(cand, ci, gi, params, fitted, X_va, y_va):
+        def record(cand, ci, gi, params, metric):
             key = (cand.model_name, ci * 10000 + gi)
             if key not in results:
                 results[key] = ValidatedCandidate(
                     cand.model_name, dict(params), [], candidate_index=ci)
-            if fitted is None:
-                results[key].metric_values.append(float("nan"))
-                return
-            try:
-                est = cand.estimator
-                model = est.model_cls(fitted=fitted, **{**est._params, **params})
-                pred = model.predict_arrays(X_va)
-                metric = self.evaluator.evaluate(y_va, pred)
-            except Exception:  # noqa: BLE001 — candidate robustness
-                metric = float("nan")
             results[key].metric_values.append(float(metric))
+
+        def make_model(cand, params, fitted):
+            est = cand.estimator
+            return est.model_cls(fitted=fitted, **{**est._params, **params})
+
+        def device_metric(cand, params, fitted, X_dev, y_dev, w_dev):
+            """Score a candidate entirely on device (see metrics_device);
+            None → caller falls back to the host path."""
+            try:
+                model = make_model(cand, params, fitted)
+                if not hasattr(model, "device_scores"):
+                    return None
+                return self.evaluator.evaluate_masked(
+                    y_dev, model.device_scores(X_dev), w_dev)
+            except Exception:  # noqa: BLE001
+                return None
+
+        def host_metric(cand, params, fitted, X_va, y_va):
+            try:
+                model = make_model(cand, params, fitted)
+                pred = model.predict_arrays(X_va)
+                return self.evaluator.evaluate(y_va, pred)
+            except Exception:  # noqa: BLE001 — candidate robustness
+                return float("nan")
 
         # (X, fold splits) groups: shared X across folds normally; per-fold X
         # when feature stages must be refit inside the fold (leakage guard,
         # ≙ OpCrossValidation.validate:87-147 DAG copy+refit).  A generator so
         # only one fold's full-size matrix is resident at a time.
+        def _col_values(b):
+            """Feature matrix in its native residency: device arrays stay on
+            device (the host link is the bottleneck on real TPU hardware)."""
+            v = b[features].values
+            if isinstance(v, jax.Array):
+                return v
+            return np.asarray(v, dtype=np.float32)
+
         def fold_groups():
             if in_fold_dag:
                 for tr_idx, va_idx in splits:
@@ -305,17 +327,22 @@ class OpValidator:
                                 for layer in in_fold_dag]
                     _, fitted_dag = fit_dag(batch.take_rows(tr_idx), dag_copy)
                     full = apply_dag(batch, fitted_dag)
-                    yield (np.asarray(full[features].values, dtype=np.float32),
-                           [(tr_idx, va_idx)])
+                    yield _col_values(full), [(tr_idx, va_idx)]
             else:
-                yield (np.asarray(batch[features].values, dtype=np.float32),
-                       splits)
+                yield _col_values(batch), splits
+
+        import jax
+        import jax.numpy as jnp
 
         y32 = np.asarray(y_all, dtype=np.float32)
         for X, fsplits in fold_groups():
             N = X.shape[0]
+            is_dev = isinstance(X, jax.Array)
+            y_dev = jnp.asarray(y32) if is_dev else None
+            X_host = None if is_dev else X   # lazy d2h only if a fallback needs it
             W = np.zeros((len(fsplits), N), np.float32)
             va_slices = []
+            va_masks_dev = []
             for f, (tr_idx, va_idx) in enumerate(fsplits):
                 w = np.zeros(N, np.float32)
                 w[tr_idx] = 1.0
@@ -323,6 +350,10 @@ class OpValidator:
                     w = splitter.validation_prepare_weights(y_all, w)
                 W[f] = w
                 va_slices.append(va_idx)
+                if is_dev:
+                    vm = np.zeros(N, np.float32)
+                    vm[va_idx] = 1.0
+                    va_masks_dev.append(jnp.asarray(vm))
             for ci, cand in enumerate(candidates):
                 try:
                     fitted_grid = cand.estimator.fit_arrays_grid(
@@ -345,10 +376,24 @@ class OpValidator:
                                 row.append(None)
                         fitted_grid.append(row)
                 for f, va_idx in enumerate(va_slices):
-                    X_va, y_va = X[va_idx], y32[va_idx]
+                    X_va = y_va = None
                     for gi, params in enumerate(cand.grid):
-                        record(cand, ci, gi, params, fitted_grid[f][gi],
-                               X_va, y_va)
+                        fitted = fitted_grid[f][gi]
+                        if fitted is None:
+                            record(cand, ci, gi, params, float("nan"))
+                            continue
+                        metric = None
+                        if is_dev:
+                            metric = device_metric(cand, params, fitted, X,
+                                                   y_dev, va_masks_dev[f])
+                        if metric is None:
+                            if X_va is None:
+                                if X_host is None:
+                                    X_host = np.asarray(X)
+                                X_va, y_va = X_host[va_idx], y32[va_idx]
+                            metric = host_metric(cand, params, fitted,
+                                                 X_va, y_va)
+                        record(cand, ci, gi, params, metric)
 
         all_results = list(results.values())
         sign = 1.0 if self.evaluator.is_larger_better else -1.0

@@ -11,10 +11,12 @@ Workload (fixed across rounds for comparability):
 Prints ONE JSON line:
   {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": ratio}
 
-vs_baseline: ratio of the reference baseline wall to ours (>1 = we are
-faster).  The reference publishes no numbers (BASELINE.md); until a measured
-Spark-local wall exists in BASELINE.json["published"]["higgs1m_train_wall_s"],
-vs_baseline is reported as 1.0.
+vs_baseline: ratio of the measured baseline wall to ours (>1 = we are
+faster).  The reference publishes no numbers (BASELINE.md), so the baseline is
+the measured local-proxy wall in BASELINE.json["published"]
+["higgs1m_train_wall_s"] (see BASELINE_MEASURED.json for provenance).  The
+ratio only applies at the full 1M-row workload (accelerator runs); the reduced
+CPU smoke run reports 1.0.
 """
 
 import json
@@ -102,7 +104,9 @@ def main():
                 "higgs1m_train_wall_s")
     except Exception:
         pass
-    vs = (baseline / wall) if baseline else 1.0
+    # the published baseline was measured at the 1M-row workload; the ratio is
+    # only meaningful when we ran the same size
+    vs = (baseline / wall) if (baseline and N == 1_000_000) else 1.0
 
     result = {
         "metric": f"OpWorkflow.train wall (HIGGS-like {N}x{D}, 3-fold CV, "

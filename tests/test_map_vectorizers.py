@@ -1,0 +1,171 @@
+"""Per-type map vectorizers (≙ SmartTextMapVectorizerTest,
+TextMapPivotVectorizerTest, MultiPickListMapVectorizerTest,
+DateMapToUnitCircleVectorizerTest, GeolocationMapVectorizerTest in the
+reference core test suite)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.columns import Column, ColumnBatch, numeric_column, object_column
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.ops.map_vectorizers import (
+    DateMapToUnitCircleVectorizer, GeolocationMapVectorizer,
+    MultiPickListMapVectorizer, SmartTextMapVectorizer, TextMapLenEstimator,
+    TextMapNullEstimator, TextMapPivotVectorizer)
+
+
+def make_batch(name, kind, maps):
+    return ColumnBatch({name: object_column(kind, maps)}, len(maps))
+
+
+def fit_transform(stage, feat, batch):
+    stage.set_input(feat)
+    stage.get_output()
+    model = stage.fit(batch)
+    return model, np.asarray(model.transform(batch).values)
+
+
+def test_smart_text_map_pivot_and_hash():
+    # key "cat" is low-cardinality → pivot; key "desc" is high-cardinality → hash
+    maps = [{"cat": ("a" if i % 2 else "b"), "desc": f"unique text {i} {i*7}"}
+            for i in range(40)]
+    maps[0] = {}  # one empty row → nulls for both keys
+    f = FeatureBuilder.TextMap("m").as_predictor()
+    st = SmartTextMapVectorizer(max_cardinality=5, top_k=10, min_support=1,
+                                num_hashes=16)
+    model, arr = fit_transform(st, f, make_batch("m", T.TextMap, maps))
+    assert model.metadata["strategies"]["m"] == {"cat": "pivot", "desc": "hash"}
+    # widths: pivot = 2 values + OTHER + null = 4; hash = 16 + null
+    assert arr.shape == (40, 4 + 17)
+    meta = model.fitted["meta"]
+    assert len(meta.columns) == arr.shape[1]
+    # row 0 (empty map): null indicators set
+    assert arr[0, 3] == 1.0  # pivot null
+    assert arr[0, -1] == 1.0  # hash null
+    # pivot one-hots: 'a' and 'b' sorted → col0='a', col1='b'
+    assert arr[1, 0] == 1.0  # i=1 → 'a'
+    assert arr[2, 1] == 1.0  # i=2 → 'b'
+
+
+def test_text_map_pivot_vectorizer_values():
+    maps = [{"k1": "x"}, {"k1": "y"}, {"k1": "x"}, {}]
+    f = FeatureBuilder.PickListMap("m").as_predictor()
+    st = TextMapPivotVectorizer(top_k=5, min_support=1)
+    model, arr = fit_transform(st, f, make_batch("m", T.PickListMap, maps))
+    # one key, 2 values + OTHER + null
+    assert arr.shape == (4, 4)
+    np.testing.assert_allclose(arr[0], [1, 0, 0, 0])
+    np.testing.assert_allclose(arr[1], [0, 1, 0, 0])
+    np.testing.assert_allclose(arr[3], [0, 0, 0, 1])
+    # unseen value at transform → OTHER
+    b2 = make_batch("m", T.PickListMap, [{"k1": "zzz"}])
+    arr2 = np.asarray(model.transform(b2).values)
+    np.testing.assert_allclose(arr2[0], [0, 0, 1, 0])
+
+
+def test_multi_picklist_map_vectorizer():
+    maps = [{"k": {"a", "b"}}, {"k": {"b"}}, {}, {"k": set()}]
+    f = FeatureBuilder.MultiPickListMap("m").as_predictor()
+    st = MultiPickListMapVectorizer(top_k=5, min_support=1)
+    model, arr = fit_transform(st, f, make_batch("m", T.MultiPickListMap, maps))
+    assert arr.shape == (4, 4)  # a, b, OTHER, null
+    np.testing.assert_allclose(arr[0], [1, 1, 0, 0])
+    np.testing.assert_allclose(arr[1], [0, 1, 0, 0])
+    np.testing.assert_allclose(arr[2], [0, 0, 0, 1])
+    np.testing.assert_allclose(arr[3], [0, 0, 0, 1])  # empty set = null
+
+
+def test_date_map_unit_circle():
+    ms_noon = 12 * 3600 * 1000  # noon epoch-day-0 → HourOfDay angle pi
+    maps = [{"d": ms_noon}, {"d": 0}, {}]
+    f = FeatureBuilder.DateMap("m").as_predictor()
+    st = DateMapToUnitCircleVectorizer(time_period="HourOfDay")
+    model, arr = fit_transform(st, f, make_batch("m", T.DateMap, maps))
+    assert arr.shape == (3, 2)
+    np.testing.assert_allclose(arr[0], [np.sin(np.pi), np.cos(np.pi)], atol=1e-5)
+    np.testing.assert_allclose(arr[1], [0.0, 1.0], atol=1e-5)
+    np.testing.assert_allclose(arr[2], [0.0, 0.0], atol=1e-5)  # missing → 0
+
+
+def test_geolocation_map_vectorizer_mean_fill():
+    maps = [{"home": [37.0, -122.0, 5.0]}, {"home": [39.0, -120.0, 5.0]}, {}]
+    f = FeatureBuilder.GeolocationMap("m").as_predictor()
+    st = GeolocationMapVectorizer()
+    model, arr = fit_transform(st, f, make_batch("m", T.GeolocationMap, maps))
+    assert arr.shape == (3, 4)  # lat, lon, acc, null
+    np.testing.assert_allclose(arr[2, :3], [38.0, -121.0, 5.0], atol=1e-5)
+    assert arr[2, 3] == 1.0 and arr[0, 3] == 0.0
+
+
+def test_text_map_null_and_len():
+    maps = [{"a": "hello", "b": "x"}, {"a": None}, {}]
+    f = FeatureBuilder.TextMap("m").as_predictor()
+    st = TextMapNullEstimator()
+    model, arr = fit_transform(st, f, make_batch("m", T.TextMap, maps))
+    assert arr.shape == (3, 2)  # keys a, b
+    np.testing.assert_allclose(arr, [[0, 0], [1, 1], [1, 1]])
+
+    st2 = TextMapLenEstimator()
+    st2.set_input(f)
+    st2.get_output()
+    m2 = st2.fit(make_batch("m", T.TextMap, maps))
+    arr2 = np.asarray(m2.transform(make_batch("m", T.TextMap, maps)).values)
+    np.testing.assert_allclose(arr2, [[5, 1], [0, 0], [0, 0]])
+
+
+def test_map_vectorizers_empty_batch_and_save_load(tmp_path):
+    from transmogrifai_tpu.stages.serialization import (stage_from_json,
+                                                        stage_to_json)
+    maps = [{"k": "v%d" % (i % 3)} for i in range(10)]
+    f = FeatureBuilder.TextMap("m").as_predictor()
+    st = SmartTextMapVectorizer(max_cardinality=5, min_support=1)
+    model, arr = fit_transform(st, f, make_batch("m", T.TextMap, maps))
+    # transform on a fresh batch of empty maps still has fitted width
+    b_empty = make_batch("m", T.TextMap, [{}, {}])
+    arr_e = np.asarray(model.transform(b_empty).values)
+    assert arr_e.shape == (2, arr.shape[1])
+
+
+def test_e2e_workflow_with_map_features():
+    """PassengerDataAll-style flow: numeric + text-map + picklist-map +
+    geolocation-map predictors through transmogrify → selector → train."""
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                            ModelCandidate, grid)
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(0)
+    n = 200
+    age = rng.uniform(18, 80, n).astype(np.float32)
+    group = ["g%d" % (i % 3) for i in range(n)]
+    y = ((age > 45) ^ (np.arange(n) % 3 == 0)).astype(np.float32)
+    desc_maps = [{"group": group[i], "note": f"note {i}"} for i in range(n)]
+    pick_maps = [{"tier": "gold" if y[i] else "silver"} for i in range(n)]
+    geo_maps = [{"home": [37.0 + float(y[i]), -122.0, 1.0]} for i in range(n)]
+
+    label = FeatureBuilder.RealNN("label").as_response()
+    f_age = FeatureBuilder.Real("age").as_predictor()
+    f_desc = FeatureBuilder.TextMap("desc").as_predictor()
+    f_pick = FeatureBuilder.PickListMap("pick").as_predictor()
+    f_geo = FeatureBuilder.GeolocationMap("geo").as_predictor()
+
+    fv = transmogrify([f_age, f_desc, f_pick, f_geo], min_support=1)
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]),
+                       "OpLogisticRegression")])
+    sel.set_input(label, fv)
+    pred = sel.get_output()
+
+    batch = ColumnBatch({
+        "label": numeric_column(T.RealNN, y),
+        "age": numeric_column(T.Real, age),
+        "desc": object_column(T.TextMap, desc_maps),
+        "pick": object_column(T.PickListMap, pick_maps),
+        "geo": object_column(T.GeolocationMap, geo_maps),
+    }, n)
+    model = Workflow().set_input_batch(batch).set_result_features(pred).train()
+    from transmogrifai_tpu.evaluators import Evaluators
+    m = model.evaluate(Evaluators.BinaryClassification.auROC(), batch=batch)
+    assert m["AuROC"] > 0.95  # tier/geo encode the label

@@ -210,9 +210,11 @@ class MapVectorizer(Estimator):
         elif issubclass(vk, Geolocation):
             fills = {}
             for k in keys:
-                vals = [m[k][:3] for m in maps if m.get(k)]
-                fills[k] = (np.mean(np.asarray(vals, np.float32), axis=0)
-                            if vals else np.zeros(3, np.float32))
+                vals = [list(m[k])[:3] for m in maps if m.get(k)]
+                # plain float lists: fitted nested dicts must stay JSON-safe
+                fills[k] = ([float(x) for x in
+                             np.mean(np.asarray(vals, np.float32), axis=0)]
+                            if vals else [0.0, 0.0, 0.0])
                 for d in ("lat", "lon", "accuracy"):
                     cols_meta.append(VectorColumnMeta(
                         f.name, kindname, grouping=k, descriptor_value=d))

@@ -124,8 +124,53 @@ def transmogrify(features: Sequence[Feature],
             from .geo import GeolocationVectorizer
             st = GeolocationVectorizer(track_nulls=track_nulls)
         elif key == "map":
+            # per-value-kind dispatch, mirroring the reference's per-map-type
+            # cases (Transmogrifier.scala:142-217)
+            from .map_vectorizers import (GeolocationMapVectorizer,
+                                          MultiPickListMapVectorizer,
+                                          SmartTextMapVectorizer,
+                                          TextMapPivotVectorizer)
             from .maps import MapVectorizer
+            from ..types import map_value_kind
+            smart_text, pivot_text, multi, geo, generic = [], [], [], [], []
             for f in feats:
+                vk = map_value_kind(f.kind)
+                if issubclass(vk, (TextArea, Text)) and vk not in (
+                        PickList, ComboBox, ID, Country, State, City,
+                        PostalCode, Street, Email, URL, Phone, Base64):
+                    smart_text.append(f)
+                elif issubclass(vk, (PickList, ComboBox, ID, Country, State,
+                                     City, PostalCode, Street, Email, URL,
+                                     Phone, Base64)):
+                    pivot_text.append(f)
+                elif issubclass(vk, MultiPickList):
+                    multi.append(f)
+                elif issubclass(vk, Geolocation):
+                    geo.append(f)
+                else:
+                    generic.append(f)
+            if smart_text:
+                st = SmartTextMapVectorizer(
+                    max_cardinality=max_categorical_cardinality, top_k=top_k,
+                    min_support=min_support, num_hashes=num_hashes,
+                    track_nulls=track_nulls)
+                st.set_input(*smart_text)
+                blocks.append(st.get_output())
+            if pivot_text:
+                st = TextMapPivotVectorizer(top_k=top_k, min_support=min_support,
+                                            track_nulls=track_nulls)
+                st.set_input(*pivot_text)
+                blocks.append(st.get_output())
+            if multi:
+                st = MultiPickListMapVectorizer(
+                    top_k=top_k, min_support=min_support, track_nulls=track_nulls)
+                st.set_input(*multi)
+                blocks.append(st.get_output())
+            if geo:
+                st = GeolocationMapVectorizer(track_nulls=track_nulls)
+                st.set_input(*geo)
+                blocks.append(st.get_output())
+            for f in generic:
                 st = MapVectorizer(top_k=top_k, min_support=min_support,
                                    track_nulls=track_nulls)
                 st.set_input(f)

@@ -41,6 +41,7 @@ _STAGE_MODULES = [
     "transmogrifai_tpu.ops.dates",
     "transmogrifai_tpu.ops.geo",
     "transmogrifai_tpu.ops.maps",
+    "transmogrifai_tpu.ops.map_vectorizers",
     "transmogrifai_tpu.ops.collections",
     "transmogrifai_tpu.ops.combiner",
     "transmogrifai_tpu.models.linear",

@@ -1,0 +1,245 @@
+"""Specialized text stages (≙ the reference suites
+ValidEmailTransformerTest, PhoneNumberParserTest, MimeTypeDetectorTest,
+OpCountVectorizerTest, OpNGramTest, OpStopWordsRemoverTest,
+NGramSimilarityTest, JaccardSimilarityTest, LangDetectorTest,
+HumanNameDetectorTest, OpLDATest, OpWord2VecTest)."""
+
+import base64
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.columns import Column, ColumnBatch, column_from_values
+from transmogrifai_tpu.features import Feature
+from transmogrifai_tpu.ops.text_specialized import (
+    EmailMapToPickListMapTransformer, EmailToPickListTransformer,
+    HumanNameDetector, IsValidPhoneDefaultCountry,
+    IsValidPhoneMapDefaultCountry, JaccardSimilarity, LangDetector,
+    MimeTypeDetector, NameEntityRecognizer, OpCountVectorizer, OpLDA, OpNGram,
+    OpStopWordsRemover, OpWord2Vec, ParsePhoneDefaultCountry,
+    TextNGramSimilarity, UrlMapToPickListMapTransformer, ngram_distance,
+    parse_phone)
+from transmogrifai_tpu.types import (Base64, Email, EmailMap, MultiPickList,
+                                     OPVector, Phone, PhoneMap, Text, TextList,
+                                     URLMap)
+
+
+def _feat(name, kind):
+    return Feature(name, kind, False, None, parents=())
+
+
+def _batch(name, kind, values):
+    col = column_from_values(kind, values)
+    return ColumnBatch({name: col}, len(col))
+
+
+def test_valid_email_and_domain():
+    from transmogrifai_tpu.ops.text_specialized import ValidEmailTransformer
+    st = ValidEmailTransformer().set_input(_feat("e", Email))
+    batch = _batch("e", Email, ["a@b.com", "not-an-email", None, "x@y.org"])
+    out = st.transform(batch)
+    assert list(np.asarray(out.values)) == [1.0, 0.0, 0.0, 1.0]
+    assert list(np.asarray(out.mask)) == [True, True, False, True]
+
+    st2 = EmailToPickListTransformer().set_input(_feat("e", Email))
+    out2 = st2.transform(batch)
+    assert list(out2.values) == ["b.com", None, None, "y.org"]
+
+
+def test_email_map_to_picklist_map():
+    st = EmailMapToPickListMapTransformer().set_input(_feat("m", EmailMap))
+    batch = _batch("m", EmailMap, [{"w": "a@b.com", "h": "bad"}, {}, None])
+    out = st.transform(batch)
+    assert out.values[0] == {"w": "b.com"}
+    assert out.values[1] == {}
+
+
+def test_url_map_to_picklist_map():
+    st = UrlMapToPickListMapTransformer().set_input(_feat("m", URLMap))
+    batch = _batch("m", URLMap, [
+        {"a": "https://Example.COM/x", "b": "notaurl", "c": "ftp://ftp.x.io"}])
+    out = st.transform(batch)
+    assert out.values[0] == {"a": "example.com", "c": "ftp.x.io"}
+
+
+def test_phone_parse_and_validate():
+    assert parse_phone("(555) 123-4567", "US") == "+15551234567"
+    assert parse_phone("+44 20 7946 0958", "US") == "+442079460958"
+    assert parse_phone("123", "US") is None
+    assert parse_phone(None) is None
+
+    st = IsValidPhoneDefaultCountry().set_input(_feat("p", Phone))
+    batch = _batch("p", Phone, ["5551234567", "12", None])
+    out = st.transform(batch)
+    assert list(np.asarray(out.values)) == [1.0, 0.0, 0.0]
+
+    st2 = ParsePhoneDefaultCountry().set_input(_feat("p", Phone))
+    out2 = st2.transform(batch)
+    assert list(out2.values) == ["+15551234567", None, None]
+
+
+def test_phone_map_validate():
+    st = IsValidPhoneMapDefaultCountry().set_input(_feat("m", PhoneMap))
+    batch = _batch("m", PhoneMap, [{"home": "5551234567", "cell": "12"}])
+    out = st.transform(batch)
+    assert out.values[0] == {"home": True, "cell": False}
+
+
+def test_mime_type_detector():
+    png = base64.b64encode(b"\x89PNG\r\n\x1a\n" + b"\0" * 16).decode()
+    pdf = base64.b64encode(b"%PDF-1.4 hello").decode()
+    txt = base64.b64encode(b"plain old words here").decode()
+    st = MimeTypeDetector().set_input(_feat("b", Base64))
+    batch = _batch("b", Base64, [png, pdf, txt, None])
+    out = st.transform(batch)
+    assert list(out.values) == ["image/png", "application/pdf", "text/plain", None]
+
+
+def test_count_vectorizer():
+    f = _feat("t", TextList)
+    st = OpCountVectorizer(vocab_size=3, min_df=1.0).set_input(f)
+    batch = _batch("t", TextList, [["a", "a", "b"], ["b", "c"], ["a", "d"], None])
+    model = st.fit(batch)
+    out = model.transform(batch)
+    arr = np.asarray(out.values)
+    vocab = model.fitted["vocab"]
+    assert len(vocab) == 3 and "a" in vocab and "b" in vocab
+    ia = vocab.index("a")
+    assert arr[0, ia] == 2.0 and arr[3].sum() == 0.0
+    assert out.meta.columns[0].indicator_value == vocab[0]
+
+
+def test_ngram_and_stopwords():
+    f = _feat("t", TextList)
+    st = OpNGram(n=2).set_input(f)
+    batch = _batch("t", TextList, [["a", "b", "c"], ["x"], None])
+    out = st.transform(batch)
+    assert out.values[0] == ["a b", "b c"]
+    assert out.values[1] == [] and out.values[2] == []
+
+    sw = OpStopWordsRemover().set_input(f)
+    out2 = sw.transform(_batch("t", TextList, [["The", "quick", "fox"], None]))
+    assert out2.values[0] == ["quick", "fox"]
+
+
+def test_ngram_similarity():
+    # identical strings → 1; empty → 0 (NGramSimilarity.scala:89)
+    assert ngram_distance("abcde", "abcde") == pytest.approx(1.0)
+    assert ngram_distance("", "x") == 0.0
+    sim_close = ngram_distance("kitten", "kittem")
+    sim_far = ngram_distance("kitten", "zzzzzz")
+    assert sim_far < sim_close < 1.0
+
+    st = TextNGramSimilarity().set_input(_feat("a", Text), _feat("b", Text))
+    batch = ColumnBatch({
+        "a": column_from_values(Text, ["Hello", "", None]),
+        "b": column_from_values(Text, ["hello", "x", "y"])}, 3)
+    out = st.transform(batch)
+    vals = np.asarray(out.values)
+    assert vals[0] == pytest.approx(1.0)  # lowercased match
+    assert vals[1] == 0.0 and vals[2] == 0.0
+
+
+def test_jaccard_similarity():
+    st = JaccardSimilarity().set_input(
+        _feat("a", MultiPickList), _feat("b", MultiPickList))
+    batch = ColumnBatch({
+        "a": column_from_values(MultiPickList, [{"x", "y"}, set(), {"q"}]),
+        "b": column_from_values(MultiPickList, [{"y", "z"}, set(), {"r"}])}, 3)
+    out = st.transform(batch)
+    vals = np.asarray(out.values)
+    assert vals[0] == pytest.approx(1 / 3)
+    assert vals[1] == 1.0  # both empty → 1.0 (JaccardSimilarity.scala:40)
+    assert vals[2] == 0.0
+
+
+def test_lang_detector():
+    st = LangDetector().set_input(_feat("t", Text))
+    batch = _batch("t", Text, [
+        "the cat sat on the mat and it was happy",
+        "le chat est dans la maison avec une souris",
+        None])
+    out = st.transform(batch)
+    assert max(out.values[0], key=out.values[0].get) == "en"
+    assert max(out.values[1], key=out.values[1].get) == "fr"
+    assert out.values[2] == {}
+
+
+def test_human_name_detector():
+    f = _feat("n", Text)
+    names = ["Mary Smith", "John Johnson", "Emily Chen", "Robert Garcia"]
+    st = HumanNameDetector().set_input(f)
+    model = st.fit(_batch("n", Text, names))
+    assert model.fitted["treat_as_name"] is True
+    out = model.transform(_batch("n", Text, ["Mary Smith", None]))
+    assert out.values[0]["IsName"] == "true"
+    assert out.values[0]["Gender"] == "Female"
+
+    # a non-name column is left empty (HumanNameDetector.scala:114)
+    st2 = HumanNameDetector().set_input(f)
+    model2 = st2.fit(_batch("n", Text, ["error code 5", "sku-123", "qty 9"]))
+    assert model2.fitted["treat_as_name"] is False
+    out2 = model2.transform(_batch("n", Text, ["Mary Smith"]))
+    assert out2.values[0] == {}
+
+
+def test_name_entity_recognizer():
+    st = NameEntityRecognizer().set_input(_feat("t", Text))
+    out = st.transform(_batch("t", Text, ["I met John and Mary today", None]))
+    assert out.values[0]["John"] == frozenset({"Person"})
+    assert out.values[0]["Mary"] == frozenset({"Person"})
+    assert out.values[1] == {}
+
+
+def test_lda_topics():
+    rng = np.random.default_rng(0)
+    # two clearly separated topics over a 6-term vocabulary
+    docs_a = rng.poisson(5, size=(20, 3))
+    docs_b = rng.poisson(5, size=(20, 3))
+    counts = np.zeros((40, 6), np.float32)
+    counts[:20, :3] = docs_a
+    counts[20:, 3:] = docs_b
+    f = _feat("v", OPVector)
+    batch = ColumnBatch({"v": Column(OPVector, counts)}, 40)
+    st = OpLDA(k=2, max_iter=30).set_input(f)
+    model = st.fit(batch)
+    out = np.asarray(model.transform(batch).values)
+    assert out.shape == (40, 2)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-3)
+    # docs from the same block should share their dominant topic
+    dom = out.argmax(axis=1)
+    assert (dom[:20] == dom[0]).all() and (dom[20:] == dom[20]).all()
+    assert dom[0] != dom[20]
+
+
+def test_word2vec_embeddings():
+    docs = [["king", "queen", "royal"], ["king", "royal", "crown"],
+            ["dog", "cat", "pet"], ["dog", "pet", "leash"]] * 5
+    f = _feat("t", TextList)
+    batch = _batch("t", TextList, docs)
+    st = OpWord2Vec(vector_size=8, min_count=2, epochs=30).set_input(f)
+    model = st.fit(batch)
+    out = np.asarray(model.transform(batch).values)
+    assert out.shape == (20, 8)
+    # out-of-vocab / empty docs → zero vector (Spark Word2Vec semantics)
+    out2 = np.asarray(model.transform(
+        _batch("t", TextList, [["zzz"], None])).values)
+    assert (out2 == 0).all()
+
+
+def test_transmogrify_routes_specialized_kinds():
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.dag import fit_dag, compute_dag
+
+    email = _feat("email", Email)
+    phone = _feat("phone", Phone)
+    vec = transmogrify([email, phone])
+    batch = ColumnBatch({
+        "email": column_from_values(Email, ["a@x.com", "b@y.com", "bad", None]),
+        "phone": column_from_values(Phone, ["5551234567", "1", None, "5559876543"]),
+    }, 4)
+    dag = compute_dag([vec])
+    out_batch, _ = fit_dag(batch, dag)
+    col = out_batch[vec.name]
+    arr = np.asarray(col.values)
+    assert arr.shape[0] == 4 and arr.shape[1] >= 3

@@ -8,6 +8,17 @@ from .bucketizers import (DecisionTreeNumericBucketizer,
 from .categorical import OneHotEstimator, StringIndexer, IndexToString
 from .combiner import VectorsCombiner
 from .transmogrify import transmogrify, TransmogrifierDefaults
+from .text_specialized import (EmailMapToPickListMapTransformer,
+                               EmailToPickListTransformer, HumanNameDetector,
+                               IsValidPhoneDefaultCountry,
+                               IsValidPhoneMapDefaultCountry, JaccardSimilarity,
+                               LangDetector, MimeTypeDetector,
+                               MimeTypeMapDetector, NameEntityRecognizer,
+                               OpCountVectorizer, OpLDA, OpNGram,
+                               OpStopWordsRemover, OpWord2Vec,
+                               ParsePhoneDefaultCountry, SetNGramSimilarity,
+                               TextNGramSimilarity, UrlMapToPickListMapTransformer,
+                               UrlToPickListTransformer, ValidEmailTransformer)
 
 __all__ = ["RealVectorizer", "RealNNVectorizer", "IntegralVectorizer",
            "BinaryVectorizer", "OneHotEstimator", "StringIndexer",
@@ -15,4 +26,12 @@ __all__ = ["RealVectorizer", "RealNNVectorizer", "IntegralVectorizer",
            "TransmogrifierDefaults", "NumericBucketizer",
            "DecisionTreeNumericBucketizer", "DecisionTreeNumericMapBucketizer",
            "PercentileCalibrator", "ScalerTransformer", "DescalerTransformer",
-           "IsotonicRegressionCalibrator"]
+           "IsotonicRegressionCalibrator", "ValidEmailTransformer",
+           "EmailToPickListTransformer", "EmailMapToPickListMapTransformer",
+           "UrlToPickListTransformer", "UrlMapToPickListMapTransformer",
+           "ParsePhoneDefaultCountry", "IsValidPhoneDefaultCountry",
+           "IsValidPhoneMapDefaultCountry", "MimeTypeDetector",
+           "MimeTypeMapDetector", "OpCountVectorizer", "OpNGram",
+           "OpStopWordsRemover", "TextNGramSimilarity", "SetNGramSimilarity",
+           "JaccardSimilarity", "LangDetector", "NameEntityRecognizer",
+           "HumanNameDetector", "OpLDA", "OpWord2Vec"]

@@ -51,8 +51,14 @@ def _group_key(kind: Type[FeatureType]) -> str:
     if issubclass(kind, (PickList, ComboBox, ID, Country, State, City,
                          PostalCode, Street)):
         return "categorical"
-    if issubclass(kind, (Base64, Phone, Email, URL)):
-        return "categorical"
+    if issubclass(kind, Email):
+        return "email"
+    if issubclass(kind, URL):
+        return "url"
+    if issubclass(kind, Phone):
+        return "phone"
+    if issubclass(kind, Base64):
+        return "base64"
     if issubclass(kind, (TextArea, Text)):
         return "text"
     if issubclass(kind, TextList):
@@ -86,6 +92,24 @@ def transmogrify(features: Sequence[Feature],
     groups: Dict[str, List[Feature]] = {}
     for f in features:
         groups.setdefault(_group_key(f.kind), []).append(f)
+
+    # specialized text kinds route through their validators/extractors first
+    # (≙ TextTransmogrify cases, Transmogrifier.scala:116-180: email/url →
+    # domain picklist, base64 → mime-type picklist, phone → isValid binary)
+    from .text_specialized import (EmailToPickListTransformer,
+                                   IsValidPhoneDefaultCountry,
+                                   MimeTypeDetector, UrlToPickListTransformer)
+    specialized_routes = [
+        ("email", EmailToPickListTransformer, "categorical"),
+        ("url", UrlToPickListTransformer, "categorical"),
+        ("base64", MimeTypeDetector, "categorical"),
+        ("phone", IsValidPhoneDefaultCountry, "binary"),
+    ]
+    for group, stage_cls, dest in specialized_routes:
+        for f in groups.pop(group, []):
+            st = stage_cls()
+            st.set_input(f)
+            groups.setdefault(dest, []).append(st.get_output())
 
     blocks: List[Feature] = []
     for key in sorted(groups):

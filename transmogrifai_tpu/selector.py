@@ -199,11 +199,22 @@ class ModelSelector(Estimator):
 
     def __init__(self, validator: OpValidator, splitter: Optional[Splitter],
                  models: Sequence[ModelCandidate],
-                 evaluators: Sequence[OpEvaluatorBase] = (), **kw):
+                 evaluators: Sequence[OpEvaluatorBase] = (),
+                 model_types_to_use: Optional[Sequence[str]] = None, **kw):
         super().__init__(**kw)
         self.validator = validator
         self.splitter = splitter
         self.models = list(models)
+        if model_types_to_use is not None:
+            # ≙ setModelsToTry/modelTypesToUse (BinaryClassificationModelSelector.scala)
+            wanted = set(model_types_to_use)
+            known = {c.model_name for c in self.models}
+            unknown = wanted - known
+            if unknown:
+                raise ValueError(
+                    f"model_types_to_use: unknown model types {sorted(unknown)}; "
+                    f"available: {sorted(known)}")
+            self.models = [c for c in self.models if c.model_name in wanted]
         self.evaluators = list(evaluators)
         self.holdout_eval: Optional[Dict[str, Any]] = None
 

@@ -38,6 +38,7 @@ _STAGE_MODULES = [
     "transmogrifai_tpu.ops.bucketizers",
     "transmogrifai_tpu.ops.categorical",
     "transmogrifai_tpu.ops.text",
+    "transmogrifai_tpu.ops.text_specialized",
     "transmogrifai_tpu.ops.dates",
     "transmogrifai_tpu.ops.geo",
     "transmogrifai_tpu.ops.maps",

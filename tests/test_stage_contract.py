@@ -1,0 +1,588 @@
+"""Universal stage contract harness (≙ OpTransformerSpec.scala:52 +
+OpEstimatorSpec + OpPipelineStageSpec:111-136).
+
+Every registered concrete stage is swept through the same contract:
+  1. batch transform == row-wise ``transform_row`` on every row,
+  2. save/load (JSON + arrays) round-trip produces identical outputs,
+  3. an all-null input batch transforms without crashing (nullable kinds),
+  4. an empty (0-row) batch transforms to 0-row output.
+
+A stage class registered in ``_STAGE_MODULES`` that has neither a contract
+case nor an explicit exemption fails ``test_registry_fully_covered`` — adding
+a stage forces adding its contract case, reference-style.
+"""
+
+import importlib
+import inspect
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.columns import Column, ColumnBatch, column_from_values
+from transmogrifai_tpu.features import Feature
+from transmogrifai_tpu.stages.base import (Estimator, PipelineStage,
+                                           Transformer, TransformerModel)
+from transmogrifai_tpu.stages.serialization import (_STAGE_MODULES,
+                                                    stage_fitted_arrays,
+                                                    stage_from_json,
+                                                    stage_to_json)
+from transmogrifai_tpu.types import (Base64, Base64Map, Binary, Date, DateList,
+                                     DateMap, Email, EmailMap, FeatureType,
+                                     Geolocation, GeolocationMap, Integral,
+                                     MultiPickList, MultiPickListMap, OPVector,
+                                     Phone, PhoneMap, PickList, Prediction,
+                                     Real, RealMap, RealNN, Text, TextList,
+                                     TextMap, URL, URLMap)
+from transmogrifai_tpu.vector_meta import VectorColumnMeta, VectorMeta
+
+N_ROWS = 24
+_rng = np.random.default_rng(7)
+
+
+# ---------------------------------------------------------------------------
+# typed random columns (testkit-style, deterministic)
+# ---------------------------------------------------------------------------
+
+def _reals(n, p_null=0.25):
+    return [None if _rng.random() < p_null else float(_rng.normal()) for _ in range(n)]
+
+
+def _realnn(n):
+    return [float(_rng.normal()) for _ in range(n)]
+
+
+def _label(n):
+    return [float(_rng.integers(0, 2)) for _ in range(n)]
+
+
+def _integrals(n, p_null=0.25):
+    return [None if _rng.random() < p_null else int(_rng.integers(0, 50)) for _ in range(n)]
+
+
+def _binaries(n, p_null=0.25):
+    return [None if _rng.random() < p_null else bool(_rng.random() < 0.5) for _ in range(n)]
+
+
+def _dates(n, p_null=0.2):
+    return [None if _rng.random() < p_null
+            else int(1.4e12 + _rng.integers(0, 1000) * 86400000) for _ in range(n)]
+
+
+def _texts(n, p_null=0.25):
+    words = ["alpha", "beta", "gamma", "delta", "epsilon"]
+    return [None if _rng.random() < p_null
+            else " ".join(_rng.choice(words, size=3)) for _ in range(n)]
+
+
+def _picklists(n, p_null=0.25):
+    return [None if _rng.random() < p_null
+            else str(_rng.choice(["red", "green", "blue"])) for _ in range(n)]
+
+
+def _emails(n, p_null=0.25):
+    return [None if _rng.random() < p_null
+            else f"user{i}@{_rng.choice(['a.com', 'b.org'])}" for i in range(n)]
+
+
+def _phones(n, p_null=0.25):
+    return [None if _rng.random() < p_null
+            else "555123" + "".join(str(_rng.integers(0, 10)) for _ in range(4))
+            for _ in range(n)]
+
+
+def _b64s(n, p_null=0.25):
+    import base64 as b
+    payloads = [b"\x89PNG\r\n\x1a\nxxxx", b"%PDF-1.4", b"hello world"]
+    return [None if _rng.random() < p_null
+            else b.b64encode(payloads[int(_rng.integers(0, 3))]).decode()
+            for _ in range(n)]
+
+
+def _textlists(n, p_null=0.2):
+    words = ["cat", "dog", "fox", "owl", "bat"]
+    return [None if _rng.random() < p_null
+            else list(_rng.choice(words, size=int(_rng.integers(0, 5))))
+            for _ in range(n)]
+
+
+def _datelists(n, p_null=0.2):
+    return [None if _rng.random() < p_null
+            else [int(1.4e12 + _rng.integers(0, 500) * 86400000)
+                  for _ in range(int(_rng.integers(0, 4)))] for _ in range(n)]
+
+
+def _sets(n, p_null=0.2):
+    dom = ["x", "y", "z", "w"]
+    return [None if _rng.random() < p_null
+            else set(_rng.choice(dom, size=int(_rng.integers(0, 3)),
+                                 replace=False).tolist()) for _ in range(n)]
+
+
+def _geos(n, p_null=0.2):
+    return [None if _rng.random() < p_null
+            else [float(_rng.uniform(-90, 90)), float(_rng.uniform(-180, 180)),
+                  float(_rng.integers(1, 10))] for _ in range(n)]
+
+
+def _maps(vgen, keys=("k1", "k2")):
+    def gen(n, p_null=0.2):
+        vals = vgen(n * len(keys), 0.0)
+        out = []
+        for i in range(n):
+            if _rng.random() < p_null:
+                out.append(None)
+            else:
+                out.append({k: vals[i * len(keys) + j]
+                            for j, k in enumerate(keys) if _rng.random() > 0.3})
+        return out
+    return gen
+
+
+def _vectors(dim=4):
+    def gen(n, p_null=0.0):
+        return [np.asarray(_rng.normal(size=dim), np.float32) for _ in range(n)]
+    return gen
+
+
+def _vector_column(name, values, dim):
+    meta = VectorMeta(name, [VectorColumnMeta(name, "OPVector",
+                                              descriptor_value=f"v{j}")
+                             for j in range(dim)])
+    arr = np.stack([np.asarray(v, np.float32) for v in values]) if len(values) \
+        else np.zeros((0, dim), np.float32)
+    return Column(OPVector, arr, meta=meta)
+
+
+def _predictions(n, p_null=0.0):
+    return [{"prediction": float(_rng.integers(0, 2)),
+             "probability_0": 0.4, "probability_1": 0.6} for _ in range(n)]
+
+
+def _urls(n, p_null=0.25):
+    return [None if _rng.random() < p_null
+            else f"https://s{i}.{_rng.choice(['a.com', 'b.io'])}/p"
+            for i in range(n)]
+
+
+GEN_BY_KIND = {
+    Real: _reals, RealNN: _realnn, Integral: _integrals, Binary: _binaries,
+    Date: _dates, Text: _texts, PickList: _picklists, Email: _emails,
+    Phone: _phones, Base64: _b64s, URL: _urls, TextList: _textlists,
+    DateList: _datelists,
+    MultiPickList: _sets, Geolocation: _geos, TextMap: _maps(_texts),
+    EmailMap: _maps(_emails), PhoneMap: _maps(_phones),
+    Base64Map: _maps(_b64s),
+    URLMap: _maps(_urls),
+    RealMap: _maps(lambda n, p: [float(x) for x in _rng.normal(size=n)]),
+    DateMap: _maps(_dates), MultiPickListMap: _maps(_sets),
+    GeolocationMap: _maps(_geos), Prediction: _predictions,
+}
+
+
+# ---------------------------------------------------------------------------
+# the contract cases
+# ---------------------------------------------------------------------------
+
+class Case:
+    def __init__(self, factory, inputs, id=None, label_input=False,
+                 vector_dim=4, atol=1e-5, wire=None):
+        self.factory = factory        # () -> stage
+        self.inputs = inputs          # [(name, kind)] — data from GEN_BY_KIND
+        self.id = id or factory.__name__ if inspect.isfunction(factory) else id
+        self.label_input = label_input
+        self.vector_dim = vector_dim
+        self.atol = atol
+        self.wire = wire              # optional (stage, batch) -> (feats, batch)
+
+
+def _mk(cls, **kw):
+    def factory():
+        return cls(**kw)
+    factory.__name__ = cls.__name__
+    return factory
+
+
+def _lda_wire(stage, batch):
+    """LDA consumes non-negative term counts, not Gaussian vectors."""
+    n = len(batch)
+    counts = _rng.poisson(2.0, size=(n, 4)).astype(np.float32)
+    col = _vector_column("v", list(counts), 4)
+    return (Feature("v", OPVector, False, None, parents=()),), \
+        ColumnBatch({"v": col}, n)
+
+
+def _descaler_case():
+    from transmogrifai_tpu.ops.bucketizers import (DescalerTransformer,
+                                                   ScalerTransformer)
+    return DescalerTransformer()
+
+
+def _descaler_wire(stage, batch):
+    """Descaler input 2 must carry a ScalerTransformer origin — wire a real
+    scaled feature (≙ DescalerTransformerTest building scale→descale chains)."""
+    from transmogrifai_tpu.ops.bucketizers import ScalerTransformer
+    a = Feature("a", Real, False, None, parents=())
+    scaler = ScalerTransformer(scaling_type="Linear",
+                               scaling_args={"slope": 2.0, "intercept": 1.0})
+    scaler.set_input(a)
+    sf = scaler.get_output()
+    scaled = scaler.transform(batch)
+    batch = batch.with_column(sf.name, scaled)
+    return (sf, sf), batch
+
+
+def _cases():
+    from transmogrifai_tpu.ops.bucketizers import (
+        DecisionTreeNumericBucketizer, DecisionTreeNumericMapBucketizer,
+        DescalerTransformer, IsotonicRegressionCalibrator, NumericBucketizer,
+        PercentileCalibrator, ScalerTransformer)
+    from transmogrifai_tpu.ops.categorical import (IndexToString,
+                                                   OneHotEstimator,
+                                                   StringIndexer)
+    from transmogrifai_tpu.ops.collections import MultiPickListVectorizer
+    from transmogrifai_tpu.ops.combiner import VectorsCombiner
+    from transmogrifai_tpu.ops.dates import (DateListVectorizer,
+                                             DateToUnitCircleVectorizer,
+                                             TimePeriodTransformer)
+    from transmogrifai_tpu.ops.geo import GeolocationVectorizer
+    from transmogrifai_tpu.ops.map_vectorizers import (
+        DateMapToUnitCircleVectorizer, GeolocationMapVectorizer,
+        MultiPickListMapVectorizer, SmartTextMapVectorizer, TextMapLenEstimator,
+        TextMapNullEstimator, TextMapPivotVectorizer)
+    from transmogrifai_tpu.ops.maps import MapVectorizer
+    from transmogrifai_tpu.ops.numeric import (BinaryVectorizer,
+                                               IntegralVectorizer,
+                                               RealNNVectorizer,
+                                               RealVectorizer, StandardScaler)
+    from transmogrifai_tpu.ops.text import (HashingVectorizer,
+                                            SmartTextVectorizer,
+                                            TextLenTransformer,
+                                            TextListVectorizer, TextTokenizer)
+    from transmogrifai_tpu.ops.text_specialized import (
+        EmailMapToPickListMapTransformer, EmailToPickListTransformer,
+        HumanNameDetector, IsValidPhoneDefaultCountry,
+        IsValidPhoneMapDefaultCountry, JaccardSimilarity, LangDetector,
+        MimeTypeDetector, MimeTypeMapDetector, NameEntityRecognizer,
+        OpCountVectorizer, OpLDA, OpNGram, OpStopWordsRemover, OpWord2Vec,
+        ParsePhoneDefaultCountry, SetNGramSimilarity, TextNGramSimilarity,
+        UrlMapToPickListMapTransformer, UrlToPickListTransformer,
+        ValidEmailTransformer)
+    from transmogrifai_tpu.models.linear import (
+        OpGeneralizedLinearRegression, OpLinearRegression, OpLinearSVC,
+        OpLogisticRegression, OpMultilayerPerceptronClassifier, OpNaiveBayes)
+    from transmogrifai_tpu.models.trees import (
+        OpDecisionTreeClassifier, OpDecisionTreeRegressor, OpGBTClassifier,
+        OpGBTRegressor, OpRandomForestClassifier, OpRandomForestRegressor,
+        OpXGBoostClassifier, OpXGBoostRegressor)
+    from transmogrifai_tpu.preparators.prediction_deindexer import \
+        PredictionDeIndexer
+    from transmogrifai_tpu.preparators.sanity_checker import SanityChecker
+    from transmogrifai_tpu.stages.transformers import (AliasTransformer,
+                                                       BinaryMathTransformer,
+                                                       ExistsTransformer,
+                                                       ReplaceTransformer,
+                                                       SubstringTransformer,
+                                                       ToOccurTransformer,
+                                                       UnaryMathTransformer)
+
+    model_kw = dict(max_iter=5)
+    tree_kw = dict(num_trees=3, max_depth=3)
+    cases = [
+        # numeric vectorizers
+        Case(_mk(RealVectorizer), [("a", Real), ("b", Real)]),
+        Case(_mk(RealNNVectorizer), [("a", RealNN)]),
+        Case(_mk(IntegralVectorizer), [("a", Integral)]),
+        Case(_mk(BinaryVectorizer), [("a", Binary)]),
+        Case(_mk(StandardScaler), [("v", OPVector)]),
+        # bucketizers / calibrators
+        Case(_mk(NumericBucketizer, splits=(-np.inf, 0.0, np.inf)), [("a", Real)]),
+        Case(_mk(DecisionTreeNumericBucketizer), [("label", RealNN), ("a", Real)],
+             label_input=True),
+        Case(_mk(DecisionTreeNumericMapBucketizer),
+             [("label", RealNN), ("m", RealMap)], label_input=True),
+        Case(_mk(PercentileCalibrator, expected_num_buckets=10), [("a", RealNN)]),
+        Case(_mk(ScalerTransformer, scaling_type="Linear",
+                 scaling_args={"slope": 2.0, "intercept": 1.0}),
+             [("a", Real)]),
+        Case(_descaler_case, [("a", Real)], id="DescalerTransformer",
+             wire=_descaler_wire),
+        Case(_mk(IsotonicRegressionCalibrator),
+             [("label", RealNN), ("score", RealNN)], label_input=True),
+        # categorical
+        Case(_mk(OneHotEstimator, top_k=5, min_support=1), [("c", PickList)]),
+        Case(_mk(StringIndexer), [("c", PickList)]),
+        Case(_mk(IndexToString, labels=["red", "green", "blue"]), [("i", Integral)]),
+        # dates / geo / collections
+        Case(_mk(DateToUnitCircleVectorizer), [("d", Date)]),
+        Case(_mk(TimePeriodTransformer, period="DayOfWeek"), [("d", Date)]),
+        Case(_mk(DateListVectorizer), [("dl", DateList)]),
+        Case(_mk(GeolocationVectorizer), [("g", Geolocation)]),
+        Case(_mk(MultiPickListVectorizer, top_k=4, min_support=1),
+             [("s", MultiPickList)]),
+        Case(_mk(VectorsCombiner), [("v1", OPVector), ("v2", OPVector)]),
+        # text
+        Case(_mk(TextTokenizer), [("t", Text)]),
+        Case(_mk(TextLenTransformer), [("t", Text)]),
+        Case(_mk(HashingVectorizer, num_hashes=16), [("t", Text)]),
+        Case(_mk(SmartTextVectorizer, max_cardinality=2, num_hashes=16),
+             [("t", Text)]),
+        Case(_mk(TextListVectorizer, num_hashes=16), [("tl", TextList)]),
+        # specialized text
+        Case(_mk(ValidEmailTransformer), [("e", Email)]),
+        Case(_mk(EmailToPickListTransformer), [("e", Email)]),
+        Case(_mk(EmailMapToPickListMapTransformer), [("m", EmailMap)]),
+        Case(_mk(UrlToPickListTransformer), [("u", URL)]),
+        Case(_mk(UrlMapToPickListMapTransformer), [("m", URLMap)]),
+        Case(_mk(ParsePhoneDefaultCountry), [("p", Phone)]),
+        Case(_mk(IsValidPhoneDefaultCountry), [("p", Phone)]),
+        Case(_mk(IsValidPhoneMapDefaultCountry), [("m", PhoneMap)]),
+        Case(_mk(MimeTypeDetector), [("b", Base64)]),
+        Case(_mk(MimeTypeMapDetector), [("m", Base64Map)]),
+        Case(_mk(OpCountVectorizer, vocab_size=8, min_df=1.0), [("tl", TextList)]),
+        Case(_mk(OpNGram, n=2), [("tl", TextList)]),
+        Case(_mk(OpStopWordsRemover), [("tl", TextList)]),
+        Case(_mk(TextNGramSimilarity), [("a", Text), ("b", Text)]),
+        Case(_mk(SetNGramSimilarity), [("a", MultiPickList), ("b", MultiPickList)]),
+        Case(_mk(JaccardSimilarity), [("a", MultiPickList), ("b", MultiPickList)]),
+        Case(_mk(LangDetector), [("t", Text)]),
+        Case(_mk(NameEntityRecognizer), [("t", Text)]),
+        Case(_mk(HumanNameDetector), [("t", Text)]),
+        Case(_mk(OpLDA, k=2, max_iter=3), [("v", OPVector)],
+             wire=_lda_wire, atol=1e-3),
+        Case(_mk(OpWord2Vec, vector_size=4, min_count=1, epochs=2),
+             [("tl", TextList)]),
+        # map vectorizers
+        Case(_mk(MapVectorizer, top_k=4, min_support=1), [("m", RealMap)]),
+        Case(_mk(SmartTextMapVectorizer, max_cardinality=2, num_hashes=16),
+             [("m", TextMap)]),
+        Case(_mk(TextMapPivotVectorizer, top_k=4, min_support=1), [("m", TextMap)]),
+        Case(_mk(MultiPickListMapVectorizer, top_k=4, min_support=1),
+             [("m", MultiPickListMap)]),
+        Case(_mk(DateMapToUnitCircleVectorizer), [("m", DateMap)]),
+        Case(_mk(GeolocationMapVectorizer), [("m", GeolocationMap)]),
+        Case(_mk(TextMapNullEstimator), [("m", TextMap)]),
+        Case(_mk(TextMapLenEstimator), [("m", TextMap)]),
+        # generic transformers
+        Case(_mk(AliasTransformer, name="alias"), [("a", Real)]),
+        Case(_mk(UnaryMathTransformer, op="abs"), [("a", Real)]),
+        Case(_mk(BinaryMathTransformer, op="plus"), [("a", Real), ("b", Real)]),
+        Case(_mk(ExistsTransformer), [("a", Real)]),
+        Case(_mk(ToOccurTransformer), [("a", Real)]),
+        Case(_mk(SubstringTransformer), [("a", Text), ("b", Text)]),
+        Case(_mk(ReplaceTransformer, match_value="red", replace_with="rouge"),
+             [("c", PickList)]),
+        # preparators
+        Case(_mk(SanityChecker, check_sample=1.0),
+             [("label", RealNN), ("v", OPVector)], label_input=True),
+        Case(_mk(PredictionDeIndexer, labels=["no", "yes"]),
+             [("p", Prediction)]),
+        # models — classification
+        Case(_mk(OpLogisticRegression, **model_kw),
+             [("label", RealNN), ("v", OPVector)], label_input=True),
+        Case(_mk(OpLinearSVC, **model_kw),
+             [("label", RealNN), ("v", OPVector)], label_input=True),
+        Case(_mk(OpNaiveBayes), [("label", RealNN), ("v", OPVector)],
+             label_input=True),
+        Case(_mk(OpMultilayerPerceptronClassifier, max_iter=3, hidden_layers=(4,)),
+             [("label", RealNN), ("v", OPVector)], label_input=True),
+        Case(_mk(OpDecisionTreeClassifier, max_depth=3),
+             [("label", RealNN), ("v", OPVector)], label_input=True),
+        Case(_mk(OpRandomForestClassifier, **tree_kw),
+             [("label", RealNN), ("v", OPVector)], label_input=True),
+        Case(_mk(OpGBTClassifier, max_iter=3, max_depth=2),
+             [("label", RealNN), ("v", OPVector)], label_input=True),
+        Case(_mk(OpXGBoostClassifier, num_round=3, max_depth=2),
+             [("label", RealNN), ("v", OPVector)], label_input=True),
+        # models — regression
+        Case(_mk(OpLinearRegression, **model_kw),
+             [("label", RealNN), ("v", OPVector)], label_input=True),
+        Case(_mk(OpGeneralizedLinearRegression, family="poisson", max_iter=5),
+             [("label", RealNN), ("v", OPVector)], label_input=True),
+        Case(_mk(OpDecisionTreeRegressor, max_depth=3),
+             [("label", RealNN), ("v", OPVector)], label_input=True),
+        Case(_mk(OpRandomForestRegressor, **tree_kw),
+             [("label", RealNN), ("v", OPVector)], label_input=True),
+        Case(_mk(OpGBTRegressor, max_iter=3, max_depth=2),
+             [("label", RealNN), ("v", OPVector)], label_input=True),
+        Case(_mk(OpXGBoostRegressor, num_round=3, max_depth=2),
+             [("label", RealNN), ("v", OPVector)], label_input=True),
+    ]
+    return cases
+
+
+# stages legitimately outside this harness, with the reason
+EXEMPT = {
+    "generator.FeatureGeneratorStage": "stage-0 raw extraction; exercised by every reader/workflow test",
+    "selector.ModelSelector": "AutoML composite; covered by test_workflow_e2e + test_models",
+    "selector.BinaryClassificationModelSelector": "covered by test_workflow_e2e",
+    "selector.MultiClassificationModelSelector": "covered by test_workflow_e2e",
+    "selector.RegressionModelSelector": "covered by test_workflow_e2e",
+    "selector.SelectedModelCombiner": "covered by test_aux_subsystems",
+    "selector.SelectedModel": "model of ModelSelector; save/load covered by e2e",
+    "selector.CombinedModel": "model of SelectedModelCombiner",
+    "trees._ForestEstimatorBase": "abstract base",
+    "trees._GBTEstimatorBase": "abstract base",
+}
+
+
+def _case_ids():
+    return [c.id or "case" for c in _cases()]
+
+
+def _build_batch(case, n):
+    cols = {}
+    for name, kind in case.inputs:
+        if kind is OPVector:
+            cols[name] = _vector_column(name, _vectors(case.vector_dim)(n), case.vector_dim)
+        elif kind is Prediction:
+            preds = np.asarray([float(_rng.integers(0, 2)) for _ in range(n)],
+                               np.float32)
+            prob1 = np.asarray(_rng.uniform(size=n), np.float32)
+            cols[name] = Column(Prediction, {
+                "prediction": preds,
+                "probability": np.stack([1.0 - prob1, prob1], axis=1)})
+        elif name == "label":
+            cols[name] = column_from_values(kind, _label(n))
+        else:
+            cols[name] = column_from_values(kind, GEN_BY_KIND[kind](n))
+    return ColumnBatch(cols, n)
+
+
+def _features_for(case):
+    return [Feature(name, kind, name == "label", None, parents=())
+            for name, kind in case.inputs]
+
+
+def _value_of(v):
+    return v.value if isinstance(v, FeatureType) else v
+
+
+def _eq(a, b, atol):
+    a, b = _value_of(a), _value_of(b)
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, dict) or isinstance(b, dict):
+        if set(a) != set(b):
+            return False
+        return all(_eq(a[k], b[k], atol) for k in a)
+    if isinstance(a, (frozenset, set)) or isinstance(b, (frozenset, set)):
+        return set(a) == set(b)
+    if isinstance(a, str) or isinstance(b, str):
+        return a == b
+    if isinstance(a, (list, tuple, np.ndarray)) or isinstance(b, (list, tuple, np.ndarray)):
+        a, b = np.asarray(a), np.asarray(b)
+        if a.shape != b.shape:
+            return False
+        if a.dtype.kind in "OUS" or b.dtype.kind in "OUS":
+            return all(_eq(x, y, atol) for x, y in zip(a.ravel(), b.ravel()))
+        return np.allclose(a.astype(np.float64), b.astype(np.float64),
+                           atol=atol, equal_nan=True)
+    if isinstance(a, bool) or isinstance(b, bool):
+        return bool(a) == bool(b)
+    return np.isclose(float(a), float(b), atol=atol, equal_nan=True)
+
+
+def _out_columns(stage, batch):
+    out = stage.transform(batch)
+    return out if isinstance(out, tuple) else (out,)
+
+
+@pytest.mark.parametrize("case", _cases(), ids=_case_ids())
+def test_stage_contract(case):
+    stage = case.factory()
+    batch = _build_batch(case, N_ROWS)
+    if case.wire is not None:
+        feats, batch = case.wire(stage, batch)
+    else:
+        feats = _features_for(case)
+    stage.set_input(*feats)
+
+    if isinstance(stage, Estimator):
+        model = stage.fit(batch)
+    else:
+        model = stage
+    out_feats = model.output_features
+    out_cols = _out_columns(model, batch)
+    assert all(len(c) == N_ROWS for c in out_cols)
+
+    # 1. batch == row-wise (≙ OpTransformerSpec "transform rows")
+    for i in range(N_ROWS):
+        row = {f.name: batch[f.name].row_value(i) for f in feats}
+        row_out = model.transform_row(row)
+        if not isinstance(row_out, dict):
+            row_out = {out_feats[0].name: row_out}
+        for f, col in zip(out_feats, out_cols):
+            want = col.row_value(i)
+            got = row_out[f.name]
+            assert _eq(want, got, case.atol), (
+                f"row {i} of {f.name}: batch={_value_of(want)!r} "
+                f"row={_value_of(got)!r}")
+
+    # 2. save/load round trip (≙ "transform after save/load")
+    d = stage_to_json(model)
+    arrays = stage_fitted_arrays(model)
+    reloaded = stage_from_json(d, arrays)
+    reloaded.set_input(*feats)
+    reloaded._output = model._output
+    reloaded.num_outputs = model.num_outputs
+    re_cols = _out_columns(reloaded, batch)
+    for f, c1, c2 in zip(out_feats, out_cols, re_cols):
+        for i in range(N_ROWS):
+            assert _eq(c1.row_value(i), c2.row_value(i), case.atol), (
+                f"save/load mismatch at row {i} of {f.name}")
+
+    # 3. all-null inputs (skip when no input kind is nullable; wired cases
+    # cover edge shapes through their component stages' own cases)
+    nullable = [] if case.wire is not None else [
+        name for name, kind in case.inputs
+        if kind not in (RealNN, OPVector, Prediction) and name != "label"]
+    if nullable:
+        cols = dict(batch._cols)
+        for name in nullable:
+            kind = dict(case.inputs)[name]
+            cols[name] = column_from_values(kind, [None] * N_ROWS)
+        null_batch = ColumnBatch(cols, N_ROWS)
+        null_cols = _out_columns(model, null_batch)
+        assert all(len(c) == N_ROWS for c in null_cols)
+
+    # 4. empty batch
+    if case.wire is None:
+        empty_cols = {}
+        for name, kind in case.inputs:
+            if kind is OPVector:
+                empty_cols[name] = _vector_column(name, [], case.vector_dim)
+            elif kind is Prediction:
+                empty_cols[name] = Column(Prediction, {
+                    "prediction": np.zeros(0, np.float32),
+                    "probability": np.zeros((0, 2), np.float32)})
+            else:
+                empty_cols[name] = column_from_values(kind, [])
+        empty = ColumnBatch(empty_cols, 0)
+        e_cols = _out_columns(model, empty)
+        assert all(len(c) == 0 for c in e_cols)
+
+
+def test_registry_fully_covered():
+    """Every concrete registered stage class has a case or an exemption."""
+    covered = set()
+    for case in _cases():
+        stage = case.factory()
+        covered.add(type(stage).__name__)
+    model_suffixes = ("Model",)
+    missing = []
+    for m in _STAGE_MODULES:
+        mod = importlib.import_module(m)
+        short = m.rsplit(".", 1)[1]
+        for name, cls in vars(mod).items():
+            if not (inspect.isclass(cls) and issubclass(cls, PipelineStage)
+                    and cls.__module__ == m):
+                continue
+            key = f"{short}.{name}"
+            if key in EXEMPT:
+                continue
+            if issubclass(cls, TransformerModel):
+                continue  # models reached through their estimator's fit
+            if name in covered:
+                continue
+            missing.append(key)
+    assert not missing, f"stages without contract coverage: {missing}"

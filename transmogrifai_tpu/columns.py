@@ -94,6 +94,14 @@ def _full_mask(n: int) -> np.ndarray:
     return np.ones(n, dtype=bool)
 
 
+def indicator_2d(flags: Iterable) -> np.ndarray:
+    """[N, 1] float32 indicator block from truthy flags — shape-safe at N==0
+    (a list-comprehension ``np.array([[1.0] if ...])`` collapses to shape (0,)
+    on empty input and breaks axis-1 concatenation)."""
+    arr = np.fromiter((1.0 if f else 0.0 for f in flags), np.float32)
+    return arr.reshape(-1, 1)
+
+
 def numeric_column(kind: Type[FeatureType], values: Iterable, n: Optional[int] = None) -> Column:
     """Build a numeric column from python values with Nones."""
     vals = list(values)

@@ -62,8 +62,22 @@ class PredictionModel(TransformerModel):
         raise NotImplementedError
 
     def transform(self, batch: ColumnBatch) -> Column:
+        import jax
+
         feats = self.input_features[1]
-        X = np.asarray(batch[feats.name].values, dtype=np.float32)
+        xv = batch[feats.name].values
+        if isinstance(xv, jax.Array) and hasattr(self, "device_scores"):
+            # device-resident matrix: score in HBM and keep the per-row
+            # results as device arrays — pulling X over the (slow) host link
+            # to predict on numpy costs more than all the compute.
+            # full=True makes device_scores mirror predict_arrays' key set,
+            # so the Prediction schema is residency-independent.
+            X = xv if xv.dtype == jnp.float32 else xv.astype(jnp.float32)
+            out = self.device_scores(X, full=True)
+            return prediction_column(out["prediction"],
+                                     out.get("probability"),
+                                     out.get("rawPrediction"))
+        X = np.asarray(xv, dtype=np.float32)
         out = self.predict_arrays(X)
         return prediction_column(
             np.asarray(out["prediction"]),

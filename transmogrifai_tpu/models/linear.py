@@ -93,24 +93,36 @@ class LinearPredictionModel(PredictionModel):
     """Fitted linear model.  ``fitted``: coef [D] or [D,C], intercept,
     kind ∈ {binary, multinomial, regression, svc}."""
 
-    def device_scores(self, Xd) -> Dict[str, Any]:
-        """Device-resident scoring for the CV loop: returns small per-row
-        device arrays ({'prediction', 'scores'|'probability'}) so only
-        scalars/metric results ever cross the (slow) host link."""
+    def device_scores(self, Xd, full: bool = False) -> Dict[str, Any]:
+        """Device-resident scoring: returns small per-row device arrays so
+        only scalars/metric results ever cross the (slow) host link.  The CV
+        loop uses the minimal set ({'prediction', 'scores'|'probability'});
+        ``full=True`` mirrors ``predict_arrays``' key set exactly (probability
+        + rawPrediction) so the Prediction schema is residency-independent."""
         coef = jnp.asarray(self.fitted["coef"])
         intercept = jnp.asarray(self.fitted["intercept"])
         kind = self.fitted["kind"]
         if kind == "multinomial":
             logits = Xd @ coef + intercept
-            return {"prediction": jnp.argmax(logits, axis=1).astype(jnp.float32),
-                    "probability": jax.nn.softmax(logits, axis=-1)}
+            out = {"prediction": jnp.argmax(logits, axis=1).astype(jnp.float32),
+                   "probability": jax.nn.softmax(logits, axis=-1)}
+            if full:
+                out["rawPrediction"] = logits
+            return out
         margin = Xd @ coef + (intercept[0] if intercept.ndim else intercept)
         if kind == "binary":
-            return {"prediction": (margin > 0).astype(jnp.float32),
-                    "scores": jax.nn.sigmoid(margin)}
+            p1 = jax.nn.sigmoid(margin)
+            out = {"prediction": (margin > 0).astype(jnp.float32), "scores": p1}
+            if full:
+                out["probability"] = jnp.stack([1.0 - p1, p1], axis=1)
+                out["rawPrediction"] = jnp.stack([-margin, margin], axis=1)
+            return out
         if kind == "svc":
-            return {"prediction": (margin > 0).astype(jnp.float32),
-                    "scores": margin}
+            out = {"prediction": (margin > 0).astype(jnp.float32),
+                   "scores": margin}
+            if full:
+                out["rawPrediction"] = jnp.stack([-margin, margin], axis=1)
+            return out
         if kind == "glm":
             family = self.fitted.get("family", "gaussian")
             eta = jnp.clip(margin, -30.0, 30.0)
@@ -339,7 +351,7 @@ OpGeneralizedLinearRegression.model_cls = GLMPredictionModel
 class NaiveBayesModel(PredictionModel):
     """Fitted multinomial NB: log_prior [C], log_prob [C,D]."""
 
-    def device_scores(self, Xd) -> Dict[str, Any]:
+    def device_scores(self, Xd, full: bool = False) -> Dict[str, Any]:
         logits = (jnp.maximum(Xd, 0.0) @ jnp.asarray(self.fitted["log_prob"]).T
                   + jnp.asarray(self.fitted["log_prior"]))
         prob = jax.nn.softmax(logits, axis=-1)
@@ -347,6 +359,8 @@ class NaiveBayesModel(PredictionModel):
                "probability": prob}
         if prob.shape[1] == 2:
             out["scores"] = prob[:, 1]
+        if full:
+            out["rawPrediction"] = logits
         return out
 
     def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
@@ -380,7 +394,7 @@ class OpNaiveBayes(PredictorEstimator):
 class MLPClassificationModel(PredictionModel):
     """Fitted MLP: list of (W, b) per layer."""
 
-    def device_scores(self, Xd) -> Dict[str, Any]:
+    def device_scores(self, Xd, full: bool = False) -> Dict[str, Any]:
         h = Xd
         n_layers = self.fitted["n_layers"]
         for i in range(n_layers):
@@ -392,6 +406,8 @@ class MLPClassificationModel(PredictionModel):
                "probability": prob}
         if prob.shape[1] == 2:
             out["scores"] = prob[:, 1]
+        if full:
+            out["rawPrediction"] = h
         return out
 
     def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:

@@ -172,7 +172,15 @@ def fit_tree(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
     for level in range(max_depth + 1):
         n_l = 2 ** level
         offset = n_l - 1
-        node_stats = jax.ops.segment_sum(stats, row_node, num_segments=n_l)
+        if n_l <= 128:
+            # one-hot matmul instead of segment_sum: TPU lowers scatter-adds
+            # to sorts and the gather/scatter forms compile pathologically
+            oh_stats = (row_node[:, None] == jnp.arange(n_l)[None, :]
+                        ).astype(jnp.float32)
+            node_stats = jnp.einsum("nk,ns->ks", oh_stats, stats)
+        else:
+            node_stats = jax.ops.segment_sum(stats, row_node,
+                                             num_segments=n_l)
         lv = leaf_fn(node_stats)
         leaf_val = jax.lax.dynamic_update_slice(leaf_val, lv.astype(jnp.float32),
                                                 (offset, 0))
@@ -249,10 +257,18 @@ def fit_tree(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
         thr_arr = jax.lax.dynamic_update_slice(thr_arr, thr, (offset,))
         leaf_flag = jax.lax.dynamic_update_slice(leaf_flag, node_is_leaf, (offset,))
 
-        # route rows: bin(feature of my node) > split bin → right child
-        f_of_row = best_feat[row_node]                       # [N]
-        b_of_row = jnp.take_along_axis(B_pad, f_of_row[:, None], axis=1)[:, 0]
-        go_right = b_of_row > best_bin[row_node]
+        # route rows: bin(feature of my node) > split bin → right child.
+        # All lookups are fused one-hot contractions — no per-row gathers
+        # (same TPU pathology as in predict_trees_raw); bins/feat ids are
+        # small integers, exact in float32
+        oh_rows = (row_node[:, None] == jnp.arange(n_l)[None, :]
+                   ).astype(jnp.float32)
+        f_of_row = (oh_rows @ best_feat.astype(jnp.float32)).astype(jnp.int32)
+        bin_of_row = oh_rows @ best_bin.astype(jnp.float32)
+        f_oh = (f_of_row[:, None] == jnp.arange(D_pad)[None, :]
+                ).astype(jnp.float32)
+        b_of_row = jnp.einsum("nd,nd->n", f_oh, B_pad.astype(jnp.float32))
+        go_right = b_of_row > bin_of_row
         row_node = 2 * row_node + go_right.astype(jnp.int32)
         parent_dead = jnp.repeat(node_is_leaf, 2)
 
@@ -265,18 +281,43 @@ def predict_trees_raw(X: jnp.ndarray, feature: jnp.ndarray, threshold: jnp.ndarr
                       max_depth: int) -> jnp.ndarray:
     """Batch prediction over an ensemble on raw features.
     feature/threshold/is_leaf: [Tr, T]; leaf: [Tr, T, V].
-    Returns [N, Tr, V] leaf values (caller aggregates)."""
-    N = X.shape[0]
-    Tr = feature.shape[0]
-    node = jnp.zeros((N, Tr), jnp.int32)
+    Returns [N, Tr, V] leaf values (caller aggregates).
+
+    TPU note: per-(row, tree) dynamic gathers (``take_along_axis``) lower to
+    scalar gather loops and compile/run pathologically on TPU, so every node
+    lookup is expressed as a one-hot contraction instead — the comparison
+    one-hots fuse into the reductions, nothing of size [N, Tr, T] is
+    materialized, and the MXU/VPU do the work (measured: ~100x faster compile
+    AND faster steady-state than the gather form at 1Mx28, 20 trees)."""
+    T = feature.shape[1]
+    D = X.shape[1]
+    dt = X.dtype
+    k_iota = jnp.arange(T, dtype=jnp.int32)
+    d_iota = jnp.arange(D, dtype=jnp.int32)
+    feature_f = feature.astype(dt)
+    # unvisited nodes carry +inf thresholds; 0 * inf = NaN would poison the
+    # one-hot contraction, so map them to float-max (same compare semantics)
+    threshold_f = jnp.where(jnp.isfinite(threshold),
+                            threshold.astype(dt),
+                            jnp.asarray(jnp.finfo(dt).max, dt))
+    leaf_flag = is_leaf.astype(dt)
+    node = jnp.zeros((X.shape[0], feature.shape[0]), jnp.int32)
+
+    def node_select(table, node):              # table [Tr, T] → [N, Tr]
+        oh = (node[:, :, None] == k_iota).astype(dt)
+        return jnp.einsum("ntk,tk->nt", oh, table)
+
     for _ in range(max_depth):
-        f = feature[jnp.arange(Tr)[None, :], node]            # [N, Tr]
-        th = threshold[jnp.arange(Tr)[None, :], node]
-        lf = is_leaf[jnp.arange(Tr)[None, :], node]
-        xf = jnp.take_along_axis(X, jnp.maximum(f, 0), axis=1)  # [N, Tr]
+        f = node_select(feature_f, node).astype(jnp.int32)     # [N, Tr]
+        th = node_select(threshold_f, node)
+        lf = node_select(leaf_flag, node)
+        f_oh = (f[:, :, None] == d_iota).astype(dt)            # fused
+        xf = jnp.einsum("ntd,nd->nt", f_oh, X)
         nxt = 2 * node + 1 + (xf > th).astype(jnp.int32)
-        node = jnp.where(lf, node, nxt)
-    return leaf[jnp.arange(Tr)[None, :], node]                # [N, Tr, V]
+        nxt = jnp.where(nxt < T, nxt, node)    # bottom level has no children
+        node = jnp.where(lf > 0.5, node, nxt)
+    oh = (node[:, :, None] == k_iota).astype(dt)
+    return jnp.einsum("ntk,tkv->ntv", oh, leaf.astype(dt))     # [N, Tr, V]
 
 
 # --------------------------------------------------------------------------
@@ -533,7 +574,7 @@ def _predict_trees_np(X: np.ndarray, feature: np.ndarray, threshold: np.ndarray,
 
 
 class TreeEnsembleModel(PredictionModel):
-    def device_scores(self, Xd) -> Dict[str, Any]:
+    def device_scores(self, Xd, full: bool = False) -> Dict[str, Any]:
         """Device-resident scoring: leaves are aggregated in HBM and only
         [N]/[N,C]-sized results exist afterwards — never transfer the
         [N, Tr, V] leaf tensor over the (slow) host link."""
@@ -551,13 +592,19 @@ class TreeEnsembleModel(PredictionModel):
                        "probability": prob}
                 if prob.shape[1] == 2:
                     out["scores"] = prob[:, 1]
+                if full:
+                    out["rawPrediction"] = jnp.log(jnp.maximum(prob, 1e-12))
                 return out
             return {"prediction": jnp.mean(leaves[:, :, 0], axis=1)}
         margin = f["base"] + f["eta"] * jnp.sum(leaves[:, :, 0], axis=1)
         if f["task"] == "classification":
             p1 = jax.nn.sigmoid(margin)
-            return {"prediction": (p1 > 0.5).astype(jnp.float32),
-                    "scores": p1, "margin": margin}
+            out = {"prediction": (p1 > 0.5).astype(jnp.float32),
+                   "scores": p1, "margin": margin}
+            if full:
+                out["probability"] = jnp.stack([1.0 - p1, p1], axis=1)
+                out["rawPrediction"] = jnp.stack([-margin, margin], axis=1)
+            return out
         return {"prediction": margin}
 
     def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:

@@ -149,7 +149,7 @@ class DateListVectorizerModel(TransformerModel):
                         mask.append(False)
                 outs.append(np.asarray(vals, np.float32)[:, None])
                 if self.get("track_nulls", True):
-                    outs.append((~np.asarray(mask)).astype(np.float32)[:, None])
+                    outs.append((~np.asarray(mask, bool)).astype(np.float32)[:, None])
             else:  # ModeDay / ModeMonth / ModeHour pivots one-hot the mode
                 period = {"ModeDay": ("DayOfWeek", 7), "ModeMonth": ("MonthOfYear", 12),
                           "ModeHour": ("HourOfDay", 24)}[pivot]

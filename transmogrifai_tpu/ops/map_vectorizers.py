@@ -19,7 +19,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from ..columns import Column, ColumnBatch
+from ..columns import Column, ColumnBatch, indicator_2d
 from ..stages.base import Estimator, TransformerModel
 from ..types import OPVector
 from ..vector_meta import (NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMeta,
@@ -100,17 +100,15 @@ class SmartTextMapVectorizerModel(TransformerModel):
                     blocks.append(col)
                 elif strat == "ignore":
                     if track_nulls:
-                        blocks.append(np.array(
-                            [[0.0] if m.get(k) is not None else [1.0]
-                             for m in maps], np.float32))
+                        blocks.append(indicator_2d(
+                            m.get(k) is None for m in maps))
                 else:  # hash
                     token_lists = [tokenize_text(None if m.get(k) is None
                                                  else str(m.get(k)))
                                    for m in maps]
                     h = hash_tokens_to_counts(token_lists, num_hashes)
                     if track_nulls:
-                        nulls = np.array([[1.0] if m.get(k) is None else [0.0]
-                                          for m in maps], np.float32)
+                        nulls = indicator_2d(m.get(k) is None for m in maps)
                         h = np.concatenate([h, nulls], axis=1)
                     blocks.append(h)
         arr = (np.concatenate(blocks, axis=1) if blocks
@@ -478,9 +476,7 @@ class TextMapNullModel(TransformerModel):
         for f in self.input_features:
             maps = _map_values(batch[f.name])
             for k in self.fitted["per_feature"][f.name]:
-                blocks.append(np.array(
-                    [[1.0] if m.get(k) is None else [0.0] for m in maps],
-                    np.float32))
+                blocks.append(indicator_2d(m.get(k) is None for m in maps))
         arr = (np.concatenate(blocks, axis=1) if blocks
                else np.zeros((n, 0), np.float32))
         return Column(OPVector, jnp.asarray(arr), meta=self.fitted["meta"])
@@ -520,9 +516,10 @@ class TextMapLenModel(TransformerModel):
         for f in self.input_features:
             maps = _map_values(batch[f.name])
             for k in self.fitted["per_feature"][f.name]:
-                blocks.append(np.array(
-                    [[0.0 if m.get(k) is None else float(len(str(m[k])))]
-                     for m in maps], np.float32))
+                lens = np.fromiter(
+                    (0.0 if m.get(k) is None else float(len(str(m[k])))
+                     for m in maps), np.float32)
+                blocks.append(lens.reshape(-1, 1))
         arr = (np.concatenate(blocks, axis=1) if blocks
                else np.zeros((n, 0), np.float32))
         return Column(OPVector, jnp.asarray(arr), meta=self.fitted["meta"])

@@ -19,7 +19,7 @@ from typing import Dict, List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from ..columns import Column, ColumnBatch
+from ..columns import Column, ColumnBatch, indicator_2d
 from ..stages.base import Estimator, Transformer, TransformerModel
 from ..types import OPVector, Real, Text, TextList
 from ..vector_meta import (NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMeta,
@@ -199,14 +199,12 @@ class SmartTextVectorizerModel(TransformerModel):
                                          np.float32))
             elif strat == "ignore":
                 if self.get("track_nulls", True):
-                    blocks.append(np.array([[1.0] if s is None else [0.0]
-                                            for s in strings], np.float32))
+                    blocks.append(indicator_2d(s is None for s in strings))
             else:  # hash
                 token_lists = [tokenize_text(s) for s in strings]
                 h = hash_tokens_to_counts(token_lists, num_hashes)
                 if self.get("track_nulls", True):
-                    nulls = np.array([[1.0] if s is None else [0.0]
-                                      for s in strings], np.float32)
+                    nulls = indicator_2d(s is None for s in strings)
                     h = np.concatenate([h, nulls], axis=1)
                 blocks.append(h)
         arr = (np.concatenate(blocks, axis=1) if blocks

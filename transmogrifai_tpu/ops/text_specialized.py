@@ -386,8 +386,13 @@ def detect_mime(data: bytes, type_hint: str = "") -> str:
         head.decode("utf-8")
         return "text/plain"
     except UnicodeDecodeError as e:
-        # tolerate a multi-byte char split by the max_bytes truncation
-        if e.start >= len(head) - 3:
+        # tolerate ONLY a genuine multi-byte char split by truncation: the
+        # failing byte must be a UTF-8 lead byte whose continuation would
+        # extend past the (cut) end — not just any junk near the end
+        b0 = head[e.start]
+        need = (2 if 0xC2 <= b0 <= 0xDF else 3 if 0xE0 <= b0 <= 0xEF
+                else 4 if 0xF0 <= b0 <= 0xF4 else 0)
+        if need and e.start + need > len(head) and e.start >= len(head) - 3:
             return "text/plain"
         return "application/octet-stream"
 
@@ -932,7 +937,8 @@ class OpLDAModel(TransformerModel):
     def transform(self, batch: ColumnBatch) -> Column:
         (f,) = self.input_features
         col = batch[f.name]
-        counts = jnp.asarray(np.asarray(col.values, np.float32))
+        counts = jnp.maximum(
+            jnp.asarray(np.asarray(col.values, np.float32)), 0.0)
         topics = jnp.asarray(self.fitted["topics"])
         mix = _lda_infer(counts, topics)
         return Column(OPVector, mix, meta=self.fitted["meta"])
@@ -951,7 +957,8 @@ class OpLDA(Estimator):
 
     def fit(self, batch: ColumnBatch) -> TransformerModel:
         (f,) = self.input_features
-        counts = jnp.asarray(np.asarray(batch[f.name].values, np.float32))
+        counts = jnp.maximum(
+            jnp.asarray(np.asarray(batch[f.name].values, np.float32)), 0.0)
         k = int(self.get("k", 10))
         topics = _lda_em(counts, k, int(self.get("max_iter", 20)),
                          int(self.get("seed", 42)))

@@ -26,7 +26,12 @@ class PredictionDeIndexer(Transformer):
     def transform(self, batch: ColumnBatch) -> Column:
         pred_col = batch[self.input_features[-1].name]
         labels = list(self.get("labels", []))
-        pred = np.asarray(pred_col.values["prediction"]).astype(np.int64)
+        vals = pred_col.values
+        if isinstance(vals, dict):
+            pred = np.asarray(vals["prediction"]).astype(np.int64)
+        else:  # object array of per-row prediction dicts (local row path)
+            pred = np.asarray([int((v or {}).get("prediction", -1))
+                               for v in vals], np.int64)
         out = np.array(
             [labels[p] if 0 <= p < len(labels) else str(p) for p in pred],
             dtype=object)

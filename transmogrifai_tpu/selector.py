@@ -154,6 +154,9 @@ class SelectedModel(PredictionModel):
     def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
         return self._best_model.predict_arrays(X)
 
+    def device_scores(self, Xd, full: bool = False):
+        return self._best_model.device_scores(Xd, full=full)
+
     def ctor_args(self) -> Dict[str, Any]:
         return dict(self._params)
 

@@ -69,6 +69,11 @@ def _json_safe(v: Any) -> Any:
         return int(v)
     if isinstance(v, (np.floating,)):
         return float(v)
+    if isinstance(v, np.ndarray):
+        # arrays nested inside dict/list fitted state (e.g. per-key splits)
+        # round-trip as lists (0-d → scalar); top-level arrays go to
+        # params.npz instead
+        return _json_safe(v.tolist())
     if isinstance(v, (list, tuple)):
         return [_json_safe(x) for x in v]
     if isinstance(v, dict):

@@ -1,0 +1,150 @@
+"""Parquet/Avro ingestion (≙ the reference's ParquetProductReaderTest /
+AvroReadersTest / CSVAutoReadersTest): schema inference from file metadata,
+round-trips, and a Titanic-from-Parquet e2e train."""
+
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.features import features_from_schema
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.readers import (AvroReader, DataReaders, ParquetReader,
+                                       read_avro_records, write_avro)
+from transmogrifai_tpu.readers.csv import read_csv_records
+from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                        ModelCandidate, grid)
+from transmogrifai_tpu.workflow import Workflow
+
+from test_workflow_e2e import DATA, TITANIC_HEADERS, TITANIC_SCHEMA
+
+
+def _titanic_parquet(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    raw = read_csv_records(
+        os.path.join(DATA, "titanic/TitanicPassengersTrainData.csv"),
+        headers=TITANIC_HEADERS)
+    cols = {
+        "id": [r["id"] for r in raw],
+        "survived": [float(r["survived"]) for r in raw],
+        "pClass": [r["pClass"] for r in raw],
+        "name": [r["name"] for r in raw],
+        "sex": [r["sex"] for r in raw],
+        "age": [None if r["age"] is None else float(r["age"]) for r in raw],
+        "sibSp": [None if r["sibSp"] is None else int(r["sibSp"]) for r in raw],
+        "parCh": [None if r["parCh"] is None else int(r["parCh"]) for r in raw],
+        "fare": [None if r["fare"] is None else float(r["fare"]) for r in raw],
+        "embarked": [r["embarked"] for r in raw],
+    }
+    path = str(tmp_path / "titanic.parquet")
+    pq.write_table(pa.table(cols), path)
+    return path
+
+
+def test_parquet_schema_inference(tmp_path):
+    path = _titanic_parquet(tmp_path)
+    reader = ParquetReader(path, key_field="id")
+    assert reader.schema["survived"] is T.Real
+    assert reader.schema["sibSp"] is T.Integral
+    assert reader.schema["name"] is T.Text
+    recs = reader.read()
+    assert len(recs) > 800 and isinstance(recs[0], dict)
+
+
+def test_titanic_from_parquet_e2e(tmp_path):
+    path = _titanic_parquet(tmp_path)
+    schema = {k: v for k, v in TITANIC_SCHEMA.items()
+              if k not in ("ticket", "cabin")}
+    reader = DataReaders.Simple.parquet(path, schema=schema, key_field="id")
+    survived, predictors = features_from_schema(schema, response="survived")
+    fv = transmogrify(predictors)
+    checked = survived.sanity_check(fv, remove_bad_features=True)
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(),
+                       grid(reg_param=[0.01]), "OpLogisticRegression")])
+    sel.set_input(survived, checked)
+    model = Workflow().set_reader(reader).set_result_features(
+        sel.get_output()).train()
+    from transmogrifai_tpu.evaluators import Evaluators
+    m = model.evaluate(Evaluators.BinaryClassification.auROC())
+    assert m["AuROC"] > 0.8
+
+
+AVRO_SCHEMA = {
+    "type": "record", "name": "Passenger", "fields": [
+        {"name": "id", "type": "string"},
+        {"name": "survived", "type": "double"},
+        {"name": "age", "type": ["null", "double"]},
+        {"name": "cls", "type": {"type": "enum", "name": "Cls",
+                                 "symbols": ["first", "second", "third"]}},
+        {"name": "tags", "type": {"type": "array", "items": "string"}},
+        {"name": "scores", "type": {"type": "map", "values": "double"}},
+        {"name": "vip", "type": "boolean"},
+        {"name": "n", "type": "long"},
+    ]}
+
+AVRO_RECORDS = [
+    {"id": "a", "survived": 1.0, "age": 22.5, "cls": "first",
+     "tags": ["x", "y"], "scores": {"s1": 0.5}, "vip": True, "n": 3},
+    {"id": "b", "survived": 0.0, "age": None, "cls": "third",
+     "tags": [], "scores": {}, "vip": False, "n": -17},
+]
+
+
+@pytest.mark.parametrize("codec", ["null", "deflate"])
+def test_avro_roundtrip(tmp_path, codec):
+    path = str(tmp_path / f"data_{codec}.avro")
+    write_avro(path, AVRO_RECORDS, AVRO_SCHEMA, codec=codec)
+    records, schema = read_avro_records(path)
+    assert records == AVRO_RECORDS
+    assert schema["name"] == "Passenger"
+
+
+def test_avro_reader_schema_mapping(tmp_path):
+    path = str(tmp_path / "data.avro")
+    write_avro(path, AVRO_RECORDS, AVRO_SCHEMA, codec="deflate")
+    reader = AvroReader(path, key_field="id")
+    assert reader.schema["survived"] is T.Real
+    assert reader.schema["age"] is T.Real          # nullable union
+    assert reader.schema["vip"] is T.Binary
+    assert reader.schema["n"] is T.Integral
+    assert reader.schema["tags"] is T.TextList
+    assert reader.schema["cls"] is T.Text
+    recs = reader.read()
+    assert recs[0]["tags"] == ["x", "y"]
+    assert recs[1]["age"] is None
+
+
+def test_avro_e2e_train(tmp_path):
+    rng = np.random.default_rng(0)
+    records = []
+    for i in range(300):
+        good = bool(rng.random() < 0.5)
+        records.append({
+            "id": str(i), "survived": 1.0 if good else 0.0,
+            "age": None if rng.random() < 0.1 else
+            float(rng.normal(40 if good else 30, 5)),
+            "cls": "first" if good else "third",
+            "tags": [], "scores": {}, "vip": good,
+            "n": int(rng.integers(0, 5)),
+        })
+    path = str(tmp_path / "train.avro")
+    write_avro(path, records, AVRO_SCHEMA, codec="deflate")
+    schema = {"survived": T.RealNN, "age": T.Real, "cls": T.PickList,
+              "vip": T.Binary, "n": T.Integral}
+    reader = DataReaders.Simple.avro(path, schema=schema, key_field="id")
+    survived, predictors = features_from_schema(schema, response="survived")
+    fv = transmogrify(predictors)
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(),
+                       grid(reg_param=[0.01]), "OpLogisticRegression")])
+    sel.set_input(survived, fv)
+    model = Workflow().set_reader(reader).set_result_features(
+        sel.get_output()).train()
+    from transmogrifai_tpu.evaluators import Evaluators
+    m = model.evaluate(Evaluators.BinaryClassification.auROC())
+    assert m["AuROC"] > 0.9

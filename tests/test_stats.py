@@ -1,0 +1,162 @@
+"""OpStatistics parity + streaming histogram (≙ OpStatisticsTest,
+StreamingHistogramTest)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.utils.stats import (StreamingHistogram,
+                                           chi_squared_test, contingency_stats,
+                                           max_confidences,
+                                           pointwise_mutual_info)
+
+
+def test_pmi_independent_is_zero():
+    # independent feature/label → all PMI ~0, MI ~0
+    c = np.outer([10, 20, 30], [0.4, 0.6]) * 10
+    pmi, mi = pointwise_mutual_info(c)
+    assert mi == pytest.approx(0.0, abs=1e-12)
+    for vals in pmi.values():
+        assert np.allclose(vals, 0.0, atol=1e-12)
+
+
+def test_pmi_perfect_association():
+    # diagonal contingency → MI = log2(k) for uniform k classes
+    c = np.diag([50.0, 50.0])
+    pmi, mi = pointwise_mutual_info(c)
+    assert mi == pytest.approx(1.0)          # log2(2)
+    assert pmi["0"][0] == pytest.approx(1.0)
+    assert pmi["0"][1] == 0.0                # zero cell → 0 by convention
+    assert pmi["1"][1] == pytest.approx(1.0)
+
+
+def test_max_confidences():
+    c = np.array([[30.0, 10.0],   # choice 0: conf 0.75, support 0.4
+                  [0.0, 60.0]])   # choice 1: conf 1.0, support 0.6
+    conf, supp = max_confidences(c)
+    assert conf == pytest.approx([0.75, 1.0])
+    assert supp == pytest.approx([0.4, 0.6])
+
+
+def test_chi_squared_and_cramers_v():
+    c = np.diag([50.0, 50.0])
+    chi2, p, v = chi_squared_test(c)
+    assert v == pytest.approx(1.0)
+    assert chi2 == pytest.approx(100.0)
+    assert p < 1e-10
+    # independence → V ~ 0, p ~ 1
+    c2 = np.outer([50, 50], [0.5, 0.5]) * 2
+    _, p2, v2 = chi_squared_test(c2)
+    assert v2 == pytest.approx(0.0, abs=1e-9)
+    assert p2 == pytest.approx(1.0)
+
+
+def test_contingency_stats_bundle():
+    cs = contingency_stats(np.array([[40.0, 10.0], [5.0, 45.0]]))
+    assert 0 < cs.cramers_v < 1
+    assert cs.mutual_info > 0
+    assert len(cs.max_confidences) == 2
+    j = cs.to_json()
+    assert set(j) == {"cramersV", "chiSquaredStat", "pValue",
+                      "pointwiseMutualInfo", "mutualInfo",
+                      "maxRuleConfidences", "supports"}
+
+
+def test_streaming_histogram_counts_and_quantiles():
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=5000)
+    h = StreamingHistogram(max_bins=64).update_all(data)
+    assert h.total == pytest.approx(5000)
+    # median estimate: sum_to(0) ≈ half the mass
+    assert h.sum_to(0.0) == pytest.approx(2500, rel=0.05)
+    assert h.sum_to(-10) == 0.0
+    assert h.sum_to(10) == pytest.approx(5000)
+
+
+def test_streaming_histogram_merge_matches_full():
+    rng = np.random.default_rng(1)
+    data = rng.gamma(2.0, size=6000)
+    shards = np.array_split(data, 3)
+    merged = StreamingHistogram(64)
+    for s in shards:
+        merged = merged.merge(StreamingHistogram(64).update_all(s))
+    full = StreamingHistogram(64).update_all(data)
+    assert merged.total == pytest.approx(full.total)
+    lo, hi = float(data.min()), float(data.max())
+    a = merged.to_fixed_bins(20, lo, hi) / merged.total
+    b = full.to_fixed_bins(20, lo, hi) / full.total
+    assert np.abs(a - b).max() < 0.05
+
+
+def test_feature_sketches_shard_merge():
+    from transmogrifai_tpu.columns import Column, ColumnBatch, column_from_values
+    from transmogrifai_tpu.features import Feature
+    from transmogrifai_tpu.filters import (compute_sketches, merge_sketches)
+    from transmogrifai_tpu import types as T
+
+    rng = np.random.default_rng(2)
+    n = 900
+    reals = [None if rng.random() < 0.2 else float(rng.normal())
+             for _ in range(n)]
+    texts = [None if rng.random() < 0.1 else str(rng.integers(0, 5))
+             for _ in range(n)]
+    feats = [Feature("r", T.Real, False, None, parents=()),
+             Feature("t", T.PickList, False, None, parents=())]
+
+    def batch_of(sl):
+        return ColumnBatch({
+            "r": column_from_values(T.Real, reals[sl]),
+            "t": column_from_values(T.PickList, texts[sl])}, len(reals[sl]))
+
+    full = compute_sketches(feats, batch_of(slice(None)))
+    parts = [compute_sketches(feats, batch_of(slice(i * 300, (i + 1) * 300)))
+             for i in range(3)]
+    merged = parts[0]
+    for p in parts[1:]:
+        merged = merge_sketches(merged, p)
+
+    for k in full:
+        fd_full = full[k].to_distribution(20)
+        fd_merged = merged[k].to_distribution(20)
+        assert fd_merged.count == fd_full.count
+        assert fd_merged.nulls == fd_full.nulls
+        assert fd_full.fill_rate == pytest.approx(fd_merged.fill_rate)
+        # text hashing is exactly mergeable
+        if k[0] == "t":
+            np.testing.assert_allclose(fd_merged.distribution,
+                                       fd_full.distribution)
+    # merged numeric sketch distribution ≈ full within JS tolerance
+    assert full[("r", None)].to_distribution(20).js_divergence(
+        merged[("r", None)].to_distribution(20)) < 0.05
+
+
+def test_sanity_checker_contingency_metadata():
+    from transmogrifai_tpu.columns import Column, ColumnBatch
+    from transmogrifai_tpu.features import Feature
+    from transmogrifai_tpu.preparators.sanity_checker import SanityChecker
+    from transmogrifai_tpu import types as T
+    from transmogrifai_tpu.vector_meta import VectorColumnMeta, VectorMeta
+
+    rng = np.random.default_rng(0)
+    n = 400
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    # categorical group: indicator 0 correlates with y, indicator 1 is noise
+    g0 = np.where(y > 0.5, rng.random(n) < 0.9, rng.random(n) < 0.1)
+    g1 = rng.random(n) < 0.5
+    X = np.stack([g0, g1, rng.normal(size=n) > 0], axis=1).astype(np.float32)
+    meta = VectorMeta("v", [
+        VectorColumnMeta("cat", "PickList", grouping="cat", indicator_value="a"),
+        VectorColumnMeta("cat", "PickList", grouping="cat", indicator_value="b"),
+        VectorColumnMeta("cat", "PickList", grouping="cat", indicator_value="c"),
+    ])
+    label = Feature("y", T.RealNN, True, None, parents=())
+    vecf = Feature("v", T.OPVector, False, None, parents=())
+    batch = ColumnBatch({"y": Column(T.RealNN, y),
+                         "v": Column(T.OPVector, X, meta=meta)}, n)
+    st = SanityChecker(remove_bad_features=False).set_input(label, vecf)
+    model = st.fit(batch)
+    cstats = model.metadata["summary"]["categoricalStats"]["contingencyStats"]
+    assert "cat(cat)" in cstats
+    panel = cstats["cat(cat)"]
+    assert "pointwiseMutualInfo" in panel and "mutualInfo" in panel
+    assert panel["mutualInfo"] > 0.05      # real association present
+    assert len(panel["maxRuleConfidences"]) == 3

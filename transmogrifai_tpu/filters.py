@@ -17,6 +17,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+import zlib
+
 from .columns import Column, ColumnBatch
 from .features import Feature
 from .types import is_map_kind, is_numeric_kind, is_text_kind
@@ -69,6 +71,126 @@ class FeatureDistribution:
                 "nulls": self.nulls, "fillRate": self.fill_rate,
                 "distribution": self.distribution.tolist(),
                 "summary": self.summary}
+
+
+@dataclass
+class FeatureSketch:
+    """Mergeable per-feature distribution sketch for sharded / streamed data
+    (≙ StreamingHistogram.java + FeatureDistribution's monoid `reduce`):
+    numeric values go into a Ben-Haim/Tom-Tov streaming histogram (merges
+    without a shared binning), text hashes into fixed bins (trivially
+    mergeable)."""
+
+    name: str
+    key: Optional[str] = None
+    count: int = 0
+    nulls: int = 0
+    histogram: Optional[Any] = None      # StreamingHistogram (numeric kinds)
+    text_counts: Optional[np.ndarray] = None  # [text_bins] (text kinds)
+
+    def merge(self, other: "FeatureSketch") -> "FeatureSketch":
+        assert (self.name, self.key) == (other.name, other.key)
+        hist = None
+        if self.histogram is not None or other.histogram is not None:
+            from .utils.stats import StreamingHistogram
+            a = self.histogram or StreamingHistogram()
+            b = other.histogram or StreamingHistogram()
+            hist = a.merge(b)
+        tc = None
+        if self.text_counts is not None or other.text_counts is not None:
+            za = self.text_counts if self.text_counts is not None else 0.0
+            zb = other.text_counts if other.text_counts is not None else 0.0
+            tc = za + zb
+        return FeatureSketch(self.name, self.key, self.count + other.count,
+                             self.nulls + other.nulls, hist, tc)
+
+    def to_distribution(self, bins: int) -> FeatureDistribution:
+        if self.text_counts is not None:
+            dist = np.asarray(self.text_counts, dtype=np.float64)
+        elif self.histogram is not None:
+            dist = self.histogram.to_fixed_bins(bins)
+        else:
+            dist = np.zeros(bins)
+        return FeatureDistribution(self.name, key=self.key, count=self.count,
+                                   nulls=self.nulls, distribution=dist)
+
+
+def compute_sketches(raw_features: Sequence[Feature], batch: ColumnBatch,
+                     max_bins: int = 64, text_bins: int = 100
+                     ) -> Dict[Tuple[str, Optional[str]], FeatureSketch]:
+    """Per-feature mergeable sketches over one shard/micro-batch.  Combine
+    shards with ``merge_sketches``; finalize with ``FeatureSketch
+    .to_distribution`` — distributions then combine across shards/streams the
+    way the reference merges StreamingHistograms (StreamingHistogram.java:269)."""
+    from .utils.stats import StreamingHistogram
+
+    out: Dict[Tuple[str, Optional[str]], FeatureSketch] = {}
+    for f in raw_features:
+        col = batch.get(f.name)
+        if col is None:
+            continue
+        n = len(col)
+        kind = f.kind
+        if is_map_kind(kind):
+            keys = sorted({k for m in col.values if m for k in m})
+            for k in keys:
+                vals = [m.get(k) if m else None for m in col.values]
+                out[(f.name, k)] = _sketch_of(
+                    f.name, k, vals, kind, max_bins, text_bins)
+            continue
+        vals = (list(col.values) if col.is_host_object()
+                else np.asarray(col.values))
+        if not col.is_host_object() and col.mask is not None:
+            vals = np.where(np.asarray(col.mask), vals, np.nan)
+        out[(f.name, None)] = _sketch_of(
+            f.name, None, vals, kind, max_bins, text_bins)
+    return out
+
+
+def _sketch_of(name, key, vals, kind, max_bins, text_bins) -> FeatureSketch:
+    from .types import map_value_kind
+    from .utils.stats import StreamingHistogram
+
+    n = len(vals)
+    vkind = map_value_kind(kind) if is_map_kind(kind) else kind
+    if is_numeric_kind(vkind):
+        arr = np.asarray(
+            [float(v) if isinstance(v, (int, float, np.floating, np.integer))
+             and not isinstance(v, bool) else
+             (1.0 if v is True else 0.0 if v is False else np.nan)
+             for v in vals] if isinstance(vals, list) else vals,
+            dtype=np.float64)
+        finite = np.isfinite(arr)
+        hist = StreamingHistogram(max_bins).update_all(arr[finite])
+        return FeatureSketch(name, key, n, int((~finite).sum()),
+                             histogram=hist)
+    counts = np.zeros(text_bins)
+    nulls = 0
+    for v in vals:
+        # same emptiness convention as _value_presence: None/""/[]/{} are null
+        if v is None or (isinstance(v, float) and np.isnan(v)) or (
+                hasattr(v, "__len__") and len(v) == 0):
+            nulls += 1
+            continue
+        for item in (v if isinstance(v, (list, set, frozenset, tuple))
+                     else [v]):
+            counts[_stable_text_bin(item, text_bins)] += 1.0
+    return FeatureSketch(name, key, n, nulls, text_counts=counts)
+
+
+def merge_sketches(a: Dict, b: Dict) -> Dict:
+    """Monoid merge of two shards' sketch maps."""
+    out = dict(a)
+    for k, sk in b.items():
+        out[k] = out[k].merge(sk) if k in out else sk
+    return out
+
+
+def _stable_text_bin(item, text_bins: int) -> int:
+    """Process-stable hash bin (crc32, not Python's randomized hash()) so
+    sketches/distributions built in different processes stay mergeable and
+    train-vs-score comparable."""
+    return zlib.crc32(str(item).encode("utf-8")) % text_bins
 
 
 def _value_presence(col: Column) -> np.ndarray:
@@ -130,7 +252,7 @@ def _histogram_of(vals, present: np.ndarray, kind, bins: int,
         if not p or v is None:
             continue
         for item in (v if isinstance(v, (list, set, tuple)) else [v]):
-            h[hash(str(item)) % text_bins] += 1.0
+            h[_stable_text_bin(item, text_bins)] += 1.0
     return h
 
 

@@ -73,18 +73,10 @@ def _rank_transform(a: jnp.ndarray) -> jnp.ndarray:
 
 
 def cramers_v(contingency: np.ndarray) -> float:
-    """Cramér's V from a contingency matrix (≙ OpStatistics.chiSquaredTest,
-    OpStatistics.scala:188)."""
-    obs = np.asarray(contingency, dtype=np.float64)
-    # drop empty rows/cols
-    obs = obs[obs.sum(axis=1) > 0][:, obs.sum(axis=0) > 0]
-    if obs.size == 0 or min(obs.shape) < 2:
-        return float("nan")
-    n = obs.sum()
-    expected = np.outer(obs.sum(axis=1), obs.sum(axis=0)) / n
-    chi2 = float(((obs - expected) ** 2 / np.maximum(expected, 1e-12)).sum())
-    k = min(obs.shape) - 1
-    return float(np.sqrt(chi2 / (n * max(k, 1))))
+    """Cramér's V (≙ OpStatistics.chiSquaredTest, OpStatistics.scala:188) —
+    re-exported from utils.stats, the single implementation."""
+    from ..utils.stats import chi_squared_test
+    return chi_squared_test(contingency)[2]
 
 
 @dataclass
@@ -99,6 +91,7 @@ class SanityCheckerSummary:
     mins: List[float] = field(default_factory=list)
     maxs: List[float] = field(default_factory=list)
     cramers_v_by_group: Dict[str, float] = field(default_factory=dict)
+    contingency_stats_by_group: Dict[str, Any] = field(default_factory=dict)
     dropped: List[str] = field(default_factory=list)
     drop_reasons: Dict[str, List[str]] = field(default_factory=dict)
     sample_size: int = 0
@@ -113,7 +106,8 @@ class SanityCheckerSummary:
             "mins": self.mins,
             "maxs": self.maxs,
             "categoricalStats": {
-                "cramersV": self.cramers_v_by_group},
+                "cramersV": self.cramers_v_by_group,
+                "contingencyStats": self.contingency_stats_by_group},
             "dropped": self.dropped,
             "dropReasons": self.drop_reasons,
             "sampleSize": self.sample_size,
@@ -225,19 +219,26 @@ class SanityChecker(Estimator):
         group_fail: Dict[int, List[str]] = {}
         max_rule_conf = float(self.get("max_rule_confidence", 1.0))
         min_rule_supp = float(self.get("min_required_rule_support", 1.0))
+        contingency_by_group: Dict[str, Dict] = {}
         for (parent, grouping), idxs in groups.items():
             G = Xs[:, np.asarray(idxs)]                  # [N, k] 0/1 indicators
             contingency = np.asarray(yoh.T @ G)          # [C, k] — tiny transfer
-            v = cramers_v(contingency)
+            # full contingency panel: Cramér's V + chi2 + PMI/MI + rule
+            # confidences (≙ OpStatistics.contingencyStats:300; reference
+            # rows=choices so transpose)
+            from ..utils.stats import contingency_stats
+            cstats = contingency_stats(contingency.T)
+            v = cstats.cramers_v
             gname = parent if grouping is None else f"{parent}({grouping})"
             cramers[gname] = v
+            contingency_by_group[gname] = cstats.to_json()
             reasons = []
             if np.isfinite(v) and v > float(self.get("max_cramers_v", 1.0)):
                 reasons.append(f"CramersV {v:.4f} > max")
             # association rule confidence (leakage): P(label=c | col=1)
-            col_count = contingency.sum(axis=0)          # [k]
-            conf = contingency.max(axis=0) / np.maximum(col_count, 1e-12)
-            supp = col_count / max(len(ys_host), 1)
+            conf = np.asarray(cstats.max_confidences)
+            supp = np.asarray(cstats.supports) * contingency.sum() / max(
+                len(ys_host), 1)
             if max_rule_conf < 1.0 or min_rule_supp < 1.0:
                 bad = (conf >= max_rule_conf) & (supp >= min_rule_supp)
                 if bad.any():
@@ -277,6 +278,7 @@ class SanityChecker(Estimator):
             variances=[float(v) for v in var], means=[float(m) for m in mean],
             mins=[float(v) for v in mn], maxs=[float(v) for v in mx],
             cramers_v_by_group=cramers,
+            contingency_stats_by_group=contingency_by_group,
             dropped=[names[i] for i in drop_idx],
             drop_reasons={names[i]: r for i, r in reasons_by_col.items()},
             sample_size=len(ys_host))

@@ -1,10 +1,14 @@
+from .avro import AvroReader, read_avro_records, write_avro
 from .base import (AggregateParams, AggregateReader, ConditionalParams,
                    ConditionalReader, DataReader, JoinedReader, Reader)
 from .csv import CSVReader, infer_schema_from_records, read_csv_records
 from .factory import DataReaders
+from .parquet import ParquetReader, read_parquet_records
 from .streaming import StreamingReader, StreamingReaders
 
 __all__ = ["Reader", "DataReader", "AggregateReader", "ConditionalReader",
            "JoinedReader", "AggregateParams", "ConditionalParams",
-           "CSVReader", "DataReaders", "infer_schema_from_records",
-           "read_csv_records", "StreamingReader", "StreamingReaders"]
+           "CSVReader", "ParquetReader", "AvroReader", "DataReaders",
+           "infer_schema_from_records", "read_csv_records",
+           "read_parquet_records", "read_avro_records", "write_avro",
+           "StreamingReader", "StreamingReaders"]

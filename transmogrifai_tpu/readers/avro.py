@@ -1,0 +1,352 @@
+"""Avro ingestion — a self-contained Object Container File codec (reference:
+readers/src/main/scala/com/salesforce/op/readers/AvroReaders.scala; the
+reference leans on the avro JVM library, this image has none, so the binary
+format is implemented directly: header Obj\\x01 + metadata map + sync marker,
+blocks of zigzag-varint-framed records, null/deflate codecs).
+
+Covers the Avro types the reference's schemas use: null, boolean, int, long,
+float, double, bytes, string, record, enum, array, map, union, fixed."""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Tuple, Type
+
+from ..types import (Binary, DateTime, FeatureType, Integral, Real, Text,
+                     TextList)
+from .base import DataReader
+
+MAGIC = b"Obj\x01"
+
+
+# ---------------------------------------------------------------------------
+# binary primitives
+# ---------------------------------------------------------------------------
+
+def _read_varint(buf: io.BytesIO) -> int:
+    shift = 0
+    result = 0
+    while True:
+        b = buf.read(1)
+        if not b:
+            raise EOFError("truncated varint")
+        byte = b[0]
+        result |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            break
+        shift += 7
+    return result
+
+
+def _read_long(buf: io.BytesIO) -> int:
+    n = _read_varint(buf)
+    return (n >> 1) ^ -(n & 1)  # zigzag
+
+
+def _write_long(out: io.BytesIO, n: int) -> None:
+    n = (n << 1) ^ (n >> 63)  # zigzag (python ints: arithmetic shift ok)
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.write(bytes([b | 0x80]))
+        else:
+            out.write(bytes([b]))
+            break
+
+
+def _read_bytes(buf: io.BytesIO) -> bytes:
+    n = _read_long(buf)
+    return buf.read(n)
+
+
+# ---------------------------------------------------------------------------
+# schema-directed decode / encode
+# ---------------------------------------------------------------------------
+
+def _decode(schema, buf: io.BytesIO) -> Any:
+    if isinstance(schema, list):  # union
+        idx = _read_long(buf)
+        return _decode(schema[idx], buf)
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            return {f["name"]: _decode(f["type"], buf)
+                    for f in schema["fields"]}
+        if t == "enum":
+            return schema["symbols"][_read_long(buf)]
+        if t == "array":
+            out = []
+            while True:
+                n = _read_long(buf)
+                if n == 0:
+                    break
+                if n < 0:
+                    _read_long(buf)  # block byte size — skipable hint
+                    n = -n
+                out.extend(_decode(schema["items"], buf) for _ in range(n))
+            return out
+        if t == "map":
+            out = {}
+            while True:
+                n = _read_long(buf)
+                if n == 0:
+                    break
+                if n < 0:
+                    _read_long(buf)
+                    n = -n
+                for _ in range(n):
+                    k = _read_bytes(buf).decode("utf-8")
+                    out[k] = _decode(schema["values"], buf)
+            return out
+        if t == "fixed":
+            return buf.read(schema["size"])
+        return _decode(t, buf)  # e.g. {"type": "string"}
+    if schema == "null":
+        return None
+    if schema == "boolean":
+        return buf.read(1) == b"\x01"
+    if schema in ("int", "long"):
+        return _read_long(buf)
+    if schema == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if schema == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if schema == "bytes":
+        return _read_bytes(buf)
+    if schema == "string":
+        return _read_bytes(buf).decode("utf-8")
+    raise ValueError(f"unsupported avro type {schema!r}")
+
+
+def _encode(schema, v: Any, out: io.BytesIO) -> None:
+    if isinstance(schema, list):  # union — pick the first matching branch
+        for i, branch in enumerate(schema):
+            if _matches(branch, v):
+                _write_long(out, i)
+                _encode(branch, v, out)
+                return
+        raise ValueError(f"no union branch of {schema} matches {v!r}")
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            for f in schema["fields"]:
+                fv = (v or {}).get(f["name"])
+                if fv is None and not _accepts_null(f["type"]):
+                    raise ValueError(
+                        f"record field {f['name']!r} is missing/None but its "
+                        f"schema {f['type']!r} is not nullable")
+                _encode(f["type"], fv, out)
+            return
+        if t == "enum":
+            _write_long(out, schema["symbols"].index(v))
+            return
+        if t == "array":
+            if v:
+                _write_long(out, len(v))
+                for item in v:
+                    _encode(schema["items"], item, out)
+            _write_long(out, 0)
+            return
+        if t == "map":
+            if v:
+                _write_long(out, len(v))
+                for k, mv in v.items():
+                    kb = str(k).encode("utf-8")
+                    _write_long(out, len(kb))
+                    out.write(kb)
+                    _encode(schema["values"], mv, out)
+            _write_long(out, 0)
+            return
+        if t == "fixed":
+            out.write(v)
+            return
+        _encode(t, v, out)
+        return
+    if schema == "null":
+        return
+    if schema == "boolean":
+        out.write(b"\x01" if v else b"\x00")
+        return
+    if schema in ("int", "long"):
+        _write_long(out, int(v))
+        return
+    if schema == "float":
+        out.write(struct.pack("<f", float(v)))
+        return
+    if schema == "double":
+        out.write(struct.pack("<d", float(v)))
+        return
+    if schema == "bytes":
+        _write_long(out, len(v))
+        out.write(v)
+        return
+    if schema == "string":
+        b = str(v).encode("utf-8")
+        _write_long(out, len(b))
+        out.write(b)
+        return
+    raise ValueError(f"unsupported avro type {schema!r}")
+
+
+def _accepts_null(schema) -> bool:
+    if schema == "null":
+        return True
+    if isinstance(schema, list):
+        return any(_accepts_null(b) for b in schema)
+    return False
+
+
+def _matches(schema, v) -> bool:
+    if schema == "null":
+        return v is None
+    if v is None:
+        return False
+    if schema == "boolean":
+        return isinstance(v, bool)
+    if schema in ("int", "long"):
+        return isinstance(v, int) and not isinstance(v, bool)
+    if schema in ("float", "double"):
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+    if schema == "string":
+        return isinstance(v, str)
+    if schema == "bytes":
+        return isinstance(v, bytes)
+    if isinstance(schema, dict):
+        t = schema["type"]
+        return ((t == "array" and isinstance(v, list))
+                or (t == "map" and isinstance(v, dict))
+                or (t == "record" and isinstance(v, dict))
+                or (t == "enum" and isinstance(v, str))
+                or (t == "fixed" and isinstance(v, bytes)))
+    return True
+
+
+# ---------------------------------------------------------------------------
+# container file read / write
+# ---------------------------------------------------------------------------
+
+def read_avro_records(path: str) -> Tuple[List[Dict[str, Any]], Any]:
+    """→ (records, schema json) from an Avro Object Container File."""
+    with open(path, "rb") as f:
+        data = f.read()
+    buf = io.BytesIO(data)
+    if buf.read(4) != MAGIC:
+        raise ValueError(f"{path}: not an Avro container file")
+    meta: Dict[str, bytes] = {}
+    while True:
+        n = _read_long(buf)
+        if n == 0:
+            break
+        if n < 0:
+            _read_long(buf)
+            n = -n
+        for _ in range(n):
+            k = _read_bytes(buf).decode("utf-8")
+            meta[k] = _read_bytes(buf)
+    schema = json.loads(meta["avro.schema"].decode("utf-8"))
+    codec = meta.get("avro.codec", b"null").decode("utf-8")
+    sync = buf.read(16)
+    records: List[Any] = []
+    while buf.tell() < len(data):
+        try:
+            count = _read_long(buf)
+        except EOFError:
+            break
+        size = _read_long(buf)
+        block = buf.read(size)
+        if codec == "deflate":
+            block = zlib.decompress(block, -15)
+        elif codec != "null":
+            raise ValueError(f"unsupported avro codec {codec!r}")
+        bbuf = io.BytesIO(block)
+        for _ in range(count):
+            records.append(_decode(schema, bbuf))
+        if buf.read(16) != sync:
+            raise ValueError(f"{path}: bad sync marker (corrupt file)")
+    return records, schema
+
+
+def write_avro(path: str, records: List[Dict[str, Any]], schema,
+               codec: str = "null") -> None:
+    """Write an Avro Object Container File (null/deflate codecs)."""
+    sync = os.urandom(16)
+    out = io.BytesIO()
+    out.write(MAGIC)
+    meta = {"avro.schema": json.dumps(schema).encode("utf-8"),
+            "avro.codec": codec.encode("utf-8")}
+    _write_long(out, len(meta))
+    for k, v in meta.items():
+        kb = k.encode("utf-8")
+        _write_long(out, len(kb))
+        out.write(kb)
+        _write_long(out, len(v))
+        out.write(v)
+    _write_long(out, 0)
+    out.write(sync)
+    block = io.BytesIO()
+    for r in records:
+        _encode(schema, r, block)
+    payload = block.getvalue()
+    if codec == "deflate":
+        c = zlib.compressobj(9, zlib.DEFLATED, -15)
+        payload = c.compress(payload) + c.flush()
+    elif codec != "null":
+        raise ValueError(f"unsupported avro codec {codec!r}")
+    _write_long(out, len(records))
+    _write_long(out, len(payload))
+    out.write(payload)
+    out.write(sync)
+    with open(path, "wb") as f:
+        f.write(out.getvalue())
+
+
+# ---------------------------------------------------------------------------
+# schema mapping + reader
+# ---------------------------------------------------------------------------
+
+def avro_type_to_kind(t) -> Type[FeatureType]:
+    if isinstance(t, list):  # union — first non-null branch decides
+        branches = [b for b in t if b != "null"]
+        return avro_type_to_kind(branches[0]) if branches else Text
+    if isinstance(t, dict):
+        tt = t["type"]
+        if tt == "array":
+            return TextList
+        if tt in ("enum", "map", "fixed", "record"):
+            return Text
+        if t.get("logicalType") in ("timestamp-millis", "timestamp-micros"):
+            return DateTime
+        return avro_type_to_kind(tt)
+    if t == "boolean":
+        return Binary
+    if t in ("int", "long"):
+        return Integral
+    if t in ("float", "double"):
+        return Real
+    return Text
+
+
+def infer_schema_from_avro(avro_schema) -> Dict[str, Type[FeatureType]]:
+    return {f["name"]: avro_type_to_kind(f["type"])
+            for f in avro_schema.get("fields", [])}
+
+
+class AvroReader(DataReader):
+    """Avro container file reader (≙ AvroReaders.scala)."""
+
+    def __init__(self, path: str,
+                 schema: Optional[Dict[str, Type[FeatureType]]] = None,
+                 key_field: Optional[str] = None):
+        records, avro_schema = read_avro_records(path)
+        self.avro_schema = avro_schema
+        self.schema = (dict(schema) if schema
+                       else infer_schema_from_avro(avro_schema))
+        key_fn = ((lambda r: r.get(key_field)) if key_field
+                  else (lambda r: id(r)))
+        super().__init__(records=records, key_fn=key_fn)
+        self.path = path

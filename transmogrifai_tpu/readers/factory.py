@@ -14,6 +14,16 @@ class DataReaders:
             return CSVReader(path, **kw)
 
         @staticmethod
+        def parquet(path: str, **kw):
+            from .parquet import ParquetReader
+            return ParquetReader(path, **kw)
+
+        @staticmethod
+        def avro(path: str, **kw):
+            from .avro import AvroReader
+            return AvroReader(path, **kw)
+
+        @staticmethod
         def custom(records=None, read_fn=None, key_fn=None) -> DataReader:
             return DataReader(records=records, read_fn=read_fn, key_fn=key_fn)
 

@@ -1,0 +1,91 @@
+"""Parquet ingestion (reference: readers/src/main/scala/com/salesforce/op/
+readers/ParquetProductReader.scala).
+
+Columns load via pyarrow straight into numpy/host columns; the arrow schema
+maps to feature kinds directly (no value-sniffing needed, unlike CSV)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Type
+
+from ..types import (Binary, Date, DateTime, FeatureType, Geolocation,
+                     Integral, MultiPickList, Real, Text, TextList)
+from .base import DataReader
+
+
+def arrow_type_to_kind(t) -> Type[FeatureType]:
+    """Arrow dtype → feature kind (≙ FeatureSparkTypes schema mapping)."""
+    import pyarrow as pa
+
+    if pa.types.is_boolean(t):
+        return Binary
+    if pa.types.is_integer(t):
+        return Integral
+    if pa.types.is_floating(t) or pa.types.is_decimal(t):
+        return Real
+    if pa.types.is_timestamp(t) or pa.types.is_date(t):
+        return DateTime
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return Text
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        vt = t.value_type
+        if pa.types.is_string(vt) or pa.types.is_large_string(vt):
+            return TextList
+        return Geolocation if pa.types.is_floating(vt) else TextList
+    return Text
+
+
+def _to_epoch_ms(v) -> int:
+    """datetime/date → epoch millis.  Naive datetimes are treated as UTC
+    (parquet stores UTC instants; ``datetime.timestamp()`` would reinterpret
+    them in the host's local timezone)."""
+    import calendar
+    import datetime
+
+    if isinstance(v, datetime.datetime):
+        if v.tzinfo is None:
+            return int(calendar.timegm(v.timetuple()) * 1000
+                       + v.microsecond // 1000)
+        return int(v.timestamp() * 1000)
+    if isinstance(v, datetime.date):
+        return int(calendar.timegm(v.timetuple()) * 1000)
+    return int(v)
+
+
+def read_parquet_records(path: str) -> List[Dict[str, Any]]:
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(path)
+    return table.to_pylist()
+
+
+def infer_schema_from_parquet(path: str) -> Dict[str, Type[FeatureType]]:
+    import pyarrow.parquet as pq
+
+    schema = pq.read_schema(path)
+    return {name: arrow_type_to_kind(schema.field(name).type)
+            for name in schema.names}
+
+
+class ParquetReader(DataReader):
+    """Parquet file reader (≙ ParquetProductReader).
+
+    ``schema``: optional name → FeatureType override; derived from the arrow
+    schema when absent."""
+
+    def __init__(self, path: str,
+                 schema: Optional[Dict[str, Type[FeatureType]]] = None,
+                 key_field: Optional[str] = None):
+        records = read_parquet_records(path)
+        self.schema = dict(schema) if schema else infer_schema_from_parquet(path)
+        # timestamps/dates → epoch millis (the Date/DateTime value convention)
+        for name, kind in self.schema.items():
+            if issubclass(kind, (Date, DateTime)):
+                for r in records:
+                    v = r.get(name)
+                    if v is not None and not isinstance(v, (int, float)):
+                        r[name] = _to_epoch_ms(v)
+        key_fn = ((lambda r: r.get(key_field)) if key_field
+                  else (lambda r: id(r)))
+        super().__init__(records=records, key_fn=key_fn)
+        self.path = path

@@ -1,0 +1,163 @@
+"""DSL enrichments + generic transformer stragglers (≙ the reference's
+dsl/Rich*FeatureTest suites, FilterTransformerTest, FilterMapTest,
+DropIndicesByTransformerTest, OPCollectionTransformerTest,
+TextListNullTransformerTest)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.columns import Column, ColumnBatch, column_from_values
+from transmogrifai_tpu.dag import apply_dag, compute_dag, fit_dag
+from transmogrifai_tpu.features import Feature, FeatureBuilder
+from transmogrifai_tpu.stages.transformers import (DropIndicesByTransformer,
+                                                   FilterMap,
+                                                   FilterTransformer,
+                                                   OPCollectionTransformer,
+                                                   TextListNullTransformer)
+from transmogrifai_tpu.vector_meta import (NULL_INDICATOR, VectorColumnMeta,
+                                           VectorMeta)
+
+
+def _run(result_feature, cols, n):
+    batch = ColumnBatch(cols, n)
+    out, _ = fit_dag(batch, compute_dag([result_feature]))
+    return out[result_feature.name]
+
+
+def test_arithmetic_dsl():
+    a = FeatureBuilder.Real("a").as_predictor()
+    b = FeatureBuilder.Real("b").as_predictor()
+    expr = (a + b) * 2.0 - 1.0
+    cols = {"a": column_from_values(T.Real, [1.0, 2.0, None]),
+            "b": column_from_values(T.Real, [3.0, 4.0, 5.0])}
+    out = _run(expr, cols, 3)
+    vals = np.asarray(out.values)
+    assert vals[0] == pytest.approx(7.0)
+    assert vals[1] == pytest.approx(11.0)
+    # + treats empty as identity (MathTransformers semantics): (None+5)*2-1
+    assert np.asarray(out.mask)[2]
+    assert vals[2] == pytest.approx(9.0)
+
+    ratio = a / b
+    out2 = _run(ratio, cols, 3)
+    assert np.asarray(out2.values)[0] == pytest.approx(1 / 3)
+
+    sq = a.power(2.0)
+    out3 = _run(sq, cols, 3)
+    assert np.asarray(out3.values)[1] == pytest.approx(4.0)
+
+
+def test_text_dsl_chain():
+    t = FeatureBuilder.Text("t").as_predictor()
+    toks = t.tokenize()
+    cols = {"t": column_from_values(T.Text, ["Hello World", None])}
+    out = _run(toks, cols, 2)
+    assert out.values[0] == ["hello", "world"]
+
+    ln = t.text_len()
+    out2 = _run(ln, cols, 2)
+    assert np.asarray(out2.values)[0] == 11.0
+
+
+def test_email_phone_dsl():
+    e = FeatureBuilder.Email("e").as_predictor()
+    p = FeatureBuilder.Phone("p").as_predictor()
+    cols = {"e": column_from_values(T.Email, ["a@b.com", "bad"]),
+            "p": column_from_values(T.Phone, ["5551234567", "1"])}
+    out = _run(e.is_valid_email(), dict(cols), 2)
+    assert list(np.asarray(out.values)) == [1.0, 0.0]
+    out2 = _run(e.to_domain_picklist(), dict(cols), 2)
+    assert out2.values[0] == "b.com"
+    out3 = _run(p.is_valid_phone(), dict(cols), 2)
+    assert list(np.asarray(out3.values)) == [1.0, 0.0]
+
+
+def test_date_and_set_dsl():
+    d = FeatureBuilder.Date("d").as_predictor()
+    cols = {"d": column_from_values(T.Date, [1500000000000, None])}
+    out = _run(d.to_time_period("DayOfWeek"), cols, 2)
+    assert out.kind is T.Integral
+
+    s1 = FeatureBuilder.MultiPickList("s1").as_predictor()
+    s2 = FeatureBuilder.MultiPickList("s2").as_predictor()
+    cols2 = {"s1": column_from_values(T.MultiPickList, [{"a", "b"}]),
+             "s2": column_from_values(T.MultiPickList, [{"b", "c"}])}
+    out2 = _run(s1.jaccard_similarity(s2), cols2, 1)
+    assert np.asarray(out2.values)[0] == pytest.approx(1 / 3)
+
+
+def test_map_values_lambda_dsl():
+    t = FeatureBuilder.Text("t").as_predictor()
+    upper = t.map_values(lambda v: None if v is None else v.upper())
+    cols = {"t": column_from_values(T.Text, ["ab", None])}
+    out = _run(upper, cols, 2)
+    assert out.values[0] == "AB" and out.values[1] is None
+
+
+def test_filter_transformer():
+    f = Feature("x", T.Real, False, None, parents=())
+    st = FilterTransformer(predicate_fn=lambda v: v is not None and v > 0,
+                           default=0.0).set_input(f)
+    batch = ColumnBatch({"x": column_from_values(T.Real, [1.5, -2.0, None])}, 3)
+    out = st.transform(batch)
+    vals = np.asarray(out.values)
+    assert vals[0] == 1.5 and vals[1] == 0.0 and vals[2] == 0.0
+
+
+def test_filter_map():
+    f = Feature("m", T.TextMap, False, None, parents=())
+    st = FilterMap(black_list_keys=["secret"]).set_input(f)
+    batch = ColumnBatch({"m": column_from_values(
+        T.TextMap, [{"a": "1", "secret": "x"}, None])}, 2)
+    out = st.transform(batch)
+    assert out.values[0] == {"a": "1"}
+    assert out.values[1] == {}
+
+    st2 = FilterMap(white_list_keys=["a"]).set_input(f)
+    out2 = st2.transform(batch)
+    assert out2.values[0] == {"a": "1"}
+
+
+def test_drop_indices_by():
+    f = Feature("v", T.OPVector, False, None, parents=())
+    meta = VectorMeta("v", [
+        VectorColumnMeta("a", "Real"),
+        VectorColumnMeta("a", "Real", indicator_value=NULL_INDICATOR),
+        VectorColumnMeta("b", "Real"),
+    ])
+    X = np.arange(6, dtype=np.float32).reshape(2, 3)
+    st = DropIndicesByTransformer(drop_null_indicators=True).set_input(f)
+    out = st.transform(ColumnBatch({"v": Column(T.OPVector, X, meta=meta)}, 2))
+    assert np.asarray(out.values).shape == (2, 2)
+    assert [c.parent_feature_name for c in out.meta.columns] == ["a", "b"]
+
+    st2 = DropIndicesByTransformer(
+        match_fn=lambda cm: cm.parent_feature_name == "a").set_input(f)
+    out2 = st2.transform(ColumnBatch({"v": Column(T.OPVector, X, meta=meta)}, 2))
+    assert np.asarray(out2.values).shape == (2, 1)
+
+
+def test_op_collection_transformer():
+    from transmogrifai_tpu.ops.text_specialized import ValidEmailTransformer
+    f = Feature("m", T.EmailMap, False, None, parents=())
+    st = OPCollectionTransformer(ValidEmailTransformer(),
+                                 out_kind=T.BinaryMap).set_input(f)
+    batch = ColumnBatch({"m": column_from_values(
+        T.EmailMap, [{"w": "a@b.com", "h": "bad"}, None])}, 2)
+    out = st.transform(batch)
+    assert out.values[0] == {"w": True, "h": False}
+    assert out.values[1] is None
+
+
+def test_text_list_null_transformer():
+    f1 = Feature("t1", T.TextList, False, None, parents=())
+    f2 = Feature("t2", T.TextList, False, None, parents=())
+    st = TextListNullTransformer().set_input(f1, f2)
+    batch = ColumnBatch({
+        "t1": column_from_values(T.TextList, [["a"], None, []]),
+        "t2": column_from_values(T.TextList, [[], ["b"], ["c"]])}, 3)
+    out = st.transform(batch)
+    arr = np.asarray(out.values)
+    np.testing.assert_array_equal(arr, [[0, 1], [1, 0], [1, 0]])
+    assert out.meta.columns[0].indicator_value == NULL_INDICATOR

@@ -279,9 +279,13 @@ def _cases():
     from transmogrifai_tpu.preparators.sanity_checker import SanityChecker
     from transmogrifai_tpu.stages.transformers import (AliasTransformer,
                                                        BinaryMathTransformer,
+                                                       DropIndicesByTransformer,
                                                        ExistsTransformer,
+                                                       FilterMap,
+                                                       FilterTransformer,
                                                        ReplaceTransformer,
                                                        SubstringTransformer,
+                                                       TextListNullTransformer,
                                                        ToOccurTransformer,
                                                        UnaryMathTransformer)
 
@@ -371,6 +375,11 @@ def _cases():
         Case(_mk(SubstringTransformer), [("a", Text), ("b", Text)]),
         Case(_mk(ReplaceTransformer, match_value="red", replace_with="rouge"),
              [("c", PickList)]),
+        Case(_mk(FilterTransformer, default=0.0), [("a", Real)]),
+        Case(_mk(FilterMap, black_list_keys=["k2"]), [("m", TextMap)]),
+        Case(_mk(DropIndicesByTransformer, drop_grouping=None,
+                 drop_null_indicators=False), [("v", OPVector)]),
+        Case(_mk(TextListNullTransformer), [("tl", TextList), ("tl2", TextList)]),
         # preparators
         Case(_mk(SanityChecker, check_sample=1.0),
              [("label", RealNN), ("v", OPVector)], label_input=True),
@@ -422,6 +431,8 @@ EXEMPT = {
     "selector.CombinedModel": "model of SelectedModelCombiner",
     "trees._ForestEstimatorBase": "abstract base",
     "trees._GBTEstimatorBase": "abstract base",
+    "transformers.OPCollectionTransformer":
+        "function-valued ctor (inner transformer); test_dsl_and_transformers",
 }
 
 

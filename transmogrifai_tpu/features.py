@@ -131,6 +131,159 @@ class Feature:
         from .ops.bucketizers import DescalerTransformer
         return self.transform_with(DescalerTransformer(**kw), scaled)
 
+    # ---- arithmetic (≙ RichNumericFeature +,-,*,/ incl. scalar variants) --
+    def _binary_math(self, other, op: str) -> "Feature":
+        from .stages.transformers import (BinaryMathTransformer,
+                                          UnaryMathTransformer)
+        if isinstance(other, Feature):
+            return self.transform_with(BinaryMathTransformer(op=op), other)
+        if op == "plus":
+            return self.transform_with(
+                UnaryMathTransformer(op="addScalar", scalar=float(other)))
+        if op == "minus":
+            return self.transform_with(
+                UnaryMathTransformer(op="addScalar", scalar=-float(other)))
+        if op == "multiply":
+            return self.transform_with(
+                UnaryMathTransformer(op="multiplyScalar", scalar=float(other)))
+        return self.transform_with(
+            UnaryMathTransformer(op="multiplyScalar", scalar=1.0 / float(other)))
+
+    def __add__(self, other) -> "Feature":
+        return self._binary_math(other, "plus")
+
+    def __sub__(self, other) -> "Feature":
+        return self._binary_math(other, "minus")
+
+    def __mul__(self, other) -> "Feature":
+        return self._binary_math(other, "multiply")
+
+    def __truediv__(self, other) -> "Feature":
+        return self._binary_math(other, "divide")
+
+    def abs(self) -> "Feature":
+        from .stages.transformers import UnaryMathTransformer
+        return self.transform_with(UnaryMathTransformer(op="abs"))
+
+    def sqrt(self) -> "Feature":
+        from .stages.transformers import UnaryMathTransformer
+        return self.transform_with(UnaryMathTransformer(op="sqrt"))
+
+    def log(self, base: float = None) -> "Feature":
+        from .stages.transformers import UnaryMathTransformer
+        return self.transform_with(UnaryMathTransformer(op="log", scalar=base))
+
+    def power(self, p: float) -> "Feature":
+        from .stages.transformers import UnaryMathTransformer
+        return self.transform_with(UnaryMathTransformer(op="power", scalar=p))
+
+    # ---- text (≙ RichTextFeature) ----------------------------------------
+    def tokenize(self, **kw) -> "Feature":
+        from .ops.text import TextTokenizer
+        return self.transform_with(TextTokenizer(**kw))
+
+    def smart_vectorize(self, **kw) -> "Feature":
+        from .ops.text import SmartTextVectorizer
+        return self.transform_with(SmartTextVectorizer(**kw))
+
+    def text_len(self) -> "Feature":
+        from .ops.text import TextLenTransformer
+        return self.transform_with(TextLenTransformer())
+
+    def detect_languages(self) -> "Feature":
+        from .ops.text_specialized import LangDetector
+        return self.transform_with(LangDetector())
+
+    def ngram_similarity(self, other: "Feature", **kw) -> "Feature":
+        from .ops.text_specialized import TextNGramSimilarity
+        return self.transform_with(TextNGramSimilarity(**kw), other)
+
+    # email/url/phone sugar (≙ RichTextFeature.isValidEmail, toDomain, ...)
+    def is_valid_email(self) -> "Feature":
+        from .ops.text_specialized import ValidEmailTransformer
+        return self.transform_with(ValidEmailTransformer())
+
+    def to_domain_picklist(self) -> "Feature":
+        from .ops.text_specialized import (EmailToPickListTransformer,
+                                           UrlToPickListTransformer)
+        from .types import URL
+        cls = (UrlToPickListTransformer if issubclass(self.kind, URL)
+               else EmailToPickListTransformer)
+        return self.transform_with(cls())
+
+    def is_valid_phone(self, default_region: str = "US") -> "Feature":
+        from .ops.text_specialized import IsValidPhoneDefaultCountry
+        return self.transform_with(
+            IsValidPhoneDefaultCountry(default_region=default_region))
+
+    def detect_mime_types(self, type_hint: str = "") -> "Feature":
+        from .ops.text_specialized import MimeTypeDetector
+        return self.transform_with(MimeTypeDetector(type_hint=type_hint))
+
+    # ---- dates (≙ RichDateFeature) ---------------------------------------
+    def to_unit_circle(self, **kw) -> "Feature":
+        from .ops.dates import DateToUnitCircleVectorizer
+        return self.transform_with(DateToUnitCircleVectorizer(**kw))
+
+    def to_time_period(self, period: str = "DayOfWeek") -> "Feature":
+        from .ops.dates import TimePeriodTransformer
+        return self.transform_with(TimePeriodTransformer(period=period))
+
+    # ---- sets / maps (≙ RichSetFeature / RichMapFeature) -----------------
+    def jaccard_similarity(self, other: "Feature") -> "Feature":
+        from .ops.text_specialized import JaccardSimilarity
+        return self.transform_with(JaccardSimilarity(), other)
+
+    def filter_map(self, white_list_keys=(), black_list_keys=(), **kw) -> "Feature":
+        from .stages.transformers import FilterMap
+        return self.transform_with(FilterMap(
+            white_list_keys=white_list_keys,
+            black_list_keys=black_list_keys, **kw))
+
+    # ---- generic (≙ RichFeature) -----------------------------------------
+    def exists(self) -> "Feature":
+        from .stages.transformers import ExistsTransformer
+        return self.transform_with(ExistsTransformer())
+
+    def to_occur(self, match_fn=None) -> "Feature":
+        from .stages.transformers import ToOccurTransformer
+        return self.transform_with(ToOccurTransformer(match_fn=match_fn))
+
+    def replace_with(self, match_value, replace_with) -> "Feature":
+        from .stages.transformers import ReplaceTransformer
+        return self.transform_with(ReplaceTransformer(
+            match_value=match_value, replace_with=replace_with))
+
+    def filter(self, predicate_fn=None, default=None) -> "Feature":
+        from .stages.transformers import FilterTransformer
+        return self.transform_with(FilterTransformer(
+            predicate_fn=predicate_fn, default=default))
+
+    def occurs_in(self, other: "Feature") -> "Feature":
+        """Is this text contained in ``other`` (≙ SubstringTransformer)."""
+        from .stages.transformers import SubstringTransformer
+        return self.transform_with(SubstringTransformer(), other)
+
+    def map_values(self, fn, out_kind=None, name: str = None) -> "Feature":
+        """Arbitrary row-level lambda stage (≙ RichFeature.map via
+        UnaryLambdaTransformer).  Not serializable — session-local sugar."""
+        from .columns import column_from_values
+        from .stages.base import LambdaTransformer
+        from .stages.transformers import _host_values
+
+        def batch_fn(col):
+            vals = [fn(v) for v in _host_values(col)]
+            return column_from_values(out_kind or self.kind, vals)
+
+        return self.transform_with(LambdaTransformer(
+            batch_fn, out_kind or self.kind, name=name or "map",
+            is_device_op=False))
+
+    # ---- vectors (≙ RichVectorFeature.combine) ---------------------------
+    def combine(self, *others: "Feature") -> "Feature":
+        from .ops.combiner import VectorsCombiner
+        return self.transform_with(VectorsCombiner(), *others)
+
 
 class FeatureBuilder:
     """Typed feature declaration (≙ FeatureBuilder.scala:48).

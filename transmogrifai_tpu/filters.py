@@ -137,6 +137,12 @@ def compute_sketches(raw_features: Sequence[Feature], batch: ColumnBatch,
                 vals = [m.get(k) if m else None for m in col.values]
                 out[(f.name, k)] = _sketch_of(
                     f.name, k, vals, kind, max_bins, text_bins)
+            # whole-map presence sketch — also the per-shard row count that
+            # merge_sketches uses to pad keys absent from a shard
+            out[(f.name, None)] = FeatureSketch(
+                f.name, None, n,
+                int(sum(1 for m in col.values if not m)),
+                text_counts=np.zeros(text_bins))
             continue
         vals = (list(col.values) if col.is_host_object()
                 else np.asarray(col.values))
@@ -179,10 +185,33 @@ def _sketch_of(name, key, vals, kind, max_bins, text_bins) -> FeatureSketch:
 
 
 def merge_sketches(a: Dict, b: Dict) -> Dict:
-    """Monoid merge of two shards' sketch maps."""
-    out = dict(a)
-    for k, sk in b.items():
-        out[k] = out[k].merge(sk) if k in out else sk
+    """Monoid merge of two shards' sketch maps.  A map key absent from one
+    shard is padded with that shard's row count as nulls (taken from the
+    feature's whole-map sketch) so per-key counts/fill rates stay exact."""
+    def _pad(sk: FeatureSketch, side: Dict) -> FeatureSketch:
+        if sk.key is None:
+            return sk
+        base = side.get((sk.name, None))
+        if base is None or base.count == 0:
+            return sk
+        missing = FeatureSketch(
+            sk.name, sk.key, base.count, base.count,
+            histogram=None if sk.histogram is None else None,
+            text_counts=None if sk.text_counts is None else
+            np.zeros_like(sk.text_counts))
+        if sk.histogram is not None:
+            from .utils.stats import StreamingHistogram
+            missing.histogram = StreamingHistogram(sk.histogram.max_bins)
+        return sk.merge(missing)
+
+    out: Dict = {}
+    for k in set(a) | set(b):
+        if k in a and k in b:
+            out[k] = a[k].merge(b[k])
+        elif k in a:
+            out[k] = _pad(a[k], b)
+        else:
+            out[k] = _pad(b[k], a)
     return out
 
 

@@ -31,7 +31,12 @@ def arrow_type_to_kind(t) -> Type[FeatureType]:
         vt = t.value_type
         if pa.types.is_string(vt) or pa.types.is_large_string(vt):
             return TextList
-        return Geolocation if pa.types.is_floating(vt) else TextList
+        if pa.types.is_floating(vt) or pa.types.is_integer(vt):
+            # numeric lists are dense vectors (≙ Spark ml Vector → OPVector);
+            # Geolocation is NOT inferred — pass it explicitly via `schema`
+            from ..types import OPVector
+            return OPVector
+        return TextList
     return Text
 
 

@@ -6,7 +6,7 @@ pairs, so they trace under jit.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Type
+from typing import Any, Callable, Optional, Sequence, Type
 
 import jax.numpy as jnp
 import numpy as np
@@ -21,6 +21,18 @@ def _as_float(col: Column):
     vals = jnp.asarray(col.values, dtype=jnp.float32)
     mask = None if col.mask is None else jnp.asarray(col.mask)
     return vals, mask
+
+
+def _host_values(col: Column) -> list:
+    """Row python values with ONE device→host copy (``row_value`` per row
+    would re-copy the whole array each time)."""
+    if col.is_host_object():
+        return list(col.values)
+    vals = np.asarray(col.values)
+    if col.mask is not None:
+        m = np.asarray(col.mask)
+        return [v.item() if mm else None for v, mm in zip(vals, m)]
+    return [v.item() for v in vals]
 
 
 def _and_mask(m1, m2):
@@ -212,3 +224,240 @@ class ReplaceTransformer(Transformer):
         v = jnp.asarray(c.values)
         out = jnp.where(v == mv, jnp.asarray(rw, dtype=v.dtype), v)
         return Column(c.kind, out, mask=c.mask)
+
+
+class FilterTransformer(Transformer):
+    """Keep values satisfying a predicate, else the default (≙
+    FilterTransformer.scala:39-48: ``a => if (p(a)) a else default``).  The
+    predicate is runtime state (like the reference's function arg) — it is
+    not serialized; persisted pipelines should prefer declarative stages."""
+
+    is_device_op = False
+
+    def __init__(self, predicate_fn: Optional[Callable[[Any], bool]] = None,
+                 default: Any = None, **params):
+        super().__init__(default=default, **params)
+        self.predicate_fn = predicate_fn or (lambda v: v is not None)
+
+    def make_output_features(self):
+        kind = self.input_features[0].kind
+        if kind.non_nullable and self.get("default") is None:
+            raise ValueError(
+                f"FilterTransformer on non-nullable {kind.__name__} requires "
+                "a non-None `default` (rows failing the predicate would "
+                "otherwise produce empty values)")
+        self.out_kind = kind
+        return super().make_output_features()
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        from ..columns import column_from_values
+        (c,) = self.input_columns(batch)
+        default = self.get("default")
+        rows = _host_values(c)
+        out = [v if self.predicate_fn(v) else default for v in rows]
+        return column_from_values(c.kind, out)
+
+
+class FilterMap(Transformer):
+    """Filter a map's keys by allow/block lists (≙ FilterMap.scala:45-55
+    with MapPivotParams white/black key lists)."""
+
+    is_device_op = False
+
+    def __init__(self, white_list_keys: Sequence[str] = (),
+                 black_list_keys: Sequence[str] = (),
+                 clean_keys: bool = False, **params):
+        super().__init__(white_list_keys=list(white_list_keys),
+                         black_list_keys=list(black_list_keys),
+                         clean_keys=clean_keys, **params)
+
+    def make_output_features(self):
+        self.out_kind = self.input_features[0].kind
+        return super().make_output_features()
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        (c,) = self.input_columns(batch)
+        white = set(self.get("white_list_keys") or ())
+        black = set(self.get("black_list_keys") or ())
+        clean = self.get("clean_keys", False)
+
+        def keep(k: str) -> bool:
+            return (not white or k in white) and k not in black
+
+        out = np.empty(len(c), object)
+        for i, m in enumerate(c.values):
+            m = m if isinstance(m, dict) else {}
+            res = {}
+            # clean BEFORE filtering so a blacklisted key cannot reappear in
+            # cleaned form; sorted iteration makes key collisions after
+            # cleaning deterministic (last sorted key wins)
+            for k in sorted(m):
+                ck = k.strip().lower() if clean else k
+                if keep(ck):
+                    res[ck] = m[k]
+            out[i] = res
+        return Column(c.kind, out)
+
+
+class DropIndicesByTransformer(Transformer):
+    """OPVector → OPVector dropping columns whose metadata matches
+    (≙ DropIndicesByTransformer.scala:50-70: matchFn on
+    OpVectorColumnMetadata selects columns to DROP).  Besides the callable,
+    ``drop_null_indicators``/``drop_grouping`` give serializable shortcuts."""
+
+    from ..types import OPVector as _V
+    in_kinds = (_V,)
+    out_kind = _V
+    is_device_op = False
+
+    def __init__(self, match_fn: Optional[Callable] = None,
+                 drop_null_indicators: bool = False,
+                 drop_grouping: Optional[str] = None, **params):
+        super().__init__(drop_null_indicators=drop_null_indicators,
+                         drop_grouping=drop_grouping, **params)
+        self.match_fn = match_fn
+
+    def _drops(self, cm) -> bool:
+        from ..vector_meta import NULL_INDICATOR
+        if self.match_fn is not None and self.match_fn(cm):
+            return True
+        if self.get("drop_null_indicators") and \
+                cm.indicator_value == NULL_INDICATOR:
+            return True
+        g = self.get("drop_grouping")
+        return g is not None and cm.grouping == g
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        from ..types import OPVector
+        (c,) = self.input_columns(batch)
+        width = int(np.asarray(c.values).shape[1]) if len(c) or True else 0
+        if c.meta is not None:
+            keep = [i for i, cm in enumerate(c.meta.columns)
+                    if not self._drops(cm)]
+            # persist the resolved slice: row-level transforms and reloaded
+            # models see plain vectors without metadata (the reference reads
+            # vectorMetadata from the input schema once, at fit time)
+            self.set("kept_indices", keep)
+            self.set("resolved_input_width", len(c.meta.columns))
+            meta = c.meta.select(keep, name=self.output_features[0].name)
+        else:
+            keep = self.get("kept_indices")
+            if keep is None:
+                raise ValueError(
+                    "DropIndicesByTransformer requires vector metadata on "
+                    "its input (or a prior batch transform that resolved "
+                    "the kept indices)")
+            expected = self.get("resolved_input_width")
+            if expected is not None and width != expected:
+                raise ValueError(
+                    f"DropIndicesByTransformer: input width {width} does not "
+                    f"match the width {expected} the kept indices were "
+                    "resolved against — upstream vector layout changed; "
+                    "re-apply on a metadata-bearing batch")
+            meta = None
+        vals = jnp.asarray(c.values)[:, np.asarray(keep, np.int64)]
+        return Column(OPVector, vals, meta=meta)
+
+
+class OPCollectionTransformer(Transformer):
+    """Lift a unary value-level transformer over a list/set/map feature
+    (≙ OPCollectionTransformer.scala:67-83: empty in → empty out, else the
+    inner transform applied per element/value)."""
+
+    is_device_op = False
+
+    def __init__(self, transformer: Transformer,
+                 out_kind: Optional[Type[FeatureType]] = None, **params):
+        super().__init__(**params)
+        self.transformer = transformer
+        self._out_kind_override = out_kind
+
+    def make_output_features(self):
+        self.out_kind = self._out_kind_override or self.input_features[0].kind
+        return super().make_output_features()
+
+    def _ensure_inner_wired(self):
+        if not self.transformer.input_features:
+            from ..features import Feature
+            in_kind = (self.transformer.in_kinds[0]
+                       if self.transformer.in_kinds else Text)
+            self.transformer.set_input(
+                Feature("_elem", in_kind, False, None, parents=()))
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        from ..columns import ColumnBatch as _CB, column_from_values
+        (c,) = self.input_columns(batch)
+        self._ensure_inner_wired()
+        f = self.transformer.input_features[0]
+        # flatten every element of the whole batch into ONE inner transform
+        # (per-element 1-row batches would pay a stage dispatch per value)
+        flat: list = []
+        specs: list = []            # per row: (tag, keys/None/len)
+        for v in c.values:
+            if v is None:
+                specs.append(("none", None))
+            elif isinstance(v, dict):
+                keys = sorted(v)
+                specs.append(("dict", keys))
+                flat.extend(v[k] for k in keys)
+            elif isinstance(v, (set, frozenset)):
+                items = sorted(v, key=str)
+                specs.append(("set", len(items)))
+                flat.extend(items)
+            elif isinstance(v, (list, tuple)):
+                specs.append(("list", len(v)))
+                flat.extend(v)
+            else:
+                specs.append(("scalar", 1))
+                flat.append(v)
+        if flat:
+            col = column_from_values(f.kind, flat)
+            res_col = self.transformer.transform(_CB({f.name: col}, len(flat)))
+            results = [res_col.row_value(i).value for i in range(len(flat))]
+        else:
+            results = []
+        out = np.empty(len(c), object)
+        pos = 0
+        for i, (tag, spec) in enumerate(specs):
+            if tag == "none":
+                out[i] = None
+            elif tag == "dict":
+                out[i] = {k: results[pos + j] for j, k in enumerate(spec)}
+                pos += len(spec)
+            elif tag == "set":
+                out[i] = set(results[pos:pos + spec])
+                pos += spec
+            elif tag == "list":
+                out[i] = list(results[pos:pos + spec])
+                pos += spec
+            else:
+                out[i] = results[pos]
+                pos += 1
+        return Column(self.out_kind, out)
+
+
+class TextListNullTransformer(Transformer):
+    """N TextList features → OPVector of per-feature null indicators
+    (≙ TextListNullTransformer.scala:39-58 — null tracking for hashed text
+    kept outside the hashing vectorizer)."""
+
+    from ..types import OPVector as _V2
+    out_kind = _V2
+    is_device_op = False
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        from ..columns import indicator_2d
+        from ..types import OPVector
+        from ..vector_meta import NULL_INDICATOR, VectorColumnMeta, VectorMeta
+        blocks = []
+        cols_meta = []
+        for f in self.input_features:
+            vals = batch[f.name].values
+            blocks.append(indicator_2d(
+                v is None or (hasattr(v, "__len__") and len(v) == 0)
+                for v in vals))
+            cols_meta.append(VectorColumnMeta(
+                f.name, f.kind.__name__, indicator_value=NULL_INDICATOR))
+        arr = np.concatenate(blocks, axis=1)
+        meta = VectorMeta(self.output_name(), cols_meta)
+        return Column(OPVector, jnp.asarray(arr), meta=meta)

@@ -1,0 +1,44 @@
+"""Boston housing regression (≙ helloworld OpBostonSimple.scala).
+
+Run:  JAX_PLATFORMS=cpu python examples/op_boston_simple.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.features import features_from_schema
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.readers import DataReaders
+from transmogrifai_tpu.selector import RegressionModelSelector
+from transmogrifai_tpu.workflow import Workflow
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "data")
+
+
+def main():
+    headers = ["rowId", "crim", "zn", "indus", "chas", "nox", "rm", "age",
+               "dis", "rad", "tax", "ptratio", "b", "lstat", "medv"]
+    schema = {h: T.Real for h in headers
+              if h not in ("rowId", "medv", "chas", "rad")}
+    schema.update({"chas": T.PickList, "rad": T.Integral, "medv": T.RealNN})
+    reader = DataReaders.Simple.csv(
+        os.path.join(DATA, "boston/housingData.csv"),
+        headers=headers, schema=schema, key_field="rowId")
+
+    medv, predictors = features_from_schema(schema, response="medv")
+    pred = RegressionModelSelector(
+        model_types_to_use=["OpLinearRegression"],
+    ).set_input(medv, transmogrify(predictors)).get_output()
+
+    model = Workflow().set_reader(reader).set_result_features(pred).train()
+    m = model.evaluate(Evaluators.Regression.rmse())
+    print(f"RMSE = {m['RootMeanSquaredError']:.3f}  R2 = {m['R2']:.4f}")
+    print(model.summary_pretty())
+
+
+if __name__ == "__main__":
+    main()

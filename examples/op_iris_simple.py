@@ -1,0 +1,50 @@
+"""Iris multiclass (≙ helloworld OpIrisSimple.scala): string label →
+StringIndexer → MultiClassificationModelSelector.
+
+Run:  JAX_PLATFORMS=cpu python examples/op_iris_simple.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.ops.categorical import StringIndexer
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.readers import DataReaders
+from transmogrifai_tpu.selector import MultiClassificationModelSelector
+from transmogrifai_tpu.workflow import Workflow
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "data")
+
+
+def main():
+    headers = ["id", "sepalLength", "sepalWidth", "petalLength", "petalWidth",
+               "irisClass"]
+    schema = {"sepalLength": T.Real, "sepalWidth": T.Real,
+              "petalLength": T.Real, "petalWidth": T.Real,
+              "irisClass": T.PickList}
+    reader = DataReaders.Simple.csv(
+        os.path.join(DATA, "iris/iris.csv"),
+        headers=headers, schema=schema, key_field="id")
+
+    label = StringIndexer().set_input(
+        FeatureBuilder.PickList("irisClass").as_response()).get_output()
+    predictors = [FeatureBuilder.Real(n).as_predictor()
+                  for n in headers[1:-1]]
+    pred = MultiClassificationModelSelector(
+        model_types_to_use=["OpLogisticRegression"],
+    ).set_input(label, transmogrify(predictors)).get_output()
+
+    model = Workflow().set_reader(reader).set_result_features(pred).train()
+    m = model.evaluate(Evaluators.MultiClassification.f1(),
+                       label_feature=label)
+    print(f"F1 = {m['F1']:.4f}  Error = {m['Error']:.4f}")
+    print(model.summary_pretty())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Titanic survival — the canonical minimal flow (≙ helloworld/src/main/
+scala/com/salesforce/hw/OpTitanicSimple.scala, README.md:33-56):
+declare typed features → transmogrify → sanity-check → model selector →
+train → evaluate → explain.
+
+Run:  JAX_PLATFORMS=cpu python examples/op_titanic_simple.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.features import features_from_schema
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.readers import DataReaders
+from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.workflow import Workflow
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "data")
+
+HEADERS = ["id", "survived", "pClass", "name", "sex", "age", "sibSp",
+           "parCh", "ticket", "fare", "cabin", "embarked"]
+SCHEMA = {
+    "survived": T.RealNN, "pClass": T.PickList, "name": T.Text,
+    "sex": T.PickList, "age": T.Real, "sibSp": T.Integral,
+    "parCh": T.Integral, "ticket": T.PickList, "fare": T.Real,
+    "cabin": T.PickList, "embarked": T.PickList,
+}
+
+
+def main():
+    reader = DataReaders.Simple.csv(
+        os.path.join(DATA, "titanic/TitanicPassengersTrainData.csv"),
+        headers=HEADERS, schema=SCHEMA, key_field="id")
+
+    survived, predictors = features_from_schema(SCHEMA, response="survived")
+    feature_vector = transmogrify(predictors)          # auto feature engineering
+    checked = survived.sanity_check(feature_vector,
+                                    remove_bad_features=True)
+    pred = BinaryClassificationModelSelector(
+        model_types_to_use=["OpLogisticRegression"],
+    ).set_input(survived, checked).get_output()
+
+    model = Workflow().set_reader(reader).set_result_features(pred).train()
+    metrics = model.evaluate(Evaluators.BinaryClassification.auPR())
+    print(f"AuPR = {metrics['AuPR']:.4f}  AuROC = {metrics['AuROC']:.4f}")
+    print(model.summary_pretty())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+"""CLI project generator (≙ cli/src/test: CliTest / ProjectGenerator tests —
+generate a project AND run its training end-to-end) + examples smoke."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.cli import (BINARY, MULTI, REGRESSION,
+                                   generate_project, infer_problem_kind, main)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TITANIC = os.path.join(REPO, "data/titanic/TitanicPassengersTrainData.csv")
+
+
+def test_infer_problem_kind():
+    assert infer_problem_kind(T.Binary, [True, False]) == BINARY
+    assert infer_problem_kind(T.Real, [0.0, 1.0, 1.0]) == BINARY
+    assert infer_problem_kind(T.Real, [1.5, 2.5, 3.5]) == REGRESSION
+    assert infer_problem_kind(T.Integral, list(range(50))) == REGRESSION
+    assert infer_problem_kind(T.PickList, ["a", "b", "c"]) == MULTI
+    assert infer_problem_kind(T.Text, ["yes", "no"]) == BINARY
+
+
+def test_gen_produces_runnable_project(tmp_path):
+    # titanic csv has no header row — write a headered copy for auto-schema
+    import csv
+    headers = ["id", "survived", "pClass", "name", "sex", "age", "sibSp",
+               "parCh", "ticket", "fare", "cabin", "embarked"]
+    src = os.path.join(str(tmp_path), "titanic.csv")
+    with open(TITANIC) as f_in, open(src, "w", newline="") as f_out:
+        w = csv.writer(f_out)
+        w.writerow(headers)
+        for row in csv.reader(f_in):
+            w.writerow(row)
+
+    out = str(tmp_path / "proj")
+    rc = main(["gen", "--name", "TitanicApp", "--input", src,
+               "--response", "survived", "--id", "id", "--output", out])
+    assert rc == 0
+    for f in ("app.py", "features.py", "README.md"):
+        assert os.path.exists(os.path.join(out, f))
+
+    # overwrite guard
+    with pytest.raises(FileExistsError):
+        generate_project("TitanicApp", src, "survived", out, id_field="id")
+
+    # the generated app trains for real (≙ cli tests actually building the
+    # generated project)
+    # trim the default grid for test speed (the generated code keeps
+    # production defaults; the point here is that the scaffold runs)
+    app_path = os.path.join(out, "app.py")
+    with open(app_path) as f:
+        app_src = f.read()
+    app_src = app_src.replace(
+        "BinaryClassificationModelSelector()",
+        "BinaryClassificationModelSelector("
+        "model_types_to_use=['OpLogisticRegression'])")
+    with open(app_path, "w") as f:
+        f.write(app_src)
+
+    env = dict(os.environ,
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    # the image's sitecustomize forces the TPU platform past JAX_PLATFORMS,
+    # so pin the CPU backend via jax.config before running the app
+    boot = ("import sys, jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import runpy; sys.argv = ['app.py', '--run-type', 'train', "
+            f"'--model-location', {os.path.join(out, 'model')!r}]; "
+            "runpy.run_path('app.py', run_name='__main__')")
+    r = subprocess.run([sys.executable, "-c", boot], cwd=out, env=env,
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert os.path.exists(os.path.join(out, "model", "op-model.json"))
+
+
+def test_gen_unknown_response(tmp_path):
+    with pytest.raises(ValueError, match="response column"):
+        generate_project("X", TITANIC, "nope", str(tmp_path / "p"))
+
+
+def test_gen_bad_name(tmp_path):
+    headers = "id,survived,pClass,name,sex,age,sibSp,parCh,ticket,fare,cabin,embarked"
+    with pytest.raises(ValueError, match="identifier"):
+        generate_project("my-app", TITANIC, "survived", str(tmp_path / "p"),
+                         headers=headers.split(","))
+    with pytest.raises(ValueError, match="must be different"):
+        generate_project("App", TITANIC, "survived", str(tmp_path / "p"),
+                         id_field="survived", headers=headers.split(","))
+    with pytest.raises(ValueError, match="id column"):
+        generate_project("App", TITANIC, "survived", str(tmp_path / "p"),
+                         id_field="nope", headers=headers.split(","))
+
+
+def test_gen_headerless_csv_and_text_label(tmp_path):
+    """--headers plumbs through for headerless CSVs (every bundled dataset),
+    and a text response generates the StringIndexer label path; the emitted
+    sources must at least compile."""
+    out = str(tmp_path / "p")
+    rc = main(["gen", "--name", "IrisApp",
+               "--input", os.path.join(REPO, "data/iris/iris.csv"),
+               "--headers", "id,sepalLength,sepalWidth,petalLength,"
+               "petalWidth,irisClass",
+               "--response", "irisClass", "--id", "id", "--output", out])
+    assert rc == 0
+    with open(os.path.join(out, "features.py")) as f:
+        feats_src = f.read()
+    with open(os.path.join(out, "app.py")) as f:
+        app_src = f.read()
+    assert "FeatureBuilder.PickList('irisClass')" in feats_src
+    assert "StringIndexer" in app_src
+    assert "MultiClassificationModelSelector" in app_src
+    compile(feats_src, "features.py", "exec")
+    compile(app_src, "app.py", "exec")
+
+
+def test_gen_nonstandard_binary_label_remapped(tmp_path):
+    """A numeric response with 2 distinct values outside {0,1} (class ids
+    1/2) must be remapped to 0/1 in the generated extract."""
+    import csv
+    src = str(tmp_path / "d.csv")
+    with open(src, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["cls", "x"])
+        for i in range(20):
+            w.writerow([1 + (i % 2), i * 0.5])
+    files = generate_project("TwoClass", src, "cls", str(tmp_path / "p"))
+    assert "!= 1.0" in files["features.py"]
+    # relative input paths must be baked absolute
+    assert os.path.isabs(src) and src in files["app.py"]
+
+
+def test_example_runs():
+    """Examples are runnable scripts (≙ helloworld apps)."""
+    env = dict(os.environ,
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    boot = ("import sys, jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import runpy; sys.argv = ['op_iris_simple.py']; "
+            "runpy.run_path('examples/op_iris_simple.py', run_name='__main__')")
+    r = subprocess.run([sys.executable, "-c", boot], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "F1 =" in r.stdout

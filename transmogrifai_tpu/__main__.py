@@ -1,0 +1,8 @@
+"""``python -m transmogrifai_tpu`` → the CLI (≙ the `op` launcher script)."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

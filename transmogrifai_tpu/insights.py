@@ -62,14 +62,20 @@ class ModelInsights:
             ins.label = {"labelName": resp.name, "rawFeatureName": [resp.name],
                          "rawFeatureType": [resp.kind.__name__]}
             if workflow_model.train_batch is not None and resp.name in workflow_model.train_batch:
-                y = np.asarray(workflow_model.train_batch[resp.name].values,
-                               dtype=np.float64)
+                raw = workflow_model.train_batch[resp.name].values
+                try:
+                    y = np.asarray(raw, dtype=np.float64)
+                except (TypeError, ValueError):
+                    # raw string labels (indexed downstream, e.g. by a
+                    # StringIndexer): profile the categorical values directly
+                    y = np.asarray([("" if v is None else str(v)) for v in raw])
                 vals, counts = np.unique(y, return_counts=True)
                 ins.label.update({
                     "sampleSize": int(len(y)),
                     "distinctCount": int(len(vals)),
-                    "mean": float(y.mean()) if len(y) else 0.0,
                 })
+                if y.dtype.kind == "f" and len(y):
+                    ins.label["mean"] = float(y.mean())
                 if len(vals) <= 30:
                     ins.label["distribution"] = {
                         str(v): int(c) for v, c in zip(vals, counts)}

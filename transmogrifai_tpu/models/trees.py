@@ -37,13 +37,18 @@ from .base import PredictionModel, PredictorEstimator
 MAX_BINS_DEFAULT = 32
 
 
-@functools.lru_cache(maxsize=None)
+def mxu_dtype_for(platform: str):
+    """Histogram-matmul dtype for a device platform: bf16 hits the MXU on TPU;
+    the CPU backend lacks BF16xBF16=F32 dot support, so f32 there."""
+    return jnp.float32 if platform == "cpu" else jnp.bfloat16
+
+
 def _mxu_dtype():
-    """One-hot histogram matmuls run in bf16 to hit the MXU on TPU; the CPU
-    backend (the 8-virtual-device test mesh) lacks BF16xBF16=F32 dot support,
-    so fall back to f32 there."""
-    return (jnp.bfloat16 if jax.devices()[0].platform not in ("cpu",)
-            else jnp.float32)
+    """Default histogram dtype from the process-global backend.  NOT cached:
+    the backend can change mid-process (dryrun_multichip switches from the
+    real chip to a virtual CPU mesh).  Computations pinned to an explicit
+    mesh should instead pass ``hist_dtype=mxu_dtype_for(<mesh platform>)``."""
+    return mxu_dtype_for(jax.default_backend())
 
 
 # --------------------------------------------------------------------------
@@ -128,7 +133,8 @@ def _leaf_xgb(s, lam=1.0):
 def fit_tree(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
              feature_mask: jnp.ndarray, *, impurity: str, max_depth: int,
              n_bins: int, min_instances: jnp.ndarray, min_gain: jnp.ndarray,
-             lam: jnp.ndarray, chunk: "Optional[int]" = None) -> TreeArrays:
+             lam: jnp.ndarray, chunk: "Optional[int]" = None,
+             hist_dtype=None) -> TreeArrays:
     """Grow one tree level-wise on binned data.
 
     B [N, D] int32; stats [N, S] pre-weighted per-row statistics (col 0 must be
@@ -140,6 +146,11 @@ def fit_tree(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
     matmul on the MXU — ``(onehot_node x stats)^T @ onehot_bins`` — instead of
     scatter-adds, which XLA lowers to sorts on TPU.  Deep levels (only
     ``max_depth > 7``-ish trees reach them) fall back to per-stat segment-sums.
+
+    ``hist_dtype`` pins the histogram-matmul dtype; callers running on an
+    explicit device mesh should pass ``mxu_dtype_for(platform)`` of the mesh's
+    platform — the default consults the process-global default backend, which
+    can differ from the mesh (e.g. a CPU mesh under a TPU default backend).
     """
     N, D = B.shape
     S = stats.shape[1]
@@ -190,7 +201,7 @@ def fit_tree(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
             break
 
         use_matmul = n_l * S <= 256
-        mxu = _mxu_dtype()
+        mxu = hist_dtype if hist_dtype is not None else _mxu_dtype()
         if use_matmul:
             # P [N, n_l*S]: each row's stats routed to its node's slot;
             # the histogram then is one MXU matmul against one-hot bins
@@ -409,27 +420,39 @@ def fit_forest(X: np.ndarray, y: np.ndarray, *, task: str, n_classes: int,
             "bin_splits": splits}
 
 
+def gbt_round_body(B, splits, X, y, w0, margin, fmask, min_instances,
+                   min_gain, lam, eta, *, task: str, max_depth: int,
+                   n_bins: int, hist_dtype=None):
+    """One second-order boosting round (grad/hess → tree fit → margin
+    update) — the single source of the round math, shared by the local jitted
+    fitter and the mesh-sharded variant in parallel/dist_fit.py."""
+    if task == "classification":
+        p = jax.nn.sigmoid(margin)
+        g, h = p - y, jnp.maximum(p * (1 - p), 1e-6)
+    else:
+        g, h = margin - y, jnp.ones_like(margin)
+    # weight ALL stat columns (incl. count) so zero-weight rows are fully
+    # excluded from min_instances feasibility, matching the grid path
+    stats = jnp.stack([jnp.ones_like(g), g, h], axis=1) * w0[:, None]
+    tree = fit_tree(B, splits, stats, fmask, impurity="xgb",
+                    max_depth=max_depth, n_bins=n_bins,
+                    min_instances=min_instances, min_gain=min_gain, lam=lam,
+                    hist_dtype=hist_dtype)
+    pred = predict_trees_raw(X, tree.feature[None], tree.threshold[None],
+                             tree.is_leaf[None], tree.leaf[None],
+                             max_depth + 1)[:, 0, 0]
+    return margin + eta * pred, tree
+
+
 @functools.lru_cache(maxsize=None)
 def _gbt_round_fitter(task: str, max_depth: int, n_bins: int):
     """Jitted single boosting round, cached on static config."""
 
     def fn(B, splits, X, y, w0, margin, fmask, min_instances, min_gain,
            lam, eta):
-        if task == "classification":
-            p = jax.nn.sigmoid(margin)
-            g, h = p - y, jnp.maximum(p * (1 - p), 1e-6)
-        else:
-            g, h = margin - y, jnp.ones_like(margin)
-        # weight ALL stat columns (incl. count) so zero-weight rows are fully
-        # excluded from min_instances feasibility, matching the grid path
-        stats = jnp.stack([jnp.ones_like(g), g, h], axis=1) * w0[:, None]
-        tree = fit_tree(B, splits, stats, fmask, impurity="xgb",
-                        max_depth=max_depth, n_bins=n_bins,
-                        min_instances=min_instances, min_gain=min_gain, lam=lam)
-        pred = predict_trees_raw(X, tree.feature[None], tree.threshold[None],
-                                 tree.is_leaf[None], tree.leaf[None],
-                                 max_depth + 1)[:, 0, 0]
-        return margin + eta * pred, tree
+        return gbt_round_body(B, splits, X, y, w0, margin, fmask,
+                              min_instances, min_gain, lam, eta, task=task,
+                              max_depth=max_depth, n_bins=n_bins)
 
     return jax.jit(fn)
 

@@ -116,6 +116,82 @@ def fit_logreg_grid_sharded(X, y, l2s, l1s, mesh: Mesh, n_iter: int = 50):
 
 
 # --------------------------------------------------------------------------
+# sharded tree ensembles (P1 × P3): rows over 'data', trees over 'model'
+# --------------------------------------------------------------------------
+
+def _mesh_platform(mesh: Mesh) -> str:
+    return mesh.devices.flat[0].platform
+
+
+def sharded_forest_fit(mesh: Mesh, *, task: str = "classification",
+                       max_depth: int = 3, n_bins: int = 8):
+    """Forest fit as one GSPMD program: the binned matrix + per-row stats are
+    row-sharded over 'data' (the histogram one-hot contractions inside
+    ``fit_tree`` contract the row axis, so XLA inserts the psum all-reduces —
+    ≙ Spark's per-partition histogram merge), and the tree axis is vmapped then
+    sharded over 'model'.  Returns the jitted fitter
+    ``(B, splits, base_stats, boot [K, N], masks [K, D]) → TreeArrays [K, T]``.
+    The class count is implied by the stats layout: ``base_stats`` is
+    ``[count, onehot(y)]`` for classification, ``[count, y, y²]`` for
+    regression (see ``fit_forest``)."""
+    from ..models.trees import fit_tree, mxu_dtype_for
+
+    impurity = "gini" if task == "classification" else "variance"
+    hist_dtype = mxu_dtype_for(_mesh_platform(mesh))
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(data_sharding(mesh, 2), replicated_sharding(mesh),
+                      data_sharding(mesh, 2),
+                      NamedSharding(mesh, P(MODEL_AXIS, DATA_AXIS)),
+                      NamedSharding(mesh, P(MODEL_AXIS, None))),
+        out_shardings=NamedSharding(mesh, P(MODEL_AXIS)))
+    def fit(B, splits, base_stats, boot, masks):
+        def one(bw, fm):
+            return fit_tree(B, splits, base_stats * bw[:, None], fm,
+                            impurity=impurity, max_depth=max_depth,
+                            n_bins=n_bins, min_instances=jnp.float32(1.0),
+                            min_gain=jnp.float32(0.0), lam=jnp.float32(1.0),
+                            hist_dtype=hist_dtype)
+
+        return jax.vmap(one)(boot, masks)
+
+    return fit
+
+
+def sharded_gbt_round(mesh: Mesh, *, task: str = "classification",
+                      max_depth: int = 3, n_bins: int = 8):
+    """One boosting round over the mesh: grad/hess on row-sharded data, one
+    tree fit (histogram reductions ride ICI psums), margin update in place.
+    The round math is ``models.trees.gbt_round_body`` — the same function the
+    local fitter jits — so weighting/hessian fixes propagate to both paths.
+    Returns the jitted
+    ``(B, splits, X, y, w0, margin, min_instances, min_gain, lam, eta)
+    → (margin', TreeArrays)``."""
+    from ..models.trees import gbt_round_body, mxu_dtype_for
+
+    hist_dtype = mxu_dtype_for(_mesh_platform(mesh))
+    repl = replicated_sharding(mesh)
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(data_sharding(mesh, 2), repl,
+                      data_sharding(mesh, 2), data_sharding(mesh, 1),
+                      data_sharding(mesh, 1), data_sharding(mesh, 1),
+                      repl, repl, repl, repl),
+        out_shardings=(data_sharding(mesh, 1), repl))
+    def round_fn(B, splits, X, y, w0, margin, min_instances, min_gain,
+                 lam, eta):
+        fmask = jnp.ones((B.shape[1],)) > 0
+        return gbt_round_body(B, splits, X, y, w0, margin, fmask,
+                              min_instances, min_gain, lam, eta, task=task,
+                              max_depth=max_depth, n_bins=n_bins,
+                              hist_dtype=hist_dtype)
+
+    return round_fn
+
+
+# --------------------------------------------------------------------------
 # full sharded training step (used by __graft_entry__.dryrun_multichip)
 # --------------------------------------------------------------------------
 

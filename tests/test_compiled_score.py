@@ -1,0 +1,124 @@
+"""Compiled score path: the fitted DAG's device-resident middle runs as ONE
+jitted XLA program (transmogrifai_tpu/compiled.py), equivalent to the eager
+apply_dag and robust to untraceable stages (automatic demotion)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.columns import Column, ColumnBatch
+from transmogrifai_tpu.compiled import ScoreProgram
+from transmogrifai_tpu.dag import apply_dag
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                        ModelCandidate, grid)
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.stages.base import LambdaTransformer
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _make_model(n=400, d=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(np.float32)
+    records = [{"y": float(y[i]),
+                **{f"x{j}": float(X[i, j]) for j in range(d)},
+                "cat": ("a" if X[i, 2] > 0 else "b")}
+               for i in range(n)]
+    label = FeatureBuilder.RealNN("y").as_response()
+    preds = [FeatureBuilder.Real(f"x{j}").as_predictor() for j in range(d)]
+    preds.append(FeatureBuilder.PickList("cat").as_predictor())
+    fv = transmogrify(preds)
+    checked = label.sanity_check(fv, remove_bad_features=True)
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]),
+                       "OpLogisticRegression")])
+    sel.set_input(label, checked)
+    pred = sel.get_output()
+    wf = Workflow().set_input_records(records).set_result_features(pred)
+    return wf.train(), pred
+
+
+@pytest.fixture(scope="module")
+def model_and_pred():
+    return _make_model()
+
+
+def test_device_run_engages(model_and_pred):
+    """The partition must place the vector-combine → sanity-slice → model
+    chain (at minimum) inside the single jitted run."""
+    model, _ = model_and_pred
+    prog = model.score_program()
+    batch = model.generate_raw_data()
+    pre, run, post = prog._partition(batch)
+    names = [s.operation_name for s in run]
+    assert "VectorsCombiner" in names
+    assert "SanityCheckerModel" in names
+    assert "SelectedModel" in names
+
+
+def test_compiled_matches_eager(model_and_pred):
+    model, pred = model_and_pred
+    batch = model.generate_raw_data()
+    eager = apply_dag(batch, model.fitted_dag)
+    compiled = model.score_program()(batch, keep_intermediate=True)
+    p1 = np.asarray(eager[pred.name].values["prediction"])
+    p2 = np.asarray(compiled[pred.name].values["prediction"])
+    np.testing.assert_allclose(p1, p2, atol=1e-6)
+    pr1 = np.asarray(eager[pred.name].values["probability"])
+    pr2 = np.asarray(compiled[pred.name].values["probability"])
+    np.testing.assert_allclose(pr1, pr2, atol=1e-6)
+
+
+def test_score_varying_batch_sizes(model_and_pred):
+    """jit retraces per shape; results must stay correct across sizes."""
+    model, pred = model_and_pred
+    full = model.generate_raw_data()
+    for n in (full and [len(full), 7, 1]):
+        sub = full.take_rows(np.arange(n))
+        scored = model.score(batch=sub)
+        assert len(scored[pred.name].values["prediction"]) == n
+
+
+def test_untraceable_stage_demoted(model_and_pred):
+    """A stage flagged device but actually host-bound (np.asarray on a tracer
+    raises) must be demoted to the host segments, not break scoring."""
+    model, pred = model_and_pred
+
+    seen = []
+
+    def hostile(col):
+        arr = np.asarray(col.values)  # raises TracerArrayConversionError in jit
+        seen.append(len(arr))
+        return Column(T.RealNN, arr * 2.0)
+
+    # consume the sanity-checked vector (produced inside the device run) so
+    # the hostile stage lands in the traced segment
+    checked_f = model.selected_model.input_features[1]
+    lam = LambdaTransformer(hostile, T.RealNN, name="HostileOp")
+    lam.set_input(checked_f)
+    out_f = lam.get_output()
+
+    prog = ScoreProgram(list(model.fitted_dag) + [[lam]],
+                        [out_f.name] + [f.name for f in model.result_features])
+    batch = model.generate_raw_data()
+    scored = prog(batch, keep_intermediate=True)
+    assert lam.uid in prog._demoted
+    # demoted stage still executed on host and the model still scored
+    assert out_f.name in scored
+    eager = apply_dag(batch, model.fitted_dag)
+    np.testing.assert_allclose(
+        np.asarray(scored[pred.name].values["prediction"]),
+        np.asarray(eager[pred.name].values["prediction"]), atol=1e-6)
+
+
+def test_evaluate_error_messages(model_and_pred):
+    model, _ = model_and_pred
+    from transmogrifai_tpu.evaluators import Evaluators
+    ev = Evaluators.BinaryClassification.auROC()
+    # response column stripped from scoring data → actionable error
+    batch = model.generate_raw_data()
+    no_label = batch.drop(["y"])
+    with pytest.raises(ValueError, match="response column 'y'"):
+        model.evaluate(ev, batch=no_label)

@@ -1,0 +1,129 @@
+"""cut_dag semantics (≙ FitStagesUtil.cutDAG:304-356 + OpWorkflowCVTest):
+'during' = the selector's ancestor DAG from the first label-consuming layer
+onward, including transformer layers interleaved after it; non-label
+estimators upstream stay in 'before'; workflow-CV training matches
+selector-CV on the same data."""
+
+import numpy as np
+
+from transmogrifai_tpu.dag import compute_dag, cut_dag, dag_stages
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.numeric import StandardScaler
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                        ModelCandidate, grid)
+from transmogrifai_tpu.stages.transformers import AliasTransformer
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _records(n=300, d=4, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    return [{"y": float(y[i]), **{f"x{j}": float(X[i, j]) for j in range(d)}}
+            for i in range(n)], d
+
+
+def test_cut_dag_interleaved_transformer_after_label_stage():
+    """sanity-check (label-consuming) → alias transformer → selector: the
+    transformer layer between the label stage and the selector must be in
+    'during' (the old contiguous-estimator heuristic dropped the whole
+    'during' DAG here, leaking the sanity-checker fit across folds)."""
+    _, d = _records()
+    label = FeatureBuilder.RealNN("y").as_response()
+    preds = [FeatureBuilder.Real(f"x{j}").as_predictor() for j in range(d)]
+    fv = transmogrify(preds)
+    checked = label.sanity_check(fv, remove_bad_features=True)
+    aliased = AliasTransformer(name="fv").set_input(checked).get_output()
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]), "LR")])
+    sel.set_input(label, aliased)
+    pred = sel.get_output()
+
+    dag = compute_dag([pred])
+    before, during, after = cut_dag(dag, sel)
+    during_names = {s.operation_name for l in during for s in l}
+    assert "SanityChecker" in during_names
+    assert "AliasTransformer" in during_names
+    before_names = {s.operation_name for l in before for s in l}
+    assert "SanityChecker" not in before_names
+    assert any(s is sel for l in after for s in l)
+
+
+def test_cut_dag_non_label_estimator_stays_before():
+    """An estimator that never sees the label (StandardScaler) is fit once on
+    the full data (reference: firstCVTSIndex counts only stages with both
+    response AND predictor inputs)."""
+    _, d = _records()
+    label = FeatureBuilder.RealNN("y").as_response()
+    preds = [FeatureBuilder.Real(f"x{j}").as_predictor() for j in range(d)]
+    fv = transmogrify(preds)
+    scaled = StandardScaler().set_input(fv).get_output()
+    checked = label.sanity_check(scaled, remove_bad_features=True)
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]), "LR")])
+    sel.set_input(label, checked)
+    pred = sel.get_output()
+
+    dag = compute_dag([pred])
+    before, during, after = cut_dag(dag, sel)
+    before_names = {s.operation_name for l in before for s in l}
+    during_names = {s.operation_name for l in during for s in l}
+    assert "StandardScaler" in before_names
+    assert during_names == {"SanityChecker"}
+
+
+def test_cut_dag_side_branch_follows_during():
+    """A non-selector-ancestor side branch consuming a 'during' output must
+    follow its producer into 'during' — leaving it in 'before' would run it
+    ahead of the sanity checker it reads from (regression: KeyError in
+    workflow-CV training)."""
+    records, d = _records()
+    label = FeatureBuilder.RealNN("y").as_response()
+    preds = [FeatureBuilder.Real(f"x{j}").as_predictor() for j in range(d)]
+    fv = transmogrify(preds)
+    checked = label.sanity_check(fv, remove_bad_features=True)
+    side1 = AliasTransformer(name="side1").set_input(checked).get_output()
+    side2 = AliasTransformer(name="side2").set_input(side1).get_output()
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]), "LR")])
+    sel.set_input(label, checked)
+    pred = sel.get_output()
+
+    dag = compute_dag([pred, side2])
+    before, during, after = cut_dag(dag, sel)
+    before_stages = {s for l in before for s in l}
+    during_stages = {s for l in during for s in l}
+    side_stages = {s for s in dag_stages(dag)
+                   if s.operation_name == "AliasTransformer"}
+    assert side_stages <= during_stages | {s for l in after for s in l}
+    assert not (side_stages & before_stages)
+
+    # and the whole workflow-CV train runs on this shape
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred, side2).with_workflow_cv().train())
+    scored = model.score()
+    assert len(scored[pred.name].values["prediction"]) == len(records)
+
+
+def test_workflow_cv_trains_and_scores():
+    """End-to-end workflow-level CV on the interleaved DAG shape."""
+    records, d = _records()
+    label = FeatureBuilder.RealNN("y").as_response()
+    preds = [FeatureBuilder.Real(f"x{j}").as_predictor() for j in range(d)]
+    fv = transmogrify(preds)
+    checked = label.sanity_check(fv, remove_bad_features=True)
+    aliased = AliasTransformer(name="fv2").set_input(checked).get_output()
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01, 0.1]),
+                       "LR")])
+    sel.set_input(label, aliased)
+    pred = sel.get_output()
+
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred).with_workflow_cv().train())
+    scored = model.score()
+    assert len(scored[pred.name].values["prediction"]) == len(records)
+    summary = model.selected_model.summary
+    assert summary.validation_results

@@ -94,3 +94,75 @@ def test_bin_score_evaluator_calibrated():
     conv = np.array(m["averageConversionRate"])
     big = counts > 30
     assert np.abs(avg[big] - conv[big]).mean() < 0.15
+
+
+def test_device_panel_matches_host_binary():
+    """evaluate_all_device must reproduce the host evaluate_all panel."""
+    import jax.numpy as jnp
+    from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
+    rng = np.random.default_rng(7)
+    n = 2000
+    y = (rng.random(n) > 0.6).astype(np.float64)
+    s = np.clip(y * 0.6 + rng.normal(scale=0.3, size=n) + 0.2, 0, 1)
+    pred = {"prediction": (s > 0.5).astype(np.float64),
+            "probability": np.stack([1 - s, s], axis=1),
+            "rawPrediction": None}
+    ev = OpBinaryClassificationEvaluator()
+    host = ev.evaluate_all(y, pred).to_json()
+    dev = ev.evaluate_all_device(
+        jnp.asarray(y, jnp.float32),
+        {"prediction": jnp.asarray(pred["prediction"], jnp.float32),
+         "probability": jnp.asarray(pred["probability"], jnp.float32),
+         "scores": jnp.asarray(s, jnp.float32)},
+        jnp.ones(n, jnp.float32)).to_json()
+    for k in ("TP", "TN", "FP", "FN"):
+        assert dev[k] == host[k], k
+    for k in ("Precision", "Recall", "F1", "Error", "AuROC", "AuPR"):
+        assert abs(dev[k] - host[k]) < 1e-4, (k, dev[k], host[k])
+    np.testing.assert_allclose(dev["truePositivesByThreshold"],
+                               host["truePositivesByThreshold"], atol=0.5)
+    np.testing.assert_allclose(dev["precisionByThreshold"],
+                               host["precisionByThreshold"], atol=1e-4)
+
+
+def test_device_panel_matches_host_regression():
+    import jax.numpy as jnp
+    from transmogrifai_tpu.evaluators import OpRegressionEvaluator
+    rng = np.random.default_rng(8)
+    n = 1500
+    y = rng.normal(size=n)
+    yhat = y + rng.normal(scale=0.4, size=n)
+    ev = OpRegressionEvaluator()
+    host = ev.evaluate_all(y, {"prediction": yhat}).to_json()
+    dev = ev.evaluate_all_device(
+        jnp.asarray(y, jnp.float32),
+        {"prediction": jnp.asarray(yhat, jnp.float32)},
+        jnp.ones(n, jnp.float32)).to_json()
+    for k in ("RootMeanSquaredError", "MeanSquaredError",
+              "MeanAbsoluteError", "R2"):
+        assert abs(dev[k] - host[k]) < 1e-4, (k, dev[k], host[k])
+    assert sum(dev["SignedPercentageErrorHistogram"]["counts"]) == n
+
+
+def test_device_threshold_panel_unsorted_thresholds():
+    """Non-ascending custom thresholds must come back in caller order,
+    matching the host panel."""
+    import jax.numpy as jnp
+    from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
+    rng = np.random.default_rng(9)
+    n = 500
+    y = (rng.random(n) > 0.5).astype(np.float64)
+    s = np.clip(y * 0.5 + rng.normal(scale=0.3, size=n) + 0.25, 0, 1)
+    ev = OpBinaryClassificationEvaluator(thresholds=np.array([0.9, 0.5, 0.1]))
+    pred = {"prediction": (s > 0.5).astype(np.float64),
+            "probability": np.stack([1 - s, s], axis=1), "rawPrediction": None}
+    host = ev.evaluate_all(y, pred).to_json()
+    dev = ev.evaluate_all_device(
+        jnp.asarray(y, jnp.float32),
+        {"prediction": jnp.asarray(pred["prediction"], jnp.float32),
+         "scores": jnp.asarray(s, jnp.float32)},
+        jnp.ones(n, jnp.float32)).to_json()
+    np.testing.assert_allclose(dev["truePositivesByThreshold"],
+                               host["truePositivesByThreshold"], atol=0.5)
+    np.testing.assert_allclose(dev["falsePositivesByThreshold"],
+                               host["falsePositivesByThreshold"], atol=0.5)

@@ -243,3 +243,33 @@ def test_transmogrify_routes_specialized_kinds():
     col = out_batch[vec.name]
     arr = np.asarray(col.values)
     assert arr.shape[0] == 4 and arr.shape[1] >= 3
+
+
+def test_packaged_resources_loader():
+    """resources/ is the models-module analog: lazily-loaded JSON assets
+    (≙ models/src/main/resources/OpenNLP + OpenNLPModels.scala loader)."""
+    import pytest
+    from transmogrifai_tpu.resources import (gender_dictionary, honorifics,
+                                             lang_profiles, load_resource,
+                                             name_dictionary)
+    profiles = lang_profiles()
+    assert len(profiles) >= 18
+    assert "the" in profiles["en"] and "und" in profiles["de"]
+    g = gender_dictionary()
+    assert g["james"] == "Male" and g["maria"] == "Female"
+    names = name_dictionary()
+    assert {"smith", "tanaka", "ivanov", "james"} <= names
+    assert "dr" in honorifics()
+    with pytest.raises(FileNotFoundError, match="unknown resource"):
+        load_resource("nope.json")
+    # cached: same object back
+    assert load_resource("surnames.json") is load_resource("surnames.json")
+
+
+def test_lang_detector_russian_swedish():
+    """New profile languages detect (the old inline table had 7 languages)."""
+    from transmogrifai_tpu.ops.text_specialized import detect_languages
+    ru = detect_languages("и в не на я быть он с что по это она")
+    assert max(ru, key=ru.get) == "ru"
+    sv = detect_languages("och i att det som en på är av för med den")
+    assert max(sv, key=sv.get) == "sv"

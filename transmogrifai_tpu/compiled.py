@@ -1,0 +1,206 @@
+"""Compiled scoring — the fitted transformer DAG as ONE XLA program.
+
+The reference's score path bulk-applies row closures per layer and persists
+every K stages to break Catalyst (FitStagesUtil.scala:96,134-165).  Here the
+device-resident middle of the DAG — vectorizer models, VectorsCombiner,
+SanityChecker slice, the selected model's forward — is traced ONCE into a
+single jitted program: one compile, one host→device transfer of the frontier
+columns, one device→host transfer of the requested results per ``score()``
+call (SURVEY.md §2.6 P5: HBM residency replaces ``.persist()``).
+
+String/object-valued stages (tokenizers, validators, pick-list maps) cannot
+live in an XLA program; they run as a host prologue/epilogue around the
+compiled run.  A stage whose ``is_device_op`` flag is optimistic but whose
+transform turns out not to be traceable is demoted automatically (one retry,
+then it joins the host segments for the lifetime of the program).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+import numpy as np
+
+from .columns import Column, ColumnBatch
+from .stages.base import Transformer
+
+
+class _StageTraceError(Exception):
+    """Tracing failed inside a specific stage; carries the stage uid."""
+
+    def __init__(self, uid: str, cause: Exception):
+        super().__init__(uid)
+        self.uid = uid
+        self.cause = cause
+
+
+class ScoreProgram:
+    """A fitted DAG compiled for repeated scoring.
+
+    ``program = ScoreProgram(stages, result_names)`` then
+    ``scored = program(batch)`` — equivalent to ``apply_dag`` but the longest
+    contiguous run of device-traceable stages executes as one jitted XLA
+    program.  jax's jit cache keys on the frontier shapes, so calls with a
+    fixed schema compile exactly once.
+    """
+
+    def __init__(self, dag: Sequence, result_names: Sequence[str]):
+        # accept a layered DAG or a flat stage list; within a layer, order
+        # host ops before device ops (any within-layer order is topologically
+        # legal) so the contiguous device run swallows as much as possible
+        layers = ([list(l) for l in dag]
+                  if dag and isinstance(dag[0], (list, tuple)) else [list(dag)])
+        self.stages: List[Transformer] = []
+        for layer in layers:
+            self.stages.extend(sorted(layer, key=lambda s: s.is_device_op))
+        self.result_names = list(result_names)
+        self._demoted: Set[str] = set()   # uids proven untraceable
+        self._jitted: Dict[Tuple[str, ...], Any] = {}
+        self._metas: Dict[Tuple[str, ...], Dict[str, Any]] = {}
+
+    # -- partition ----------------------------------------------------------
+    def _partition(self, batch: ColumnBatch
+                   ) -> Tuple[List[Transformer], List[Transformer], List[Transformer]]:
+        """Split stages (already in topo order) into host-pre / device-run /
+        host-post, where the run is the longest contiguous stretch of stages
+        that are device ops over array-resident inputs."""
+        arrayish: Dict[str, bool] = {
+            name: batch[name].is_device for name in batch.names()}
+        flags: List[bool] = []
+        for st in self.stages:
+            ok = (st.is_device_op and st.uid not in self._demoted
+                  and all(arrayish.get(f.name, False)
+                          for f in st.input_features))
+            for f in st.output_features:
+                # host stages may still emit array columns (e.g. one-hot on
+                # strings); simulate with the same rule Column.is_device uses
+                arrayish[f.name] = True if ok else _kind_arrayish(f.kind)
+            flags.append(ok)
+        # longest contiguous True run
+        best_s = best_e = 0
+        s = None
+        for i, f in enumerate(flags + [False]):
+            if f and s is None:
+                s = i
+            elif not f and s is not None:
+                if i - s > best_e - best_s:
+                    best_s, best_e = s, i
+                s = None
+        return (self.stages[:best_s], self.stages[best_s:best_e],
+                self.stages[best_e:])
+
+    # -- execution ----------------------------------------------------------
+    def __call__(self, batch: ColumnBatch, keep_intermediate: bool = False
+                 ) -> ColumnBatch:
+        for _attempt in range(len(self.stages) + 1):
+            pre, run, post = self._partition(batch)
+            b = batch
+            for st in pre:
+                b = st.transform_batch(b)
+            if run:
+                try:
+                    b = self._apply_run(b, run, post, keep_intermediate)
+                except _StageTraceError as e:
+                    # demote the offending stage to the host segments and
+                    # re-partition; transforms are pure so re-running the
+                    # prologue on the original batch is safe
+                    self._demoted.add(e.uid)
+                    continue
+            for st in post:
+                b = st.transform_batch(b)
+            return b
+        raise RuntimeError("ScoreProgram failed to converge on a partition")
+
+    def _wanted_outputs(self, run: List[Transformer], post: List[Transformer],
+                        keep_intermediate: bool) -> List[str]:
+        produced = [f.name for st in run for f in st.output_features]
+        if keep_intermediate:
+            return produced
+        needed = set(self.result_names)
+        for st in post:
+            needed.update(f.name for f in st.input_features)
+        return [n for n in produced if n in needed]
+
+    def _apply_run(self, batch: ColumnBatch, run: List[Transformer],
+                   post: List[Transformer], keep_intermediate: bool
+                   ) -> ColumnBatch:
+        key = tuple(st.uid for st in run) + (keep_intermediate,)
+        frontier = sorted({f.name for st in run for f in st.input_features
+                           if f.name in batch})
+        # _partition simulates host-stage outputs by kind; validate against
+        # the actual columns and demote consumers of any misprediction (e.g.
+        # a numeric-kinded host stage that emitted an object array)
+        host_cols = [n for n in frontier if not batch[n].is_device]
+        if host_cols:
+            offender = next(st for st in run if any(
+                f.name in host_cols for f in st.input_features))
+            raise _StageTraceError(offender.uid, TypeError(
+                f"frontier columns {host_cols} are host-resident"))
+        out_names = self._wanted_outputs(run, post, keep_intermediate)
+        kinds = {n: batch[n].kind for n in frontier}
+        metas_in = {n: batch[n].meta for n in frontier}
+
+        if key not in self._jitted:
+            metas_out: Dict[str, Any] = {}
+
+            def traced(arrays: Dict[str, Tuple[Any, Any]]):
+                # row count from the traced arrays (NOT the captured batch:
+                # jit retraces on new shapes and closures would be stale)
+                v0 = next(iter(arrays.values()))[0]
+                n_rows = (next(iter(v0.values())).shape[0]
+                          if isinstance(v0, dict) else v0.shape[0])
+                cols = {n: Column(kinds[n], v, m, meta=metas_in[n])
+                        for n, (v, m) in arrays.items()}
+                b = ColumnBatch(dict(cols), n_rows)
+                for st in run:
+                    try:
+                        b = st.transform_batch(b)
+                    except Exception as e:  # noqa: BLE001 — demotion signal
+                        raise _StageTraceError(st.uid, e) from e
+                out = {}
+                for n in out_names:
+                    c = b[n]
+                    metas_out[n] = (c.meta, c.kind)
+                    out[n] = (c.values, c.mask)
+                return out
+
+            self._jitted[key] = jax.jit(traced)
+            self._metas[key] = metas_out
+
+        arrays = {n: (batch[n].values, batch[n].mask) for n in frontier}
+        try:
+            out = self._jitted[key](arrays)
+        except _StageTraceError:
+            self._jitted.pop(key, None)
+            self._metas.pop(key, None)
+            raise
+        except Exception:
+            # unexpected jit-boundary failure: never break scoring — run the
+            # segment eagerly (≙ apply_dag) and stop attempting to compile
+            self._jitted.pop(key, None)
+            self._metas.pop(key, None)
+            self._demoted.update(st.uid for st in run)
+            b = batch
+            for st in run:
+                b = st.transform_batch(b)
+            return b
+        metas_out = self._metas[key]
+        new_cols = {}
+        for n, (v, m) in out.items():
+            meta, kind = metas_out[n]
+            new_cols[n] = Column(kind, v, m, meta=meta)
+        return batch.with_columns(new_cols)
+
+
+def _kind_arrayish(kind) -> bool:
+    """Static analog of Column.is_device for a feature kind: does a column of
+    this kind hold dense arrays (vs host object arrays)?"""
+    from .types import Geolocation, OPVector, Prediction, is_numeric_kind
+    if kind is None:
+        return False
+    if issubclass(kind, (OPVector, Prediction, Geolocation)):
+        return True
+    if is_numeric_kind(kind):
+        return True
+    return False

@@ -91,9 +91,16 @@ def apply_dag(batch: ColumnBatch, dag: List[StageLayer],
 
 def cut_dag(dag: List[StageLayer], selector) -> Tuple[List[StageLayer], List[StageLayer], List[StageLayer]]:
     """Split the DAG into (before, during, after) relative to a ModelSelector
-    (≙ FitStagesUtil.cutDAG:304) for workflow-level cross-validation: 'during'
-    holds the feature-engineering stages that must be refit inside each fold to
-    avoid leakage; 'before' is everything upstream shared by all folds."""
+    for workflow-level cross-validation (≙ FitStagesUtil.cutDAG:304-356).
+
+    Reference semantics: label leakage flows only through stages that consume
+    BOTH a response and a non-response input (SanityChecker and friends), so
+    'during' — the sub-DAG refit inside every fold — is the selector's
+    ancestor DAG from the first such label-consuming layer onward
+    (``firstCVTSIndex``, FitStagesUtil.scala:333-337).  Everything upstream of
+    that layer ('before') is fit once on the full data, even estimators,
+    exactly as the reference does; side branches feeding other result features
+    also stay in 'before' (the ``nonMSDAG - CVTSDAG`` rule, :344-349)."""
     sel_layer_idx = None
     for i, layer in enumerate(dag):
         if any(s is selector for s in layer):
@@ -101,15 +108,36 @@ def cut_dag(dag: List[StageLayer], selector) -> Tuple[List[StageLayer], List[Sta
             break
     if sel_layer_idx is None:
         return dag, [], []
-    # Estimators feeding the selector (directly or transitively after the last
-    # upstream estimator barrier) must be refit per fold.  The reference cuts at
-    # the last layer containing no estimators before the selector; we do the
-    # same simple cut: 'during' = contiguous estimator-containing layers
-    # immediately preceding the selector.
-    start = sel_layer_idx
-    while start > 0 and any(isinstance(s, Estimator) for s in dag[start - 1]):
-        start -= 1
-    before = dag[:start]
-    during = dag[start:sel_layer_idx]
+
+    # the selector's own ancestor DAG, deepest-first, selector layer dropped
+    anc_layers = compute_dag(selector.output_features)
+    if anc_layers and any(s is selector for s in anc_layers[-1]):
+        anc_layers = anc_layers[:-1]
+
+    def consumes_label_and_features(stage) -> bool:
+        ins = stage.input_features
+        return (any(f.is_response for f in ins)
+                and any(not f.is_response for f in ins))
+
+    first = next((i for i, layer in enumerate(anc_layers)
+                  if any(consumes_label_and_features(s) for s in layer)), -1)
+    during_stages = (set() if first < 0 else
+                     {s for layer in anc_layers[first:] for s in layer})
+
+    # side branches consuming a 'during' output must follow it into 'during':
+    # leaving them in 'before' would run them ahead of their producer.  One
+    # forward pass suffices — layers are topologically ordered.
+    during_out = {f.name for s in during_stages for f in s.output_features}
+    for layer in dag[:sel_layer_idx]:
+        for s in layer:
+            if s not in during_stages and any(
+                    f.name in during_out for f in s.input_features):
+                during_stages.add(s)
+                during_out.update(f.name for f in s.output_features)
+
+    before = [[s for s in layer if s not in during_stages]
+              for layer in dag[:sel_layer_idx]]
+    during = [[s for s in layer if s in during_stages]
+              for layer in dag[:sel_layer_idx]]
     after = dag[sel_layer_idx:]
-    return before, during, after
+    return ([l for l in before if l], [l for l in during if l], after)

@@ -165,6 +165,13 @@ class OpEvaluatorBase:
         has no device implementation (caller falls back to the host path)."""
         return None
 
+    def evaluate_all_device(self, y_dev, device_out: Dict[str, Any],
+                            w_dev) -> Optional[EvaluationMetrics]:
+        """Device fast path for the FULL metric panel (≙ evaluate_all): every
+        reduction runs in HBM and only scalars cross the host link.  Returns
+        None when unavailable (caller falls back to the host path)."""
+        return None
+
 
 class OpBinaryClassificationEvaluator(OpEvaluatorBase):
     """≙ OpBinaryClassificationEvaluator.scala:67-185."""
@@ -205,11 +212,7 @@ class OpBinaryClassificationEvaluator(OpEvaluatorBase):
                                      masked_binary_confusion)
         m = self.default_metric
         if m in ("AuROC", "AuPR"):
-            s = device_out.get("scores")
-            if s is None:
-                p = device_out.get("probability")
-                if p is not None and getattr(p, "ndim", 0) == 2 and p.shape[1] == 2:
-                    s = p[:, 1]
+            s = self._device_scores_vec(device_out)
             if s is None:
                 return None
             fn = masked_auroc if m == "AuROC" else masked_aupr
@@ -231,6 +234,56 @@ class OpBinaryClassificationEvaluator(OpEvaluatorBase):
                         if precision + recall > 0 else 0.0)
             return (fp + fn_) / max(tp + fp + tn + fn_, 1.0)
         return None
+
+    @staticmethod
+    def _device_scores_vec(device_out):
+        s = device_out.get("scores")
+        if s is None:
+            p = device_out.get("probability")
+            if p is not None and getattr(p, "ndim", 0) == 2 and p.shape[1] == 2:
+                s = p[:, 1]
+        return s
+
+    def evaluate_all_device(self, y_dev, device_out, w_dev):
+        from .metrics_device import (masked_aupr, masked_auroc,
+                                     masked_binary_confusion,
+                                     masked_threshold_confusion)
+        s = self._device_scores_vec(device_out)
+        pred = device_out.get("prediction")
+        if s is None or pred is None:
+            return None
+        import jax.numpy as jnp
+        conf = masked_binary_confusion(y_dev, pred, w_dev)
+        au_roc = masked_auroc(y_dev, s, w_dev)
+        au_pr = masked_aupr(y_dev, s, w_dev)
+        # the device path buckets with searchsorted, which needs ascending
+        # thresholds; sort, then un-permute the panel back to caller order
+        thr_np = np.asarray(self.thresholds, dtype=np.float64)
+        order = np.argsort(thr_np, kind="stable")
+        thr = masked_threshold_confusion(
+            y_dev, s, w_dev, jnp.asarray(thr_np[order], jnp.float32))
+        # one scalar-block d2h transfer for the whole panel
+        tp, fp, tn, fn = (float(v) for v in np.asarray(conf))
+        inv = np.empty_like(order)
+        inv[order] = np.arange(len(order))
+        ttp, tfp, ttn, tfn = np.asarray(thr, dtype=np.float64)[:, inv]
+        precision = tp / (tp + fp) if tp + fp > 0 else 0.0
+        recall = tp / (tp + fn) if tp + fn > 0 else 0.0
+        n = max(tp + fp + tn + fn, 1.0)
+        m = {"TP": tp, "TN": tn, "FP": fp, "FN": fn,
+             "Precision": precision, "Recall": recall,
+             "F1": (2 * precision * recall / (precision + recall)
+                    if precision + recall > 0 else 0.0),
+             "Error": (fp + fn) / n,
+             "AuROC": float(au_roc), "AuPR": float(au_pr),
+             "thresholds": np.asarray(self.thresholds).tolist(),
+             "precisionByThreshold": (ttp / np.maximum(ttp + tfp, 1.0)).tolist(),
+             "recallByThreshold": (ttp / np.maximum(ttp + tfn, 1.0)).tolist(),
+             "truePositivesByThreshold": ttp.tolist(),
+             "falsePositivesByThreshold": tfp.tolist(),
+             "trueNegativesByThreshold": ttn.tolist(),
+             "falseNegativesByThreshold": tfn.tolist()}
+        return EvaluationMetrics(m)
 
 
 class OpMultiClassificationEvaluator(OpEvaluatorBase):
@@ -370,6 +423,36 @@ class OpRegressionEvaluator(OpEvaluatorBase):
         return {"RootMeanSquaredError": float(np.sqrt(mse)),
                 "MeanSquaredError": mse,
                 "MeanAbsoluteError": mae}[self.default_metric]
+
+    def evaluate_all_device(self, y_dev, device_out, w_dev):
+        pred = device_out.get("prediction")
+        if pred is None:
+            return None
+        import jax.numpy as jnp
+        from .metrics_device import masked_reg_errors
+        mse, mae = (float(v) for v in np.asarray(
+            masked_reg_errors(y_dev, pred, w_dev)))
+        wsum = jnp.maximum(jnp.sum(w_dev), 1e-12)
+        ym = jnp.sum(w_dev * y_dev) / wsum
+        var = float(jnp.sum(w_dev * (y_dev - ym) ** 2) / wsum)
+        # residual histogram on device: static bin count, one [bins] transfer
+        err = (pred - y_dev)
+        lo = float(jnp.min(jnp.where(w_dev > 0, err, jnp.inf)))
+        hi = float(jnp.max(jnp.where(w_dev > 0, err, -jnp.inf)))
+        edges = np.linspace(lo, hi if hi > lo else lo + 1.0, self.hist_bins + 1)
+        idx = jnp.clip(jnp.searchsorted(jnp.asarray(edges[1:-1]), err,
+                                        side="right"), 0, self.hist_bins - 1)
+        import jax
+        counts = jax.ops.segment_sum(w_dev, idx, num_segments=self.hist_bins)
+        return EvaluationMetrics({
+            "RootMeanSquaredError": float(np.sqrt(mse)),
+            "MeanSquaredError": mse,
+            "MeanAbsoluteError": mae,
+            "R2": 1.0 - mse / var if var > 0 else 0.0,
+            "SignedPercentageErrorHistogram": {
+                "counts": [int(c) for c in np.asarray(counts)],
+                "bins": edges.tolist()},
+        })
 
 
 class OpForecastEvaluator(OpEvaluatorBase):

@@ -98,3 +98,28 @@ def masked_multiclass_confusion(y: jnp.ndarray, yhat: jnp.ndarray,
     yo = jax.nn.one_hot(y.astype(jnp.int32), n_classes, dtype=jnp.float32)
     ho = jax.nn.one_hot(yhat.astype(jnp.int32), n_classes, dtype=jnp.float32)
     return (yo * w[:, None]).T @ ho
+
+
+@jax.jit
+def masked_threshold_confusion(y: jnp.ndarray, scores: jnp.ndarray,
+                               w: jnp.ndarray, thresholds: jnp.ndarray):
+    """Per-threshold [4, T] weighted (tp, fp, tn, fn) in one fused program:
+    scores are bucketed into the threshold grid with searchsorted, then the
+    per-threshold counts are suffix sums of a [T+1]-bin histogram — no [T, N]
+    broadcast ever materializes (≙ the reference evaluator's
+    thresholds panel, OpBinaryClassificationEvaluator.scala:67-185)."""
+    wpos = w * (y > 0.5)
+    wneg = w * (y <= 0.5)
+    # bin i ⇔ thresholds[i-1] <= s < thresholds[i]; prediction at threshold t
+    # is s >= t, so counts at t = sum of bins >= its index (suffix sum)
+    bins = jnp.searchsorted(thresholds, scores, side="right")
+    T = thresholds.shape[0]
+    pos_hist = jax.ops.segment_sum(wpos, bins, num_segments=T + 1)
+    neg_hist = jax.ops.segment_sum(wneg, bins, num_segments=T + 1)
+    pos_suffix = jnp.cumsum(pos_hist[::-1])[::-1]
+    neg_suffix = jnp.cumsum(neg_hist[::-1])[::-1]
+    tp = pos_suffix[1:]
+    fp = neg_suffix[1:]
+    n_pos = jnp.sum(wpos)
+    n_neg = jnp.sum(wneg)
+    return jnp.stack([tp, fp, n_neg - fp, n_pos - tp])

@@ -22,8 +22,16 @@ from .solvers import (FitResult, fista_fit, linear_grid_fit, naive_bayes_fit,
                       ridge_fit, ridge_grid_fit, standardize, unscale_params)
 
 
-def _n_classes(y: np.ndarray) -> int:
-    return int(np.max(y)) + 1 if len(y) else 2
+def _n_classes(y) -> int:
+    if not len(y):
+        return 2
+    import jax
+    if isinstance(y, jax.Array):
+        # reduce on device: np.max on a device array round-trips the whole
+        # column over the (slow) accelerator link — measured 16s at 1M rows
+        # on the tunneled TPU vs one d2h scalar here
+        return int(jnp.max(y)) + 1
+    return int(np.max(y)) + 1
 
 
 def _grouped_grid_fit(est, X, y, fold_weights, grids, *, loss: str,

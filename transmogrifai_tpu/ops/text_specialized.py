@@ -699,22 +699,13 @@ class JaccardSimilarity(Transformer):
 # profile scoring)
 # ---------------------------------------------------------------------------
 
-_LANG_PROFILES: Dict[str, Set[str]] = {
-    "en": {"the", "and", "of", "to", "in", "is", "that", "it", "was", "for",
-           "with", "as", "his", "on", "be", "at", "by", "had", "not", "are"},
-    "fr": {"le", "la", "les", "de", "des", "et", "un", "une", "du", "est",
-           "que", "dans", "pour", "qui", "sur", "pas", "avec", "au", "il"},
-    "de": {"der", "die", "das", "und", "ist", "ein", "eine", "nicht", "mit",
-           "von", "den", "auf", "für", "im", "des", "sich", "dem", "zu"},
-    "es": {"el", "la", "los", "las", "de", "y", "en", "que", "un", "una",
-           "es", "del", "por", "con", "para", "su", "se", "no", "al"},
-    "it": {"il", "la", "di", "e", "che", "un", "una", "per", "in", "del",
-           "della", "con", "non", "sono", "da", "le", "si", "dei"},
-    "pt": {"o", "a", "os", "as", "de", "e", "que", "um", "uma", "do", "da",
-           "em", "para", "com", "não", "por", "no", "na", "se"},
-    "nl": {"de", "het", "een", "van", "en", "in", "is", "dat", "op", "te",
-           "met", "voor", "niet", "aan", "er", "maar", "zijn", "ook"},
-}
+def _lang_profiles() -> Dict[str, Set[str]]:
+    """Packaged per-language stop-word profiles (18 languages) — loaded from
+    the resources module, the analog of Optimaize's language profiles shipped
+    in the reference's models module (see resources/__init__.py)."""
+    from ..resources import lang_profiles
+    return lang_profiles()
+
 
 _WORD_RE = re.compile(r"[^\W\d_]+", re.UNICODE)
 
@@ -727,7 +718,7 @@ def detect_languages(s: str) -> Dict[str, float]:
     if not tokens:
         return {}
     scores = {}
-    for lang, profile in _LANG_PROFILES.items():
+    for lang, profile in _lang_profiles().items():
         hits = sum(1 for t in tokens if t in profile)
         if hits:
             scores[lang] = hits / len(tokens)
@@ -759,40 +750,77 @@ class LangDetector(Transformer):
 # OpenNLP models replaced by dictionaries + heuristics)
 # ---------------------------------------------------------------------------
 
-# compact first-name → gender dictionary (≙ NameDetectUtils.DefaultGenderDictionary)
-GENDER_DICT: Dict[str, str] = {
-    "james": "Male", "john": "Male", "robert": "Male", "michael": "Male",
-    "william": "Male", "david": "Male", "richard": "Male", "joseph": "Male",
-    "thomas": "Male", "charles": "Male", "daniel": "Male", "matthew": "Male",
-    "anthony": "Male", "mark": "Male", "paul": "Male", "steven": "Male",
-    "andrew": "Male", "kenneth": "Male", "george": "Male", "kevin": "Male",
-    "brian": "Male", "edward": "Male", "peter": "Male", "jose": "Male",
-    "carlos": "Male", "juan": "Male", "luis": "Male", "ahmed": "Male",
-    "mohammed": "Male", "ali": "Male", "chen": "Male", "wei": "Male",
-    "mary": "Female", "patricia": "Female", "jennifer": "Female",
-    "linda": "Female", "elizabeth": "Female", "barbara": "Female",
-    "susan": "Female", "jessica": "Female", "sarah": "Female",
-    "karen": "Female", "nancy": "Female", "lisa": "Female", "betty": "Female",
-    "margaret": "Female", "sandra": "Female", "ashley": "Female",
-    "emily": "Female", "donna": "Female", "michelle": "Female",
-    "carol": "Female", "amanda": "Female", "maria": "Female",
-    "laura": "Female", "anna": "Female", "emma": "Female", "olivia": "Female",
-    "sophia": "Female", "fatima": "Female", "aisha": "Female", "mei": "Female",
-}
+class _LazyMapping:
+    """Dict/set-like view over a packaged resource, loaded on first use
+    (≙ OpenNLPModels' lazily-loaded model cache).  Supports the read API of
+    the dict/set constants it replaces: ``in``, iteration, ``len``, ``get``,
+    ``[]``, ``keys``/``items``, and set union/intersection."""
+
+    def __init__(self, loader):
+        self._loader = loader
+        self._data = None
+
+    def _load(self):
+        if self._data is None:
+            self._data = self._loader()
+        return self._data
+
+    def __contains__(self, item):
+        return item in self._load()
+
+    def __iter__(self):
+        return iter(self._load())
+
+    def __len__(self):
+        return len(self._load())
+
+    def __getitem__(self, key):
+        return self._load()[key]
+
+    def get(self, key, default=None):
+        d = self._load()
+        return d.get(key, default) if hasattr(d, "get") else default
+
+    def keys(self):
+        d = self._load()
+        return d.keys() if hasattr(d, "keys") else iter(d)
+
+    def items(self):
+        return self._load().items()
+
+    def __or__(self, other):
+        return set(self._load()) | set(other)
+
+    def __and__(self, other):
+        return set(self._load()) & set(other)
+
+
+def _load_gender():
+    from ..resources import gender_dictionary
+    return gender_dictionary()
+
+
+def _load_names():
+    from ..resources import name_dictionary
+    return name_dictionary()
+
+
+# first-name → gender dictionary (≙ NameDetectUtils.DefaultGenderDictionary)
+GENDER_DICT = _LazyMapping(_load_gender)
 
 # surname + first-name union (≙ NameDetectUtils.DefaultNameDictionary)
-NAME_DICT: Set[str] = set(GENDER_DICT) | set("""smith johnson williams brown
-jones garcia miller davis rodriguez martinez hernandez lopez gonzalez wilson
-anderson thomas taylor moore jackson martin lee perez thompson white harris
-sanchez clark ramirez lewis robinson walker young allen king wright scott
-torres nguyen hill flores green adams nelson baker hall rivera campbell
-mitchell carter roberts kim chen wang li zhang liu singh kumar patel""".split())
+NAME_DICT = _LazyMapping(_load_names)
 
 
 def _name_tokens(s: Optional[str]) -> List[str]:
+    """Lower-cased word tokens with salutations stripped (≙ NameDetectUtils
+    preprocessing: honorifics like 'Dr.'/'Mrs.' never count as name hits)."""
     if not s:
         return []
-    return [t.lower() for t in re.findall(r"[A-Za-z']+", s)]
+    from ..resources import honorifics
+    hon = honorifics()
+    return [t.lower() for t in re.findall(r"[A-Za-z']+", s)
+            if t.lower() not in hon]
 
 
 class HumanNameDetectorModel(TransformerModel):

@@ -1,0 +1,59 @@
+"""Packaged NLP model resources — the analog of the reference's `models`
+module (models/src/main/resources/OpenNLP/*.bin, loaded lazily by
+core/.../utils/text/OpenNLPModels.scala).
+
+Where the reference ships OpenNLP binaries (NER/sentence/tokenizer/POS) and
+Optimaize language profiles, this package ships JSON data files consumed by
+the specialized text stages (ops/text_specialized.py):
+
+  * ``lang_profiles.json``  — per-language stop-word profiles (18 languages)
+    for LangDetector (≙ Optimaize profiles).
+  * ``name_gender.json``    — first-name → gender dictionary for
+    HumanNameDetector (≙ NameDetectUtils.DefaultGenderDictionary).
+  * ``surnames.json``       — surname list (≙ DefaultNameDictionary).
+  * ``honorifics.json``     — salutation tokens stripped in name parsing.
+
+Resources load lazily and cache per-process, like OpenNLPModels' model cache.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+from typing import Any
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+@functools.lru_cache(maxsize=None)
+def load_resource(name: str) -> Any:
+    """Load + cache a packaged JSON resource by file name (≙
+    OpenNLPModels.loadModel)."""
+    path = os.path.join(_DIR, name)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"unknown resource {name!r}; available: "
+            f"{sorted(f for f in os.listdir(_DIR) if f.endswith('.json'))}")
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+@functools.lru_cache(maxsize=None)
+def lang_profiles() -> dict:
+    """language → set of profile stop-words (cached: LangDetector consults
+    this per row)."""
+    return {k: set(v) for k, v in load_resource("lang_profiles.json").items()}
+
+
+def gender_dictionary() -> dict:
+    return dict(load_resource("name_gender.json"))
+
+
+def name_dictionary() -> set:
+    return set(load_resource("name_gender.json")) | set(
+        load_resource("surnames.json"))
+
+
+def honorifics() -> set:
+    return set(load_resource("honorifics.json"))

@@ -236,6 +236,59 @@ class ModelSelector(Estimator):
                                        in_fold_dag=in_fold_dag,
                                        splitter=self.splitter)
 
+    def _refit_reusing_grid_executable(self, result, X, y):
+        """Final full-data refit through the SAME batched (fold × grid)
+        program the CV already compiled: with identical array shapes (all-ones
+        fold weights [F, N], the winner's params padded to the family's grid
+        width G) jax's executable cache hits and the refit costs F·G redundant
+        cheap fits instead of compiling + loading a fresh single-fit program —
+        on the tunneled TPU the compile/load dwarfs the compute.  Returns None
+        (→ caller falls back to ``fit_arrays``) when the shapes differ (e.g. a
+        Balancer resampled the train set) or anything goes wrong."""
+        shape = getattr(self.validator, "last_fit_shape", None)
+        if shape is None or shape[1] != X.shape[0]:
+            return None
+        cand = next((c for c in self.models
+                     if c.model_name == result.best.model_name), None)
+        if cand is None or not cand.grid:
+            return None
+        try:
+            F = shape[0]
+            W = np.ones((F, X.shape[0]), np.float32)
+            grids = [dict(result.best_params)] * len(cand.grid)
+            return cand.estimator.fit_arrays_grid(X, y, W, grids)[0][0]
+        except Exception:  # noqa: BLE001 — reuse is an optimization only
+            return None
+
+    def _evaluate_all(self, model, X, y) -> Dict[str, Any]:
+        """All-evaluator panel; device reductions when X is device-resident."""
+        import jax
+        import jax.numpy as jnp
+
+        out: Dict[str, Any] = {}
+        dev_out = y_dev = w_dev = None
+        if isinstance(X, jax.Array) and hasattr(model, "device_scores"):
+            try:
+                dev_out = model.device_scores(X, full=True)
+                y_dev = jnp.asarray(y, jnp.float32)
+                w_dev = jnp.ones_like(y_dev)
+            except Exception:  # noqa: BLE001 — fall back to host
+                dev_out = None
+        pred = None
+        for ev in self.evaluators:
+            em = None
+            if dev_out is not None:
+                try:
+                    em = ev.evaluate_all_device(y_dev, dev_out, w_dev)
+                except Exception:  # noqa: BLE001
+                    em = None
+            if em is None:
+                if pred is None:
+                    pred = model.predict_arrays(X)
+                em = ev.evaluate_all(y, pred)
+            out[ev.name] = em.to_json()
+        return out
+
     def fit(self, batch: ColumnBatch, in_fold_dag=None) -> SelectedModel:
         label_f, feats_f = self.input_features
         label = label_f.name
@@ -252,21 +305,20 @@ class ModelSelector(Estimator):
             train_batch = self.splitter.validation_prepare(batch, label)
         best_est: PredictorEstimator = result.best.estimator
         X, y = extract_xy(train_batch, label_f, feats_f)
-        fitted = best_est.fit_arrays(X, y)
+        fitted = self._refit_reusing_grid_executable(result, X, y)
+        if fitted is None:
+            fitted = best_est.fit_arrays(X, y)
         best_model = best_est.model_cls(fitted=fitted, **best_est._params)
 
-        # evaluate all evaluators on the training data (≙ trainEvaluation)
-        pred = best_model.predict_arrays(X)
-        train_eval: Dict[str, Any] = {}
-        for ev in self.evaluators:
-            train_eval[ev.name] = ev.evaluate_all(y, pred).to_json()
+        # evaluate all evaluators on the training data (≙ trainEvaluation) —
+        # on device when possible: pulling 1M-row prediction vectors over the
+        # host link costs more than the whole grid's compute
+        train_eval = self._evaluate_all(best_model, X, y)
 
         holdout_eval = None
         if holdout is not None and len(holdout):
             Xh, yh = extract_xy(holdout, label_f, feats_f)
-            ph = best_model.predict_arrays(Xh)
-            holdout_eval = {ev.name: ev.evaluate_all(yh, ph).to_json()
-                            for ev in self.evaluators}
+            holdout_eval = self._evaluate_all(best_model, Xh, yh)
             self.holdout_eval = holdout_eval
 
         summary = ModelSelectorSummary(

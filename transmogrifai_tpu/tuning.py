@@ -335,6 +335,9 @@ class OpValidator:
         import jax.numpy as jnp
 
         y32 = np.asarray(y_all, dtype=np.float32)
+        # shape of the fold-weight mask used for the batched fits — the final
+        # refit reuses it to hit the SAME compiled executable (shape-keyed)
+        self.last_fit_shape = None if in_fold_dag else (len(splits), len(y32))
         for X, fsplits in fold_groups():
             N = X.shape[0]
             is_dev = isinstance(X, jax.Array)
@@ -354,9 +357,9 @@ class OpValidator:
                     vm = np.zeros(N, np.float32)
                     vm[va_idx] = 1.0
                     va_masks_dev.append(jnp.asarray(vm))
-            for ci, cand in enumerate(candidates):
+            def fit_candidate(cand):
                 try:
-                    fitted_grid = cand.estimator.fit_arrays_grid(
+                    return cand.estimator.fit_arrays_grid(
                         X, y32, W, cand.grid)
                 except Exception:  # noqa: BLE001
                     # batched fit failed as a block — retry per point so one
@@ -375,6 +378,23 @@ class OpValidator:
                             except Exception:  # noqa: BLE001
                                 row.append(None)
                         fitted_grid.append(row)
+                    return fitted_grid
+
+            # candidate families fit concurrently on a thread pool (≙ the
+            # reference's Futures fan-out, OpValidator.scala:320-349 +
+            # `parallelism` :106).  Device execution serializes on the TPU
+            # stream; the win is overlapping the XLA *compiles* of the
+            # per-family batched programs, which dominate first-run wall.
+            n_workers = min(self.parallelism, len(candidates))
+            if n_workers > 1:
+                from concurrent.futures import ThreadPoolExecutor
+                with ThreadPoolExecutor(max_workers=n_workers) as pool:
+                    fitted_grids = list(pool.map(fit_candidate, candidates))
+            else:
+                fitted_grids = [fit_candidate(c) for c in candidates]
+
+            for ci, cand in enumerate(candidates):
+                fitted_grid = fitted_grids[ci]
                 for f, va_idx in enumerate(va_slices):
                     X_va = y_va = None
                     for gi, params in enumerate(cand.grid):

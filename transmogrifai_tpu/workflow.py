@@ -215,21 +215,18 @@ class Workflow(_WorkflowCore):
         for layer in before:
             batch, fitted = fit_layer(batch, layer)
             fitted_dag.append(fitted)
-        # 'during' estimators are refit per fold by the validator, then once
-        # on the full data for the final model
+        # 'during' estimators are refit per fold by the validator; fit them on
+        # the full data first (the final model's feature stages) so every
+        # 'after' stage — selector or side branch, in any within-layer order —
+        # sees its inputs materialized
+        for dl in during:
+            batch, f2 = fit_layer(batch, dl)
+            fitted_dag.append(f2)
         for layer in after:
             new_layer = []
             for st in layer:
                 if st is selector:
-                    # fit remaining 'during' stages on the full data first
-                    b2 = batch
-                    during_fitted = []
-                    for dl in during:
-                        b2, f2 = fit_layer(b2, dl)
-                        during_fitted.append(f2)
-                    model = selector.fit(b2, in_fold_dag=during)
-                    fitted_dag.extend(during_fitted)
-                    batch = b2
+                    model = selector.fit(batch, in_fold_dag=during)
                     new_layer.append(model)
                     batch = model.transform_batch(batch)
                 else:
@@ -286,6 +283,19 @@ class WorkflowModel(_WorkflowCore):
         return None
 
     # -- scoring -----------------------------------------------------------
+    def score_program(self):
+        """The fitted DAG compiled for repeated scoring: host prologue →
+        ONE jitted XLA program over the device-resident middle → host
+        epilogue (≙ the reference's bulk applyOpTransformations row map,
+        FitStagesUtil.scala:96, minus the persist-every-K hacks).  Cached on
+        the model; jit re-uses the executable across calls with one compile
+        per input shape."""
+        if getattr(self, "_score_program", None) is None:
+            from .compiled import ScoreProgram
+            self._score_program = ScoreProgram(
+                self.fitted_dag, [f.name for f in self.result_features])
+        return self._score_program
+
     def score(self, batch: Optional[ColumnBatch] = None,
               keep_raw_features: bool = False,
               keep_intermediate_features: bool = False) -> ColumnBatch:
@@ -293,7 +303,8 @@ class WorkflowModel(_WorkflowCore):
         DAG and return the result-feature columns."""
         if batch is None:
             batch = self.generate_raw_data()
-        scored = apply_dag(batch, self.fitted_dag)
+        scored = self.score_program()(
+            batch, keep_intermediate=keep_intermediate_features)
         names = [f.name for f in self.result_features if f.name in scored]
         if keep_intermediate_features:
             return scored
@@ -314,14 +325,59 @@ class WorkflowModel(_WorkflowCore):
         """≙ OpWorkflowModel.evaluate:320."""
         if batch is None:
             batch = self.generate_raw_data()
-        scored = apply_dag(batch, self.fitted_dag)
         label = label_feature or next(
-            f for f in self.raw_features if f.is_response)
-        pred_f = next(f for f in self.result_features
-                      if f.kind is Prediction or
-                      (f.name in scored and isinstance(scored[f.name].values, dict)))
-        y = np.asarray(scored[label.name].values, dtype=np.float64)
+            (f for f in self.raw_features if f.is_response), None)
+        if label is None:
+            raise ValueError(
+                "evaluate: no response feature in the model's raw features — "
+                "pass label_feature explicitly")
+        try:
+            scored = self.score_program()(batch)
+        except KeyError as e:
+            raise ValueError(
+                f"evaluate: column {e.args[0]!r} required by the DAG is "
+                "missing from the scoring data — evaluation needs labelled "
+                "rows (use score() for label-free data)") from e
+        has_intermediate = False
+        if label.name not in scored:
+            # a DAG-computed label (e.g. an indexed text response) may live in
+            # an intermediate column the lean score pass dropped
+            scored = self.score_program()(batch, keep_intermediate=True)
+            has_intermediate = True
+        if label.name not in scored:
+            raise ValueError(
+                f"evaluate: response column {label.name!r} is not present in "
+                "the scoring data — evaluation needs labelled rows (use "
+                "score() for label-free data)")
+        pred_f = next(
+            (f for f in self.result_features if f.kind is Prediction), None)
+        if pred_f is None:
+            # fallback: any dict-valued (Prediction-shaped) result column
+            if not has_intermediate:
+                scored = self.score_program()(batch, keep_intermediate=True)
+            pred_f = next(
+                (f for f in self.result_features
+                 if f.name in scored and isinstance(scored[f.name].values, dict)),
+                None)
+        if pred_f is None:
+            raise ValueError(
+                "evaluate: no Prediction-typed result feature on this model; "
+                f"result features: {[f.name for f in self.result_features]}")
         pred_col = scored[pred_f.name]
+        import jax
+        if any(isinstance(v, jax.Array) for v in pred_col.values.values()):
+            # device-resident scores (the compiled score program keeps them in
+            # HBM): run the whole metric panel as device reductions — only
+            # scalars cross the host link
+            import jax.numpy as jnp
+            y_dev = jnp.asarray(
+                np.asarray(scored[label.name].values, dtype=np.float32))
+            dev_out = dict(pred_col.values)
+            em = evaluator.evaluate_all_device(
+                y_dev, dev_out, jnp.ones_like(y_dev))
+            if em is not None:
+                return em.to_json()
+        y = np.asarray(scored[label.name].values, dtype=np.float64)
         pred = {k: np.asarray(v) for k, v in pred_col.values.items()}
         for opt in ("probability", "rawPrediction"):
             pred.setdefault(opt, None)

@@ -1,0 +1,171 @@
+"""Event/CutOffTime time-window aggregation (≙ features/.../aggregators/
+Event.scala, CutOffTime.scala, TimeBasedAggregator + AggregateDataReaderTest)
+and the SequenceAggregators utility (≙ utils/spark/SequenceAggregators.scala)."""
+
+import numpy as np
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.aggregators import (CutOffTime, Event,
+                                           split_events_at_cutoff)
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.readers.base import AggregateParams, AggregateReader
+from transmogrifai_tpu.utils.sequence_aggregators import (
+    count_maps_by_key, mean_by_position, mean_maps_by_key, mode_by_position,
+    mode_maps_by_key, sum_by_position, sum_maps_by_key)
+
+DAY = 24 * 60 * 60 * 1000
+
+
+def test_cutoff_time_factories():
+    assert CutOffTime.no_cutoff().timestamp_ms() is None
+    assert CutOffTime.unix_epoch(123456).timestamp_ms() == 123456
+    # 04051999 = 1999-05-04 UTC midnight = 10715 days * 86400000 ms
+    ts = CutOffTime.dd_mm_yyyy("04051999").timestamp_ms()
+    assert ts == 10715 * 86400000
+    now = 100 * DAY
+    assert CutOffTime.days_ago(10).timestamp_ms(now_ms=now) == 90 * DAY
+
+
+def test_split_events_windows():
+    evs = [Event(t * DAY, t) for t in range(10)]
+    pred, resp = split_events_at_cutoff(evs, 5 * DAY)
+    assert [e.value for e in pred] == [0, 1, 2, 3, 4]
+    assert [e.value for e in resp] == [5, 6, 7, 8, 9]
+    # trailing predictor window: only 2 days of history
+    pred, _ = split_events_at_cutoff(evs, 5 * DAY, predictor_window_ms=2 * DAY)
+    assert [e.value for e in pred] == [3, 4]
+    # leading response window
+    _, resp = split_events_at_cutoff(evs, 5 * DAY, response_window_ms=2 * DAY)
+    assert [e.value for e in resp] == [5, 6]
+    # no cutoff: everything is history
+    pred, resp = split_events_at_cutoff(evs, None)
+    assert len(pred) == 10 and resp == []
+
+
+def test_aggregate_reader_with_cutoff_time():
+    """Predictors sum events before the cutoff; the response takes events
+    after; a per-feature .window() narrows a predictor's history."""
+    records = []
+    for day, amt, label in [(1, 10.0, 0.0), (2, 20.0, 0.0), (3, 30.0, 0.0),
+                            (6, 99.0, 1.0)]:
+        records.append({"id": "u1", "timestamp": day * DAY,
+                        "amount": amt, "label": label})
+    records.append({"id": "u2", "timestamp": 2 * DAY,
+                    "amount": 5.0, "label": 0.0})
+    records.append({"id": "u2", "timestamp": 7 * DAY,
+                    "amount": 0.0, "label": 0.0})
+
+    amount = FeatureBuilder.Real("amount").extract(
+        lambda r: r.get("amount")).as_predictor()
+    recent = (FeatureBuilder.Real("recent")
+              .extract(lambda r: r.get("amount"))
+              .window(2 * DAY).as_predictor())
+    label = FeatureBuilder.RealNN("label").extract(
+        lambda r: r.get("label")).as_response()
+
+    reader = AggregateReader(
+        records=records, key_fn=lambda r: r["id"],
+        aggregate_params=AggregateParams(
+            cutoff_time=CutOffTime.unix_epoch(5 * DAY)))
+    batch = reader.generate_batch([amount, recent, label])
+    keys = list(batch["key"].values)
+    i1, i2 = keys.index("u1"), keys.index("u2")
+    # u1: amounts before day 5 sum to 60; the trailing 2-day window keeps only
+    # events with t >= day 3 — just the day-3 amount
+    assert float(np.asarray(batch["amount"].values)[i1]) == 60.0
+    assert float(np.asarray(batch["recent"].values)[i1]) == 30.0
+    # u1 response: the day-6 event
+    assert float(np.asarray(batch["label"].values)[i1]) == 1.0
+    assert float(np.asarray(batch["amount"].values)[i2]) == 5.0
+
+
+def test_response_window_applies():
+    """A .window() on a RESPONSE narrows the leading window after the cutoff
+    (reference: TimeBasedAggregator timeWindow applies to responses too)."""
+    records = [{"id": "u", "timestamp": d * DAY, "label": v}
+               for d, v in [(1, 0.0), (6, 1.0), (20, 5.0)]]
+    label = (FeatureBuilder.RealNN("label")
+             .extract(lambda r: r.get("label"))
+             .window(3 * DAY).as_response())
+    reader = AggregateReader(
+        records=records, key_fn=lambda r: r["id"],
+        aggregate_params=AggregateParams(
+            cutoff_time=CutOffTime.unix_epoch(5 * DAY)))
+    batch = reader.generate_batch([label])
+    # only the day-6 event is within [5, 8) days; day-20 falls outside
+    assert float(np.asarray(batch["label"].values)[0]) == 1.0
+
+
+def test_window_survives_save_load(tmp_path):
+    """aggregate_window_ms persists through model save/load (a reloaded model
+    scoring via an aggregate reader must window identically to training)."""
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                            ModelCandidate, grid)
+    from transmogrifai_tpu.stages.generator import FeatureGeneratorStage
+    from transmogrifai_tpu.workflow import Workflow, WorkflowModel
+
+    rng = np.random.default_rng(0)
+    records = [{"y": float(i % 2), "amount": float(rng.normal())}
+               for i in range(60)]
+    label = FeatureBuilder.RealNN("y").as_response()
+    amount = (FeatureBuilder.Real("amount")
+              .extract(lambda r: r.get("amount"), source="r.get('amount')")
+              .window(2 * DAY).as_predictor())
+    checked = transmogrify([amount])
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]), "LR")])
+    sel.set_input(label, checked)
+    model = (Workflow().set_input_records(records)
+             .set_result_features(sel.get_output()).train())
+    model.save(str(tmp_path / "m"))
+    loaded = WorkflowModel.load(str(tmp_path / "m"))
+    gens = [f.origin_stage for f in loaded.raw_features
+            if f.name == "amount"]
+    assert isinstance(gens[0], FeatureGeneratorStage)
+    assert gens[0].get("aggregate_window_ms") == 2 * DAY
+    # the extract source round-trips into a working extractor
+    assert gens[0].extract_source == "r.get('amount')"
+    assert gens[0].extract_fn({"amount": 7.5}) == 7.5
+
+
+def test_custom_extract_without_source_warns(tmp_path):
+    """Saving a model whose feature has a custom extract fn but no source
+    text warns that the reloaded model will fall back to by-name lookup."""
+    import warnings as _w
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                            ModelCandidate, grid)
+    from transmogrifai_tpu.workflow import Workflow
+
+    records = [{"y": float(i % 2), "a": float(i)} for i in range(40)]
+    label = FeatureBuilder.RealNN("y").as_response()
+    feat = (FeatureBuilder.Real("doubled")
+            .extract(lambda r: 2 * r.get("a")).as_predictor())
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]), "LR")])
+    sel.set_input(label, transmogrify([feat]))
+    model = (Workflow().set_input_records(records)
+             .set_result_features(sel.get_output()).train())
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        model.save(str(tmp_path / "m"))
+    assert any("custom extract function" in str(w.message) for w in caught)
+
+
+def test_sequence_aggregators():
+    rows = [(1.0, None), (3.0, 4.0), (None, 8.0)]
+    assert sum_by_position(rows) == [4.0, 12.0]
+    assert mean_by_position(rows) == [2.0, 6.0]
+    assert mode_by_position([(1, 5), (2, 5), (1, None)]) == [1, 5]
+    # tie breaks to smallest value (reference semantics)
+    assert mode_by_position([(3,), (1,), (3,), (1,)]) == [1]
+    assert mean_by_position([]) == []
+
+    mrows = [({"a": 1.0, "b": 2.0},), ({"a": 3.0},), ({},)]
+    assert sum_maps_by_key(mrows) == [{"a": 4.0, "b": 2.0}]
+    assert mean_maps_by_key(mrows) == [{"a": 2.0, "b": 2.0}]
+    assert count_maps_by_key(mrows) == [{"a": 2, "b": 1}]
+    assert mode_maps_by_key([({"a": 1},), ({"a": 2},), ({"a": 1},)]) == [{"a": 1}]

@@ -1,0 +1,72 @@
+"""Sanitizer checks (sanitizer.py — ≙ ClosureUtils.checkSerializable at
+OpWorkflow.scala:277-335 + jax.debug_nans discipline, SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.columns import ColumnBatch, column_from_values
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.sanitizer import (PurityError, audit_stage_purity,
+                                         audit_stage_serialization, nan_guard)
+from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                        ModelCandidate, grid)
+from transmogrifai_tpu.stages.base import LambdaTransformer
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _records(n=120, d=3, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    return [{"y": float(y[i]), **{f"x{j}": float(X[i, j]) for j in range(d)}}
+            for i in range(n)]
+
+
+def test_train_with_sanitizers_clean_workflow():
+    records = _records()
+    label = FeatureBuilder.RealNN("y").as_response()
+    preds = [FeatureBuilder.Real(f"x{j}").as_predictor() for j in range(3)]
+    checked = label.sanity_check(transmogrify(preds), remove_bad_features=True)
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]), "LR")])
+    sel.set_input(label, checked)
+    model = (Workflow().set_input_records(records)
+             .set_result_features(sel.get_output())
+             .with_sanitizers(nan_check=True).train())
+    assert model.score() is not None
+
+
+def test_purity_audit_catches_impure_stage():
+    state = {"n": 0}
+
+    def impure(col):
+        state["n"] += 1
+        return type(col)(T.RealNN, np.asarray(col.values) + state["n"])
+
+    f = FeatureBuilder.Real("x").as_predictor()
+    lam = LambdaTransformer(impure, T.RealNN, name="Impure")
+    lam.set_input(f)
+    lam.get_output()
+    batch = ColumnBatch({"x": column_from_values(T.Real, [1.0, 2.0])}, 2)
+    with pytest.raises(PurityError, match="impure"):
+        audit_stage_purity(lam, batch)
+
+
+def test_serialization_audit_catches_bad_params():
+    f = FeatureBuilder.Real("x").as_predictor()
+    lam = LambdaTransformer(lambda c: c, T.RealNN, name="Bad",
+                            unserializable=object())
+    lam.set_input(f)
+    with pytest.raises(PurityError, match="serialize"):
+        audit_stage_serialization([lam])
+
+
+def test_nan_guard_restores_flag():
+    import jax
+    prev = jax.config.jax_debug_nans
+    with nan_guard(True):
+        assert jax.config.jax_debug_nans is True
+    assert jax.config.jax_debug_nans == prev

@@ -98,3 +98,95 @@ def default_aggregator(kind: Type[FeatureType]) -> MonoidAggregator:
 
 class CustomMonoidAggregator(MonoidAggregator):
     """User-supplied monoid (≙ CustomMonoidAggregator)."""
+
+
+# ---------------------------------------------------------------------------
+# Event-time machinery (≙ features/.../aggregators/: Event[O], CutOffTime,
+# TimeBasedAggregator)
+# ---------------------------------------------------------------------------
+
+from dataclasses import dataclass  # noqa: E402
+
+
+@dataclass(frozen=True)
+class Event:
+    """A timestamped value (≙ Event[O], features/.../aggregators/Event.scala):
+    the unit the aggregate/conditional readers group and window over."""
+    time_ms: int
+    value: Any
+
+    def __lt__(self, other):
+        return self.time_ms < other.time_ms
+
+
+_MS_PER_DAY = 24 * 60 * 60 * 1000
+
+
+class CutOffTime:
+    """Cut-off point separating predictor history from response future
+    (≙ CutOffTime.scala: UnixEpoch / DaysAgo / DDMMYYYY / NoCutoff).
+
+    ``timestamp_ms(now_ms)`` resolves the cutoff; None means no cutoff (all
+    events are predictor history).
+    """
+
+    def __init__(self, kind: str, value: Optional[int] = None):
+        self.kind = kind
+        self.value = value
+
+    # -- factories (≙ CutOffTime companion object) -------------------------
+    @staticmethod
+    def unix_epoch(ms: int) -> "CutOffTime":
+        return CutOffTime("UnixEpoch", int(ms))
+
+    @staticmethod
+    def days_ago(days: int) -> "CutOffTime":
+        return CutOffTime("DaysAgo", int(days))
+
+    @staticmethod
+    def dd_mm_yyyy(date: str) -> "CutOffTime":
+        """'ddMMyyyy' string, e.g. '04051999' → epoch ms at UTC midnight."""
+        import datetime as _dt
+        d = _dt.datetime.strptime(date, "%d%m%Y").replace(
+            tzinfo=_dt.timezone.utc)
+        return CutOffTime("DDMMYYYY", int(d.timestamp() * 1000))
+
+    @staticmethod
+    def no_cutoff() -> "CutOffTime":
+        return CutOffTime("NoCutoff", None)
+
+    def timestamp_ms(self, now_ms: Optional[int] = None) -> Optional[int]:
+        if self.kind == "NoCutoff":
+            return None
+        if self.kind == "DaysAgo":
+            if now_ms is None:
+                import time as _time
+                now_ms = int(_time.time() * 1000)
+            return now_ms - self.value * _MS_PER_DAY
+        return self.value
+
+
+def split_events_at_cutoff(
+        events: Sequence[Event], cutoff_ms: Optional[int],
+        predictor_window_ms: Optional[int] = None,
+        response_window_ms: Optional[int] = None,
+) -> "tuple[List[Event], List[Event]]":
+    """(predictor_events, response_events) for one key — the TimeBasedAggregator
+    window rule: predictors take events strictly BEFORE the cutoff (within the
+    trailing ``predictor_window_ms`` when given); responses take events at or
+    after it (within the leading ``response_window_ms``).  With no cutoff
+    everything is predictor history."""
+    if cutoff_ms is None:
+        return list(events), []
+    pred: List[Event] = []
+    resp: List[Event] = []
+    for ev in events:
+        if ev.time_ms < cutoff_ms:
+            if (predictor_window_ms is None
+                    or ev.time_ms >= cutoff_ms - predictor_window_ms):
+                pred.append(ev)
+        else:
+            if (response_window_ms is None
+                    or ev.time_ms < cutoff_ms + response_window_ms):
+                resp.append(ev)
+    return pred, resp

@@ -310,11 +310,19 @@ class FeatureBuilder:
         self._aggregator = aggregator
         return self
 
+    def window(self, window_ms: int) -> "FeatureBuilder":
+        """Trailing event-time window for aggregate readers: only events
+        within ``window_ms`` before the cutoff feed this feature
+        (≙ FeatureBuilderWithExtract.window / FeatureAggregator timeWindow)."""
+        self._window_ms = int(window_ms)
+        return self
+
     def _build(self, is_response: bool) -> Feature:
         from .stages.generator import FeatureGeneratorStage
         stage = FeatureGeneratorStage(
             name=self.name, kind=self.kind, extract_fn=self._extract,
-            aggregator=self._aggregator, extract_source=self._extract_source)
+            aggregator=self._aggregator, extract_source=self._extract_source,
+            aggregate_window_ms=getattr(self, "_window_ms", None))
         feat = Feature(self.name, self.kind, is_response, stage, parents=())
         stage._output = feat
         return feat

@@ -76,14 +76,24 @@ class DataReader(Reader):
 
 @dataclass
 class AggregateParams:
-    """≙ AggregateParams (DataReader.scala:279)."""
-    cutoff_time_fn: Optional[Callable[[Dict], bool]] = None  # event → is before cutoff
+    """≙ AggregateParams (DataReader.scala:279).
+
+    Either a typed ``cutoff_time`` (CutOffTime + ``time_fn`` event timestamps,
+    with optional trailing/leading windows — the reference's
+    TimeBasedAggregator semantics) or a bare boolean ``cutoff_time_fn``
+    (event → is-before-cutoff)."""
+    cutoff_time_fn: Optional[Callable[[Dict], bool]] = None
+    cutoff_time: Optional[Any] = None            # aggregators.CutOffTime
+    time_fn: Callable[[Dict], int] = lambda r: int(r.get("timestamp", 0))
+    predictor_window_ms: Optional[int] = None
+    response_window_ms: Optional[int] = None
 
 
 class AggregateReader(DataReader):
     """Event-time aggregation (≙ AggregateDataReader, DataReader.scala:252):
-    group records by key; predictors aggregate events before the cutoff,
-    responses after."""
+    group records by key; predictors aggregate events before the cutoff
+    (within the trailing predictor window), responses after (within the
+    leading response window)."""
 
     def __init__(self, records=None, read_fn=None, key_fn=None,
                  aggregate_params: Optional[AggregateParams] = None):
@@ -91,16 +101,50 @@ class AggregateReader(DataReader):
         self.params = aggregate_params or AggregateParams()
 
     def generate_batch(self, raw_features: Sequence[Feature]) -> ColumnBatch:
+        from ..aggregators import Event, split_events_at_cutoff
+
         records = self.read()
+        p = self.params
         grouped: Dict[Any, List[Dict]] = {}
         for r in records:
             grouped.setdefault(self.key_fn(r), []).append(r)
-        cols: Dict[str, Column] = {}
-        for f in raw_features:
-            gen = _generator_of(f)
-            cols[f.name] = gen.extract_aggregated(
-                grouped, cutoff_fn=self.params.cutoff_time_fn,
-                is_response=f.is_response)
+
+        if p.cutoff_time is not None:
+            cutoff_ms = p.cutoff_time.timestamp_ms()
+            # Event lists built ONCE per key; per-feature windows re-slice them
+            split: Dict[Any, Any] = {}
+            for k, events in grouped.items():
+                evs = [Event(p.time_fn(r), r) for r in events]
+                split[k] = split_events_at_cutoff(
+                    evs, cutoff_ms, p.predictor_window_ms,
+                    p.response_window_ms)
+            cols: Dict[str, Column] = {}
+            for f in raw_features:
+                gen = _generator_of(f)
+                # a per-feature window narrows this feature's slice further:
+                # trailing for predictors, leading for responses
+                # (≙ FeatureBuilder .window / FeatureAggregator timeWindow)
+                win = gen.get("aggregate_window_ms")
+                vals = []
+                for k in grouped:
+                    pred_evs, resp_evs = split[k]
+                    evs = resp_evs if f.is_response else pred_evs
+                    if win is not None and cutoff_ms is not None:
+                        if f.is_response:
+                            _, evs = split_events_at_cutoff(
+                                evs, cutoff_ms, None, int(win))
+                        else:
+                            evs, _ = split_events_at_cutoff(
+                                evs, cutoff_ms, int(win), None)
+                    vals.append(gen.aggregate_records([e.value for e in evs]))
+                cols[f.name] = column_from_values(f.kind, vals)
+        else:
+            cols = {}
+            for f in raw_features:
+                gen = _generator_of(f)
+                cols[f.name] = gen.extract_aggregated(
+                    grouped, cutoff_fn=p.cutoff_time_fn,
+                    is_response=f.is_response)
         from ..types import Text
         cols["key"] = column_from_values(Text, [str(k) for k in grouped])
         return ColumnBatch(cols, len(grouped))

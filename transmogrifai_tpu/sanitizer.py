@@ -1,0 +1,132 @@
+"""Purity / NaN discipline checks — the TPU analog of the reference's
+closure-serializability validation (utils ClosureUtils.checkSerializable,
+enforced at OpWorkflow.scala:277-335) and of JVM-side sanitizers
+(SURVEY.md §5 "Race detection / sanitizers": the JAX equivalents are
+``jax.debug_nans`` and pure-function discipline in traced stages).
+
+Three checks, all opt-in via ``Workflow.with_sanitizers()``:
+
+  * **NaN guard** — enables ``jax_debug_nans`` for the duration of ``train()``
+    so the first NaN-producing primitive raises at its origin instead of
+    corrupting downstream fits silently.
+  * **Purity audit** — every fitted transformer is applied twice to the same
+    batch; outputs must match bitwise.  Catches side-effecting or
+    RNG-without-seed ``transform`` implementations, which would break the
+    compiled score program (same trace, different results) exactly the way a
+    non-serializable closure broke Spark jobs.
+  * **Serialization audit** — every stage must JSON-round-trip
+    (≙ the reference's uid/ctor-args validation, OpWorkflow.scala:292-317).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .columns import ColumnBatch
+
+
+class PurityError(RuntimeError):
+    """A stage's transform is not a pure function of its inputs."""
+
+
+@contextlib.contextmanager
+def nan_guard(enable: bool = True):
+    """Context manager toggling ``jax_debug_nans`` (restores prior value)."""
+    import jax
+
+    if not enable:
+        yield
+        return
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def _col_payload(col) -> List[np.ndarray]:
+    vals = col.values
+    if isinstance(vals, dict):
+        return [np.asarray(v) for v in vals.values() if v is not None]
+    return [np.asarray(vals)]
+
+
+def _equal(a: List[np.ndarray], b: List[np.ndarray]) -> bool:
+    if len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        if x.shape != y.shape or x.dtype != y.dtype:
+            return False
+        if x.dtype == object:
+            def same(u, v):
+                if u is v:
+                    return True
+                # NaN != NaN would flag bitwise-identical outputs as impure
+                if isinstance(u, float) and isinstance(v, float):
+                    return u == v or (u != u and v != v)
+                return u == v
+            if not all(same(u, v) for u, v in zip(x.ravel(), y.ravel())):
+                return False
+        elif not np.array_equal(x, y, equal_nan=True):
+            return False
+    return True
+
+
+def audit_stage_purity(stage, batch: ColumnBatch) -> None:
+    """Apply ``stage.transform_batch`` twice; raise PurityError on any
+    difference (side effects, unseeded RNG, input mutation)."""
+    out1 = stage.transform_batch(batch)
+    out2 = stage.transform_batch(batch)
+    for f in stage.output_features:
+        if not _equal(_col_payload(out1[f.name]), _col_payload(out2[f.name])):
+            raise PurityError(
+                f"stage {stage.operation_name} ({stage.uid}) is impure: "
+                f"output {f.name!r} differs across identical applications — "
+                "traced stages must be pure functions of their inputs")
+
+
+def audit_dag_purity(fitted_dag, batch: ColumnBatch) -> None:
+    """Sweep every fitted transformer in DAG order (each stage audited on the
+    batch state it actually sees)."""
+    from .stages.base import Transformer
+
+    b = batch
+    for layer in fitted_dag:
+        for st in layer:
+            if isinstance(st, Transformer):
+                audit_stage_purity(st, b)
+        for st in layer:
+            if isinstance(st, Transformer):
+                b = st.transform_batch(b)
+
+
+def audit_stage_serialization(stages) -> None:
+    """Every stage must produce JSON-serializable ctor args
+    (≙ OpWorkflow.validateStages serializability check)."""
+    import json
+
+    from .stages.serialization import stage_to_json
+
+    for st in stages:
+        try:
+            d = stage_to_json(st)
+            json.dumps(d)
+        except Exception as e:  # noqa: BLE001
+            raise PurityError(
+                f"stage {st.operation_name} ({st.uid}) does not serialize: "
+                f"{e} — stage params must be JSON-encodable "
+                "(≙ ClosureUtils.checkSerializable)") from e
+        # stage_to_json nulls what it cannot encode; a param silently lost is
+        # exactly the state a reloaded model would be missing
+        saved = d.get("params", {})
+        for k, v in st.params.items():
+            if v is not None and saved.get(k) is None:
+                raise PurityError(
+                    f"stage {st.operation_name} ({st.uid}) does not "
+                    f"serialize: param {k!r} (= {type(v).__name__}) is not "
+                    "JSON-encodable and would be lost on save/load "
+                    "(≙ ClosureUtils.checkSerializable)")

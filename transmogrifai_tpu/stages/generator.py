@@ -24,8 +24,14 @@ class FeatureGeneratorStage(PipelineStage):
         self.name = name
         self.kind = kind
         self.out_kind = kind
-        # default extractor = by-name lookup (what a reloaded model uses: the
-        # reference serializes the extract source text, FeatureBuilderMacros)
+        self.has_custom_extract = extract_fn is not None
+        if extract_fn is None and extract_source:
+            # rebuild from persisted source text — ``extract_source`` is a
+            # Python expression over the record ``r`` (≙ the reference
+            # recompiling the macro-captured source, FeatureBuilderMacros)
+            extract_fn = eval(f"lambda r: ({extract_source})")  # noqa: S307
+            self.has_custom_extract = True
+        # default extractor = by-name lookup
         self.extract_fn = extract_fn or (lambda r, _n=name: r.get(_n))
         self.extract_source = extract_source
         from ..aggregators import default_aggregator
@@ -47,6 +53,12 @@ class FeatureGeneratorStage(PipelineStage):
             zero = 0.0
             vals = [zero if v is None else v for v in vals]
         return column_from_values(self.kind, vals)
+
+    def aggregate_records(self, records: Sequence[Dict[str, Any]]) -> Any:
+        """Monoid-aggregate the extracted values of pre-selected event records
+        (the reader does the time-window selection; ≙ FeatureAggregator)."""
+        return self.aggregator.aggregate(
+            [self.extract_fn(r) for r in records])
 
     def extract_aggregated(self, grouped: Dict[Any, Sequence[Dict[str, Any]]],
                            cutoff_fn=None, is_response: bool = False) -> Column:

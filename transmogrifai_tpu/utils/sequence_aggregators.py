@@ -1,0 +1,131 @@
+"""Sequence aggregators — per-position / per-key reductions over sequence
+columns (reference: utils/src/main/scala/com/salesforce/op/utils/spark/
+SequenceAggregators.scala: SumNumSeq, MeanSeqNullNum, ModeSeqNullInt,
+SumSeqMapDouble, MeanSeqMapDouble, CountSeqMapLong, ModeSeqMapLong).
+
+The reference implements these as Spark SQL ``Aggregator``s consumed by
+sequence estimators (fill-value computation for numeric/map vectorizers).
+Here they are vectorized host reductions: sequence columns are short per-row
+tuples (one slot per input feature), so the reduction is numpy over [N, S]
+with NaN masks; map variants fold per (key, position).
+"""
+
+from __future__ import annotations
+
+from collections import Counter, defaultdict
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def _to_masked(rows: Sequence[Sequence[Optional[float]]]) -> np.ndarray:
+    """[N, S] float array with None → NaN."""
+    return np.array([[np.nan if v is None else float(v) for v in row]
+                     for row in rows], dtype=np.float64)
+
+
+def sum_by_position(rows: Sequence[Sequence[Optional[float]]]) -> List[float]:
+    """≙ SumNumSeq: per-position sums, nulls count as zero."""
+    if not len(rows):
+        return []
+    a = _to_masked(rows)
+    return np.nansum(a, axis=0).tolist()
+
+
+def mean_by_position(rows: Sequence[Sequence[Optional[float]]]) -> List[float]:
+    """≙ MeanSeqNullNum: per-position means ignoring nulls (0.0 when a
+    position is all-null, matching the reference's 0-count guard)."""
+    if not len(rows):
+        return []
+    a = _to_masked(rows)
+    cnt = np.sum(~np.isnan(a), axis=0)
+    s = np.nansum(a, axis=0)
+    return np.where(cnt > 0, s / np.maximum(cnt, 1), 0.0).tolist()
+
+
+def mode_by_position(rows: Sequence[Sequence[Optional[int]]]) -> List[int]:
+    """≙ ModeSeqNullInt: per-position modal value ignoring nulls; ties break
+    to the smallest value (reference: min of max-count values); all-null → 0."""
+    if not len(rows):
+        return []
+    S = len(rows[0])
+    out: List[int] = []
+    for s in range(S):
+        c = Counter(int(row[s]) for row in rows if row[s] is not None)
+        if not c:
+            out.append(0)
+            continue
+        top = max(c.values())
+        out.append(min(v for v, n in c.items() if n == top))
+    return out
+
+
+def sum_maps_by_key(rows: Sequence[Sequence[Dict[str, float]]]
+                    ) -> List[Dict[str, float]]:
+    """≙ SumSeqMapDouble: per-(position, key) sums over a sequence of map
+    columns."""
+    if not len(rows):
+        return []
+    S = len(rows[0])
+    out: List[Dict[str, float]] = []
+    for s in range(S):
+        acc: Dict[str, float] = defaultdict(float)
+        for row in rows:
+            for k, v in (row[s] or {}).items():
+                acc[k] += float(v)
+        out.append(dict(acc))
+    return out
+
+
+def mean_maps_by_key(rows: Sequence[Sequence[Dict[str, float]]]
+                     ) -> List[Dict[str, float]]:
+    """≙ MeanSeqMapDouble: per-(position, key) means over present entries."""
+    if not len(rows):
+        return []
+    S = len(rows[0])
+    out: List[Dict[str, float]] = []
+    for s in range(S):
+        acc: Dict[str, float] = defaultdict(float)
+        cnt: Dict[str, int] = defaultdict(int)
+        for row in rows:
+            for k, v in (row[s] or {}).items():
+                acc[k] += float(v)
+                cnt[k] += 1
+        out.append({k: acc[k] / cnt[k] for k in acc})
+    return out
+
+
+def count_maps_by_key(rows: Sequence[Sequence[Dict[str, Any]]]
+                      ) -> List[Dict[str, int]]:
+    """≙ CountSeqMapLong: per-(position, key) presence counts."""
+    if not len(rows):
+        return []
+    S = len(rows[0])
+    out: List[Dict[str, int]] = []
+    for s in range(S):
+        cnt: Dict[str, int] = defaultdict(int)
+        for row in rows:
+            for k in (row[s] or {}):
+                cnt[k] += 1
+        out.append(dict(cnt))
+    return out
+
+
+def mode_maps_by_key(rows: Sequence[Sequence[Dict[str, int]]]
+                     ) -> List[Dict[str, int]]:
+    """≙ ModeSeqMapLong: per-(position, key) modal value, ties to smallest."""
+    if not len(rows):
+        return []
+    S = len(rows[0])
+    out: List[Dict[str, int]] = []
+    for s in range(S):
+        per_key: Dict[str, Counter] = defaultdict(Counter)
+        for row in rows:
+            for k, v in (row[s] or {}).items():
+                per_key[k][int(v)] += 1
+        res = {}
+        for k, c in per_key.items():
+            top = max(c.values())
+            res[k] = min(v for v, n in c.items() if n == top)
+        out.append(res)
+    return out

@@ -92,6 +92,7 @@ class Workflow(_WorkflowCore):
         self._workflow_cv = False
         self._raw_feature_filter = None
         self._model_stages: Dict[str, TransformerModel] = {}
+        self._sanitizers: Dict[str, bool] = {}
 
     def set_result_features(self, *features: Feature) -> "Workflow":
         """≙ setResultFeatures: reconstruct the stage DAG (OpWorkflow.scala:207)."""
@@ -104,6 +105,18 @@ class Workflow(_WorkflowCore):
         """≙ withWorkflowCV (OpWorkflowCore.scala:104): refit the feature
         stages feeding the model selector inside each CV fold."""
         self._workflow_cv = True
+        return self
+
+    def with_sanitizers(self, nan_check: bool = False,
+                        purity_check: bool = True,
+                        serialization_check: bool = True) -> "Workflow":
+        """Opt-in discipline checks during train (sanitizer.py — the analog
+        of the reference's closure-serializability validation and of JVM
+        sanitizers): ``nan_check`` turns on jax_debug_nans for the whole fit;
+        ``purity_check`` asserts every fitted transformer is deterministic;
+        ``serialization_check`` asserts every stage JSON-round-trips."""
+        self._sanitizers = {"nan": nan_check, "purity": purity_check,
+                            "serialization": serialization_check}
         return self
 
     def with_raw_feature_filter(self, **kw) -> "Workflow":
@@ -163,6 +176,9 @@ class Workflow(_WorkflowCore):
     # -- training ----------------------------------------------------------
     def train(self) -> "WorkflowModel":
         """≙ OpWorkflow.train:344."""
+        from .sanitizer import (audit_dag_purity, audit_stage_serialization,
+                                nan_guard)
+
         batch = self.generate_raw_data()
         rff_results = None
         if self._raw_feature_filter is not None:
@@ -171,10 +187,16 @@ class Workflow(_WorkflowCore):
             self.blacklisted = dropped
             self._apply_blacklist()
         dag = compute_dag(self.result_features)
-        if self._workflow_cv:
-            batch, fitted_dag = self._fit_with_workflow_cv(batch, dag)
-        else:
-            batch, fitted_dag = self._fit_plain(batch, dag)
+        if self._sanitizers.get("serialization"):
+            audit_stage_serialization(dag_stages(dag))
+        raw_batch = batch if self._sanitizers.get("purity") else None
+        with nan_guard(self._sanitizers.get("nan", False)):
+            if self._workflow_cv:
+                batch, fitted_dag = self._fit_with_workflow_cv(batch, dag)
+            else:
+                batch, fitted_dag = self._fit_plain(batch, dag)
+        if raw_batch is not None:
+            audit_dag_purity(fitted_dag, raw_batch)
         model = WorkflowModel(
             result_features=self.result_features,
             fitted_dag=fitted_dag,
@@ -426,10 +448,24 @@ class WorkflowModel(_WorkflowCore):
                 continue
             st = f.origin_stage
             if isinstance(st, FeatureGeneratorStage):
-                raw_json.append({"uid": st.uid, "name": st.name,
-                                 "type": f.kind.__name__,
-                                 "isResponse": f.is_response,
-                                 "outputFeature": f.uid})
+                d = {"uid": st.uid, "name": st.name,
+                     "type": f.kind.__name__,
+                     "isResponse": f.is_response,
+                     "outputFeature": f.uid}
+                if st.get("aggregate_window_ms") is not None:
+                    d["aggregateWindowMs"] = int(st.get("aggregate_window_ms"))
+                if st.extract_source:
+                    d["extractSource"] = st.extract_source
+                elif st.has_custom_extract:
+                    import warnings
+                    warnings.warn(
+                        f"feature {st.name!r} has a custom extract function "
+                        "with no source text; the reloaded model will fall "
+                        "back to by-name record lookup — pass "
+                        "FeatureBuilder.extract(fn, source='<expr over r>') "
+                        "to persist it (≙ FeatureBuilderMacros source capture)",
+                        stacklevel=3)
+                raw_json.append(d)
         manifest = {
             "uid": "OpWorkflowModel",
             "resultFeaturesUids": [f.uid for f in self.result_features],
@@ -465,7 +501,9 @@ class WorkflowModel(_WorkflowCore):
         raw_gens: Dict[str, FeatureGeneratorStage] = {}
         for d in manifest["rawFeatures"]:
             gen = FeatureGeneratorStage(
-                name=d["name"], kind=kind_by_name(d["type"]), uid=d["uid"])
+                name=d["name"], kind=kind_by_name(d["type"]), uid=d["uid"],
+                aggregate_window_ms=d.get("aggregateWindowMs"),
+                extract_source=d.get("extractSource"))
             raw_gens[d["uid"]] = gen
 
         # 2. rebuild features

@@ -43,10 +43,12 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(arr, axis_names)
 
 
-def data_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
-    """Shard axis 0 (rows) over 'data', replicate the rest."""
-    spec = P(DATA_AXIS, *([None] * (ndim - 1)))
-    return NamedSharding(mesh, spec)
+def data_sharding(mesh: Mesh, ndim: int = 2, row_axis: int = 0) -> NamedSharding:
+    """Shard ``row_axis`` (default axis 0, rows) over 'data', replicate the
+    rest — e.g. ``row_axis=1`` for [folds, rows] weight masks."""
+    spec = [None] * ndim
+    spec[row_axis] = DATA_AXIS
+    return NamedSharding(mesh, P(*spec))
 
 
 def candidate_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:

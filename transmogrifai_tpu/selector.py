@@ -253,8 +253,22 @@ class ModelSelector(Estimator):
         if cand is None or not cand.grid:
             return None
         try:
+            import jax
+            import jax.numpy as jnp
+
             F = shape[0]
             W = np.ones((F, X.shape[0]), np.float32)
+            mesh = getattr(self.validator, "last_mesh", None)
+            if mesh is not None:
+                # match the CV call's shardings exactly — the jit cache keys
+                # on them, so a layout mismatch would recompile the whole
+                # batched program instead of reusing it
+                from .parallel import data_sharding
+                X = jax.device_put(
+                    X if isinstance(X, jax.Array)
+                    else jnp.asarray(X, jnp.float32), data_sharding(mesh, 2))
+                W = jax.device_put(jnp.asarray(W),
+                                   data_sharding(mesh, 2, row_axis=1))
             grids = [dict(result.best_params)] * len(cand.grid)
             return cand.estimator.fit_arrays_grid(X, y, W, grids)[0][0]
         except Exception:  # noqa: BLE001 — reuse is an optimization only

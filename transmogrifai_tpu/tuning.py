@@ -252,6 +252,28 @@ class OpValidator:
             iters = nxt
         return np.asarray(out, dtype=np.int64)
 
+    def _maybe_mesh(self, n_rows: int):
+        """A data-axis mesh when several devices are visible and the batch is
+        big enough to shard profitably (force on/off with
+        TRANSMOGRIFAI_TPU_MESH=1/0; row threshold via
+        TRANSMOGRIFAI_TPU_MESH_MIN_ROWS)."""
+        import os
+
+        import jax
+
+        n_dev = len(jax.devices())
+        flag = os.environ.get("TRANSMOGRIFAI_TPU_MESH")
+        if flag == "0" or n_dev < 2:
+            return None
+        min_rows = int(os.environ.get("TRANSMOGRIFAI_TPU_MESH_MIN_ROWS",
+                                      262144))
+        if flag != "1" and n_rows < min_rows:
+            return None
+        if n_rows % n_dev:
+            return None  # keep static shapes exact; no padding surprises
+        from . import parallel
+        return parallel.make_mesh()
+
     # -- main entry -------------------------------------------------------
     def validate(self, candidates: Sequence[ModelCandidate], batch: ColumnBatch,
                  label: str, features: str,
@@ -340,8 +362,22 @@ class OpValidator:
         self.last_fit_shape = None if in_fold_dag else (len(splits), len(y32))
         for X, fsplits in fold_groups():
             N = X.shape[0]
+            mesh = self._maybe_mesh(N)
+            self.last_mesh = mesh
+            from .parallel import data_sharding
+            if mesh is not None:
+                # multi-device: row-shard the matrix over the mesh 'data' axis
+                # and let GSPMD insert the collectives inside every batched
+                # fit/metric program (SURVEY §2.6 P1/P3 on the REAL path)
+                Xj = X if isinstance(X, jax.Array) else jnp.asarray(
+                    X, jnp.float32)
+                X = jax.device_put(Xj, data_sharding(mesh, 2))
             is_dev = isinstance(X, jax.Array)
-            y_dev = jnp.asarray(y32) if is_dev else None
+            y_dev = None
+            if is_dev:
+                y_dev = (jax.device_put(jnp.asarray(y32),
+                                        data_sharding(mesh, 1))
+                         if mesh is not None else jnp.asarray(y32))
             X_host = None if is_dev else X   # lazy d2h only if a fallback needs it
             W = np.zeros((len(fsplits), N), np.float32)
             va_slices = []
@@ -356,7 +392,13 @@ class OpValidator:
                 if is_dev:
                     vm = np.zeros(N, np.float32)
                     vm[va_idx] = 1.0
-                    va_masks_dev.append(jnp.asarray(vm))
+                    vmj = jnp.asarray(vm)
+                    if mesh is not None:
+                        vmj = jax.device_put(vmj, data_sharding(mesh, 1))
+                    va_masks_dev.append(vmj)
+            if mesh is not None:
+                W = jax.device_put(jnp.asarray(W),
+                                   data_sharding(mesh, 2, row_axis=1))
             def fit_candidate(cand):
                 try:
                     return cand.estimator.fit_arrays_grid(

@@ -205,6 +205,7 @@ class Workflow(_WorkflowCore):
             parameters=self.parameters,
             rff_results=rff_results)
         model.reader = self.reader
+        model._input_batch = self._input_batch
         model.train_batch = batch
         return model
 

@@ -166,3 +166,31 @@ def test_device_threshold_panel_unsorted_thresholds():
                                host["truePositivesByThreshold"], atol=0.5)
     np.testing.assert_allclose(dev["falsePositivesByThreshold"],
                                host["falsePositivesByThreshold"], atol=0.5)
+
+
+def test_device_panel_matches_host_multiclass():
+    import jax.numpy as jnp
+    from transmogrifai_tpu.evaluators import OpMultiClassificationEvaluator
+    rng = np.random.default_rng(11)
+    n, C = 1200, 4
+    y = rng.integers(0, C, size=n)
+    logits = rng.normal(size=(n, C)) + 2.0 * np.eye(C)[y]
+    prob = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    yhat = prob.argmax(1)
+    ev = OpMultiClassificationEvaluator()
+    host = ev.evaluate_all(y, {"prediction": yhat, "probability": prob}).to_json()
+    dev = ev.evaluate_all_device(
+        jnp.asarray(y, jnp.float32),
+        {"prediction": jnp.asarray(yhat, jnp.float32),
+         "probability": jnp.asarray(prob, jnp.float32)},
+        jnp.ones(n, jnp.float32)).to_json()
+    for k in ("Precision", "Recall", "F1", "Error"):
+        assert abs(dev[k] - host[k]) < 1e-6, k
+    np.testing.assert_allclose(dev["confusionMatrix"], host["confusionMatrix"])
+    h = host["ThresholdMetrics"]["byTopN"]
+    d = dev["ThresholdMetrics"]["byTopN"]
+    for nk in h:
+        np.testing.assert_allclose(d[nk]["topNCountByBin"],
+                                   h[nk]["topNCountByBin"], atol=0.5)
+        np.testing.assert_allclose(d[nk]["topNCorrectByBin"],
+                                   h[nk]["topNCorrectByBin"], atol=0.5)

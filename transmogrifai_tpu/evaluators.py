@@ -298,12 +298,11 @@ class OpMultiClassificationEvaluator(OpEvaluatorBase):
         self.top_ns = tuple(top_ns)
         self.n_bins = n_bins
 
-    def evaluate_all(self, y, pred) -> EvaluationMetrics:
-        y = np.asarray(y, dtype=np.int64)
-        yhat = np.asarray(pred["prediction"], dtype=np.int64)
-        C = int(max(y.max(initial=0), yhat.max(initial=0))) + 1
-        conf = np.zeros((C, C), dtype=np.float64)
-        np.add.at(conf, (y, yhat), 1.0)
+    @staticmethod
+    def _conf_panel(conf: np.ndarray) -> Dict[str, Any]:
+        """Weighted precision/recall/F1/error from a [C, C] confusion matrix
+        (shared by the host and device paths)."""
+        C = conf.shape[0]
         support = conf.sum(axis=1)
         tp = np.diag(conf)
         pred_count = conf.sum(axis=0)
@@ -312,12 +311,20 @@ class OpMultiClassificationEvaluator(OpEvaluatorBase):
         f1_c = np.divide(2 * prec_c * rec_c, prec_c + rec_c,
                          out=np.zeros(C), where=(prec_c + rec_c) > 0)
         wts = support / max(support.sum(), 1.0)
-        m: Dict[str, Any] = {
+        return {
             "Precision": float(wts @ prec_c), "Recall": float(wts @ rec_c),
             "F1": float(wts @ f1_c),
             "Error": 1.0 - float(tp.sum() / max(support.sum(), 1.0)),
             "confusionMatrix": conf.tolist(),
         }
+
+    def evaluate_all(self, y, pred) -> EvaluationMetrics:
+        y = np.asarray(y, dtype=np.int64)
+        yhat = np.asarray(pred["prediction"], dtype=np.int64)
+        C = int(max(y.max(initial=0), yhat.max(initial=0))) + 1
+        conf = np.zeros((C, C), dtype=np.float64)
+        np.add.at(conf, (y, yhat), 1.0)
+        m: Dict[str, Any] = self._conf_panel(conf)
         prob = pred.get("probability")
         if prob is not None:
             prob = np.asarray(prob, dtype=np.float64)
@@ -358,18 +365,43 @@ class OpMultiClassificationEvaluator(OpEvaluatorBase):
         C = int(jnp.maximum(jnp.max(y_dev), jnp.max(pred))) + 1
         conf = np.asarray(masked_multiclass_confusion(
             y_dev, pred, w_dev, n_classes=C), dtype=np.float64)
-        support = conf.sum(axis=1)
-        tp = np.diag(conf)
-        pred_count = conf.sum(axis=0)
-        prec_c = np.divide(tp, pred_count, out=np.zeros(C), where=pred_count > 0)
-        rec_c = np.divide(tp, support, out=np.zeros(C), where=support > 0)
-        f1_c = np.divide(2 * prec_c * rec_c, prec_c + rec_c,
-                         out=np.zeros(C), where=(prec_c + rec_c) > 0)
-        wts = support / max(support.sum(), 1.0)
-        return {"Precision": float(wts @ prec_c), "Recall": float(wts @ rec_c),
-                "F1": float(wts @ f1_c),
-                "Error": 1.0 - float(tp.sum() / max(support.sum(), 1.0)),
-                }[self.default_metric]
+        return self._conf_panel(conf)[self.default_metric]
+
+    def evaluate_all_device(self, y_dev, device_out, w_dev):
+        pred = device_out.get("prediction")
+        if pred is None or not len(y_dev):
+            return None  # host path handles the empty-input degenerate case
+        import jax
+        import jax.numpy as jnp
+
+        from .metrics_device import masked_multiclass_confusion
+        C = int(jnp.maximum(jnp.max(y_dev), jnp.max(pred))) + 1
+        conf = np.asarray(masked_multiclass_confusion(
+            y_dev, pred, w_dev, n_classes=C), dtype=np.float64)
+        m: Dict[str, Any] = self._conf_panel(conf)
+        prob = device_out.get("probability")
+        if prob is not None and getattr(prob, "ndim", 0) == 2:
+            order = jnp.argsort(-prob, axis=1)
+            maxprob = jnp.max(prob, axis=1)
+            bins = jnp.clip((maxprob * self.n_bins).astype(jnp.int32),
+                            0, self.n_bins - 1)
+            yi = y_dev.astype(jnp.int32)
+            counts = jax.ops.segment_sum(w_dev, bins,
+                                         num_segments=self.n_bins)
+            topns = {}
+            for n in self.top_ns:
+                correct = (order[:, :n] == yi[:, None]).any(axis=1)
+                corr = jax.ops.segment_sum(
+                    w_dev * correct.astype(w_dev.dtype), bins,
+                    num_segments=self.n_bins)
+                topns[str(n)] = {
+                    "topNCorrectByBin": np.asarray(corr, np.float64).tolist(),
+                    "topNCountByBin": np.asarray(counts, np.float64).tolist(),
+                }
+            m["ThresholdMetrics"] = {
+                "topNs": list(self.top_ns), "nBins": self.n_bins,
+                "byTopN": topns}
+        return EvaluationMetrics(m)
 
 
 class OpRegressionEvaluator(OpEvaluatorBase):

@@ -49,19 +49,32 @@ def tokenize_text(s: Optional[str], min_token_length: int = 1,
 
 def hash_tokens_to_counts(token_lists: Sequence[Sequence[str]], num_hashes: int,
                           binary: bool = False) -> np.ndarray:
-    """Hashing trick: token lists → [N, num_hashes] term-frequency matrix."""
+    """Hashing trick: token lists → [N, num_hashes] term-frequency matrix.
+
+    Vectorized host path (SURVEY §7 hard part (b)): tokens flatten to one
+    array, each DISTINCT token hashes once (np.unique + inverse codes), and
+    the counts land via one ``np.add.at`` scatter — the per-(row, token)
+    Python loop this replaces dominated text-scoring wall time."""
     out = np.zeros((len(token_lists), num_hashes), dtype=np.float32)
-    cache: Dict[str, int] = {}
-    for i, toks in enumerate(token_lists):
-        for t in toks:
-            j = cache.get(t)
-            if j is None:
-                j = fnv1a_32(t) % num_hashes
-                cache[t] = j
-            if binary:
-                out[i, j] = 1.0
-            else:
-                out[i, j] += 1.0
+    lens = np.fromiter((len(t) for t in token_lists), np.int64,
+                       count=len(token_lists))
+    total = int(lens.sum())
+    if not total:
+        return out
+    flat = np.empty(total, dtype=object)
+    pos = 0
+    for toks in token_lists:
+        flat[pos:pos + len(toks)] = toks
+        pos += len(toks)
+    rows = np.repeat(np.arange(len(token_lists)), lens)
+    # np.unique on the object array directly: astype(str) would allocate a
+    # fixed-width U<longest-token> copy (one huge token → OOM)
+    uniq, codes = np.unique(flat, return_inverse=True)
+    buckets = np.fromiter((fnv1a_32(t) % num_hashes for t in uniq),
+                          np.int64, count=len(uniq))
+    np.add.at(out, (rows, buckets[codes]), 1.0)
+    if binary:
+        out = (out > 0).astype(np.float32)
     return out
 
 

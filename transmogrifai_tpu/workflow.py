@@ -348,8 +348,16 @@ class WorkflowModel(_WorkflowCore):
         """≙ OpWorkflowModel.evaluate:320."""
         if batch is None:
             batch = self.generate_raw_data()
-        label = label_feature or next(
-            (f for f in self.raw_features if f.is_response), None)
+        label = label_feature
+        if label is None:
+            # the label the model actually trained on — the selector's first
+            # input (e.g. an INDEXED text response), not the raw string column
+            sm = self.selected_model
+            if sm is not None and sm.input_features:
+                label = sm.input_features[0]
+        if label is None:
+            label = next(
+                (f for f in self.raw_features if f.is_response), None)
         if label is None:
             raise ValueError(
                 "evaluate: no response feature in the model's raw features — "

@@ -1,0 +1,128 @@
+"""Workflow edge cases the reference exercises across its suites: all-null
+features (SanityChecker drops, training continues), DataBalancer on skewed
+binary labels, DataCutter dropping rare multiclass labels, lenient scoring on
+records missing a column, and duplicate-uid validation."""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                        ModelCandidate,
+                                        MultiClassificationModelSelector, grid)
+from transmogrifai_tpu.readers.base import DataReader
+from transmogrifai_tpu.tuning import DataBalancer, DataCutter
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _lr():
+    return [ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]),
+                           "OpLogisticRegression")]
+
+
+def test_all_null_feature_dropped_and_training_succeeds():
+    rng = np.random.default_rng(0)
+    records = [{"y": float(i % 2), "x": float(rng.normal()) + (i % 2),
+                "dead": None} for i in range(200)]
+    label = FeatureBuilder.RealNN("y").as_response()
+    x = FeatureBuilder.Real("x").as_predictor()
+    dead = FeatureBuilder.Real("dead").as_predictor()
+    checked = label.sanity_check(transmogrify([x, dead]),
+                                 remove_bad_features=True)
+    sel = BinaryClassificationModelSelector(models=_lr())
+    sel.set_input(label, checked)
+    model = (Workflow().set_input_records(records)
+             .set_result_features(sel.get_output()).train())
+    # the all-null column contributes zero-variance derived columns → dropped
+    summary = model.summary()
+    dropped = [c["name"] for f in summary["features"]
+               for c in f["derivedFeatures"] if c["dropped"]]
+    assert any("dead" in n for n in dropped)
+    m = model.evaluate(Evaluators.BinaryClassification.auROC())
+    assert m["AuROC"] > 0.7
+
+
+def test_data_balancer_on_skewed_labels():
+    rng = np.random.default_rng(1)
+    records = []
+    for i in range(1000):
+        lab = 1.0 if i < 30 else 0.0  # 3% positives
+        records.append({"y": lab, "x": float(rng.normal()) + 2.0 * lab})
+    label = FeatureBuilder.RealNN("y").as_response()
+    x = FeatureBuilder.Real("x").as_predictor()
+    sel = BinaryClassificationModelSelector(
+        models=_lr(), splitter=DataBalancer(sample_fraction=0.3, seed=7))
+    sel.set_input(label, transmogrify([x]))
+    model = (Workflow().set_input_records(records)
+             .set_result_features(sel.get_output()).train())
+    sm = model.selected_model
+    prep = sm.summary.data_prep_results
+    assert prep.get("positiveFraction") == pytest.approx(0.03)
+    # the balancer actually down-sampled the majority class
+    assert 0.0 < prep.get("downSampleFraction", 1.0) < 1.0
+    m = model.evaluate(Evaluators.BinaryClassification.auROC())
+    assert m["AuROC"] > 0.85
+
+
+def test_data_cutter_drops_rare_labels():
+    rng = np.random.default_rng(2)
+    records = []
+    for i in range(600):
+        lab = float(i % 3) if i % 100 else 3.0  # label 3 is rare (~1%)
+        records.append({"y": lab, "x": float(rng.normal()) + lab})
+    label = FeatureBuilder.RealNN("y").as_response()
+    x = FeatureBuilder.Real("x").as_predictor()
+    sel = MultiClassificationModelSelector(
+        models=_lr(), splitter=DataCutter(min_label_fraction=0.05, seed=3))
+    sel.set_input(label, transmogrify([x]))
+    model = (Workflow().set_input_records(records)
+             .set_result_features(sel.get_output()).train())
+    prep = model.selected_model.summary.data_prep_results
+    assert 3.0 in prep.get("labelsDropped", [])
+    assert sorted(prep.get("labelsKept", [])) == [0.0, 1.0, 2.0]
+    m = model.evaluate(Evaluators.MultiClassification.f1())
+    assert m["F1"] > 0.5
+
+
+def test_scoring_records_missing_column_is_lenient():
+    """≙ the reference's null handling: a scoring record without a predictor
+    column treats it as null and still produces a prediction."""
+    rng = np.random.default_rng(3)
+    records = [{"y": float(i % 2), "a": float(rng.normal()) + (i % 2),
+                "b": float(rng.normal())} for i in range(200)]
+    label = FeatureBuilder.RealNN("y").as_response()
+    a = FeatureBuilder.Real("a").as_predictor()
+    b = FeatureBuilder.Real("b").as_predictor()
+    sel = BinaryClassificationModelSelector(models=_lr())
+    sel.set_input(label, transmogrify([a, b]))
+    pred = sel.get_output()
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred).train())
+    # score records that lack column 'b' entirely
+    score_records = [{"y": 0.0, "a": 0.5}, {"y": 1.0, "a": -0.5}]
+    model.set_reader(DataReader(records=score_records))
+    scored = model.score()
+    assert len(scored[pred.name].values["prediction"]) == 2
+
+
+def test_duplicate_stage_uid_rejected():
+    label = FeatureBuilder.RealNN("y").as_response()
+    x = FeatureBuilder.Real("x").as_predictor()
+    sel = BinaryClassificationModelSelector(models=_lr())
+    checked = transmogrify([x])
+    sel.set_input(label, checked)
+    pred = sel.get_output()
+    # forge a colliding uid upstream
+    dup = checked.origin_stage
+    sel_stage = pred.origin_stage
+    old_uid = sel_stage.uid
+    sel_stage.uid = dup.uid
+    try:
+        with pytest.raises(ValueError, match="duplicate stage uid"):
+            Workflow().set_result_features(pred)
+    finally:
+        sel_stage.uid = old_uid

@@ -45,7 +45,14 @@ def main():
     N = 1_000_000 if on_accel else 100_000
     # BENCH_ROWS overrides for scale probes (the headline metric and the
     # vs_baseline ratio stay pinned to the 1M workload for comparability)
-    N = int(os.environ.get("BENCH_ROWS", N))
+    rows_env = os.environ.get("BENCH_ROWS", "").strip()
+    if rows_env:
+        try:
+            N = int(float(rows_env))  # accept 4e6-style values
+        except ValueError:
+            sys.exit(f"BENCH_ROWS={rows_env!r} is not a number")
+        if N < 1000:
+            sys.exit(f"BENCH_ROWS={N} too small (need >= 1000)")
     D = 28
 
     from transmogrifai_tpu.columns import Column, ColumnBatch

@@ -1,0 +1,120 @@
+"""Golden numeric checks against sklearn/scipy (SURVEY §4: "numeric golden
+checks against sklearn-computed stats") — metrics, model fits, calibrators,
+and sanity statistics must agree with the independent implementations."""
+
+import numpy as np
+import pytest
+
+sklearn = pytest.importorskip("sklearn")
+
+from sklearn.isotonic import IsotonicRegression  # noqa: E402
+from sklearn.linear_model import LogisticRegression, Ridge  # noqa: E402
+from sklearn.metrics import (average_precision_score,  # noqa: E402
+                             roc_auc_score)
+
+
+def _binary_data(n=3000, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d)
+    y = (X @ w + rng.normal(scale=0.5, size=n) > 0).astype(np.float32)
+    return X, y
+
+
+def test_auroc_aupr_match_sklearn():
+    from transmogrifai_tpu.evaluators import aupr, auroc
+    rng = np.random.default_rng(1)
+    y = (rng.random(4000) > 0.6).astype(np.float64)
+    s = np.clip(y * 0.5 + rng.normal(scale=0.35, size=4000) + 0.25, 0, 1)
+    assert auroc(y, s) == pytest.approx(roc_auc_score(y, s), abs=1e-9)
+    # AuPR is MLlib-style trapezoid over threshold-grouped points; sklearn AP
+    # is a right-step sum — systematically different estimators, so only a
+    # loose agreement is expected
+    assert aupr(y, s) == pytest.approx(average_precision_score(y, s), abs=2e-2)
+
+
+def test_device_auroc_matches_sklearn():
+    import jax.numpy as jnp
+    from transmogrifai_tpu.metrics_device import masked_auroc
+    rng = np.random.default_rng(2)
+    y = (rng.random(2500) > 0.5).astype(np.float64)
+    s = rng.random(2500).round(2)  # heavy ties → exercises midranks
+    got = float(masked_auroc(jnp.asarray(y, jnp.float32),
+                             jnp.asarray(s, jnp.float32),
+                             jnp.ones(2500, jnp.float32)))
+    assert got == pytest.approx(roc_auc_score(y, s), abs=1e-5)
+
+
+def test_logistic_fit_matches_sklearn():
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    X, y = _binary_data()
+    reg = 0.01
+    est = OpLogisticRegression(reg_param=reg, elastic_net_param=0.0,
+                               max_iter=400, standardization=False)
+    fitted = est.fit_arrays(X, y)
+    # sklearn C = 1 / (n * reg) for mean-normalized log-loss
+    sk = LogisticRegression(C=1.0 / (len(y) * reg), max_iter=2000,
+                            tol=1e-10).fit(X, y)
+    np.testing.assert_allclose(np.asarray(fitted["coef"]).ravel(),
+                               sk.coef_.ravel(), atol=2e-2)
+    assert float(np.asarray(fitted["intercept"]).ravel()[0]) == pytest.approx(
+        float(sk.intercept_[0]), abs=2e-2)
+
+
+def test_ridge_fit_matches_sklearn():
+    from transmogrifai_tpu.models.linear import OpLinearRegression
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(2000, 5)).astype(np.float32)
+    w = rng.normal(size=5)
+    yv = (X @ w + 0.1 * rng.normal(size=2000)).astype(np.float32)
+    reg = 0.1
+    est = OpLinearRegression(reg_param=reg, elastic_net_param=0.0,
+                             standardization=False)
+    fitted = est.fit_arrays(X, yv)
+    sk = Ridge(alpha=reg * len(yv)).fit(X, yv)
+    np.testing.assert_allclose(np.asarray(fitted["coef"]).ravel(),
+                               sk.coef_.ravel(), atol=1e-3)
+
+
+def test_isotonic_calibrator_matches_sklearn():
+    from transmogrifai_tpu.ops.bucketizers import pav_fit
+    rng = np.random.default_rng(4)
+    x = np.sort(rng.random(500))
+    y = np.clip(x + rng.normal(scale=0.1, size=500), 0, 1)
+    ours_x, ours_y = pav_fit(x, y)
+    sk = IsotonicRegression(out_of_bounds="clip").fit(x, y)
+    grid = np.linspace(0, 1, 101)
+    ours = np.interp(grid, np.asarray(ours_x), np.asarray(ours_y))
+    np.testing.assert_allclose(ours, sk.predict(grid), atol=1e-6)
+
+
+def test_pearson_spearman_match_scipy():
+    scipy_stats = pytest.importorskip("scipy.stats")
+    import jax.numpy as jnp
+    from transmogrifai_tpu.preparators.sanity_checker import (_col_stats,
+                                                              _rank_transform)
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(800, 4)).astype(np.float32)
+    X[:, 1] = X[:, 0] ** 3 + 0.2 * rng.normal(size=800)  # monotone nonlinear
+    y = (X[:, 0] + 0.3 * rng.normal(size=800)).astype(np.float32)
+    pearson = np.asarray(_col_stats(jnp.asarray(X), jnp.asarray(y))[4])
+    spearman = np.asarray(_col_stats(_rank_transform(jnp.asarray(X)),
+                                     _rank_transform(jnp.asarray(y)))[4])
+    for j in range(4):
+        assert pearson[j] == pytest.approx(
+            scipy_stats.pearsonr(X[:, j], y)[0], abs=1e-4)
+        assert spearman[j] == pytest.approx(
+            scipy_stats.spearmanr(X[:, j], y)[0], abs=1e-4)
+
+
+def test_cramers_v_matches_scipy_chi2():
+    scipy_stats = pytest.importorskip("scipy.stats")
+    from transmogrifai_tpu.utils.stats import contingency_stats
+    rng = np.random.default_rng(6)
+    table = rng.integers(5, 60, size=(3, 4)).astype(np.float64)
+    cs = contingency_stats(table)
+    chi2 = scipy_stats.chi2_contingency(table, correction=False)[0]
+    n = table.sum()
+    k = min(table.shape) - 1
+    expected_v = np.sqrt(chi2 / (n * k))
+    assert cs.cramers_v == pytest.approx(expected_v, abs=1e-9)

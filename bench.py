@@ -49,8 +49,8 @@ def main():
     if rows_env:
         try:
             N = int(float(rows_env))  # accept 4e6-style values
-        except ValueError:
-            sys.exit(f"BENCH_ROWS={rows_env!r} is not a number")
+        except (ValueError, OverflowError):
+            sys.exit(f"BENCH_ROWS={rows_env!r} is not a usable row count")
         if N < 1000:
             sys.exit(f"BENCH_ROWS={N} too small (need >= 1000)")
     D = 28

@@ -111,8 +111,31 @@ def test_gen_headerless_csv_and_text_label(tmp_path):
     assert "FeatureBuilder.PickList('irisClass')" in feats_src
     assert "StringIndexer" in app_src
     assert "MultiClassificationModelSelector" in app_src
+    # the generated reader must carry the headers — without them it would eat
+    # the first data row as a header and every column lookup returns None
+    assert "headers=['id', 'sepalLength'" in app_src
     compile(feats_src, "features.py", "exec")
     compile(app_src, "app.py", "exec")
+
+    # and the headerless scaffold actually trains
+    with open(os.path.join(out, "app.py")) as f:
+        app_src = f.read()
+    app_src = app_src.replace(
+        "MultiClassificationModelSelector()",
+        "MultiClassificationModelSelector("
+        "model_types_to_use=['OpLogisticRegression'])")
+    with open(os.path.join(out, "app.py"), "w") as f:
+        f.write(app_src)
+    env = dict(os.environ,
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    boot = ("import sys, jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import runpy; sys.argv = ['app.py', '--run-type', 'train', "
+            f"'--model-location', {os.path.join(out, 'model')!r}]; "
+            "runpy.run_path('app.py', run_name='__main__')")
+    r = subprocess.run([sys.executable, "-c", boot], cwd=out, env=env,
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert os.path.exists(os.path.join(out, "model", "op-model.json"))
 
 
 def test_gen_nonstandard_binary_label_remapped(tmp_path):

@@ -146,14 +146,33 @@ class OpWorkflowRunner:
                 [f for f in model.raw_features if not f.is_response])
         score_fn = model.score_fn()
         loc = params.write_location
+        if loc:
+            os.makedirs(loc, exist_ok=True)
         n_batches = 0
-        for i, batch in enumerate(self.score_reader.stream()):
-            with timer.phase(f"batch_{i}"):
-                scored = score_fn(batch)
-                if loc:
-                    os.makedirs(loc, exist_ok=True)
-                    _write_scores(scored, os.path.join(loc, f"scores_{i}.jsonl"))
-            n_batches += 1
+        # double-buffered pipeline (SURVEY §2.6 P6): scoring dispatches
+        # asynchronously on the device, so batch i computes while the host
+        # serializes batch i-1's results — the d2h pull in _write_scores is
+        # the host stage of the pipeline
+        pending = None  # (index, scored)
+
+        def flush():
+            nonlocal pending
+            if pending is not None and loc:
+                j, prev = pending
+                with timer.phase(f"write_{j}"):
+                    _write_scores(prev, os.path.join(loc, f"scores_{j}.jsonl"))
+            pending = None
+
+        try:
+            for i, batch in enumerate(self.score_reader.stream()):
+                with timer.phase(f"batch_{i}"):
+                    scored = score_fn(batch)
+                flush()
+                pending = (i, scored)
+                n_batches += 1
+        finally:
+            # a mid-stream failure must not lose the last scored batch
+            flush()
         return OpWorkflowRunnerResult(RunType.STREAMING_SCORE,
                                       scores_location=loc,
                                       metrics={"batches": n_batches})

@@ -252,3 +252,32 @@ class TestParallel:
                             np.zeros(G, np.float32))
         assert np.asarray(w).shape == (D,)
         assert np.isfinite(np.asarray(losses)).all()
+
+
+def test_runner_score_without_workflow(tmp_path):
+    """Score-type runs need only a saved model; train without a workflow
+    raises an actionable error (≙ OpWorkflowRunner run-type dispatch)."""
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.runner import OpWorkflowRunner, RunType
+    from transmogrifai_tpu.selector import ModelCandidate, grid
+
+    rng = np.random.default_rng(0)
+    records = [{"y": float(i % 2), "x": float(rng.normal()) + (i % 2)}
+               for i in range(120)]
+    label = FeatureBuilder.RealNN("y").as_response()
+    x = FeatureBuilder.Real("x").as_predictor()
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]), "LR")])
+    sel.set_input(label, transmogrify([x]))
+    model = (Workflow().set_input_records(records)
+             .set_result_features(sel.get_output()).train())
+    loc = str(tmp_path / "m")
+    model.save(loc)
+
+    runner = OpWorkflowRunner(score_reader=DataReader(records=records[:10]))
+    res = runner.run(RunType.SCORE, OpParams(
+        model_location=loc, write_location=str(tmp_path / "scores")))
+    assert res.scores_location
+
+    with pytest.raises(ValueError, match="needs a Workflow"):
+        OpWorkflowRunner().run(RunType.TRAIN, OpParams(model_location=loc))

@@ -47,10 +47,12 @@ class OpWorkflowRunnerResult:
 class OpWorkflowRunner:
     """≙ OpWorkflowRunner.scala:296."""
 
-    def __init__(self, workflow: Workflow,
+    def __init__(self, workflow: Optional[Workflow] = None,
                  train_reader=None, score_reader=None,
                  evaluator=None, evaluation_feature=None,
                  features_to_compute=None):
+        # score / streaming-score / evaluate / features run types load a
+        # saved model and need no workflow; only train requires one
         self.workflow = workflow
         self.train_reader = train_reader
         self.score_reader = score_reader
@@ -89,6 +91,10 @@ class OpWorkflowRunner:
     # -- run types --------------------------------------------------------
     def _train(self, params: OpParams, timer: PhaseTimer) -> OpWorkflowRunnerResult:
         """≙ :163-196: train, save model + summary."""
+        if self.workflow is None:
+            raise ValueError(
+                "run-type 'train' needs a Workflow — construct the runner "
+                "with OpWorkflowRunner(workflow, ...)")
         if self.train_reader is not None:
             self.workflow.set_reader(self.train_reader)
         with timer.phase("train"):

@@ -90,3 +90,16 @@ def test_mesh_guard_on_indivisible_rows(monkeypatch):
     model = wf.train()
     scored = model.score()
     assert len(scored[pred.name].values["prediction"]) == 16387
+
+
+def test_init_distributed_single_process_noop(monkeypatch):
+    """Single-process init is safe and reports no multi-host runtime; calling
+    twice is idempotent (≙ library code may call unconditionally).  Cluster
+    env vars are cleared so jax's real auto-detect never runs here."""
+    from transmogrifai_tpu.parallel import init_distributed, is_multihost
+    from transmogrifai_tpu.parallel.multihost import _CLUSTER_ENV_VARS
+    for v in _CLUSTER_ENV_VARS:
+        monkeypatch.delenv(v, raising=False)
+    assert init_distributed() is False
+    assert init_distributed() is False
+    assert is_multihost() is False

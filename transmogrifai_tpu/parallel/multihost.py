@@ -1,0 +1,69 @@
+"""Multi-host initialization — the DCN story (SURVEY §2.6 P7).
+
+The reference's cross-executor traffic rides Spark's netty shuffle; here
+cross-HOST traffic is jax's distributed runtime: every host calls
+``init_distributed()`` (coordinator address + process id, or nothing under a
+supported cluster environment), after which ``jax.devices()`` spans all hosts
+and the SAME mesh/sharding code in this package rides ICI within a slice and
+DCN across slices — no separate transport layer exists or is needed.
+
+Typical launch (one line per host)::
+
+    from transmogrifai_tpu.parallel import init_distributed, make_mesh
+    init_distributed()          # auto-detected under TPU pods / GKE
+    mesh = make_mesh()          # all hosts' devices, rows over 'data'
+
+Single-process runs are a no-op, so library code can call this
+unconditionally.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+_CLUSTER_ENV_VARS = (
+    "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
+    "CLOUD_TPU_TASK_ID", "SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE",
+)
+
+
+def _cluster_env_present() -> bool:
+    import os
+    return any(os.environ.get(v) for v in _CLUSTER_ENV_VARS)
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> bool:
+    """Initialize jax's distributed runtime (idempotent, single-process safe).
+
+    Returns True when a multi-process runtime is active after the call.
+    Auto-detection only runs under a recognizable cluster environment (TPU
+    pod / GKE / SLURM / MPI env vars) — probing jax's auto-detect on plain
+    single-host machines can hard-abort the process, so without a coordinator
+    and without cluster env vars this is a clean no-op.
+    """
+    already = getattr(jax.distributed, "is_initialized", None)
+    if already is not None and already():
+        return jax.process_count() > 1
+    if coordinator_address is None and not _cluster_env_present():
+        return False
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except Exception:  # noqa: BLE001
+        if coordinator_address is not None:
+            # an EXPLICIT multi-host request that fails must not silently
+            # degrade to single-host (every host would train divergently)
+            raise
+        return False
+    return jax.process_count() > 1
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1

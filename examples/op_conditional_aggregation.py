@@ -1,0 +1,96 @@
+"""Conditional aggregation (≙ helloworld dataprep/ConditionalAggregation.scala):
+predict the likelihood of a purchase within a day of a user hitting a target
+landing page.  The conditional reader anchors every user's timeline at the
+first time the target condition fires; predictors aggregate the week BEFORE,
+the response the day AFTER.
+
+Run:  JAX_PLATFORMS=cpu python examples/op_conditional_aggregation.py
+"""
+
+import os
+import sys
+from datetime import datetime, timezone
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from transmogrifai_tpu.aggregators import MonoidAggregator
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.readers.base import ConditionalParams, ConditionalReader
+from transmogrifai_tpu.workflow import Workflow
+
+DAY = 24 * 3600 * 1000
+
+
+def ts(s: str) -> int:
+    return int(datetime.strptime(s, "%Y-%m-%d::%H:%M:%S")
+               .replace(tzinfo=timezone.utc).timestamp() * 1000)
+
+
+# WebVisits-style records: userId, url, productId (purchase), timestamp
+VISITS = [
+    {"userId": "xyz@example.com", "url": "/home", "productId": None,
+     "timestamp": ts("2017-09-01::10:00:00")},
+    {"userId": "xyz@example.com", "url": "/search", "productId": None,
+     "timestamp": ts("2017-09-02::11:00:00")},
+    {"userId": "xyz@example.com", "url": "/deals", "productId": None,
+     "timestamp": ts("2017-09-03::12:00:00")},
+    {"userId": "xyz@example.com", "url": "http://www.amazon.com/SaveBig",
+     "productId": None, "timestamp": ts("2017-09-04::09:00:00")},
+    {"userId": "xyz@example.com", "url": "/checkout", "productId": 231,
+     "timestamp": ts("2017-09-04::18:00:00")},
+    {"userId": "lmn@example.com", "url": "http://www.amazon.com/SaveBig",
+     "productId": None, "timestamp": ts("2017-09-01::08:00:00")},
+    {"userId": "lmn@example.com", "url": "/checkout", "productId": 12,
+     "timestamp": ts("2017-09-01::20:00:00")},
+    {"userId": "abc@example.com", "url": "/home", "productId": None,
+     "timestamp": ts("2017-09-01::08:00:00")},  # never hits the target → dropped
+]
+
+
+def main():
+    sum_real = MonoidAggregator(None, lambda a, b: a + b, "sum")
+
+    num_visits_week_prior = (
+        FeatureBuilder.RealNN("numVisitsWeekPrior")
+        .extract(lambda r: 1.0, source="1.0")
+        .aggregate(sum_real)
+        .window(7 * DAY)
+        .as_predictor())
+
+    num_purchases_next_day = (
+        FeatureBuilder.RealNN("numPurchasesNextDay")
+        .extract(lambda r: 1.0 if r.get("productId") is not None else 0.0,
+                 source="1.0 if r.get('productId') is not None else 0.0")
+        .aggregate(sum_real)
+        .window(1 * DAY)
+        .as_response())
+
+    reader = ConditionalReader(
+        records=VISITS, key_fn=lambda r: r["userId"],
+        conditional_params=ConditionalParams(
+            target_condition=lambda r: r["url"] == "http://www.amazon.com/SaveBig",
+            response_window_ms=1 * DAY,
+            time_fn=lambda r: r["timestamp"],
+            drop_if_target_condition_not_met=True))
+
+    model = (Workflow().set_reader(reader)
+             .set_result_features(num_visits_week_prior,
+                                  num_purchases_next_day).train())
+    scored = model.score(keep_raw_features=True)
+    keys = list(scored["key"].values)
+    visits = scored["numVisitsWeekPrior"].values
+    buys = scored["numPurchasesNextDay"].values
+    print(f"{'key':22s} {'numVisitsWeekPrior':>18s} {'numPurchasesNextDay':>20s}")
+    for i, k in enumerate(keys):
+        print(f"{k:22s} {float(visits[i]):18.1f} {float(buys[i]):20.1f}")
+    assert "abc@example.com" not in keys  # condition never met → dropped
+    return dict(zip(keys, zip([float(v) for v in visits],
+                              [float(b) for b in buys])))
+
+
+if __name__ == "__main__":
+    out = main()
+    # xyz: 3 visits in the prior week, 1 purchase next day; lmn: 0 prior, 1 next
+    assert out["xyz@example.com"] == (3.0, 1.0), out
+    assert out["lmn@example.com"] == (0.0, 1.0), out
+    print("ConditionalAggregation OK")

@@ -155,6 +155,56 @@ def test_custom_extract_without_source_warns(tmp_path):
     assert any("custom extract function" in str(w.message) for w in caught)
 
 
+def test_non_nullable_empty_window_takes_monoid_zero():
+    """A RealNN aggregate over an empty window is 0.0, not an error
+    (≙ SumRealNN's zero in the reference's ConditionalAggregation)."""
+    records = [{"id": "u", "timestamp": 10 * DAY, "n": 1.0}]
+    feat = (FeatureBuilder.RealNN("n")
+            .extract(lambda r: r.get("n")).as_predictor())
+    reader = AggregateReader(
+        records=records, key_fn=lambda r: r["id"],
+        aggregate_params=AggregateParams(
+            cutoff_time=CutOffTime.unix_epoch(5 * DAY)))
+    batch = reader.generate_batch([feat])
+    # the only event is AFTER the cutoff → empty predictor window → zero
+    assert float(np.asarray(batch["n"].values)[0]) == 0.0
+
+
+def test_joined_reader_feature_join():
+    """left_features= routes each side's features through its own aggregate
+    reader; the columns then join per key (≙ JoinedDataReader post-join
+    aggregation)."""
+    from transmogrifai_tpu.readers.base import JoinedReader
+    clicks = [{"u": 1, "ts": 1 * DAY}, {"u": 1, "ts": 2 * DAY},
+              {"u": 2, "ts": 1 * DAY}]
+    sends = [{"u": 1, "ts": 1 * DAY}, {"u": 3, "ts": 2 * DAY}]
+    from transmogrifai_tpu.aggregators import MonoidAggregator
+    s = MonoidAggregator(None, lambda a, b: a + b, "sum")
+    n_clicks = (FeatureBuilder.Real("nClicks")
+                .extract(lambda r: 1.0).aggregate(s).as_predictor())
+    n_sends = (FeatureBuilder.Real("nSends")
+               .extract(lambda r: 1.0).aggregate(s).as_predictor())
+    agg = AggregateParams(cutoff_time=CutOffTime.unix_epoch(5 * DAY),
+                          time_fn=lambda r: r["ts"])
+    joined = JoinedReader(
+        left=AggregateReader(records=clicks, key_fn=lambda r: r["u"],
+                             aggregate_params=agg),
+        right=AggregateReader(records=sends, key_fn=lambda r: r["u"],
+                              aggregate_params=agg),
+        how="outer", left_features=["nClicks"])
+    batch = joined.generate_batch([n_clicks, n_sends])
+    rows = {k: (batch["nClicks"].row_value(i).value,
+                batch["nSends"].row_value(i).value)
+            for i, k in enumerate(batch["key"].values)}
+    assert rows["1"] == (2.0, 1.0)
+    assert rows["2"] == (1.0, None)     # no sends for user 2
+    assert rows["3"] == (None, 1.0)     # outer: right-only key kept
+    # inner join drops one-sided keys
+    joined.how = "inner"
+    b2 = joined.generate_batch([n_clicks, n_sends])
+    assert list(b2["key"].values) == ["1"]
+
+
 def test_sequence_aggregators():
     rows = [(1.0, None), (3.0, 4.0), (None, 8.0)]
     assert sum_by_position(rows) == [4.0, 12.0]

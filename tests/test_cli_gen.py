@@ -165,3 +165,19 @@ def test_example_runs():
                        capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "F1 =" in r.stdout
+
+
+def test_dataprep_examples_run():
+    """ConditionalAggregation + JoinsAndAggregates (≙ helloworld dataprep)
+    run and self-check their expected outputs."""
+    env = dict(os.environ,
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    for ex, marker in (("op_conditional_aggregation", "ConditionalAggregation OK"),
+                       ("op_joins_and_aggregates", "JoinsAndAggregates OK")):
+        boot = ("import sys, jax; jax.config.update('jax_platforms', 'cpu'); "
+                f"import runpy; sys.argv = ['{ex}.py']; "
+                f"runpy.run_path('examples/{ex}.py', run_name='__main__')")
+        r = subprocess.run([sys.executable, "-c", boot], cwd=REPO, env=env,
+                           capture_output=True, text=True, timeout=600)
+        assert r.returncode == 0, (ex, r.stderr[-2000:])
+        assert marker in r.stdout, (ex, r.stdout[-500:])

@@ -196,15 +196,26 @@ class ConditionalReader(DataReader):
                     if p.response_window_ms is None or t < cutoff + p.response_window_ms:
                         resp_events.append(e)
             keys.append(k)
-            rows[k] = (pred_events, resp_events)
+            rows[k] = (cutoff, pred_events, resp_events)
         cols: Dict[str, Column] = {}
         for f in raw_features:
             gen = _generator_of(f)
+            # per-feature .window() narrows this feature's slice around the
+            # per-key condition time (trailing for predictors, leading for
+            # responses — ≙ FeatureBuilder.window in ConditionalAggregation)
+            win = gen.get("aggregate_window_ms")
             vals = []
             for k in keys:
-                pred_events, resp_events = rows[k]
+                cutoff, pred_events, resp_events = rows[k]
                 evs = resp_events if f.is_response else pred_events
-                vals.append(gen.aggregator.aggregate([gen.extract_fn(e) for e in evs]))
+                if win is not None:
+                    if f.is_response:
+                        evs = [e for e in evs
+                               if p.time_fn(e) < cutoff + int(win)]
+                    else:
+                        evs = [e for e in evs
+                               if p.time_fn(e) >= cutoff - int(win)]
+                vals.append(gen.aggregate_records(evs))
             cols[f.name] = column_from_values(f.kind, vals)
         from ..types import Text
         cols["key"] = column_from_values(Text, [str(k) for k in keys])
@@ -212,15 +223,39 @@ class ConditionalReader(DataReader):
 
 
 class JoinedReader(Reader):
-    """Typed key join of two readers (≙ JoinedDataReader.scala:218)."""
+    """Typed key join of two readers (≙ JoinedDataReader.scala:218).
+
+    Two modes, mirroring the reference:
+
+    * **record join** (default): ``read()``/``generate_batch`` emit merged
+      record dicts (cross product per key for multi-matches) — enrichment
+      joins.
+    * **feature join** (``left_features=`` given): each side's reader
+      generates (and aggregates) ITS OWN features, then the feature COLUMNS
+      join per key — the reference's join-then-aggregate flow
+      (JoinedDataReader + post-join aggregation of time-based features).
+      ``left_features`` names the features produced from the left reader's
+      records; everything else routes to the right reader.
+    """
 
     def __init__(self, left: Reader, right: Reader, how: str = "inner",
                  left_key: Optional[Callable[[Dict], Any]] = None,
-                 right_key: Optional[Callable[[Dict], Any]] = None):
+                 right_key: Optional[Callable[[Dict], Any]] = None,
+                 left_features: Optional[Sequence[str]] = None):
         super().__init__()
+        if how not in ("inner", "left", "outer"):
+            raise ValueError(f"JoinedReader: how={how!r} must be one of "
+                             "'inner', 'left', 'outer'")
         self.left, self.right, self.how = left, right, how
         self.left_key = left_key or left.key_fn
         self.right_key = right_key or right.key_fn
+        self.left_features = (set(left_features)
+                              if left_features is not None else None)
+        if self.left_features is not None and (left_key or right_key):
+            raise ValueError(
+                "JoinedReader: feature-join mode (left_features=) joins on "
+                "each side's own key column — set key_fn on the left/right "
+                "readers instead of left_key/right_key")
 
     def read(self) -> List[Dict[str, Any]]:
         lrecs, rrecs = self.left.read(), self.right.read()
@@ -253,10 +288,56 @@ class JoinedReader(Reader):
         return out
 
     def generate_batch(self, raw_features: Sequence[Feature]) -> ColumnBatch:
-        records = self.read()
-        cols: Dict[str, Column] = {}
-        for f in raw_features:
-            cols[f.name] = _generator_of(f).extract_column(records)
         from ..types import Text
-        cols["key"] = column_from_values(Text, [str(r.get("key")) for r in records])
-        return ColumnBatch(cols, len(records))
+
+        if self.left_features is None:
+            records = self.read()
+            cols: Dict[str, Column] = {}
+            for f in raw_features:
+                cols[f.name] = _generator_of(f).extract_column(records)
+            cols["key"] = column_from_values(
+                Text, [str(r.get("key")) for r in records])
+            return ColumnBatch(cols, len(records))
+
+        # feature join: each side aggregates its own features, columns merge
+        # per key (missing side → null, the feature's empty-aggregation value)
+        unknown = self.left_features - {f.name for f in raw_features}
+        if unknown:
+            raise ValueError(
+                f"JoinedReader: left_features {sorted(unknown)} do not match "
+                f"any raw feature; available: "
+                f"{sorted(f.name for f in raw_features)}")
+        lfeats = [f for f in raw_features if f.name in self.left_features]
+        rfeats = [f for f in raw_features if f.name not in self.left_features]
+        lb = self.left.generate_batch(lfeats)
+        rb = self.right.generate_batch(rfeats)
+        lkeys = [str(k) for k in lb["key"].values]
+        rkeys = [str(k) for k in rb["key"].values]
+        for side, ks in (("left", lkeys), ("right", rkeys)):
+            if len(set(ks)) != len(ks):
+                raise ValueError(
+                    f"JoinedReader: the {side} reader emitted duplicate keys "
+                    "— feature-join mode needs one aggregated row per key "
+                    "(use an AggregateReader or a unique key_fn)")
+        lpos = {k: i for i, k in enumerate(lkeys)}
+        rpos = {k: i for i, k in enumerate(rkeys)}
+        if self.how == "inner":
+            keys = [k for k in lkeys if k in rpos]
+        elif self.how == "left":
+            keys = list(lkeys)
+        else:  # outer
+            keys = list(lkeys) + [k for k in rkeys if k not in lpos]
+
+        from ..stages.generator import non_nullable_empty_value
+        cols = {}
+        for feats, batch, pos in ((lfeats, lb, lpos), (rfeats, rb, rpos)):
+            for f in feats:
+                col = batch[f.name]
+                vals = [col.row_value(pos[k]).value if k in pos else None
+                        for k in keys]
+                if f.kind.non_nullable:
+                    zero = non_nullable_empty_value(f.kind)
+                    vals = [zero if v is None else v for v in vals]
+                cols[f.name] = column_from_values(f.kind, vals)
+        cols["key"] = column_from_values(Text, keys)
+        return ColumnBatch(cols, len(keys))

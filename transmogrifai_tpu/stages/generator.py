@@ -16,6 +16,16 @@ from ..types import FeatureType
 from .base import PipelineStage
 
 
+def non_nullable_empty_value(kind: Type[FeatureType]):
+    """The value a non-nullable kind takes when nothing was observed — the
+    SINGLE definition of empty-aggregation semantics (≙ the reference's
+    monoid zeros: SumRealNN → 0).  Prediction has no raw-empty analog."""
+    from ..types import Prediction
+    if issubclass(kind, Prediction):
+        return {"prediction": 0.0}
+    return 0.0
+
+
 class FeatureGeneratorStage(PipelineStage):
     def __init__(self, name: str, kind: Type[FeatureType],
                  extract_fn: Optional[Callable[[Dict[str, Any]], Any]] = None,
@@ -50,15 +60,20 @@ class FeatureGeneratorStage(PipelineStage):
             # non-nullable features absent at scoring time (e.g. the response
             # on unlabeled data) take the monoid zero, matching the
             # reference's empty-aggregation semantics
-            zero = 0.0
+            zero = non_nullable_empty_value(self.kind)
             vals = [zero if v is None else v for v in vals]
         return column_from_values(self.kind, vals)
 
     def aggregate_records(self, records: Sequence[Dict[str, Any]]) -> Any:
         """Monoid-aggregate the extracted values of pre-selected event records
-        (the reader does the time-window selection; ≙ FeatureAggregator)."""
-        return self.aggregator.aggregate(
+        (the reader does the time-window selection; ≙ FeatureAggregator).
+        Empty windows on non-nullable kinds take the monoid zero (the
+        reference's SumRealNN-style empty aggregation → 0)."""
+        out = self.aggregator.aggregate(
             [self.extract_fn(r) for r in records])
+        if out is None and self.kind.non_nullable:
+            return non_nullable_empty_value(self.kind)
+        return out
 
     def extract_aggregated(self, grouped: Dict[Any, Sequence[Dict[str, Any]]],
                            cutoff_fn=None, is_response: bool = False) -> Column:
@@ -74,8 +89,7 @@ class FeatureGeneratorStage(PipelineStage):
                     before = cutoff_fn(ev)
                     if (not is_response and before) or (is_response and not before):
                         selected.append(ev)
-            raw = [self.extract_fn(ev) for ev in selected]
-            vals.append(self.aggregator.aggregate(raw))
+            vals.append(self.aggregate_records(selected))
         return column_from_values(self.kind, vals)
 
     def ctor_args(self):

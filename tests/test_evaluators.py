@@ -194,3 +194,37 @@ def test_device_panel_matches_host_multiclass():
                                    h[nk]["topNCountByBin"], atol=0.5)
         np.testing.assert_allclose(d[nk]["topNCorrectByBin"],
                                    h[nk]["topNCorrectByBin"], atol=0.5)
+
+
+def test_custom_evaluator_in_selector():
+    """Evaluators.*.custom drives model selection with a user metric
+    (≙ Evaluators.scala custom evaluators)."""
+    from transmogrifai_tpu.evaluators import Evaluators
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                            ModelCandidate, grid)
+    from transmogrifai_tpu.workflow import Workflow
+
+    def neg_logloss(y, pred):
+        p = np.clip(np.asarray(pred["probability"])[:, 1], 1e-9, 1 - 1e-9)
+        yy = np.asarray(y)
+        return float(np.mean(yy * np.log(p) + (1 - yy) * np.log(1 - p)))
+
+    ev = Evaluators.BinaryClassification.custom("negLogLoss", neg_logloss)
+    assert ev.is_larger_better and ev.default_metric == "negLogLoss"
+    rng = np.random.default_rng(0)
+    records = [{"y": float(i % 2), "x": float(rng.normal()) + (i % 2)}
+               for i in range(160)]
+    label = FeatureBuilder.RealNN("y").as_response()
+    x = FeatureBuilder.Real("x").as_predictor()
+    sel = BinaryClassificationModelSelector(
+        models=[ModelCandidate(OpLogisticRegression(),
+                               grid(reg_param=[0.01, 0.5]), "LR")],
+        validation_metric=ev)
+    sel.set_input(label, transmogrify([x]))
+    model = (Workflow().set_input_records(records)
+             .set_result_features(sel.get_output()).train())
+    m = model.evaluate(ev)
+    assert -1.0 < m["negLogLoss"] < 0.0

@@ -549,8 +549,37 @@ class OpBinScoreEvaluator(OpEvaluatorBase):
 # factory (≙ Evaluators.scala:40)
 # --------------------------------------------------------------------------
 
+class CustomEvaluator(OpEvaluatorBase):
+    """User-supplied metric (≙ Evaluators.*.custom, Evaluators.scala:126):
+    ``evaluate_fn(y, pred)`` receives the label array and the prediction dict
+    and returns one float.  All three keys ('prediction', 'probability',
+    'rawPrediction') are always PRESENT but may be None for models that don't
+    produce them (e.g. regression) — the fn must handle None values, as the
+    reference leaves error scenarios to the caller."""
+
+    def __init__(self, metric_name: str, evaluate_fn, larger_better: bool = True):
+        super().__init__(default_metric=metric_name,
+                         is_larger_better=larger_better)
+        self.name = metric_name
+        self.evaluate_fn = evaluate_fn
+
+    def evaluate_all(self, y, pred) -> EvaluationMetrics:
+        # uniform contract across the CV loop and Workflow.evaluate: keys
+        # always present, None when the model has no such output
+        pred = dict(pred)
+        for k in ("prediction", "probability", "rawPrediction"):
+            pred.setdefault(k, None)
+        return EvaluationMetrics(
+            {self.default_metric: float(self.evaluate_fn(y, pred))})
+
+
 class Evaluators:
+    # user metric factory, shared by every problem-type family
+    custom = CustomEvaluator
+
     class BinaryClassification:
+        custom = CustomEvaluator
+
         @staticmethod
         def auPR() -> OpBinaryClassificationEvaluator:
             return OpBinaryClassificationEvaluator(default_metric="AuPR")
@@ -581,6 +610,8 @@ class Evaluators:
             return OpBinScoreEvaluator()
 
     class MultiClassification:
+        custom = CustomEvaluator
+
         @staticmethod
         def precision() -> OpMultiClassificationEvaluator:
             return OpMultiClassificationEvaluator(default_metric="Precision")
@@ -599,6 +630,8 @@ class Evaluators:
                 default_metric="Error", is_larger_better=False)
 
     class Regression:
+        custom = CustomEvaluator
+
         @staticmethod
         def rmse() -> OpRegressionEvaluator:
             return OpRegressionEvaluator(default_metric="RootMeanSquaredError")

@@ -47,15 +47,19 @@ def model_and_pred():
 
 def test_device_run_engages(model_and_pred):
     """The partition must place the vector-combine → sanity-slice → model
-    chain (at minimum) inside the single jitted run."""
+    chain (at minimum) inside ONE jitted device segment."""
     model, _ = model_and_pred
     prog = model.score_program()
     batch = model.generate_raw_data()
-    pre, run, post = prog._partition(batch)
-    names = [s.operation_name for s in run]
-    assert "VectorsCombiner" in names
-    assert "SanityCheckerModel" in names
-    assert "SelectedModel" in names
+    segments = prog._partition(batch)
+    dev_segs = [[s.operation_name for s in seg] for is_dev, seg in segments
+                if is_dev]
+    assert any({"VectorsCombiner", "SanityCheckerModel",
+                "SelectedModel"} <= set(names) for names in dev_segs), dev_segs
+    # the numeric vectorizer (device op over raw numeric columns) also
+    # compiles, in its own earlier segment or the same one
+    all_dev = {n for names in dev_segs for n in names}
+    assert any("RealVectorizer" in n or "Vectorizer" in n for n in all_dev)
 
 
 def test_compiled_matches_eager(model_and_pred):

@@ -1,18 +1,21 @@
 """Compiled scoring — the fitted transformer DAG as ONE XLA program.
 
 The reference's score path bulk-applies row closures per layer and persists
-every K stages to break Catalyst (FitStagesUtil.scala:96,134-165).  Here the
-device-resident middle of the DAG — vectorizer models, VectorsCombiner,
-SanityChecker slice, the selected model's forward — is traced ONCE into a
-single jitted program: one compile, one host→device transfer of the frontier
-columns, one device→host transfer of the requested results per ``score()``
-call (SURVEY.md §2.6 P5: HBM residency replaces ``.persist()``).
+every K stages to break Catalyst (FitStagesUtil.scala:96,134-165).  Here
+every maximal device-resident stretch of the DAG — vectorizer models,
+VectorsCombiner, SanityChecker slice, the selected model's forward — traces
+into its own jitted program: one compile per segment (cached across calls),
+one host→device transfer of each segment's frontier columns, one
+device→host transfer of the requested results per ``score()`` call
+(SURVEY.md §2.6 P5: HBM residency replaces ``.persist()``).  For a typical
+numeric workflow that is ONE fused program; text-heavy DAGs get a device
+segment before and after their string stages.
 
 String/object-valued stages (tokenizers, validators, pick-list maps) cannot
-live in an XLA program; they run as a host prologue/epilogue around the
-compiled run.  A stage whose ``is_device_op`` flag is optimistic but whose
-transform turns out not to be traceable is demoted automatically (one retry,
-then it joins the host segments for the lifetime of the program).
+live in an XLA program; they run eagerly between the compiled segments.  A
+stage whose ``is_device_op`` flag is optimistic but whose transform turns
+out not to be traceable is demoted automatically (one retry, then it joins
+the host segments for the lifetime of the program).
 """
 
 from __future__ import annotations
@@ -39,16 +42,17 @@ class ScoreProgram:
     """A fitted DAG compiled for repeated scoring.
 
     ``program = ScoreProgram(stages, result_names)`` then
-    ``scored = program(batch)`` — equivalent to ``apply_dag`` but the longest
-    contiguous run of device-traceable stages executes as one jitted XLA
-    program.  jax's jit cache keys on the frontier shapes, so calls with a
-    fixed schema compile exactly once.
+    ``scored = program(batch)`` — equivalent to ``apply_dag`` but every
+    maximal contiguous run of device-traceable stages executes as one jitted
+    XLA program (host stages eager in between).  jax's jit cache keys on the
+    frontier shapes, so calls with a fixed schema compile each segment
+    exactly once.
     """
 
     def __init__(self, dag: Sequence, result_names: Sequence[str]):
         # accept a layered DAG or a flat stage list; within a layer, order
         # host ops before device ops (any within-layer order is topologically
-        # legal) so the contiguous device run swallows as much as possible
+        # legal) so device segments coalesce instead of fragmenting
         layers = ([list(l) for l in dag]
                   if dag and isinstance(dag[0], (list, tuple)) else [list(dag)])
         self.stages: List[Transformer] = []
@@ -60,14 +64,16 @@ class ScoreProgram:
         self._metas: Dict[Tuple[str, ...], Dict[str, Any]] = {}
 
     # -- partition ----------------------------------------------------------
-    def _partition(self, batch: ColumnBatch
-                   ) -> Tuple[List[Transformer], List[Transformer], List[Transformer]]:
-        """Split stages (already in topo order) into host-pre / device-run /
-        host-post, where the run is the longest contiguous stretch of stages
-        that are device ops over array-resident inputs."""
+    def _partition(self, batch: ColumnBatch) -> List[Tuple[bool, List[Transformer]]]:
+        """Split stages (already in topo order) into alternating
+        (is_device_segment, stages) groups: every maximal contiguous stretch
+        of device ops over array-resident inputs becomes its own jitted
+        segment, with host stages eager in between (a text-heavy DAG can have
+        device vectorizers BEFORE its string stages and the fused model tail
+        after — both compile)."""
         arrayish: Dict[str, bool] = {
             name: batch[name].is_device for name in batch.names()}
-        flags: List[bool] = []
+        segments: List[Tuple[bool, List[Transformer]]] = []
         for st in self.stages:
             ok = (st.is_device_op and st.uid not in self._demoted
                   and all(arrayish.get(f.name, False)
@@ -76,54 +82,47 @@ class ScoreProgram:
                 # host stages may still emit array columns (e.g. one-hot on
                 # strings); simulate with the same rule Column.is_device uses
                 arrayish[f.name] = True if ok else _kind_arrayish(f.kind)
-            flags.append(ok)
-        # longest contiguous True run
-        best_s = best_e = 0
-        s = None
-        for i, f in enumerate(flags + [False]):
-            if f and s is None:
-                s = i
-            elif not f and s is not None:
-                if i - s > best_e - best_s:
-                    best_s, best_e = s, i
-                s = None
-        return (self.stages[:best_s], self.stages[best_s:best_e],
-                self.stages[best_e:])
+            if segments and segments[-1][0] == ok:
+                segments[-1][1].append(st)
+            else:
+                segments.append((ok, [st]))
+        return segments
 
     # -- execution ----------------------------------------------------------
     def __call__(self, batch: ColumnBatch, keep_intermediate: bool = False
                  ) -> ColumnBatch:
         for _attempt in range(len(self.stages) + 1):
-            pre, run, post = self._partition(batch)
+            segments = self._partition(batch)
             b = batch
-            for st in pre:
-                b = st.transform_batch(b)
-            if run:
-                try:
-                    b = self._apply_run(b, run, post, keep_intermediate)
-                except _StageTraceError as e:
-                    # demote the offending stage to the host segments and
-                    # re-partition; transforms are pure so re-running the
-                    # prologue on the original batch is safe
-                    self._demoted.add(e.uid)
-                    continue
-            for st in post:
-                b = st.transform_batch(b)
+            try:
+                for i, (is_dev, stages) in enumerate(segments):
+                    if not is_dev:
+                        for st in stages:
+                            b = st.transform_batch(b)
+                        continue
+                    later = [st for _, seg in segments[i + 1:] for st in seg]
+                    b = self._apply_run(b, stages, later, keep_intermediate)
+            except _StageTraceError as e:
+                # demote the offending stage to the host segments and
+                # re-partition; transforms are pure so re-running the
+                # prologue on the original batch is safe
+                self._demoted.add(e.uid)
+                continue
             return b
         raise RuntimeError("ScoreProgram failed to converge on a partition")
 
-    def _wanted_outputs(self, run: List[Transformer], post: List[Transformer],
+    def _wanted_outputs(self, run: List[Transformer], later: List[Transformer],
                         keep_intermediate: bool) -> List[str]:
         produced = [f.name for st in run for f in st.output_features]
         if keep_intermediate:
             return produced
         needed = set(self.result_names)
-        for st in post:
+        for st in later:
             needed.update(f.name for f in st.input_features)
         return [n for n in produced if n in needed]
 
     def _apply_run(self, batch: ColumnBatch, run: List[Transformer],
-                   post: List[Transformer], keep_intermediate: bool
+                   later: List[Transformer], keep_intermediate: bool
                    ) -> ColumnBatch:
         key = tuple(st.uid for st in run) + (keep_intermediate,)
         frontier = sorted({f.name for st in run for f in st.input_features
@@ -137,7 +136,7 @@ class ScoreProgram:
                 f.name in host_cols for f in st.input_features))
             raise _StageTraceError(offender.uid, TypeError(
                 f"frontier columns {host_cols} are host-resident"))
-        out_names = self._wanted_outputs(run, post, keep_intermediate)
+        out_names = self._wanted_outputs(run, later, keep_intermediate)
         kinds = {n: batch[n].kind for n in frontier}
         metas_in = {n: batch[n].meta for n in frontier}
 

@@ -281,3 +281,40 @@ def test_runner_score_without_workflow(tmp_path):
 
     with pytest.raises(ValueError, match="needs a Workflow"):
         OpWorkflowRunner().run(RunType.TRAIN, OpParams(model_location=loc))
+
+
+def test_runner_applies_stage_params(tmp_path):
+    """OpParams.stageParams inject per-stage-class hyperparameters before
+    training (≙ OpWorkflow.setStageParameters, OpWorkflow.scala:178-199)."""
+    import numpy as np
+    from transmogrifai_tpu.params import OpParams
+    from transmogrifai_tpu.runner import OpWorkflowRunner, RunType
+    from transmogrifai_tpu.selector import ModelCandidate, grid
+
+    rng = np.random.default_rng(0)
+    records = [{"y": float(i % 2), "x": float(rng.normal()) + (i % 2)}
+               for i in range(120)]
+    label = FeatureBuilder.RealNN("y").as_response()
+    x = FeatureBuilder.Real("x").as_predictor()
+    checked = label.sanity_check(transmogrify([x]),
+                                 remove_bad_features=False)
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]), "LR")])
+    sel.set_input(label, checked)
+    wf = Workflow().set_input_records(records) \
+                   .set_result_features(sel.get_output())
+    checker_stage = checked.origin_stage
+    assert checker_stage.get("max_correlation") != 0.77
+    runner = OpWorkflowRunner(wf)
+    runner.run(RunType.TRAIN, OpParams(
+        model_location=str(tmp_path / "m"),
+        stage_params={"SanityChecker": {"max_correlation": 0.77}}))
+    assert checker_stage.get("max_correlation") == 0.77
+
+    # a typo'd stage-class name warns instead of silently training defaults
+    import warnings as _w
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        wf.apply_stage_params(OpParams(
+            stage_params={"SanityCheker": {"max_correlation": 0.5}}))
+    assert any("matched no stage" in str(w.message) for w in caught)

@@ -15,6 +15,37 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TITANIC = os.path.join(REPO, "data/titanic/TitanicPassengersTrainData.csv")
 
 
+def run_script(script_path, argv=(), cwd=REPO, timeout=900):
+    """Run a python script in a subprocess pinned to the CPU backend (the
+    image's sitecustomize forces the TPU platform past JAX_PLATFORMS, so the
+    pin happens via jax.config before the script executes)."""
+    env = dict(os.environ,
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    boot = ("import sys, jax; jax.config.update('jax_platforms', 'cpu'); "
+            f"import runpy; sys.argv = {[os.path.basename(script_path), *argv]!r}; "
+            f"runpy.run_path({script_path!r}, run_name='__main__')")
+    return subprocess.run([sys.executable, "-c", boot], cwd=cwd, env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def train_generated_app(out_dir, selector_cls):
+    """Trim the generated app's grid for test speed, then train it."""
+    app_path = os.path.join(out_dir, "app.py")
+    with open(app_path) as f:
+        app_src = f.read()
+    app_src = app_src.replace(
+        f"{selector_cls}()",
+        f"{selector_cls}(model_types_to_use=['OpLogisticRegression'])")
+    with open(app_path, "w") as f:
+        f.write(app_src)
+    r = run_script(app_path,
+                   ["--run-type", "train",
+                    "--model-location", os.path.join(out_dir, "model")],
+                   cwd=out_dir)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert os.path.exists(os.path.join(out_dir, "model", "op-model.json"))
+
+
 def test_infer_problem_kind():
     assert infer_problem_kind(T.Binary, [True, False]) == BINARY
     assert infer_problem_kind(T.Real, [0.0, 1.0, 1.0]) == BINARY
@@ -49,30 +80,7 @@ def test_gen_produces_runnable_project(tmp_path):
 
     # the generated app trains for real (≙ cli tests actually building the
     # generated project)
-    # trim the default grid for test speed (the generated code keeps
-    # production defaults; the point here is that the scaffold runs)
-    app_path = os.path.join(out, "app.py")
-    with open(app_path) as f:
-        app_src = f.read()
-    app_src = app_src.replace(
-        "BinaryClassificationModelSelector()",
-        "BinaryClassificationModelSelector("
-        "model_types_to_use=['OpLogisticRegression'])")
-    with open(app_path, "w") as f:
-        f.write(app_src)
-
-    env = dict(os.environ,
-               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
-    # the image's sitecustomize forces the TPU platform past JAX_PLATFORMS,
-    # so pin the CPU backend via jax.config before running the app
-    boot = ("import sys, jax; jax.config.update('jax_platforms', 'cpu'); "
-            "import runpy; sys.argv = ['app.py', '--run-type', 'train', "
-            f"'--model-location', {os.path.join(out, 'model')!r}]; "
-            "runpy.run_path('app.py', run_name='__main__')")
-    r = subprocess.run([sys.executable, "-c", boot], cwd=out, env=env,
-                       capture_output=True, text=True, timeout=900)
-    assert r.returncode == 0, r.stderr[-2000:]
-    assert os.path.exists(os.path.join(out, "model", "op-model.json"))
+    train_generated_app(out, "BinaryClassificationModelSelector")
 
 
 def test_gen_unknown_response(tmp_path):
@@ -96,7 +104,7 @@ def test_gen_bad_name(tmp_path):
 def test_gen_headerless_csv_and_text_label(tmp_path):
     """--headers plumbs through for headerless CSVs (every bundled dataset),
     and a text response generates the StringIndexer label path; the emitted
-    sources must at least compile."""
+    scaffold must train for real."""
     out = str(tmp_path / "p")
     rc = main(["gen", "--name", "IrisApp",
                "--input", os.path.join(REPO, "data/iris/iris.csv"),
@@ -116,26 +124,7 @@ def test_gen_headerless_csv_and_text_label(tmp_path):
     assert "headers=['id', 'sepalLength'" in app_src
     compile(feats_src, "features.py", "exec")
     compile(app_src, "app.py", "exec")
-
-    # and the headerless scaffold actually trains
-    with open(os.path.join(out, "app.py")) as f:
-        app_src = f.read()
-    app_src = app_src.replace(
-        "MultiClassificationModelSelector()",
-        "MultiClassificationModelSelector("
-        "model_types_to_use=['OpLogisticRegression'])")
-    with open(os.path.join(out, "app.py"), "w") as f:
-        f.write(app_src)
-    env = dict(os.environ,
-               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
-    boot = ("import sys, jax; jax.config.update('jax_platforms', 'cpu'); "
-            "import runpy; sys.argv = ['app.py', '--run-type', 'train', "
-            f"'--model-location', {os.path.join(out, 'model')!r}]; "
-            "runpy.run_path('app.py', run_name='__main__')")
-    r = subprocess.run([sys.executable, "-c", boot], cwd=out, env=env,
-                       capture_output=True, text=True, timeout=900)
-    assert r.returncode == 0, r.stderr[-2000:]
-    assert os.path.exists(os.path.join(out, "model", "op-model.json"))
+    train_generated_app(out, "MultiClassificationModelSelector")
 
 
 def test_gen_nonstandard_binary_label_remapped(tmp_path):
@@ -154,30 +143,17 @@ def test_gen_nonstandard_binary_label_remapped(tmp_path):
     assert os.path.isabs(src) and src in files["app.py"]
 
 
-def test_example_runs():
-    """Examples are runnable scripts (≙ helloworld apps)."""
-    env = dict(os.environ,
-               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
-    boot = ("import sys, jax; jax.config.update('jax_platforms', 'cpu'); "
-            "import runpy; sys.argv = ['op_iris_simple.py']; "
-            "runpy.run_path('examples/op_iris_simple.py', run_name='__main__')")
-    r = subprocess.run([sys.executable, "-c", boot], cwd=REPO, env=env,
-                       capture_output=True, text=True, timeout=600)
-    assert r.returncode == 0, r.stderr[-2000:]
-    assert "F1 =" in r.stdout
-
-
-def test_dataprep_examples_run():
-    """ConditionalAggregation + JoinsAndAggregates (≙ helloworld dataprep)
-    run and self-check their expected outputs."""
-    env = dict(os.environ,
-               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
-    for ex, marker in (("op_conditional_aggregation", "ConditionalAggregation OK"),
-                       ("op_joins_and_aggregates", "JoinsAndAggregates OK")):
-        boot = ("import sys, jax; jax.config.update('jax_platforms', 'cpu'); "
-                f"import runpy; sys.argv = ['{ex}.py']; "
-                f"runpy.run_path('examples/{ex}.py', run_name='__main__')")
-        r = subprocess.run([sys.executable, "-c", boot], cwd=REPO, env=env,
-                           capture_output=True, text=True, timeout=600)
-        assert r.returncode == 0, (ex, r.stderr[-2000:])
-        assert marker in r.stdout, (ex, r.stdout[-500:])
+@pytest.mark.parametrize("example,marker", [
+    ("op_iris_simple", "F1 ="),
+    ("op_titanic_simple", "AuROC"),
+    ("op_boston_simple", "RMSE"),
+    ("op_conditional_aggregation", "ConditionalAggregation OK"),
+    ("op_joins_and_aggregates", "JoinsAndAggregates OK"),
+])
+def test_examples_run(example, marker):
+    """Every shipped example runs and prints its signature output
+    (≙ the reference's helloworld apps, incl. the dataprep pair)."""
+    r = run_script(os.path.join(REPO, "examples", f"{example}.py"),
+                   timeout=600)
+    assert r.returncode == 0, (example, r.stderr[-2000:])
+    assert marker in r.stdout, (example, r.stdout[-500:])

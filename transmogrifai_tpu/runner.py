@@ -97,6 +97,8 @@ class OpWorkflowRunner:
                 "with OpWorkflowRunner(workflow, ...)")
         if self.train_reader is not None:
             self.workflow.set_reader(self.train_reader)
+        if params.stage_params:
+            self.workflow.apply_stage_params(params)
         with timer.phase("train"):
             model = self.workflow.train()
         summary = None

@@ -119,6 +119,30 @@ class Workflow(_WorkflowCore):
                             "serialization": serialization_check}
         return self
 
+    def apply_stage_params(self, op_params) -> "Workflow":
+        """Per-stage-class hyperparameter injection from OpParams
+        (≙ OpWorkflow.setStageParameters, OpWorkflow.scala:178-199).  Entries
+        matching no stage warn — a typo'd class name must not silently train
+        with defaults."""
+        import warnings
+
+        stages = dag_stages(compute_dag(self.result_features))
+        for match, kv in (op_params.stage_params or {}).items():
+            hit = False
+            for st in stages:
+                cls_name = type(st).__name__
+                if cls_name == match or cls_name.startswith(match):
+                    hit = True
+                    for k, v in kv.items():
+                        st.set(k, v)
+            if not hit:
+                warnings.warn(
+                    f"stageParams entry {match!r} matched no stage in the "
+                    f"workflow (stages: "
+                    f"{sorted({type(s).__name__ for s in stages})})",
+                    stacklevel=2)
+        return self
+
     def with_raw_feature_filter(self, **kw) -> "Workflow":
         """≙ withRawFeatureFilter (OpWorkflow.scala:538)."""
         from .filters import RawFeatureFilter

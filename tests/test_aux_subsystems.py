@@ -318,3 +318,55 @@ def test_runner_applies_stage_params(tmp_path):
         wf.apply_stage_params(OpParams(
             stage_params={"SanityCheker": {"max_correlation": 0.5}}))
     assert any("matched no stage" in str(w.message) for w in caught)
+
+
+def test_rff_js_divergence_drops_shifted_feature():
+    """A feature whose train vs scoring distributions diverge beyond
+    max_js_divergence is dropped (≙ RawFeatureFilter's train-vs-score JS
+    check, RawFeatureFilter.scala:218-445)."""
+    rng = np.random.default_rng(5)
+    n = 400
+    train_records, score_records = [], []
+    for i in range(n):
+        train_records.append({"y": float(i % 2),
+                              "stable": float(rng.normal()),
+                              "shifty": float(rng.normal(0.0, 0.5))})
+        score_records.append({"stable": float(rng.normal()),
+                              "shifty": float(rng.normal(50.0, 0.5))})
+    schema = {"y": T.RealNN, "stable": T.Real, "shifty": T.Real}
+    y, predictors = features_from_schema(schema, response="y")
+    raw = [y] + predictors
+    batch = DataReader(records=train_records).generate_batch(raw)
+    rff = RawFeatureFilter(max_js_divergence=0.5,
+                           score_reader=DataReader(records=score_records))
+    clean, dropped, results = rff.filter_batch(batch, raw)
+    assert "shifty" in results.dropped
+    assert "stable" not in results.dropped
+    assert any("js" in " ".join(rs).lower()
+               for rs in results.reasons.values() if rs)
+
+
+def test_rff_drops_shifted_map_keys_individually():
+    """A map feature with one shifted key drops just that KEY (cleaned out of
+    the surviving column); the whole feature drops only when every key
+    fails (≙ per-key FeatureDistributions + mapKeysDropped)."""
+    rng = np.random.default_rng(6)
+    n = 300
+    train_records, score_records = [], []
+    for i in range(n):
+        train_records.append({"y": float(i % 2),
+                              "m": {"ok": float(rng.normal()),
+                                    "drift": float(rng.normal(0.0, 0.5))}})
+        score_records.append({"m": {"ok": float(rng.normal()),
+                                    "drift": float(rng.normal(40.0, 0.5))}})
+    schema = {"y": T.RealNN, "m": T.RealMap}
+    y, predictors = features_from_schema(schema, response="y")
+    raw = [y] + predictors
+    batch = DataReader(records=train_records).generate_batch(raw)
+    rff = RawFeatureFilter(max_js_divergence=0.5,
+                           score_reader=DataReader(records=score_records))
+    clean, dropped, results = rff.filter_batch(batch, raw)
+    assert results.dropped_map_keys.get("m") == ["drift"]
+    assert "m" not in results.dropped          # one healthy key survives
+    assert all("drift" not in (m or {}) for m in clean["m"].values)
+    assert any("ok" in (m or {}) for m in clean["m"].values)

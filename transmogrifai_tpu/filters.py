@@ -231,19 +231,82 @@ def _value_presence(col: Column) -> np.ndarray:
     return np.ones(len(col), dtype=bool)
 
 
+def numeric_ranges(feature: Feature, col: Column
+                   ) -> Dict[Optional[str], Tuple[float, float]]:
+    """Per-(feature[, map-key]) numeric (min, max) — the reference's Summary
+    pass.  Train + score ranges merge so BOTH sides bin identically; without a
+    shared range a pure mean shift produces near-identical histogram shapes
+    and JS divergence never fires."""
+    kind = feature.kind
+    out: Dict[Optional[str], Tuple[float, float]] = {}
+
+    def rng_of(vals):
+        arr = np.asarray(
+            [float(v) if isinstance(v, (int, float, np.integer, np.floating))
+             and not isinstance(v, bool) else np.nan for v in vals],
+            dtype=np.float64)
+        arr = arr[np.isfinite(arr)]
+        if not arr.size:
+            return None
+        return float(arr.min()), float(arr.max())
+
+    if is_map_kind(kind):
+        from .types import map_value_kind
+        if not is_numeric_kind(map_value_kind(kind)):
+            return out
+        keys = sorted({k for m in col.values if m for k in m})
+        for k in keys:
+            r = rng_of([m.get(k) if m else None for m in col.values])
+            if r is not None:
+                out[k] = r
+        return out
+    if is_numeric_kind(kind) and not col.is_host_object():
+        vals = np.asarray(col.values, dtype=np.float64)
+        if col.mask is not None:
+            vals = vals[np.asarray(col.mask)]
+        vals = vals[np.isfinite(vals)]
+        if vals.size:
+            out[None] = (float(vals.min()), float(vals.max()))
+    elif is_numeric_kind(kind):
+        r = rng_of(list(col.values))
+        if r is not None:
+            out[None] = r
+    return out
+
+
+def merge_ranges(a: Dict, b: Dict) -> Dict:
+    out = dict(a)
+    for k, (lo, hi) in b.items():
+        if k in out:
+            out[k] = (min(out[k][0], lo), max(out[k][1], hi))
+        else:
+            out[k] = (lo, hi)
+    return out
+
+
 def compute_distribution(feature: Feature, col: Column, bins: int,
-                         text_bins: int) -> List[FeatureDistribution]:
-    """Per-feature histogram(s).  Maps expand per key (≙ PreparedFeatures)."""
+                         text_bins: int,
+                         ranges: Optional[Dict] = None
+                         ) -> List[FeatureDistribution]:
+    """Per-feature histogram(s).  Maps expand per key (≙ PreparedFeatures).
+    ``ranges`` pins the numeric binning range per key (shared train/score
+    Summary)."""
     n = len(col)
     present = _value_presence(col)
     out = []
     kind = feature.kind
+    ranges = ranges or {}
     if is_map_kind(kind):
+        from .types import map_value_kind
+        vkind = map_value_kind(kind)
         keys = sorted({k for m in col.values if m for k in m})
         for k in keys:
             vals = [m.get(k) if m else None for m in col.values]
             sub_present = np.array([v is not None for v in vals])
-            dist = _histogram_of(vals, sub_present, kind, bins, text_bins)
+            # histogram by the map's VALUE kind: a RealMap's values are
+            # numeric and must bin numerically, not hash as text
+            dist = _histogram_of(vals, sub_present, vkind, bins, text_bins,
+                                 value_range=ranges.get(k))
             out.append(FeatureDistribution(
                 feature.name, key=k, count=n,
                 nulls=int((~sub_present).sum()), distribution=dist))
@@ -253,7 +316,8 @@ def compute_distribution(feature: Feature, col: Column, bins: int,
         return out
     dist = _histogram_of(list(np.asarray(col.values, dtype=object))
                          if col.is_host_object() else np.asarray(col.values),
-                         present, kind, bins, text_bins)
+                         present, kind, bins, text_bins,
+                         value_range=ranges.get(None))
     out.append(FeatureDistribution(feature.name, count=n,
                                    nulls=int((~present).sum()),
                                    distribution=dist))
@@ -261,7 +325,7 @@ def compute_distribution(feature: Feature, col: Column, bins: int,
 
 
 def _histogram_of(vals, present: np.ndarray, kind, bins: int,
-                  text_bins: int) -> np.ndarray:
+                  text_bins: int, value_range=None) -> np.ndarray:
     if is_numeric_kind(kind):
         arr = np.asarray(
             [float(v) if (v is not None and not isinstance(v, str)) else np.nan
@@ -270,7 +334,10 @@ def _histogram_of(vals, present: np.ndarray, kind, bins: int,
         arr = arr[present & np.isfinite(arr)]
         if arr.size == 0:
             return np.zeros(bins)
-        lo, hi = float(arr.min()), float(arr.max())
+        if value_range is not None:
+            lo, hi = value_range
+        else:
+            lo, hi = float(arr.min()), float(arr.max())
         if lo == hi:
             hi = lo + 1.0
         h, _ = np.histogram(arr, bins=bins, range=(lo, hi))
@@ -345,17 +412,31 @@ class RawFeatureFilter:
         for f in raw_features:
             if f.name not in batch or f.is_response:
                 continue
-            fdists = compute_distribution(f, batch[f.name], self.bins, self.text_bins)
+            # shared Summary range over BOTH readers so train and score bin
+            # identically (≙ Summary.scala) — a mean shift must move mass to
+            # different bins, or JS divergence can never see it
+            ranges = numeric_ranges(f, batch[f.name])
+            score_col = (score_batch[f.name] if score_batch is not None
+                         and f.name in score_batch else None)
+            if score_col is not None:
+                ranges = merge_ranges(ranges, numeric_ranges(f, score_col))
+            fdists = compute_distribution(f, batch[f.name], self.bins,
+                                          self.text_bins, ranges=ranges)
             dists[f.name] = fdists
             results.train_distributions.extend(fdists)
-            reasons: List[str] = []
+            sdists: List[FeatureDistribution] = []
+            if score_col is not None:
+                sdists = compute_distribution(f, score_col, self.bins,
+                                              self.text_bins, ranges=ranges)
+                results.score_distributions.extend(sdists)
             if f.name in self.protected:
                 continue
-            train_d = fdists[0]
+
+            reasons: List[str] = []
             # minimum fill rate (≙ minFill)
             if all(d.fill_rate < self.min_fill_rate for d in fdists):
                 reasons.append(
-                    f"fill rate {train_d.fill_rate:.4f} < minFillRate")
+                    f"fill rate {fdists[0].fill_rate:.4f} < minFillRate")
             # null-label correlation (leakage through missingness)
             if label_values is not None and len(np.unique(label_values)) > 1:
                 presence = _value_presence(batch[f.name]).astype(np.float64)
@@ -364,23 +445,55 @@ class RawFeatureFilter:
                     if np.isfinite(corr) and abs(corr) > self.max_correlation:
                         reasons.append(
                             f"null-label correlation {corr:.4f} > max")
-            # train-vs-score distribution shift
-            if score_batch is not None and f.name in score_batch:
-                sdists = compute_distribution(
-                    f, score_batch[f.name], self.bins, self.text_bins)
-                results.score_distributions.extend(sdists)
-                sd = sdists[0]
-                if train_d.relative_fill_rate(sd) > self.max_fill_difference:
-                    reasons.append("fill rate difference train/score too large")
-                if train_d.relative_fill_ratio(sd) > self.max_fill_ratio_diff:
-                    reasons.append("fill rate ratio train/score too large")
-                js = train_d.js_divergence(sd)
+
+            # train-vs-score distribution shift, compared PER KEY for maps
+            # (≙ getFeaturesToExclude pairing distributions by (name, key));
+            # shifted map keys drop individually, the whole feature drops
+            # only when every key fails
+            sd_by_key = {d.key: d for d in sdists}
+            shifted_keys: List[str] = []
+            for d in fdists:
+                sd = sd_by_key.get(d.key)
+                if sd is None:
+                    continue
+                kreasons = []
+                if d.relative_fill_rate(sd) > self.max_fill_difference:
+                    kreasons.append("fill rate difference train/score too large")
+                if d.relative_fill_ratio(sd) > self.max_fill_ratio_diff:
+                    kreasons.append("fill rate ratio train/score too large")
+                js = d.js_divergence(sd)
                 if js > self.max_js_divergence:
-                    reasons.append(f"JS divergence {js:.4f} > max")
+                    kreasons.append(f"JS divergence {js:.4f} > max")
+                if not kreasons:
+                    continue
+                if d.key is None:
+                    reasons.extend(kreasons)
+                else:
+                    shifted_keys.append(d.key)
+                    results.reasons[f"{f.name}[{d.key}]"] = kreasons
+            all_keys = [d.key for d in fdists if d.key is not None]
+            if shifted_keys:
+                results.dropped_map_keys[f.name] = shifted_keys
+                if len(shifted_keys) == len(all_keys):
+                    reasons.append("every map key failed train/score checks")
             if reasons:
                 results.dropped.append(f.name)
-                results.reasons[f.name] = reasons
+                results.reasons[f.name] = reasons + \
+                    results.reasons.get(f.name, [])
 
-        dropped_features = [f for f in raw_features if f.name in set(results.dropped)]
+        dropped = set(results.dropped)
+        dropped_features = [f for f in raw_features if f.name in dropped]
         clean = batch.drop(results.dropped)
+        # strip dropped keys out of surviving map columns (≙ generateFilteredRaw
+        # cleaning map values of excluded keys)
+        for name, keys in results.dropped_map_keys.items():
+            if name in dropped or name not in clean:
+                continue
+            kset = set(keys)
+            col = clean[name]
+            vals = np.empty(len(col), dtype=object)
+            for i, m in enumerate(col.values):
+                vals[i] = ({k: v for k, v in m.items() if k not in kset}
+                           if m else m)
+            clean = clean.with_column(name, Column(col.kind, vals))
         return clean, dropped_features, results

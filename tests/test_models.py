@@ -122,3 +122,21 @@ def test_logreg_matches_sklearn_style_solution(binary_data):
     p = np.asarray(jnn.sigmoid(logits))
     grad = X.T @ (p - y) / len(y)
     assert np.abs(grad).max() < 5e-3
+
+
+def test_forest_learns_interactions_with_feature_subsetting():
+    """Per-NODE feature subsetting (Spark featureSubsetStrategy semantics):
+    a forest with sqrt-features must still learn a zero-marginal interaction
+    (XOR-style), which per-TREE subsetting cannot — regression for the bug
+    where depth-6 forests scored ~0.58 AuROC while sklearn scored ~0.95."""
+    from transmogrifai_tpu.evaluators import auroc
+    from transmogrifai_tpu.models.trees import OpRandomForestClassifier
+
+    rng = np.random.default_rng(0)
+    N, D = 30_000, 16
+    X = rng.normal(size=(N, D)).astype(np.float32)
+    y = ((X[:, 0] * X[:, 1] > 0) ^ (X[:, 2] > 0.5)).astype(np.float32)
+    est = OpRandomForestClassifier(num_trees=20, max_depth=6)
+    model = est.model_cls(fitted=est.fit_arrays(X, y), **est._params)
+    s = np.asarray(model.predict_arrays(X)["probability"])[:, 1]
+    assert auroc(y, s) > 0.85

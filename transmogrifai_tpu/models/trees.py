@@ -134,12 +134,20 @@ def fit_tree(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
              feature_mask: jnp.ndarray, *, impurity: str, max_depth: int,
              n_bins: int, min_instances: jnp.ndarray, min_gain: jnp.ndarray,
              lam: jnp.ndarray, chunk: "Optional[int]" = None,
-             hist_dtype=None) -> TreeArrays:
+             hist_dtype=None, node_feature_key=None,
+             features_per_node: "Optional[int]" = None) -> TreeArrays:
     """Grow one tree level-wise on binned data.
 
     B [N, D] int32; stats [N, S] pre-weighted per-row statistics (col 0 must be
     the row weight/count); feature_mask [D] 0/1.  Returns perfect-heap arrays
     with ``T = 2^(max_depth+1) - 1`` nodes.
+
+    ``node_feature_key`` + ``features_per_node`` enable random-forest PER-NODE
+    feature subsetting (Spark's featureSubsetStrategy / sklearn max_features
+    semantics): every node at every level draws its own candidate-feature set.
+    Restricting whole TREES to a feature subset instead cripples interaction
+    learning — with D features and k per tree, almost no tree holds all the
+    interacting features together.
 
     Histogram strategy (the TPU-critical choice): for shallow levels
     (``n_l * S <= 256``) the per-(node, feature, bin) stats come from one bf16
@@ -202,6 +210,18 @@ def fit_tree(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
 
         use_matmul = n_l * S <= 256
         mxu = hist_dtype if hist_dtype is not None else _mxu_dtype()
+        # per-node candidate-feature masks [n_chunks, chunk, n_l]: each node
+        # draws its own subset (uniform scores, k-th order-statistic cut)
+        if (node_feature_key is not None and features_per_node is not None
+                and features_per_node < D):
+            kl = jax.random.fold_in(node_feature_key, level)
+            scores = jax.random.uniform(kl, (n_l, D_pad))
+            scores = jnp.where(fmask[None, :] > 0, scores, jnp.inf)
+            kth = jnp.sort(scores, axis=1)[:, features_per_node - 1][:, None]
+            node_mask = scores <= kth                        # [n_l, D_pad]
+            nm_chunks = node_mask.T.reshape(n_chunks, chunk, n_l)
+        else:
+            nm_chunks = jnp.ones((n_chunks, chunk, n_l), bool)
         if use_matmul:
             # P [N, n_l*S]: each row's stats routed to its node's slot;
             # the histogram then is one MXU matmul against one-hot bins
@@ -232,14 +252,14 @@ def fit_tree(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
 
         def scan_chunk(carry, xs):
             best_gain, best_feat, best_bin = carry
-            bc, mc, base_idx = xs           # [chunk, N], [chunk], scalar
+            bc, mc, nmc, base_idx = xs      # [chunk, N], [chunk], [chunk, n_l]
             hist = chunk_hist(bc)
             left = jnp.cumsum(hist, axis=2)                  # [chunk, n_l, n_bins, S]
             right = node_stats[None, :, None, :] - left
             gains = gain_fn(left, right, node_stats[None, :, None, :], lam)
             ok = ((left[..., 0] >= min_instances) &
                   (right[..., 0] >= min_instances) &
-                  mc[:, None, None] &
+                  mc[:, None, None] & nmc[:, :, None] &
                   (jnp.arange(n_bins)[None, None, :] < n_bins - 1))
             gains = jnp.where(ok, gains, -jnp.inf)           # [chunk, n_l, n_bins]
             cg = jnp.max(gains, axis=2)                      # [chunk, n_l]
@@ -257,7 +277,7 @@ def fit_tree(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
                 jnp.zeros((n_l,), jnp.int32), jnp.zeros((n_l,), jnp.int32))
         base_idxs = jnp.arange(n_chunks, dtype=jnp.int32) * chunk
         (best_gain, best_feat, best_bin), _ = jax.lax.scan(
-            scan_chunk, init, (B_chunks, m_chunks, base_idxs))
+            scan_chunk, init, (B_chunks, m_chunks, nm_chunks, base_idxs))
 
         node_is_leaf = (best_gain <= min_gain) | (~jnp.isfinite(best_gain)) | parent_dead
         splits_pad = jnp.pad(splits, ((0, pad), (0, 0)),
@@ -335,43 +355,40 @@ def predict_trees_raw(X: jnp.ndarray, feature: jnp.ndarray, threshold: jnp.ndarr
 # forest / boosting drivers
 # --------------------------------------------------------------------------
 
-def _feature_masks(key, n_trees: int, d: int, strategy: str) -> jnp.ndarray:
-    if strategy == "all" or n_trees == 1:
-        return jnp.ones((n_trees, d), jnp.float32) > 0
-    k = {"sqrt": max(1, int(math.sqrt(d))),
-         "onethird": max(1, d // 3)}.get(strategy, d)
-    if k >= d:
-        return jnp.ones((n_trees, d), jnp.float32) > 0
-    keys = jax.random.split(key, n_trees)
-
-    def one(k_):
-        scores = jax.random.uniform(k_, (d,))
-        thresh = jnp.sort(scores)[k - 1]
-        return scores <= thresh
-
-    return jax.vmap(one)(keys)
-
-
 @functools.lru_cache(maxsize=None)
-def _forest_fitter(impurity: str, max_depth: int, n_bins: int, use_vmap: bool):
+def _forest_fitter(impurity: str, max_depth: int, n_bins: int, use_vmap: bool,
+                   features_per_node: Optional[int] = None):
     """Jitted whole-forest fit, cached on the static tree shape so CV-grid
-    candidates sharing a config reuse the compiled executable."""
+    candidates sharing a config reuse the compiled executable.  Feature
+    subsetting is PER NODE (Spark featureSubsetStrategy semantics) via
+    per-tree RNG keys."""
 
-    def fn(B, splits, base_stats, boot, masks, min_instances, min_gain, lam):
+    def fn(B, splits, base_stats, boot, masks, keys, min_instances, min_gain,
+           lam):
         def fit_one(args):
-            bw, fm = args
+            bw, fm, k_ = args
             stats = base_stats * bw[:, None]
             return fit_tree(B, splits, stats, fm, impurity=impurity,
                             max_depth=max_depth, n_bins=n_bins,
                             min_instances=min_instances, min_gain=min_gain,
-                            lam=lam)
+                            lam=lam, node_feature_key=k_,
+                            features_per_node=features_per_node)
 
         # memory heuristic: deep trees → sequential lax.map, shallow → vmap
         if use_vmap:
-            return jax.vmap(fit_one)((boot, masks))
-        return jax.lax.map(fit_one, (boot, masks))
+            return jax.vmap(fit_one)((boot, masks, keys))
+        return jax.lax.map(fit_one, (boot, masks, keys))
 
     return jax.jit(fn)
+
+
+def _features_per_node(strategy: str, d: int) -> Optional[int]:
+    """Per-node candidate count for a featureSubsetStrategy name; None = all."""
+    if strategy == "all":
+        return None
+    k = {"sqrt": max(1, int(math.sqrt(d))),
+         "onethird": max(1, d // 3)}.get(strategy)
+    return None if k is None or k >= d else k
 
 
 def fit_forest(X: np.ndarray, y: np.ndarray, *, task: str, n_classes: int,
@@ -391,7 +408,11 @@ def fit_forest(X: np.ndarray, y: np.ndarray, *, task: str, n_classes: int,
     k_boot, k_feat = jax.random.split(key)
     boot = (jax.random.poisson(k_boot, subsample, (n_trees, N)).astype(jnp.float32)
             if bootstrap else jnp.ones((n_trees, N), jnp.float32))
-    masks = _feature_masks(k_feat, n_trees, D, feature_strategy)
+    # features sample PER NODE inside fit_tree; the tree-level mask stays
+    # all-true (per-TREE subsetting cannot learn interactions across subsets)
+    masks = jnp.ones((n_trees, D)) > 0
+    fpn = _features_per_node(feature_strategy, D) if n_trees > 1 else None
+    tree_keys = jax.random.split(k_feat, n_trees)
 
     if task == "classification":
         impurity = "gini"
@@ -407,8 +428,8 @@ def fit_forest(X: np.ndarray, y: np.ndarray, *, task: str, n_classes: int,
     S = base_stats.shape[1]
     est_bytes = 32 * N * max(S, 4) * 4 * n_trees
     use_vmap = max_depth <= 8 and n_trees <= 64 and est_bytes < 2 << 30
-    fitter = _forest_fitter(impurity, max_depth, max_bins, use_vmap)
-    trees = fitter(B, jnp.asarray(splits), base_stats, boot, masks,
+    fitter = _forest_fitter(impurity, max_depth, max_bins, use_vmap, fpn)
+    trees = fitter(B, jnp.asarray(splits), base_stats, boot, masks, tree_keys,
                    jnp.float32(min_instances), jnp.float32(min_gain),
                    jnp.float32(1.0))
     return {"kind": "forest", "task": task, "n_classes": n_classes,
@@ -510,14 +531,16 @@ def _tree_batch_budget(N: int, n_bins: int) -> Tuple[int, int]:
 
 @functools.lru_cache(maxsize=None)
 def _forest_grid_fitter(impurity: str, max_depth: int, n_bins: int,
-                        bootstrap: bool, chunk: int, batch_size: int):
+                        bootstrap: bool, chunk: int, batch_size: int,
+                        features_per_node: Optional[int] = None):
     """Jitted fit of ALL trees of a (fold × grid-point) forest group.
 
     Per-tree traced inputs: fold id (row-weight mask row), PRNG key (Poisson
     bootstrap drawn on device — no [Kt, N] boot matrix in HBM), min_instances,
     min_gain, subsample rate, feature mask.  ``lax.map(batch_size=...)`` bounds
     the histogram working set while still vmapping ``batch_size`` trees onto
-    the MXU at once."""
+    the MXU at once.  Feature subsetting is PER NODE (featureSubsetStrategy
+    semantics) using a key derived from the tree's bootstrap key."""
 
     def fn(B, splits, base_stats, fold_w, fold_ids, keys, mis, mgs, subs,
            masks, lam):
@@ -525,16 +548,18 @@ def _forest_grid_fitter(impurity: str, max_depth: int, n_bins: int,
 
         def fit_one(args):
             fid, key, mi, mg, sub, fm = args
+            k_boot, k_feat = jax.random.split(key)
             w = fold_w[fid]
             if bootstrap:
-                bw = jax.random.poisson(key, sub, (N,)).astype(jnp.float32) * w
+                bw = jax.random.poisson(k_boot, sub, (N,)).astype(jnp.float32) * w
             else:
                 bw = w
             stats = base_stats * bw[:, None]
             return fit_tree(B, splits, stats, fm, impurity=impurity,
                             max_depth=max_depth, n_bins=n_bins,
                             min_instances=mi, min_gain=mg, lam=lam,
-                            chunk=chunk)
+                            chunk=chunk, node_feature_key=k_feat,
+                            features_per_node=features_per_node)
 
         return jax.lax.map(fit_one, (fold_ids, keys, mis, mgs, subs, masks),
                            batch_size=batch_size)
@@ -758,9 +783,13 @@ class _ForestEstimatorBase(PredictorEstimator):
             splits, B = splits_cache[max_bins]
             Gg = len(gidx)
             Kt = K * Gg * n_trees
-            k_boot, k_feat = jax.random.split(jax.random.PRNGKey(seed))
-            masks = jnp.tile(_feature_masks(k_feat, n_trees, D, strategy),
-                             (K * Gg, 1))
+            # (split kept for draw-compatibility with fit_forest's seeding;
+            # per-node feature keys derive from each tree's bootstrap key)
+            k_boot, _ = jax.random.split(jax.random.PRNGKey(seed))
+            # per-NODE feature subsetting happens inside fit_tree (keys drawn
+            # from each tree's key); the tree-level mask stays all-true
+            fpn = (_features_per_node(strategy, D) if n_trees > 1 else None)
+            masks = jnp.ones((Kt, D)) > 0
             # one bootstrap key per TREE INDEX, shared across folds and grid
             # points — grid points differing only in traced params see
             # identical draws (candidates are ranked by hyper-parameters, not
@@ -777,7 +806,7 @@ class _ForestEstimatorBase(PredictorEstimator):
             subs = per_tree([mval(gi, "subsampling_rate", 1.0) for gi in gidx])
             chunk, batch_size = _tree_batch_budget(N, max_bins)
             fitter = _forest_grid_fitter(impurity, max_depth, max_bins,
-                                         bootstrap, chunk, batch_size)
+                                         bootstrap, chunk, batch_size, fpn)
             trees = fitter(B, jnp.asarray(splits), base_stats, fold_w,
                            fold_ids, keys, mis, mgs, subs, masks,
                            jnp.float32(1.0))

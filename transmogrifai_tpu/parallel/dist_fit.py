@@ -124,13 +124,17 @@ def _mesh_platform(mesh: Mesh) -> str:
 
 
 def sharded_forest_fit(mesh: Mesh, *, task: str = "classification",
-                       max_depth: int = 3, n_bins: int = 8):
+                       max_depth: int = 3, n_bins: int = 8,
+                       features_per_node: "Optional[int]" = None):
     """Forest fit as one GSPMD program: the binned matrix + per-row stats are
     row-sharded over 'data' (the histogram one-hot contractions inside
     ``fit_tree`` contract the row axis, so XLA inserts the psum all-reduces —
     ≙ Spark's per-partition histogram merge), and the tree axis is vmapped then
     sharded over 'model'.  Returns the jitted fitter
-    ``(B, splits, base_stats, boot [K, N], masks [K, D]) → TreeArrays [K, T]``.
+    ``(B, splits, base_stats, boot [K, N], masks [K, D], keys [K])
+    → TreeArrays [K, T]``.  ``features_per_node`` enables per-NODE feature
+    subsetting from each tree's key (same semantics as the local fitters —
+    per-TREE masks cannot learn cross-subset interactions).
     The class count is implied by the stats layout: ``base_stats`` is
     ``[count, onehot(y)]`` for classification, ``[count, y, y²]`` for
     regression (see ``fit_forest``)."""
@@ -144,17 +148,19 @@ def sharded_forest_fit(mesh: Mesh, *, task: str = "classification",
         in_shardings=(data_sharding(mesh, 2), replicated_sharding(mesh),
                       data_sharding(mesh, 2),
                       NamedSharding(mesh, P(MODEL_AXIS, DATA_AXIS)),
-                      NamedSharding(mesh, P(MODEL_AXIS, None))),
+                      NamedSharding(mesh, P(MODEL_AXIS, None)),
+                      NamedSharding(mesh, P(MODEL_AXIS))),
         out_shardings=NamedSharding(mesh, P(MODEL_AXIS)))
-    def fit(B, splits, base_stats, boot, masks):
-        def one(bw, fm):
+    def fit(B, splits, base_stats, boot, masks, keys):
+        def one(bw, fm, k_):
             return fit_tree(B, splits, base_stats * bw[:, None], fm,
                             impurity=impurity, max_depth=max_depth,
                             n_bins=n_bins, min_instances=jnp.float32(1.0),
                             min_gain=jnp.float32(0.0), lam=jnp.float32(1.0),
-                            hist_dtype=hist_dtype)
+                            hist_dtype=hist_dtype, node_feature_key=k_,
+                            features_per_node=features_per_node)
 
-        return jax.vmap(one)(boot, masks)
+        return jax.vmap(one)(boot, masks, keys)
 
     return fit
 

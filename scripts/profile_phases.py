@@ -1,0 +1,73 @@
+"""Phase-level timing of the bench workload: cold (compile) vs warm (execute)
+wall for each candidate family's grid fit, plus the feature/sanity DAG.
+
+Usage: python scripts/profile_phases.py [N]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def t(fn, *a, **k):
+    t0 = time.time()
+    out = fn(*a, **k)
+    import jax
+    jax.block_until_ready(jax.tree.leaves(out))
+    return time.time() - t0, out
+
+
+def main():
+    import jax
+
+    N = int(float(sys.argv[1])) if len(sys.argv) > 1 else 1_000_000
+    D = 28
+    from bench import make_data
+    X, y = make_data(N, D)
+
+    print(f"platform={jax.devices()[0].platform} N={N} D={D}", flush=True)
+
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.models.trees import (OpGBTClassifier,
+                                                OpRandomForestClassifier)
+
+    # 3-fold masks like the validator builds
+    rng = np.random.default_rng(42)
+    perm = rng.permutation(N)
+    folds = np.array_split(perm, 3)
+    W = np.zeros((3, N), np.float32)
+    for f in range(3):
+        for j in range(3):
+            if j != f:
+                W[f, folds[j]] = 1.0
+
+    y32 = y.astype(np.float32)
+
+    lr = OpLogisticRegression()
+    lr_grid = [dict(reg_param=r, elastic_net_param=0.1, max_iter=50)
+               for r in (0.001, 0.01, 0.1, 0.2)]
+    dt, _ = t(lr.fit_arrays_grid, X, y32, W, lr_grid)
+    print(f"LR grid cold: {dt:.1f}s", flush=True)
+    dt, _ = t(lr.fit_arrays_grid, X, y32, W, lr_grid)
+    print(f"LR grid warm: {dt:.1f}s", flush=True)
+
+    rf = OpRandomForestClassifier()
+    rf_grid = [dict(num_trees=20, max_depth=6, min_instances_per_node=10)]
+    dt, _ = t(rf.fit_arrays_grid, X, y32, W, rf_grid)
+    print(f"RF grid cold: {dt:.1f}s", flush=True)
+    dt, _ = t(rf.fit_arrays_grid, X, y32, W, rf_grid)
+    print(f"RF grid warm: {dt:.1f}s", flush=True)
+
+    gbt = OpGBTClassifier()
+    gbt_grid = [dict(max_iter=20, max_depth=3, min_instances_per_node=10)]
+    dt, _ = t(gbt.fit_arrays_grid, X, y32, W, gbt_grid)
+    print(f"GBT grid cold: {dt:.1f}s", flush=True)
+    dt, _ = t(gbt.fit_arrays_grid, X, y32, W, gbt_grid)
+    print(f"GBT grid warm: {dt:.1f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

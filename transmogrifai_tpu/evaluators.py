@@ -158,11 +158,15 @@ class OpEvaluatorBase:
         return float(self.evaluate_all(y, pred)[self.default_metric])
 
     def evaluate_masked(self, y_dev, device_out: Dict[str, Any],
-                        w_dev) -> Optional[float]:
+                        w_dev, defer: bool = False):
         """Device fast path for the CV loop: score ``device_out`` (a model's
         ``device_scores`` result) over the 0/1 row mask ``w_dev`` without any
         bulk device→host transfer.  Returns None when this evaluator/metric
-        has no device implementation (caller falls back to the host path)."""
+        has no device implementation (caller falls back to the host path).
+
+        ``defer=True`` keeps the result as a DEVICE scalar when the metric is
+        a pure device reduction — the caller batches many candidates' scalars
+        into one host pull (a float() each costs a full link round trip)."""
         return None
 
     def evaluate_all_device(self, y_dev, device_out: Dict[str, Any],
@@ -207,7 +211,8 @@ class OpBinaryClassificationEvaluator(OpEvaluatorBase):
                 y, np.asarray(pred["prediction"], dtype=np.float64))[m]
         return super().evaluate(y, pred)
 
-    def evaluate_masked(self, y_dev, device_out, w_dev) -> Optional[float]:
+    def evaluate_masked(self, y_dev, device_out, w_dev,
+                        defer: bool = False):
         from .metrics_device import (masked_aupr, masked_auroc,
                                      masked_binary_confusion)
         m = self.default_metric
@@ -216,7 +221,8 @@ class OpBinaryClassificationEvaluator(OpEvaluatorBase):
             if s is None:
                 return None
             fn = masked_auroc if m == "AuROC" else masked_aupr
-            return float(fn(y_dev, s, w_dev))
+            out = fn(y_dev, s, w_dev)
+            return out if defer else float(out)
         if m in ("Precision", "Recall", "F1", "Error"):
             pred = device_out.get("prediction")
             if pred is None:
@@ -353,7 +359,8 @@ class OpMultiClassificationEvaluator(OpEvaluatorBase):
         fast = {"prediction": pred["prediction"]}
         return float(self.evaluate_all(y, fast)[self.default_metric])
 
-    def evaluate_masked(self, y_dev, device_out, w_dev) -> Optional[float]:
+    def evaluate_masked(self, y_dev, device_out, w_dev,
+                        defer: bool = False):
         if self.default_metric not in ("Precision", "Recall", "F1", "Error"):
             return None
         pred = device_out.get("prediction")
@@ -444,14 +451,20 @@ class OpRegressionEvaluator(OpEvaluatorBase):
             return float(np.mean(np.abs(err))) if len(y) else 0.0
         return super().evaluate(y, pred)
 
-    def evaluate_masked(self, y_dev, device_out, w_dev) -> Optional[float]:
+    def evaluate_masked(self, y_dev, device_out, w_dev,
+                        defer: bool = False):
         pred = device_out.get("prediction")
         if pred is None or self.default_metric not in (
                 "RootMeanSquaredError", "MeanSquaredError", "MeanAbsoluteError"):
             return None
         from .metrics_device import masked_reg_errors
-        mse, mae = (float(v) for v in np.asarray(
-            masked_reg_errors(y_dev, pred, w_dev)))
+        errs = masked_reg_errors(y_dev, pred, w_dev)
+        if defer:
+            import jax.numpy as jnp
+            return {"RootMeanSquaredError": jnp.sqrt(errs[0]),
+                    "MeanSquaredError": errs[0],
+                    "MeanAbsoluteError": errs[1]}[self.default_metric]
+        mse, mae = (float(v) for v in np.asarray(errs))
         return {"RootMeanSquaredError": float(np.sqrt(mse)),
                 "MeanSquaredError": mse,
                 "MeanAbsoluteError": mae}[self.default_metric]

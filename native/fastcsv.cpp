@@ -1,0 +1,329 @@
+// fastcsv — columnar CSV parser for transmogrifai_tpu's ingestion path.
+//
+// The reference's readers run on the JVM executor fleet
+// (readers/src/main/scala/com/salesforce/op/readers/CSVReaders.scala); this
+// framework's runtime equivalent is a native parser that goes straight from
+// bytes to typed COLUMNS (no per-row Python dicts/objects), feeding the
+// columnar ColumnBatch the stage DAG compiles over.
+//
+// Exposed API (module _fastcsv):
+//   parse(path: str, n_headers: int, skip_first_row: bool,
+//         force_string: sequence[int])
+//       -> (n_rows: int, cols: list, is_int: list[bool])
+//   where cols[i] is either
+//       numpy.ndarray[float64]  — numeric column, NaN marks empty fields, or
+//       list[str | None]        — non-numeric column, None marks empty fields.
+//   A column is numeric iff every non-empty field fully parses as a double
+//   and its index is not in force_string (schema-typed text columns must
+//   keep their raw text — e.g. leading-zero postal codes).  is_int[i] is
+//   True when every non-empty field also parses as a plain integer (drives
+//   Integral-vs-Real schema inference on the Python side).
+//
+// Dialect: comma separator, RFC-4180 double-quote quoting with "" escapes,
+// \n or \r\n row terminators, optional trailing newline.  Rows shorter than
+// n_headers are padded with empty fields; extra fields are ignored.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#include <numpy/arrayobject.h>
+
+#include <charconv>
+#include <cmath>
+#include <cstring>
+#include <deque>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Field {
+    const char* begin;
+    const char* end;
+    bool quoted;
+};
+
+// Parse one record starting at p (p < end).  Appends fields; returns pointer
+// past the record's terminator.
+const char* parse_record(const char* p, const char* end,
+                         std::vector<Field>& fields, std::string& scratch,
+                         std::deque<std::string>& scratch_pool) {
+    for (;;) {
+        Field f{p, p, false};
+        if (p < end && *p == '"') {
+            // quoted field; unescape into a pooled scratch string when an
+            // escaped quote is present, else point into the buffer directly
+            ++p;
+            const char* seg = p;
+            scratch.clear();
+            bool used_scratch = false;
+            for (;;) {
+                if (p >= end) break;  // unterminated quote: take rest
+                if (*p == '"') {
+                    if (p + 1 < end && p[1] == '"') {
+                        scratch.append(seg, p - seg);
+                        scratch.push_back('"');
+                        used_scratch = true;
+                        p += 2;
+                        seg = p;
+                        continue;
+                    }
+                    break;
+                }
+                ++p;
+            }
+            if (used_scratch) {
+                scratch.append(seg, p - seg);
+                scratch_pool.emplace_back(scratch);
+                const std::string& s = scratch_pool.back();
+                f.begin = s.data();
+                f.end = s.data() + s.size();
+            } else {
+                f.begin = seg;
+                f.end = p;
+            }
+            f.quoted = true;
+            if (p < end && *p == '"') ++p;  // closing quote
+        } else {
+            const char* seg = p;
+            while (p < end && *p != ',' && *p != '\n' && *p != '\r') ++p;
+            f.begin = seg;
+            f.end = p;
+        }
+        fields.push_back(f);
+        if (p >= end) return p;
+        if (*p == ',') {
+            ++p;
+            continue;
+        }
+        if (*p == '\r') {
+            ++p;
+            if (p < end && *p == '\n') ++p;
+            return p;
+        }
+        if (*p == '\n') return ++p;
+        // stray character after a closing quote (malformed): skip to sep
+        while (p < end && *p != ',' && *p != '\n' && *p != '\r') ++p;
+    }
+}
+
+bool parse_double(const Field& f, double* out, bool* is_int) {
+    const char* b = f.begin;
+    const char* e = f.end;
+    while (b < e && (*b == ' ' || *b == '\t')) ++b;
+    while (e > b && (e[-1] == ' ' || e[-1] == '\t')) --e;
+    if (b == e) return false;
+    auto res = std::from_chars(b, e, *out);
+    if (res.ec != std::errc() || res.ptr != e) return false;
+    long long iv;
+    auto ri = std::from_chars(b, e, iv);
+    *is_int = (ri.ec == std::errc() && ri.ptr == e);
+    return true;
+}
+
+PyObject* parse(PyObject*, PyObject* args) {
+    const char* path;
+    Py_ssize_t n_cols_py;
+    int skip_first;
+    PyObject* force_string = nullptr;
+    if (!PyArg_ParseTuple(args, "snp|O", &path, &n_cols_py, &skip_first,
+                          &force_string))
+        return nullptr;
+    const size_t n_cols = static_cast<size_t>(n_cols_py);
+
+    std::string buf;
+    {
+        FILE* fp = fopen(path, "rb");
+        if (!fp) {
+            PyErr_SetFromErrnoWithFilename(PyExc_OSError, path);
+            return nullptr;
+        }
+        fseek(fp, 0, SEEK_END);
+        long sz = ftell(fp);
+        fseek(fp, 0, SEEK_SET);
+        buf.resize(static_cast<size_t>(sz));
+        size_t got = sz ? fread(buf.data(), 1, static_cast<size_t>(sz), fp) : 0;
+        fclose(fp);
+        buf.resize(got);
+    }
+    const char* p = buf.data();
+    const char* end = p + buf.size();
+    if (buf.size() >= 3 && static_cast<unsigned char>(buf[0]) == 0xEF &&
+        static_cast<unsigned char>(buf[1]) == 0xBB &&
+        static_cast<unsigned char>(buf[2]) == 0xBF)
+        p += 3;  // UTF-8 BOM
+
+    // per-column state
+    std::vector<std::vector<double>> nums(n_cols);
+    std::vector<char> numeric_ok(n_cols, 1);
+    std::vector<char> int_ok(n_cols, 1);
+    if (force_string && force_string != Py_None) {
+        PyObject* seq = PySequence_Fast(force_string, "force_string");
+        if (!seq) return nullptr;
+        Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+        for (Py_ssize_t i = 0; i < n; ++i) {
+            long idx = PyLong_AsLong(PySequence_Fast_GET_ITEM(seq, i));
+            if (idx >= 0 && static_cast<size_t>(idx) < n_cols) {
+                numeric_ok[idx] = 0;
+                int_ok[idx] = 0;
+            }
+        }
+        Py_DECREF(seq);
+    }
+    // raw text kept only for columns that stop being numeric; to bound
+    // memory we do a second pass for string columns instead of storing all
+    // raw fields.  Pass 1 detects types + fills numeric columns.
+    std::vector<Field> fields;
+    fields.reserve(n_cols + 4);
+    std::string scratch;
+    // deque: growth never invalidates references (Fields point into entries)
+    std::deque<std::string> scratch_pool;
+
+    size_t n_rows = 0;
+    {
+        const char* q = p;
+        bool first = true;
+        while (q < end) {
+            fields.clear();
+            q = parse_record(q, end, fields, scratch, scratch_pool);
+            if (first && skip_first) {
+                first = false;
+                continue;
+            }
+            first = false;
+            if (fields.size() == 1 && fields[0].begin == fields[0].end &&
+                q >= end)
+                break;  // trailing blank line
+            ++n_rows;
+            for (size_t c = 0; c < n_cols; ++c) {
+                if (!numeric_ok[c]) continue;
+                double v = NAN;
+                if (c < fields.size()) {
+                    const Field& f = fields[c];
+                    if (f.begin != f.end) {
+                        bool is_int = false;
+                        bool ok = parse_double(f, &v, &is_int);
+                        // integers beyond 2^53 do not round-trip through the
+                        // float64 store — keep such columns as raw text so
+                        // IDs/keys stay exact
+                        if (ok && is_int &&
+                            (v > 9007199254740992.0 || v < -9007199254740992.0))
+                            ok = false;
+                        if (!ok) {
+                            numeric_ok[c] = 0;
+                            int_ok[c] = 0;
+                            nums[c].clear();
+                            nums[c].shrink_to_fit();
+                            continue;
+                        }
+                        if (!is_int) int_ok[c] = 0;
+                    }
+                }
+                nums[c].push_back(v);
+            }
+            scratch_pool.clear();
+        }
+    }
+
+    bool any_string = false;
+    for (size_t c = 0; c < n_cols; ++c)
+        if (!numeric_ok[c]) any_string = true;
+
+    PyObject* cols = PyList_New(static_cast<Py_ssize_t>(n_cols));
+    if (!cols) return nullptr;
+
+    // string columns: second pass collecting Python objects directly
+    std::vector<PyObject*> str_lists(n_cols, nullptr);
+    if (any_string) {
+        for (size_t c = 0; c < n_cols; ++c) {
+            if (numeric_ok[c]) continue;
+            str_lists[c] = PyList_New(static_cast<Py_ssize_t>(n_rows));
+            if (!str_lists[c]) {
+                Py_DECREF(cols);
+                return nullptr;
+            }
+        }
+        const char* q = p;
+        bool first = true;
+        size_t r = 0;
+        while (q < end && r < n_rows) {
+            fields.clear();
+            q = parse_record(q, end, fields, scratch, scratch_pool);
+            if (first && skip_first) {
+                first = false;
+                continue;
+            }
+            first = false;
+            for (size_t c = 0; c < n_cols; ++c) {
+                if (numeric_ok[c]) continue;
+                PyObject* v;
+                if (c < fields.size() && fields[c].begin != fields[c].end) {
+                    v = PyUnicode_FromStringAndSize(
+                        fields[c].begin, fields[c].end - fields[c].begin);
+                    if (!v) {
+                        Py_DECREF(cols);
+                        return nullptr;
+                    }
+                } else {
+                    v = Py_None;
+                    Py_INCREF(Py_None);
+                }
+                PyList_SET_ITEM(str_lists[c], static_cast<Py_ssize_t>(r), v);
+            }
+            scratch_pool.clear();
+            ++r;
+        }
+    }
+
+    for (size_t c = 0; c < n_cols; ++c) {
+        PyObject* col;
+        if (numeric_ok[c]) {
+            npy_intp dim = static_cast<npy_intp>(n_rows);
+            col = PyArray_SimpleNew(1, &dim, NPY_FLOAT64);
+            if (!col) {
+                Py_DECREF(cols);
+                return nullptr;
+            }
+            if (n_rows)
+                memcpy(PyArray_DATA(reinterpret_cast<PyArrayObject*>(col)),
+                       nums[c].data(), n_rows * sizeof(double));
+        } else {
+            col = str_lists[c];
+        }
+        PyList_SET_ITEM(cols, static_cast<Py_ssize_t>(c), col);
+    }
+
+    PyObject* ints = PyList_New(static_cast<Py_ssize_t>(n_cols));
+    if (!ints) {
+        Py_DECREF(cols);
+        return nullptr;
+    }
+    for (size_t c = 0; c < n_cols; ++c) {
+        PyObject* b = (numeric_ok[c] && int_ok[c]) ? Py_True : Py_False;
+        Py_INCREF(b);
+        PyList_SET_ITEM(ints, static_cast<Py_ssize_t>(c), b);
+    }
+    PyObject* out = Py_BuildValue("nNN", static_cast<Py_ssize_t>(n_rows),
+                                  cols, ints);
+    return out;
+}
+
+PyMethodDef methods[] = {
+    {"parse", parse, METH_VARARGS,
+     "parse(path, n_cols, skip_first_row, force_string=()) -> "
+     "(n_rows, cols, is_int)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_fastcsv",
+    "Columnar CSV parser (native ingestion runtime).", -1, methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__fastcsv(void) {
+    import_array();
+    return PyModule_Create(&moduledef);
+}

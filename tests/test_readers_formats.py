@@ -148,3 +148,92 @@ def test_avro_e2e_train(tmp_path):
     from transmogrifai_tpu.evaluators import Evaluators
     m = model.evaluate(Evaluators.BinaryClassification.auROC())
     assert m["AuROC"] > 0.9
+
+
+# ---------------------------------------------------------------------------
+# native columnar CSV parser vs pure-Python path (semantics parity)
+# ---------------------------------------------------------------------------
+
+def test_native_csv_parity_titanic(monkeypatch):
+    """The C++ columnar parser and the Python record path must agree on
+    schema, typed records, and the generated ColumnBatch."""
+    import transmogrifai_tpu.native as native_mod
+    from transmogrifai_tpu.readers.csv import CSVReader
+
+    path = os.path.join(DATA, "titanic", "TitanicPassengersTrainData.csv")
+    headers = ["id", "survived", "pClass", "name", "sex", "age", "sibSp",
+               "parCh", "ticket", "fare", "cabin", "embarked"]
+    fast = CSVReader(path, headers=headers, key_field="id")
+    if fast._store is None:
+        pytest.skip("native toolchain unavailable")
+
+    monkeypatch.setenv("TRANSMOGRIFAI_NATIVE", "0")
+    native_mod._CACHE.clear()
+    slow = CSVReader(path, headers=headers, key_field="id")
+    native_mod._CACHE.clear()
+
+    assert fast.schema == slow.schema
+    assert fast.read() == slow.read()
+
+    schema = fast.schema
+    label, predictors = features_from_schema(schema, response="survived")
+    for r in (fast, slow):
+        r._batch = r.generate_batch([label] + predictors)
+    for f in [label] + predictors:
+        a, b = fast._batch[f.name], slow._batch[f.name]
+        assert a.kind is b.kind, f.name
+        va, vb = np.asarray(a.values), np.asarray(b.values)
+        if va.dtype == object:
+            assert list(va) == list(vb), f.name
+        else:
+            np.testing.assert_allclose(va, vb, err_msg=f.name)
+        if a.mask is not None or b.mask is not None:
+            np.testing.assert_array_equal(np.asarray(a.mask),
+                                          np.asarray(b.mask), f.name)
+    assert list(np.asarray(fast._batch["key"].values)) == list(
+        np.asarray(slow._batch["key"].values))
+
+
+def test_native_csv_forced_string_schema(tmp_path):
+    """Schema-typed text columns keep raw text (leading zeros survive)."""
+    from transmogrifai_tpu.readers.csv import CSVReader
+
+    p = tmp_path / "z.csv"
+    p.write_text("code,v\n02134,1.5\n00501,2.5\n,3.5\n")
+    r = CSVReader(str(p), schema={"code": T.PostalCode, "v": T.Real})
+    if r._store is None:
+        pytest.skip("native toolchain unavailable")
+    recs = r.read()
+    assert [x["code"] for x in recs] == ["02134", "00501", None]
+    assert [x["v"] for x in recs] == [1.5, 2.5, 3.5]
+
+
+def test_native_csv_bigint_ids_stay_exact(tmp_path):
+    """Integer IDs beyond 2^53 must not round-trip through float64."""
+    from transmogrifai_tpu.readers.csv import CSVReader
+
+    big = 9007199254740993  # 2^53 + 1: not representable as float64
+    p = tmp_path / "ids.csv"
+    p.write_text(f"id,v\n{big},1.0\n{big + 2},2.0\n")
+    r = CSVReader(str(p), key_field="id")
+    recs = r.read()
+    assert recs[0]["id"] == big and recs[1]["id"] == big + 2
+    batch = r.generate_batch([])
+    keys = list(np.asarray(batch["key"].values))
+    assert keys == [str(big), str(big + 2)]
+
+
+def test_native_csv_binary_schema_text_booleans(tmp_path):
+    """An explicit Binary schema over 'true'/'false' text must coerce like
+    the record path (_as_bool), on both the fast batch and read() paths."""
+    from transmogrifai_tpu.readers.csv import CSVReader
+
+    p = tmp_path / "b.csv"
+    p.write_text("flag,v\ntrue,1.0\nfalse,2.0\nyes,3.0\n2,4.0\n")
+    r = CSVReader(str(p), schema={"flag": T.Binary, "v": T.Real})
+    assert [x["flag"] for x in r.read()] == [True, False, True, False]
+    label, preds = features_from_schema(r.schema, response="v",
+                                        response_kind=T.RealNN)
+    batch = r.generate_batch([label] + preds)
+    vals = np.asarray(batch["flag"].values)
+    assert vals.tolist() == [True, False, True, False]

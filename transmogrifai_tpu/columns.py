@@ -25,6 +25,37 @@ from .types import (
 from .vector_meta import VectorMeta
 
 
+def to_device_f32(values) -> Any:
+    """Host→device transfer of real-valued bulk data for compute.
+
+    On accelerator backends the WIRE format is bf16 — half the bytes over the
+    host link, which on tunneled TPU setups runs at single-digit MB/s and
+    dominates ingestion wall time — while everything downstream accumulates in
+    f32 on device (the standard TPU bf16-storage/f32-accumulate discipline).
+    Exact for 0/1 masks and small integers; float features lose bits beyond
+    bf16's 8-bit mantissa, which is noise relative to feature measurement
+    error.  Opt out with TRANSMOGRIFAI_WIRE_F32=1.  CPU backends (tests,
+    goldens) always transfer exact f32.
+    """
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(values, jax.Array):
+        return values if values.dtype == jnp.float32 else values.astype(
+            jnp.float32)
+    arr = np.asarray(values)
+    if (arr.dtype in (np.float32, np.float64)
+            and arr.size >= (1 << 16)
+            and jax.default_backend() != "cpu"
+            and os.environ.get("TRANSMOGRIFAI_WIRE_F32") != "1"):
+        import ml_dtypes
+        wire = arr.astype(ml_dtypes.bfloat16)
+        return jax.device_put(wire).astype(jnp.float32)
+    return jnp.asarray(arr, jnp.float32)
+
+
 @dataclass
 class Column:
     """A typed column of N rows.

@@ -167,7 +167,16 @@ class ScoreProgram:
             self._jitted[key] = jax.jit(traced)
             self._metas[key] = metas_out
 
-        arrays = {n: (batch[n].values, batch[n].mask) for n in frontier}
+        def _prep(v):
+            # float32 columns ride the bf16 wire format to the device (see
+            # columns.to_device_f32); other dtypes transfer as-is inside jit
+            if isinstance(v, np.ndarray) and v.dtype == np.float32:
+                from .columns import to_device_f32
+                return to_device_f32(v)
+            return v
+
+        arrays = {n: (_prep(batch[n].values), batch[n].mask)
+                  for n in frontier}
         try:
             out = self._jitted[key](arrays)
         except _StageTraceError:

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..columns import to_device_f32
 from .base import PredictionModel, PredictorEstimator
 
 MAX_BINS_DEFAULT = 32
@@ -573,7 +574,7 @@ def fit_forest(X: np.ndarray, y: np.ndarray, *, task: str, n_classes: int,
     lax.map when deep trees would blow HBM)."""
     N, D = X.shape
     splits = build_bin_splits(X, max_bins)
-    Xj = jnp.asarray(X, jnp.float32)
+    Xj = to_device_f32(X)
     B = bin_data(Xj, jnp.asarray(splits))
     w0 = jnp.ones(N, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight)
     yj = jnp.asarray(y, jnp.float32)
@@ -648,7 +649,7 @@ def fit_gbt(X: np.ndarray, y: np.ndarray, *, task: str, n_rounds: int,
     N, D = X.shape
     splits = build_bin_splits(X, max_bins)
     splits_j = jnp.asarray(splits)
-    Xj = jnp.asarray(X, jnp.float32)
+    Xj = to_device_f32(X)
     B = bin_data(Xj, splits_j)
     w0 = jnp.ones(N, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight)
     yj = jnp.asarray(y, jnp.float32)
@@ -901,7 +902,8 @@ class _ForestEstimatorBase(PredictorEstimator):
         if strategy == "auto":
             strategy = (self.default_feature_strategy
                         if self.get("num_trees", 20) > 1 else "all")
-        n_classes = (int(np.max(y)) + 1 if self.task == "classification" else 0)
+        from .linear import _n_classes
+        n_classes = (_n_classes(y) if self.task == "classification" else 0)
         return fit_forest(
             X, y, task=self.task, n_classes=max(n_classes, 2),
             n_trees=int(self.get("num_trees", 20)),
@@ -925,7 +927,8 @@ class _ForestEstimatorBase(PredictorEstimator):
         K, G = fold_weights.shape[0], len(grids)
         out: list = [[None] * G for _ in range(K)]
         N, D = X.shape
-        n_classes = (int(np.max(y)) + 1 if self.task == "classification" else 0)
+        from .linear import _n_classes
+        n_classes = (_n_classes(y) if self.task == "classification" else 0)
         n_classes = max(n_classes, 2)
 
         groups = defaultdict(list)
@@ -949,8 +952,8 @@ class _ForestEstimatorBase(PredictorEstimator):
         else:
             impurity = "variance"
             base_stats = jnp.stack([jnp.ones(N), yj, yj * yj], axis=1)
-        fold_w = jnp.asarray(fold_weights, jnp.float32)
-        Xj = jnp.asarray(X, jnp.float32)
+        fold_w = to_device_f32(fold_weights)
+        Xj = to_device_f32(X)
         splits_cache: dict = {}
 
         def mval(gi, name, default):
@@ -1082,9 +1085,9 @@ class _GBTEstimatorBase(PredictorEstimator):
             groups[(int(m.get("max_iter", 20)), int(m.get("max_depth", 5)),
                     int(m.get("max_bins", MAX_BINS_DEFAULT)))].append(gi)
 
-        Xj = jnp.asarray(X, jnp.float32)
+        Xj = to_device_f32(X)
         yj = jnp.asarray(y, jnp.float32)
-        fold_w = jnp.asarray(fold_weights, jnp.float32)
+        fold_w = to_device_f32(fold_weights)
         fmask = jnp.ones((D,), jnp.float32) > 0
         splits_cache: dict = {}
 

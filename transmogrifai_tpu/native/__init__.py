@@ -1,0 +1,74 @@
+"""Native runtime components (C++), built lazily with the system toolchain.
+
+The reference's ingestion/runtime layer is JVM code running on Spark
+executors; this framework's equivalent native layer lives here.  Modules are
+compiled on first use with ``g++`` (no pip/network), cached next to the
+package, and every consumer has a pure-Python fallback — absence of a
+toolchain degrades performance, never correctness.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+from typing import Any, Optional
+
+_CACHE: dict = {}
+
+
+def _build_dir() -> str:
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _source_path(name: str) -> str:
+    # native/ sources live at the repo root next to the package
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg_root), "native", f"{name}.cpp")
+
+
+def _compile(name: str) -> Optional[str]:
+    src = _source_path(name)
+    if not os.path.exists(src):
+        return None
+    so = os.path.join(_build_dir(), f"_{name}.so")
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return so
+    import numpy as np
+    cmd = [
+        os.environ.get("CXX", "g++"), "-O2", "-std=c++17", "-shared", "-fPIC",
+        f"-I{sysconfig.get_paths()['include']}",
+        f"-I{np.get_include()}",
+        src, "-o", so,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except Exception:  # pragma: no cover — toolchain-dependent
+        return None
+    return so
+
+
+def load(name: str) -> Optional[Any]:
+    """Import native module ``_<name>``, compiling it if needed.  Returns the
+    module or None (callers fall back to pure Python).  Disable with
+    TRANSMOGRIFAI_NATIVE=0."""
+    if name in _CACHE:
+        return _CACHE[name]
+    mod = None
+    if os.environ.get("TRANSMOGRIFAI_NATIVE", "1") != "0":
+        try:
+            so = _compile(name)
+            if so is not None:
+                spec = importlib.util.spec_from_file_location(f"_{name}", so)
+                if spec and spec.loader:
+                    mod = importlib.util.module_from_spec(spec)
+                    sys.modules[f"_{name}"] = mod
+                    spec.loader.exec_module(mod)
+        except Exception:  # pragma: no cover — best-effort native path
+            mod = None
+    _CACHE[name] = mod
+    return mod

@@ -10,7 +10,7 @@ from typing import List
 
 import jax.numpy as jnp
 
-from ..columns import Column, ColumnBatch
+from ..columns import Column, ColumnBatch, to_device_f32
 from ..stages.base import Transformer
 from ..types import OPVector
 from ..vector_meta import VectorColumnMeta, VectorMeta
@@ -27,7 +27,7 @@ class VectorsCombiner(Transformer):
         arrays, metas = [], []
         for f in self.input_features:
             col = batch[f.name]
-            v = jnp.asarray(col.values, jnp.float32)
+            v = to_device_f32(col.values)
             if v.ndim == 1:
                 v = v[:, None]
             arrays.append(v)

@@ -15,14 +15,14 @@ from typing import List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from ..columns import Column, ColumnBatch
+from ..columns import Column, ColumnBatch, to_device_f32
 from ..stages.base import Estimator, Transformer, TransformerModel
 from ..types import Binary, Integral, OPNumeric, OPVector, Real, RealNN
 from ..vector_meta import NULL_INDICATOR, VectorColumnMeta, VectorMeta
 
 
 def _masked_f32(col: Column):
-    v = jnp.asarray(col.values, jnp.float32)
+    v = to_device_f32(col.values)
     m = col.mask
     m = jnp.ones(v.shape[0], bool) if m is None else jnp.asarray(m)
     return v, m
@@ -81,7 +81,7 @@ class RealNNVectorizerModel(TransformerModel):
     out_kind = OPVector
 
     def transform(self, batch: ColumnBatch) -> Column:
-        outs = [jnp.asarray(batch[f.name].values, jnp.float32)[:, None]
+        outs = [to_device_f32(batch[f.name].values)[:, None]
                 for f in self.input_features]
         return Column(OPVector, jnp.concatenate(outs, axis=1), meta=self.fitted["meta"])
 
@@ -143,7 +143,7 @@ class BinaryVectorizerModel(TransformerModel):
         outs = []
         for f in self.input_features:
             col = batch[f.name]
-            v = jnp.asarray(col.values).astype(jnp.float32)
+            v = to_device_f32(col.values)
             m = (jnp.ones(v.shape[0], bool) if col.mask is None
                  else jnp.asarray(col.mask))
             outs.append(jnp.where(m, v, 0.0)[:, None])
@@ -177,7 +177,7 @@ class StandardScalerModel(TransformerModel):
 
     def transform(self, batch: ColumnBatch) -> Column:
         (col,) = self.input_columns(batch)
-        v = jnp.asarray(col.values, jnp.float32)
+        v = to_device_f32(col.values)
         if v.ndim == 1:
             v = v[:, None]
         out = (jnp.nan_to_num(v) - self.fitted["mean"]) / self.fitted["std"]
@@ -197,7 +197,7 @@ class StandardScaler(Estimator):
     def fit(self, batch: ColumnBatch) -> TransformerModel:
         (f,) = self.input_features
         col = batch[f.name]
-        v = jnp.asarray(col.values, jnp.float32)
+        v = to_device_f32(col.values)
         if v.ndim == 1:
             v = v[:, None]
         # masked moments: missing entries (mask=False, stored as NaN/0) must

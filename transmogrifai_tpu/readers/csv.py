@@ -1,6 +1,17 @@
 """CSV ingestion with automatic schema inference (reference:
 readers/src/main/scala/com/salesforce/op/readers/CSVReaders.scala and
 CSVAutoReaders.scala; inference ≙ FeatureBuilder.fromDataFrame auto-typing).
+
+Two paths share identical semantics:
+
+* **native columnar** (default): the C++ parser (`native/fastcsv.cpp`) goes
+  bytes → typed columns in one pass — no per-row Python objects — and
+  ``generate_batch`` builds the ``ColumnBatch`` straight from the columnar
+  store when every raw feature uses the default by-name extractor.  This is
+  the runtime analog of the reference's executor-side record parsing, done
+  native instead of JVM.
+* **pure Python** fallback (no toolchain, custom extractors, exotic kinds):
+  row dicts through ``FeatureGeneratorStage.extract_column``.
 """
 
 from __future__ import annotations
@@ -8,9 +19,13 @@ from __future__ import annotations
 import csv
 from typing import Any, Dict, List, Optional, Sequence, Type
 
+import numpy as np
+
+from ..columns import Column, ColumnBatch, column_from_values
 from ..features import infer_feature_kind
-from ..types import Binary, FeatureType, Integral, Real, Text
-from .base import DataReader
+from ..types import (Binary, Date, DateTime, FeatureType, Integral, Real,
+                     Text, is_numeric_kind, is_text_kind)
+from .base import DataReader, _generator_of
 
 
 def _coerce(v: str) -> Any:
@@ -50,23 +65,35 @@ def infer_schema_from_records(records: Sequence[Dict[str, Any]],
 def _typed_records(records: List[Dict[str, Any]],
                    schema: Dict[str, Type[FeatureType]]) -> List[Dict[str, Any]]:
     """Coerce string values to the schema's python types."""
-    out = []
-    for r in records:
-        t: Dict[str, Any] = {}
-        for k, v in r.items():
-            kind = schema.get(k)
-            if v is None or kind is None:
-                t[k] = v
-            elif issubclass(kind, Binary):
-                t[k] = str(v).strip().lower() in ("1", "true", "yes", "t")
-            elif issubclass(kind, Integral):
-                t[k] = int(float(v))
-            elif issubclass(kind, Real):
-                t[k] = float(v)
-            else:
-                t[k] = v
-        out.append(t)
-    return out
+    return [{k: _typed_scalar(v, schema.get(k)) for k, v in r.items()}
+            for r in records]
+
+
+def _typed_scalar(v, kind):
+    if v is None or kind is None:
+        return v
+    if issubclass(kind, Binary):
+        return _as_bool(v)
+    if issubclass(kind, Integral):
+        try:
+            return int(v)          # exact for arbitrarily large integers
+        except (TypeError, ValueError):
+            return int(float(v))
+    if issubclass(kind, Real):
+        return float(v)
+    return v
+
+
+def _as_bool(v: Any) -> bool:
+    if isinstance(v, float):
+        return v != 0.0
+    return str(v).strip().lower() in ("1", "true", "yes", "t")
+
+
+def _csv_headers(path: str) -> List[str]:
+    with open(path, newline="") as f:
+        row = next(csv.reader(f), [])
+    return list(row)
 
 
 class CSVReader(DataReader):
@@ -78,10 +105,163 @@ class CSVReader(DataReader):
     def __init__(self, path: str, headers: Optional[Sequence[str]] = None,
                  schema: Optional[Dict[str, Type[FeatureType]]] = None,
                  key_field: Optional[str] = None, has_header: Optional[bool] = None):
-        raw = read_csv_records(path, headers=headers, has_header=has_header)
-        self.schema = dict(schema) if schema else infer_schema_from_records(raw)
-        records = _typed_records(raw, self.schema)
+        self.path = path
+        self._key_field = key_field
+        self._store: Optional[Dict[str, Any]] = None   # name → f64 array | list
+        self._n_rows = 0
+
+        if headers is None:
+            headers = _csv_headers(path)
+            skip_first = True
+        else:
+            headers = list(headers)
+            skip_first = bool(has_header)
+
+        from ..native import load
+        native = load("fastcsv")
+        records = None
+        if native is not None:
+            try:
+                # with a user schema, only columns the schema types as
+                # plain-numeric may take the float store; Binary goes through
+                # raw text (record-path _as_bool semantics), and columns NOT
+                # in the schema keep their raw text for read()/joins
+                force = ([i for i, h in enumerate(headers)
+                          if h not in schema
+                          or not is_numeric_kind(schema[h])
+                          or issubclass(schema[h], Binary)]
+                         if schema else [])
+                n, cols, is_int = native.parse(path, len(headers),
+                                               skip_first, force)
+                self._store = dict(zip(headers, cols))
+                self._is_int = dict(zip(headers, is_int))
+                self._n_rows = n
+            except Exception:  # pragma: no cover — fall back to Python
+                self._store = None
+        if self._store is None:
+            raw = read_csv_records(path, headers=headers,
+                                   has_header=skip_first or has_header)
+            self.schema = dict(schema) if schema else infer_schema_from_records(raw)
+            records = _typed_records(raw, self.schema)
+            self._n_rows = len(records)
+        else:
+            self.schema = (dict(schema) if schema
+                           else self._infer_schema_from_store())
+
         key_fn = ((lambda r: r.get(key_field)) if key_field
                   else (lambda r: id(r)))
         super().__init__(records=records, key_fn=key_fn)
-        self.path = path
+
+    # -- columnar store helpers -------------------------------------------
+    def _infer_schema_from_store(self, sample: int = 1000) -> Dict[str, Type[FeatureType]]:
+        schema: Dict[str, Type[FeatureType]] = {}
+        for name, col in self._store.items():
+            if isinstance(col, np.ndarray):
+                vals = col[:sample]
+                as_int = self._is_int.get(name, False)
+                pyvals = [None if np.isnan(v)
+                          else (int(v) if as_int else float(v))
+                          for v in vals]
+            else:
+                pyvals = col[:sample]
+            schema[name] = infer_feature_kind(pyvals)
+        return schema
+
+    def _store_column(self, name: str, kind: Type[FeatureType],
+                      non_nullable: bool) -> Column:
+        col = self._store[name]
+        if is_numeric_kind(kind):
+            if isinstance(col, np.ndarray):
+                mask = ~np.isnan(col)
+                if issubclass(kind, Binary):
+                    arr: Any = np.where(mask, col != 0.0, False).astype(bool)
+                elif issubclass(kind, (Date, DateTime, Integral)):
+                    arr = np.where(mask, col, 0.0).astype(np.int64)
+                else:
+                    arr = col.astype(np.float32)
+                    if non_nullable:
+                        arr = np.where(mask, arr, np.float32(0.0))
+                return Column(kind, arr, mask=None if non_nullable else mask)
+            if issubclass(kind, Binary):
+                vals = [None if v is None else _as_bool(v) for v in col]
+                return column_from_values(kind, vals)
+            # schema says numeric but the column has non-numeric text — same
+            # error the typed-record path raises
+            vals = [None if v is None else float(v) for v in col]
+            return column_from_values(kind, vals)
+        if is_text_kind(kind):
+            if isinstance(col, np.ndarray):
+                as_int = self._is_int.get(name, False)
+                vals = [None if np.isnan(v)
+                        else (str(int(v)) if as_int else str(float(v)))
+                        for v in col]
+            else:
+                vals = col
+            return column_from_values(kind, vals)
+        raise TypeError(kind)  # caller falls back to the record path
+
+    def generate_batch(self, raw_features) -> ColumnBatch:
+        st = self._store
+        if st is not None:
+            fast = all(
+                (not _generator_of(f).has_custom_extract)
+                and f.name in st
+                and (is_numeric_kind(f.kind) or is_text_kind(f.kind))
+                for f in raw_features)
+            if fast:
+                cols: Dict[str, Column] = {}
+                for f in raw_features:
+                    fill_zero = f.kind.non_nullable
+                    c = self._store_column(f.name, f.kind, fill_zero)
+                    cols[f.name] = c
+                cols["key"] = self._key_column()
+                return ColumnBatch(cols, self._n_rows)
+        return super().generate_batch(raw_features)
+
+    def _key_column(self) -> Column:
+        kf = self._key_field
+        if kf and kf in self._store:
+            col = self._store[kf]
+            if isinstance(col, np.ndarray):
+                as_int = self._is_int.get(kf, False)
+                keys = [("None" if np.isnan(v)
+                         else (str(int(v)) if as_int else str(float(v))))
+                        for v in col]
+            else:
+                keys = [("None" if v is None else str(v)) for v in col]
+        else:
+            keys = [str(i) for i in range(self._n_rows)]
+        return column_from_values(Text, keys)
+
+    # -- record path (read(), joins, aggregates) --------------------------
+    def read(self) -> List[Dict[str, Any]]:
+        if self._records is None and self._store is not None:
+            self._records = self._records_from_store()
+        return super().read()
+
+    def _records_from_store(self) -> List[Dict[str, Any]]:
+        n = self._n_rows
+        typed: Dict[str, List[Any]] = {}
+        for name, col in self._store.items():
+            kind = self.schema.get(name)
+            if isinstance(col, np.ndarray):
+                mask = ~np.isnan(col)
+                if kind is not None and issubclass(kind, Binary):
+                    vals = [bool(v != 0.0) if m else None
+                            for v, m in zip(col, mask)]
+                elif kind is not None and issubclass(kind, Integral):
+                    vals = [int(v) if m else None for v, m in zip(col, mask)]
+                elif kind is not None and issubclass(kind, Real):
+                    vals = [float(v) if m else None for v, m in zip(col, mask)]
+                else:
+                    as_int = self._is_int.get(name, False)
+                    vals = [(str(int(v)) if as_int else str(float(v))) if m
+                            else None for v, m in zip(col, mask)]
+            else:
+                vals = [_typed_scalar(v, kind) for v in col]
+            typed[name] = vals
+        names = list(typed)
+        return [
+            {h: typed[h][i] for h in names} for i in range(n)
+        ]
+

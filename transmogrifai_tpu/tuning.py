@@ -370,7 +370,12 @@ class OpValidator:
         # shape of the fold-weight mask used for the batched fits — the final
         # refit reuses it to hit the SAME compiled executable (shape-keyed)
         self.last_fit_shape = None if in_fold_dag else (len(splits), len(y32))
+        from .columns import to_device_f32
         for X, fsplits in fold_groups():
+            if not isinstance(X, jax.Array):
+                # ONE host→device transfer shared by every candidate family —
+                # the host link is the scarce resource on tunneled TPUs
+                X = to_device_f32(X)
             N = X.shape[0]
             mesh = self._maybe_mesh(N)
             self.last_mesh = mesh
@@ -385,6 +390,7 @@ class OpValidator:
             is_dev = isinstance(X, jax.Array)
             y_dev = None
             if is_dev:
+                # labels transfer EXACT (f32): bf16 wire is for features only
                 y_dev = (jax.device_put(jnp.asarray(y32),
                                         data_sharding(mesh, 1))
                          if mesh is not None else jnp.asarray(y32))
@@ -402,17 +408,28 @@ class OpValidator:
                 if is_dev:
                     vm = np.zeros(N, np.float32)
                     vm[va_idx] = 1.0
-                    vmj = jnp.asarray(vm)
+                    vmj = to_device_f32(vm)       # 0/1 mask: bf16 wire exact
                     if mesh is not None:
                         vmj = jax.device_put(vmj, data_sharding(mesh, 1))
                     va_masks_dev.append(vmj)
             if mesh is not None:
                 W = jax.device_put(jnp.asarray(W),
                                    data_sharding(mesh, 2, row_axis=1))
+            else:
+                # one shared transfer; family fits see a no-op conversion.
+                # bf16 wire only when the weights are exactly representable
+                # (0/1 fold masks; balancer keep/drop weights) — custom
+                # splitters may emit arbitrary weights, which go exact
+                import ml_dtypes
+                if np.array_equal(
+                        W, W.astype(ml_dtypes.bfloat16).astype(np.float32)):
+                    W = to_device_f32(W)
+                else:
+                    W = jnp.asarray(W)
             def fit_candidate(cand):
                 try:
                     return cand.estimator.fit_arrays_grid(
-                        X, y32, W, cand.grid)
+                        X, y_dev if y_dev is not None else y32, W, cand.grid)
                 except Exception:  # noqa: BLE001
                     # batched fit failed as a block — retry per point so one
                     # bad candidate can't take down the family (≙ Try-wrapped

@@ -13,10 +13,19 @@ sys.path.insert(0, ".")
 
 
 def t(fn, *a, **k):
+    """Time fn to COMPLETION: block_until_ready does not reliably wait on the
+    tunneled 'axon' platform, so force a scalar device→host pull over every
+    array leaf (measured: dispatch returns in ~0ms while the device still has
+    seconds of queued work)."""
+    import jax
+    import jax.numpy as jnp
+
     t0 = time.time()
     out = fn(*a, **k)
-    import jax
-    jax.block_until_ready(jax.tree.leaves(out))
+    leaves = [l for l in jax.tree.leaves(out) if isinstance(l, jax.Array)]
+    if leaves:
+        float(jnp.stack([jnp.sum(jnp.asarray(l, jnp.float32).ravel()[:1])
+                         for l in leaves]).sum())
     return time.time() - t0, out
 
 
@@ -69,5 +78,107 @@ def main():
     print(f"GBT grid warm: {dt:.1f}s", flush=True)
 
 
-if __name__ == "__main__":
+if __name__ == "__main__" and "--train" not in sys.argv:
     main()
+
+
+def profile_train(N=1_000_000, D=28):
+    """Run the REAL bench workload with per-phase forced-sync timing."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench import make_data
+    from transmogrifai_tpu import dag as dag_mod
+    from transmogrifai_tpu.columns import Column, ColumnBatch
+    from transmogrifai_tpu.evaluators import Evaluators
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.models.trees import (OpGBTClassifier,
+                                                OpRandomForestClassifier)
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                            ModelCandidate, ModelSelector,
+                                            grid)
+    from transmogrifai_tpu.types import RealNN
+    from transmogrifai_tpu.workflow import Workflow
+
+    def sync(tag, t0):
+        # the device stream is in-order: pulling one fresh scalar waits for
+        # all previously queued work (block_until_ready does not, on axon)
+        float(jnp.zeros(()).sum())
+        print(f"  {tag}: {time.time()-t0:.2f}s", flush=True)
+
+    X, y = make_data(N, D)
+    label = FeatureBuilder.RealNN("label").as_response()
+    feats = [FeatureBuilder.RealNN(f"f{i}").as_predictor() for i in range(D)]
+    checked = label.sanity_check(transmogrify(feats), remove_bad_features=True)
+    models = [
+        ModelCandidate(OpLogisticRegression(),
+                       grid(reg_param=[0.001, 0.01, 0.1, 0.2],
+                            elastic_net_param=[0.1], max_iter=[50]), "LR"),
+        ModelCandidate(OpRandomForestClassifier(),
+                       grid(num_trees=[20], max_depth=[6],
+                            min_instances_per_node=[10]), "RF"),
+        ModelCandidate(OpGBTClassifier(),
+                       grid(max_iter=[20], max_depth=[3],
+                            min_instances_per_node=[10]), "GBT"),
+    ]
+    selector = BinaryClassificationModelSelector(models=models)
+    selector.set_input(label, checked)
+    pred = selector.get_output()
+    cols = {"label": Column(RealNN, y)}
+    for i in range(D):
+        cols[f"f{i}"] = Column(RealNN, X[:, i])
+    batch = ColumnBatch(cols, N)
+    wf = Workflow().set_input_batch(batch).set_result_features(pred)
+
+    orig_fit_layer = dag_mod.fit_layer
+
+    def timed_fit_layer(b, layer):
+        t0 = time.time()
+        out = orig_fit_layer(b, layer)
+        names = [type(s).__name__ for s in layer]
+        sync(f"fit_layer {names}", t0)
+        return out
+
+    dag_mod.fit_layer = timed_fit_layer
+    import transmogrifai_tpu.workflow as wf_mod
+    wf_mod.fit_layer = timed_fit_layer
+
+    orig_find = ModelSelector.find_best_estimator
+    orig_refit = ModelSelector._refit_reusing_grid_executable
+    orig_eval_all = ModelSelector._evaluate_all
+
+    def timed_find(self, *a, **k):
+        t0 = time.time()
+        out = orig_find(self, *a, **k)
+        sync("selector.find_best_estimator", t0)
+        return out
+
+    def timed_refit(self, *a, **k):
+        t0 = time.time()
+        out = orig_refit(self, *a, **k)
+        sync("selector.refit", t0)
+        return out
+
+    def timed_eval_all(self, *a, **k):
+        t0 = time.time()
+        out = orig_eval_all(self, *a, **k)
+        sync("selector.evaluate_all", t0)
+        return out
+
+    ModelSelector.find_best_estimator = timed_find
+    ModelSelector._refit_reusing_grid_executable = timed_refit
+    ModelSelector._evaluate_all = timed_eval_all
+
+    t0 = time.time()
+    model = wf.train()
+    print(f"TOTAL train: {time.time()-t0:.2f}s", flush=True)
+    t0 = time.time()
+    m = model.evaluate(Evaluators.BinaryClassification.auROC(), batch=batch)
+    print(f"evaluate: {time.time()-t0:.2f}s AuROC={m['AuROC']:.4f}", flush=True)
+
+
+if __name__ == "__main__" and "--train" in sys.argv:
+    _pos = [a for a in sys.argv[1:] if not a.startswith("-")]
+    profile_train(N=int(float(_pos[0])) if _pos else 1_000_000)

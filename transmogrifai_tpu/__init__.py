@@ -22,7 +22,10 @@ if _os.environ.get("TRANSMOGRIFAI_COMPILATION_CACHE", "1") != "0":
             "jax_compilation_cache_dir",
             _os.environ.get("JAX_COMPILATION_CACHE_DIR",
                             "/tmp/transmogrifai_tpu_jax_cache"))
-        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # cache even small programs: a warm train run launches ~90 distinct
+        # executables and re-compiling the sub-second ones still costs
+        # multiple seconds of wall per run
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
     except Exception:  # pragma: no cover — cache is best-effort
         pass
 

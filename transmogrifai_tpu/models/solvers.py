@@ -106,17 +106,26 @@ class FitResult(NamedTuple):
     objective: jnp.ndarray  # final objective value
 
 
-def _spectral_norm_sq(Xw: jnp.ndarray, iters: int = 16) -> jnp.ndarray:
-    """Largest eigenvalue of (Xw^T Xw) via power iteration (static iters)."""
-    d = Xw.shape[1]
-    v = jnp.full((d,), 1.0 / jnp.sqrt(d), Xw.dtype)
+def _spectral_norm_sq_weighted(X: jnp.ndarray, wn: jnp.ndarray,
+                               mean: jnp.ndarray, scale: jnp.ndarray,
+                               iters: int = 16) -> jnp.ndarray:
+    """λ_max of Xs^T diag(wn) Xs for the IMPLICITLY standardized matrix
+    Xs = (X - mean)/scale, never materializing Xs or the weighted product —
+    one shared HBM-resident X serves every (fold × grid) lane."""
+    d = X.shape[1]
+    v = jnp.full((d,), 1.0 / jnp.sqrt(d), X.dtype)
+
+    def mv(v):
+        u = (X @ (v / scale)) - mean @ (v / scale)     # Xs @ v  [N]
+        u = wn * u
+        return (X.T @ u - mean * jnp.sum(u)) / scale   # Xs^T u  [D]
 
     def body(_, v):
-        u = Xw.T @ (Xw @ v)
+        u = mv(v)
         return u / (jnp.linalg.norm(u) + 1e-12)
 
     v = jax.lax.fori_loop(0, iters, body, v)
-    return jnp.vdot(v, Xw.T @ (Xw @ v))
+    return jnp.vdot(v, mv(v))
 
 
 @functools.partial(
@@ -125,12 +134,23 @@ def _spectral_norm_sq(Xw: jnp.ndarray, iters: int = 16) -> jnp.ndarray:
 def fista_fit(X: jnp.ndarray, y: jnp.ndarray, sample_weight: jnp.ndarray,
               l2: jnp.ndarray, l1: jnp.ndarray, *, loss: str = "logistic",
               fit_intercept: bool = True, max_iter: int = 100,
-              tol: float = 1e-6, n_classes: int = 1) -> FitResult:
+              tol: float = 1e-6, n_classes: int = 1,
+              mean: Optional[jnp.ndarray] = None,
+              scale: Optional[jnp.ndarray] = None,
+              sigma_sq: Optional[jnp.ndarray] = None) -> FitResult:
     """Accelerated proximal gradient with adaptive restart.
 
-    minimises  mean_loss(Xw + b) + l2/2 ||w||² + l1 ||w||₁   (no penalty on b).
+    minimises  mean_loss(Xs w + b) + l2/2 ||w||² + l1 ||w||₁  (no penalty on b)
+    where Xs = (X - mean)/scale is the IMPLICITLY standardized matrix when
+    ``mean``/``scale`` are given — the standardized copy is never
+    materialized, so every (fold × grid) vmap lane shares the single
+    HBM-resident ``X`` and XLA batches the lanes' matvecs into one matmul.
+    The returned coefficients live in the standardized basis (caller
+    un-scales, matching Spark ML's internal-standardization contract).
 
     ``l2``/``l1`` may be traced scalars → vmap over a regularisation grid.
+    ``sigma_sq`` (λ_max of the weighted Gram) may be shared across grid
+    lanes; computed here when absent.
     """
     n, d = X.shape
     C = n_classes
@@ -144,9 +164,28 @@ def fista_fit(X: jnp.ndarray, y: jnp.ndarray, sample_weight: jnp.ndarray,
     else:
         target = y.astype(X.dtype)
 
-    # step size from Lipschitz bound: c * sigma_max(X_w)^2 (+ l2)
-    sw = jnp.sqrt(w / jnp.sum(w))
-    L = _LOSS_CURVATURE[loss] * _spectral_norm_sq(X * sw[:, None]) + l2
+    std = scale is not None
+    mu = mean if std else jnp.zeros((d,), X.dtype)
+    sc = scale if std else jnp.ones((d,), X.dtype)
+
+    def xs_mv(coef):
+        """Xs @ coef without materializing Xs ([N] or [N, C])."""
+        v = coef / (sc[:, None] if coef.ndim == 2 else sc)
+        return X @ v - mu @ v
+
+    def xs_tmv(glin):
+        """Xs^T @ glin ([D] or [D, C])."""
+        if glin.ndim == 2:
+            sg = jnp.sum(glin, axis=0)
+            num = X.T @ glin - mu[:, None] * sg[None, :]
+            return num / sc[:, None]
+        return (X.T @ glin - mu * jnp.sum(glin)) / sc
+
+    # step size from Lipschitz bound: c * sigma_max(Xs_w)^2 (+ l2)
+    wn = w / jnp.sum(w)
+    if sigma_sq is None:
+        sigma_sq = _spectral_norm_sq_weighted(X, wn, mu, sc)
+    L = _LOSS_CURVATURE[loss] * sigma_sq + l2
     step0 = 1.0 / jnp.maximum(L, 1e-12)
     backtrack = loss in _BACKTRACK_LOSSES
 
@@ -155,14 +194,14 @@ def fista_fit(X: jnp.ndarray, y: jnp.ndarray, sample_weight: jnp.ndarray,
 
     def smooth_grad(coef, intercept):
         """Value and gradient of the smooth part (loss + l2 ridge)."""
-        lin = X @ coef + intercept
+        lin = xs_mv(coef) + intercept
         lval, glin = loss_fn(lin, target, w)
-        gcoef = X.T @ glin + l2 * coef
+        gcoef = xs_tmv(glin) + l2 * coef
         gint = (jnp.sum(glin, axis=0) if C > 1 else jnp.sum(glin))
         return lval + 0.5 * l2 * jnp.sum(coef * coef), gcoef, gint
 
     def smooth_val(coef, intercept):
-        lin = X @ coef + intercept
+        lin = xs_mv(coef) + intercept
         lval, _ = loss_fn(lin, target, w)
         return lval + 0.5 * l2 * jnp.sum(coef * coef)
 
@@ -297,14 +336,19 @@ def linear_grid_fit(X: jnp.ndarray, y: jnp.ndarray, fold_weights: jnp.ndarray,
 
     def one_fold(w):
         if standardization:
-            Xs, mean, scale = standardize(X, w, center=fit_intercept)
+            mean, scale = standardize_moments(X, w, center=fit_intercept)
         else:
-            Xs, mean, scale = X, jnp.zeros((d,), X.dtype), jnp.ones((d,), X.dtype)
+            mean, scale = (jnp.zeros((d,), X.dtype), jnp.ones((d,), X.dtype))
+        # λ_max of the fold's weighted Gram is grid-independent: compute it
+        # once per fold and share it across the vmapped grid lanes
+        wn = w / jnp.sum(w)
+        sigma_sq = _spectral_norm_sq_weighted(X, wn, mean, scale)
 
         def one_pt(l2, l1):
-            res = fista_fit(Xs, y, w, l2, l1, loss=loss,
+            res = fista_fit(X, y, w, l2, l1, loss=loss,
                             fit_intercept=fit_intercept, max_iter=max_iter,
-                            tol=tol, n_classes=n_classes)
+                            tol=tol, n_classes=n_classes,
+                            mean=mean, scale=scale, sigma_sq=sigma_sq)
             return unscale_params(res, mean, scale, n_classes)
 
         return jax.vmap(one_pt)(l2s, l1s)
@@ -318,33 +362,83 @@ def ridge_grid_fit(X: jnp.ndarray, y: jnp.ndarray, fold_weights: jnp.ndarray,
                    l2s: jnp.ndarray, *, fit_intercept: bool = True,
                    standardization: bool = True) -> FitResult:
     """Closed-form ridge over the (fold × l2-grid) matrix in one program
-    (the l1=0 fast path of the OpLinearRegression grid)."""
+    (the l1=0 fast path of the OpLinearRegression grid).
+
+    Works on per-fold Gram statistics of ONE shared matrix: when an
+    intercept is fit, X is first shifted by its global column means (a single
+    [N, D] copy total — algebraic Gram centering of raw data would
+    catastrophically cancel in f32 for large-mean features), then each fold's
+    (X^T W X)/s is one matmul; the residual per-fold centering and the
+    standardization now act on O(variance)-magnitude Gram entries, which is
+    numerically safe."""
     d = X.shape[1]
+    if fit_intercept:
+        g = jnp.mean(X, axis=0)
+        X = X - g
+    else:
+        g = jnp.zeros((d,), X.dtype)
 
     def one_fold(w):
+        s = jnp.sum(w)
+        Xw = X * w[:, None]
+        G = (X.T @ Xw) / s                       # (X^T W X)/s  [D, D]
+        p = (Xw.T @ y) / s                       # (X^T W y)/s  [D]
+        m = (w @ X) / s                          # weighted mean [D]
+        ym = jnp.sum(w * y) / s
+        yy = jnp.sum(w * y * y) / s
         if standardization:
-            Xs, mean, scale = standardize(X, w, center=fit_intercept)
+            var = jnp.diagonal(G) - m * m
+            scale = jnp.sqrt(jnp.maximum(var, 1e-12))
         else:
-            Xs, mean, scale = X, jnp.zeros((d,), X.dtype), jnp.ones((d,), X.dtype)
+            scale = jnp.ones((d,), X.dtype)
+        if fit_intercept:
+            # center by the weighted mean: Gc = G - m m^T, bc = p - m*ym
+            Gc = G - jnp.outer(m, m)
+            bc = p - m * ym
+            y0 = ym
+            mean_u = m
+        else:
+            Gc, bc, y0 = G, p, jnp.zeros((), X.dtype)
+            mean_u = jnp.zeros((d,), X.dtype)
+        # standardized basis: A = D^-1 Gc D^-1, b = D^-1 bc
+        A0 = Gc / (scale[:, None] * scale[None, :])
+        b = bc / scale
 
         def one_pt(l2):
-            res = ridge_fit(Xs, y, w, l2, fit_intercept=fit_intercept)
-            return unscale_params(res, mean, scale, 1)
+            A = A0 + l2 * jnp.eye(d, dtype=X.dtype)
+            coef = jax.scipy.linalg.solve(A, b, assume_a="pos")
+            obj = 0.5 * (yy - y0 * y0 - 2.0 * b @ coef + coef @ (A0 @ coef)
+                         ) + 0.5 * l2 * jnp.sum(coef * coef)
+            res = FitResult(coef, jnp.atleast_1d(y0),
+                            jnp.zeros((), jnp.int32), obj)
+            res = unscale_params(res, mean_u, scale, 1)
+            # undo the global shift: predictions are X@coef + (b - g@coef)
+            return FitResult(res.coef, res.intercept - g @ res.coef,
+                             res.n_iter, res.objective)
 
         return jax.vmap(one_pt)(l2s)
 
     return jax.vmap(one_fold)(fold_weights)
 
 
-def standardize(X: jnp.ndarray, sample_weight: jnp.ndarray,
-                center: bool) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Weighted feature standardisation (Spark ML standardizes internally and
-    un-scales the coefficients; we do the same).  Returns (Xs, mean, scale)."""
+def standardize_moments(X: jnp.ndarray, sample_weight: jnp.ndarray,
+                        center: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Weighted standardisation moments (mean, scale) — consumers apply them
+    IMPLICITLY inside their matvecs; the standardized matrix itself is never
+    materialized (a per-(fold × grid) copy of X would dominate HBM)."""
     w = sample_weight / jnp.sum(sample_weight)
     mean = w @ X
     var = w @ (X * X) - mean * mean
     scale = jnp.sqrt(jnp.maximum(var, 1e-12))
     mu = mean if center else jnp.zeros_like(mean)
+    return mu, scale
+
+
+def standardize(X: jnp.ndarray, sample_weight: jnp.ndarray,
+                center: bool) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Weighted feature standardisation (Spark ML standardizes internally and
+    un-scales the coefficients; we do the same).  Returns (Xs, mean, scale)."""
+    mu, scale = standardize_moments(X, sample_weight, center)
     return (X - mu) / scale, mu, scale
 
 

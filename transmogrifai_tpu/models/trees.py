@@ -683,17 +683,23 @@ def fit_gbt(X: np.ndarray, y: np.ndarray, *, task: str, n_rounds: int,
 
 def _tree_batch_budget(N: int, n_bins: int) -> Tuple[int, int]:
     """(chunk, batch_size) so the one-hot working set of the trees running
-    concurrently under ``lax.map(batch_size=...)`` stays ≲4 GiB.
+    concurrently under ``lax.map(batch_size=...)`` fits the budget
+    below (HBM minus data/program headroom).
 
     Measured on v5e at 1Mx28: wide feature chunks with a narrow tree batch
     (chunk=16, batch=4) run ~2.5x faster than narrow chunks with a wide batch
     (2, 8) — fewer scan iterations beat more vmap lanes, and XLA compile time
     is flat across the grid."""
-    per_feat = max(N * n_bins * 2, 1)              # bf16 one-hot per feature col
-    total = max(1, (4 << 30) // per_feat)
-    batch_size = max(1, min(4, total))             # shrink at very large N
-    chunk = max(1, min(16, total // batch_size))
-    return chunk, batch_size
+    budget = 6 << 30
+    per_col = max(2 * N, 1)       # bf16 bytes of one [N] column
+    p_cols = 256                  # routing matrix P [N, P_n*S] upper bound
+    # prefer 4 concurrent lanes at wide chunks; shrink chunk, then lanes
+    for batch_size in (4, 2, 1):
+        avail = budget // batch_size // per_col - p_cols
+        chunk = min(16, avail // n_bins)
+        if chunk >= 1:
+            return int(chunk), batch_size
+    return 1, 1
 
 
 @functools.lru_cache(maxsize=None)

@@ -25,6 +25,9 @@ from .types import (
 from .vector_meta import VectorMeta
 
 
+_DEVICE_CACHE: Dict[int, Any] = {}   # id(host array) → (weakref, device array)
+
+
 def to_device_f32(values) -> Any:
     """Host→device transfer of real-valued bulk data for compute.
 
@@ -36,8 +39,15 @@ def to_device_f32(values) -> Any:
     bf16's 8-bit mantissa, which is noise relative to feature measurement
     error.  Opt out with TRANSMOGRIFAI_WIRE_F32=1.  CPU backends (tests,
     goldens) always transfer exact f32.
+
+    Large arrays are cached (weakref-keyed on the host buffer) so a column
+    used by several stages — vectorizer fit, compiled transform, evaluate —
+    ships over the link ONCE per batch rather than once per consumer.
+    Columns are treated as immutable throughout the framework; in-place
+    mutation of a transferred array is not supported.
     """
     import os
+    import weakref
 
     import jax
     import jax.numpy as jnp
@@ -46,14 +56,26 @@ def to_device_f32(values) -> Any:
         return values if values.dtype == jnp.float32 else values.astype(
             jnp.float32)
     arr = np.asarray(values)
-    if (arr.dtype in (np.float32, np.float64)
-            and arr.size >= (1 << 16)
-            and jax.default_backend() != "cpu"
+    big = arr.size >= (1 << 16) and arr.dtype in (np.float32, np.float64)
+    if big:
+        ent = _DEVICE_CACHE.get(id(arr))
+        if ent is not None and ent[0]() is arr:
+            return ent[1]
+    if (big and jax.default_backend() != "cpu"
             and os.environ.get("TRANSMOGRIFAI_WIRE_F32") != "1"):
         import ml_dtypes
         wire = arr.astype(ml_dtypes.bfloat16)
-        return jax.device_put(wire).astype(jnp.float32)
-    return jnp.asarray(arr, jnp.float32)
+        dev = jax.device_put(wire).astype(jnp.float32)
+    else:
+        dev = jnp.asarray(arr, jnp.float32)
+    if big:
+        key = id(arr)
+        try:
+            ref = weakref.ref(arr, lambda _r, _k=key: _DEVICE_CACHE.pop(_k, None))
+            _DEVICE_CACHE[key] = (ref, dev)
+        except TypeError:  # pragma: no cover — un-weakref-able array subtype
+            pass
+    return dev
 
 
 @dataclass

@@ -380,9 +380,9 @@ def ridge_grid_fit(X: jnp.ndarray, y: jnp.ndarray, fold_weights: jnp.ndarray,
 
     def one_fold(w):
         s = jnp.sum(w)
-        Xw = X * w[:, None]
+        Xw = X * w[:, None]                      # fold-local scratch [N, D]
         G = (X.T @ Xw) / s                       # (X^T W X)/s  [D, D]
-        p = (Xw.T @ y) / s                       # (X^T W y)/s  [D]
+        p = (X.T @ (w * y)) / s                  # (X^T W y)/s  [D]
         m = (w @ X) / s                          # weighted mean [D]
         ym = jnp.sum(w * y) / s
         yy = jnp.sum(w * y * y) / s
@@ -418,7 +418,10 @@ def ridge_grid_fit(X: jnp.ndarray, y: jnp.ndarray, fold_weights: jnp.ndarray,
 
         return jax.vmap(one_pt)(l2s)
 
-    return jax.vmap(one_fold)(fold_weights)
+    # lax.map (not vmap) over folds: the weighted Gram scratch Xw is [N, D]
+    # per fold — batching folds would materialize an [F, N, D] operand
+    # (~3.7 GiB at the 11M-row scale this path exists for)
+    return jax.lax.map(one_fold, fold_weights)
 
 
 def standardize_moments(X: jnp.ndarray, sample_weight: jnp.ndarray,

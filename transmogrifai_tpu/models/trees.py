@@ -889,6 +889,7 @@ class _ForestEstimatorBase(PredictorEstimator):
     model_cls = TreeEnsembleModel
     task = "classification"
     default_feature_strategy = "sqrt"
+    hbm_heavy = True      # one-hot histogram working set ~6 GiB at large N
 
     def __init__(self, num_trees: int = 20, max_depth: int = 5,
                  max_bins: int = MAX_BINS_DEFAULT, min_instances_per_node: int = 1,
@@ -1052,6 +1053,7 @@ class OpDecisionTreeRegressor(OpDecisionTreeClassifier):
 class _GBTEstimatorBase(PredictorEstimator):
     model_cls = TreeEnsembleModel
     task = "classification"
+    hbm_heavy = True
 
     def __init__(self, max_iter: int = 20, max_depth: int = 5,
                  max_bins: int = MAX_BINS_DEFAULT, min_instances_per_node: int = 1,

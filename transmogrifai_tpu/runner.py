@@ -119,6 +119,12 @@ class OpWorkflowRunner:
         model = WorkflowModel.load(params.model_location)
         if self.score_reader is not None:
             model.set_reader(self.score_reader)
+        elif self.workflow is not None and self.workflow.reader is not None:
+            # no dedicated scoring reader: score the app's data source (the
+            # reference's OpApp subclasses usually pass an explicit
+            # scoringReader; falling back keeps `--run-type score` working
+            # out of the box for generated starter apps)
+            model.set_reader(self.workflow.reader)
         return model
 
     def _score(self, params: OpParams, timer: PhaseTimer) -> OpWorkflowRunnerResult:

@@ -442,6 +442,19 @@ def _gbt_regressor(p=DefaultSelectorParams) -> ModelCandidate:
         "OpGBTRegressor")
 
 
+def _compact_models(linear_cls, forest_cls) -> List[ModelCandidate]:
+    """Fast starter grid (linear reg sweep + one compact forest) for generated
+    apps and demos; the full reference default grids stay the constructor
+    default of every selector."""
+    return [
+        ModelCandidate(linear_cls(), grid(reg_param=[0.01, 0.1]),
+                       type(linear_cls()).__name__),
+        ModelCandidate(forest_cls(),
+                       grid(num_trees=[20], max_depth=[6]),
+                       type(forest_cls()).__name__),
+    ]
+
+
 class BinaryClassificationModelSelector(ModelSelector):
     """≙ BinaryClassificationModelSelector.scala:60-133 — defaults: LR, RF,
     GBT, LinearSVC on; NB/DT/XGB off; 3-fold CV on AuPR; DataSplitter."""
@@ -466,6 +479,12 @@ class BinaryClassificationModelSelector(ModelSelector):
         super().__init__(validator, splitter if splitter is not None else DataSplitter(seed),
                          models, evaluators, **kw)
 
+    @staticmethod
+    def compact_models() -> List[ModelCandidate]:
+        from .models.linear import OpLogisticRegression
+        from .models.trees import OpRandomForestClassifier
+        return _compact_models(OpLogisticRegression, OpRandomForestClassifier)
+
 
 class MultiClassificationModelSelector(ModelSelector):
     """≙ MultiClassificationModelSelector — defaults: LR, RF; DataCutter;
@@ -486,6 +505,12 @@ class MultiClassificationModelSelector(ModelSelector):
         super().__init__(validator, splitter if splitter is not None else DataCutter(seed=seed),
                          models, evaluators, **kw)
 
+    @staticmethod
+    def compact_models() -> List[ModelCandidate]:
+        from .models.linear import OpLogisticRegression
+        from .models.trees import OpRandomForestClassifier
+        return _compact_models(OpLogisticRegression, OpRandomForestClassifier)
+
 
 class RegressionModelSelector(ModelSelector):
     """≙ RegressionModelSelector.scala:61 — defaults: LinReg, RF, GBT;
@@ -505,6 +530,12 @@ class RegressionModelSelector(ModelSelector):
         evaluators = [OpRegressionEvaluator()]
         super().__init__(validator, splitter if splitter is not None else DataSplitter(seed),
                          models, evaluators, **kw)
+
+    @staticmethod
+    def compact_models() -> List[ModelCandidate]:
+        from .models.linear import OpLinearRegression
+        from .models.trees import OpRandomForestRegressor
+        return _compact_models(OpLinearRegression, OpRandomForestRegressor)
 
 
 class SelectedModelCombiner(Estimator):

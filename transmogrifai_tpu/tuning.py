@@ -454,7 +454,18 @@ class OpValidator:
             # `parallelism` :106).  Device execution serializes on the TPU
             # stream; the win is overlapping the XLA *compiles* of the
             # per-family batched programs, which dominate first-run wall.
+            # At very large N the families' HBM working sets no longer fit
+            # side by side (each TREE family budgets ~6 GiB of one-hot
+            # space) — fit sequentially so peak = max, not sum.  Grids with
+            # no HBM-heavy family keep the compile-overlap pool at any N.
+            import os as _os
+            serial_rows = int(_os.environ.get(
+                "TRANSMOGRIFAI_SERIAL_FIT_ROWS", 4_000_000))
             n_workers = min(self.parallelism, len(candidates))
+            if N >= serial_rows and any(
+                    getattr(c.estimator, "hbm_heavy", False)
+                    for c in candidates):
+                n_workers = 1
             if n_workers > 1:
                 from concurrent.futures import ThreadPoolExecutor
                 with ThreadPoolExecutor(max_workers=n_workers) as pool:

@@ -26,6 +26,10 @@ from .vector_meta import VectorMeta
 
 
 _DEVICE_CACHE: Dict[int, Any] = {}   # id(host array) → (weakref, device array)
+_DEVICE_CACHE_BYTES = [0]
+# HBM the cache may pin (FIFO-evicted beyond this; override via env)
+_DEVICE_CACHE_CAP = int(__import__("os").environ.get(
+    "TRANSMOGRIFAI_DEVICE_CACHE_BYTES", 2 << 30))
 
 
 def to_device_f32(values) -> Any:
@@ -70,11 +74,23 @@ def to_device_f32(values) -> Any:
         dev = jnp.asarray(arr, jnp.float32)
     if big:
         key = id(arr)
+        nbytes = int(dev.size) * 4
+
+        def _drop(_r, _k=key, _b=nbytes):
+            if _DEVICE_CACHE.pop(_k, None) is not None:
+                _DEVICE_CACHE_BYTES[0] -= _b
+
         try:
-            ref = weakref.ref(arr, lambda _r, _k=key: _DEVICE_CACHE.pop(_k, None))
-            _DEVICE_CACHE[key] = (ref, dev)
+            ref = weakref.ref(arr, _drop)
         except TypeError:  # pragma: no cover — un-weakref-able array subtype
-            pass
+            return dev
+        while (_DEVICE_CACHE_BYTES[0] + nbytes > _DEVICE_CACHE_CAP
+               and _DEVICE_CACHE):
+            oldest = next(iter(_DEVICE_CACHE))   # dicts preserve insertion order
+            _, old = _DEVICE_CACHE.pop(oldest)
+            _DEVICE_CACHE_BYTES[0] -= int(old.size) * 4
+        _DEVICE_CACHE[key] = (ref, dev)
+        _DEVICE_CACHE_BYTES[0] += nbytes
     return dev
 
 

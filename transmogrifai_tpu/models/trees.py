@@ -484,7 +484,10 @@ def _fit_tree_unrolled(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
 def predict_trees_raw(X: jnp.ndarray, feature: jnp.ndarray, threshold: jnp.ndarray,
                       is_leaf: jnp.ndarray, leaf: jnp.ndarray,
                       max_depth: int) -> jnp.ndarray:
-    """Batch prediction over an ensemble on raw features.
+    """Batch prediction over an ensemble on raw features — row-chunked via
+    ``lax.map`` above ~1M rows so the per-step working set stays bounded
+    regardless of N (the fused one-hot walk is cheap per block; very large
+    single dispatches have crashed the worker on marginal links).
     feature/threshold/is_leaf: [Tr, T]; leaf: [Tr, T, V].
     Returns [N, Tr, V] leaf values (caller aggregates).
 
@@ -494,6 +497,23 @@ def predict_trees_raw(X: jnp.ndarray, feature: jnp.ndarray, threshold: jnp.ndarr
     one-hots fuse into the reductions, nothing of size [N, Tr, T] is
     materialized, and the MXU/VPU do the work (measured: ~100x faster compile
     AND faster steady-state than the gather form at 1Mx28, 20 trees)."""
+    N = X.shape[0]
+    BLOCK = 1 << 20
+    if N > BLOCK:
+        n_blocks = -(-N // BLOCK)
+        pad = n_blocks * BLOCK - N
+        Xp = jnp.pad(X, ((0, pad), (0, 0))).reshape(n_blocks, BLOCK,
+                                                    X.shape[1])
+        out = jax.lax.map(
+            lambda xb: _predict_trees_block(xb, feature, threshold, is_leaf,
+                                            leaf, max_depth), Xp)
+        return out.reshape(n_blocks * BLOCK, *out.shape[2:])[:N]
+    return _predict_trees_block(X, feature, threshold, is_leaf, leaf,
+                                max_depth)
+
+
+def _predict_trees_block(X, feature, threshold, is_leaf, leaf,
+                         max_depth: int):
     T = feature.shape[1]
     D = X.shape[1]
     dt = X.dtype

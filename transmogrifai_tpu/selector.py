@@ -448,10 +448,10 @@ def _compact_models(linear_cls, forest_cls) -> List[ModelCandidate]:
     default of every selector."""
     return [
         ModelCandidate(linear_cls(), grid(reg_param=[0.01, 0.1]),
-                       type(linear_cls()).__name__),
+                       linear_cls.__name__),
         ModelCandidate(forest_cls(),
                        grid(num_trees=[20], max_depth=[6]),
-                       type(forest_cls()).__name__),
+                       forest_cls.__name__),
     ]
 
 

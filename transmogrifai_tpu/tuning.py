@@ -473,10 +473,29 @@ class OpValidator:
             else:
                 fitted_grids = [fit_candidate(c) for c in candidates]
 
+            va_cache: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+
+            def va_slice(f, va_idx):
+                """Pulled validation slice, cached per FOLD so every
+                fallback candidate shares one transfer."""
+                if f not in va_cache:
+                    nonlocal X_host
+                    if is_dev:
+                        # gather ONLY the validation slice on device, then
+                        # pull — the full matrix is folds-times bigger and
+                        # the link is the bottleneck
+                        xv = np.asarray(jnp.take(
+                            X, jnp.asarray(va_idx), axis=0))
+                    else:
+                        if X_host is None:
+                            X_host = np.asarray(X)
+                        xv = X_host[va_idx]
+                    va_cache[f] = (xv, y32[va_idx])
+                return va_cache[f]
+
             for ci, cand in enumerate(candidates):
                 fitted_grid = fitted_grids[ci]
                 for f, va_idx in enumerate(va_slices):
-                    X_va = y_va = None
                     for gi, params in enumerate(cand.grid):
                         fitted = fitted_grid[f][gi]
                         if fitted is None:
@@ -487,12 +506,8 @@ class OpValidator:
                             metric = device_metric(cand, params, fitted, X,
                                                    y_dev, va_masks_dev[f])
                         if metric is None:
-                            if X_va is None:
-                                if X_host is None:
-                                    X_host = np.asarray(X)
-                                X_va, y_va = X_host[va_idx], y32[va_idx]
                             metric = host_metric(cand, params, fitted,
-                                                 X_va, y_va)
+                                                 *va_slice(f, va_idx))
                         record(cand, ci, gi, params, metric)
 
         if deferred:

@@ -140,3 +140,69 @@ def test_forest_learns_interactions_with_feature_subsetting():
     model = est.model_cls(fitted=est.fit_arrays(X, y), **est._params)
     s = np.asarray(model.predict_arrays(X)["probability"])[:, 1]
     assert auroc(y, s) > 0.85
+
+
+def test_compact_tree_matches_unrolled():
+    """The fori_loop level-body tree fitter must produce EXACTLY the same
+    tree as the unrolled reference implementation (same splits, thresholds,
+    leaf flags and values) across impurities and per-node feature
+    subsetting."""
+    import jax
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models.trees import (_fit_tree_compact,
+                                                _fit_tree_unrolled,
+                                                bin_data, build_bin_splits)
+
+    rng = np.random.default_rng(3)
+    N, D, n_bins = 500, 7, 8
+    X = rng.normal(size=(N, D)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 > 0).astype(np.float32)
+    splits = jnp.asarray(build_bin_splits(X, n_bins))
+    B = bin_data(jnp.asarray(X), splits)
+    w = rng.random(N).astype(np.float32)
+
+    cases = [
+        ("gini", np.stack([w, w * (1 - y), w * y], axis=1), None),
+        ("variance", np.stack([w, w * y, w * y * y], axis=1), None),
+        ("xgb", np.stack([w, w * (y - 0.5), w * np.full(N, 0.25)], axis=1),
+         None),
+        ("gini", np.stack([w, w * (1 - y), w * y], axis=1), 3),
+    ]
+    for impurity, stats, fpn in cases:
+        for depth in (1, 2, 4):
+            kw = dict(impurity=impurity, max_depth=depth, n_bins=n_bins,
+                      min_instances=jnp.float32(2.0),
+                      min_gain=jnp.float32(0.0), lam=jnp.float32(1.0),
+                      node_feature_key=(jax.random.PRNGKey(0)
+                                        if fpn else None),
+                      features_per_node=fpn)
+            a = _fit_tree_compact(B, splits, jnp.asarray(stats),
+                                  jnp.ones(D) > 0, **kw)
+            b = _fit_tree_unrolled(B, splits, jnp.asarray(stats),
+                                   jnp.ones(D) > 0, **kw)
+            tag = f"{impurity} d{depth} fpn={fpn}"
+            # compare only REACHABLE slots: the two implementations write
+            # different (harmless) garbage under pruned subtrees
+            def reachable(feat, leaf_flag):
+                live = {0}
+                for s in range(len(feat)):
+                    if s not in live:
+                        continue
+                    if not bool(leaf_flag[s]) and 2 * s + 2 < len(feat):
+                        live |= {2 * s + 1, 2 * s + 2}
+                return sorted(live)
+
+            idx = reachable(np.asarray(b.feature), np.asarray(b.is_leaf))
+            assert reachable(np.asarray(a.feature),
+                             np.asarray(a.is_leaf)) == idx, tag
+            np.testing.assert_array_equal(
+                np.asarray(a.feature)[idx], np.asarray(b.feature)[idx], tag)
+            np.testing.assert_array_equal(
+                np.asarray(a.is_leaf)[idx], np.asarray(b.is_leaf)[idx], tag)
+            np.testing.assert_allclose(
+                np.asarray(a.threshold)[idx], np.asarray(b.threshold)[idx],
+                err_msg=tag)
+            np.testing.assert_allclose(
+                np.asarray(a.leaf)[idx], np.asarray(b.leaf)[idx], rtol=1e-5,
+                err_msg=tag)

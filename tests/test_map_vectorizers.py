@@ -69,9 +69,11 @@ def test_multi_picklist_map_vectorizer():
     f = FeatureBuilder.MultiPickListMap("m").as_predictor()
     st = MultiPickListMapVectorizer(top_k=5, min_support=1)
     model, arr = fit_transform(st, f, make_batch("m", T.MultiPickListMap, maps))
-    assert arr.shape == (4, 4)  # a, b, OTHER, null
+    # pivot layout = (count desc, value asc) like the reference:
+    # b appears twice, a once -> [b, a, OTHER, null]
+    assert arr.shape == (4, 4)
     np.testing.assert_allclose(arr[0], [1, 1, 0, 0])
-    np.testing.assert_allclose(arr[1], [0, 1, 0, 0])
+    np.testing.assert_allclose(arr[1], [1, 0, 0, 0])
     np.testing.assert_allclose(arr[2], [0, 0, 0, 1])
     np.testing.assert_allclose(arr[3], [0, 0, 0, 1])  # empty set = null
 

@@ -14,6 +14,7 @@ from transmogrifai_tpu.ops.numeric import (BinaryVectorizer,
                                            IntegralVectorizer,
                                            RealNNVectorizer, RealVectorizer)
 from transmogrifai_tpu.ops.text import SmartTextVectorizer, tokenize_text
+from transmogrifai_tpu import types as T
 from transmogrifai_tpu.types import (Binary, Integral, PickList, Real, RealNN,
                                      Text)
 
@@ -128,3 +129,55 @@ def test_vectors_combiner_merges_metadata():
     parents = [c.parent_feature_name for c in out.meta.columns]
     assert parents == ["x", "x", "y", "y"]
     assert [c.index for c in out.meta.columns] == [0, 1, 2, 3]
+
+
+def test_smart_text_reference_decision_matrix():
+    """The reference's 4-field scenario (SmartTextVectorizerTest.scala:75-97):
+    small-domain text pivots, large-domain text hashes, and fixed-length
+    high-cardinality IDs are IGNORED when min_length_std_dev > 0 (the branch
+    is off by default, matching MinTextLengthStdDev = 0)."""
+    rng = np.random.default_rng(11)
+    n = 300
+    cats = [str(rng.choice(list("ABCDEF"))) for _ in range(n)]
+    countries = [f"country_{rng.integers(0, 200)}" for _ in range(n)]
+    ids = [f"{40230 + rng.integers(0, 1000):06d}" for _ in range(n)]
+    free = ["".join(rng.choice(list("abcdef "), size=rng.integers(1, 60)))
+            for _ in range(n)]
+
+    feats = [FeatureBuilder.Text(nm).as_predictor()
+             for nm in ("cat", "country", "tid", "txt")]
+    batch = ColumnBatch({
+        "cat": column_from_values(T.Text, cats),
+        "country": column_from_values(T.Text, countries),
+        "tid": column_from_values(T.Text, ids),
+        "txt": column_from_values(T.Text, free)}, n)
+
+    st = SmartTextVectorizer(max_cardinality=10, num_hashes=4, top_k=2,
+                             min_support=1, min_length_std_dev=0.3)
+    st.set_input(*feats)
+    model = st.fit(batch)
+    strat = model.metadata["strategies"]
+    assert strat == {"cat": "pivot", "country": "hash",
+                     "tid": "ignore", "txt": "hash"}, strat
+
+    # default (min_length_std_dev=0): the ignore branch never fires
+    st2 = SmartTextVectorizer(max_cardinality=10, num_hashes=4, top_k=2,
+                              min_support=1)
+    st2.set_input(*feats)
+    strat2 = st2.fit(batch).metadata["strategies"]
+    assert strat2["tid"] == "hash", strat2
+
+
+def test_one_hot_layout_orders_by_count_then_value():
+    """Pivot column order is (count desc, value asc) — the reference's
+    sortBy(-count -> value) take(topK) (SmartTextVectorizer.scala:97-100)."""
+    vals = ["z"] * 5 + ["a"] * 3 + ["m"] * 3 + ["q"] * 1
+    f = FeatureBuilder.PickList("p").as_predictor()
+    st = OneHotEstimator(top_k=3, min_support=2)
+    st.set_input(f)
+    batch = ColumnBatch({"p": column_from_values(T.PickList, vals)}, len(vals))
+    model = st.fit(batch)
+    meta = model.fitted["meta"]
+    indicators = [c.indicator_value for c in meta.columns]
+    # z(5) first, then the a/m tie broken by value, q dropped by min_support
+    assert indicators[:3] == ["z", "a", "m"], indicators

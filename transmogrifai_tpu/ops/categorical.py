@@ -34,6 +34,16 @@ def _col_strings(col: Column) -> np.ndarray:
     return vals.astype(object)
 
 
+def top_values_by_count(counts, top_k: int, min_support: int):
+    """Reference top-value selection (SmartTextVectorizer.scala:97-100,
+    OpOneHotVectorizer): drop values below ``min_support``, order by
+    (count desc, value asc), take ``top_k``.  The returned ORDER is the
+    pivot column layout — most frequent value first."""
+    eligible = [(v, c) for v, c in counts.items() if c >= min_support]
+    eligible.sort(key=lambda vc: (-vc[1], vc[0]))
+    return [v for v, _ in eligible[:top_k]]
+
+
 def encode_with_vocab(values: np.ndarray, vocab: Dict[str, int], other_id: int) -> np.ndarray:
     """strings → int ids; None→other_id+1 (null slot)."""
     null_id = other_id + 1
@@ -93,10 +103,10 @@ class OneHotEstimator(Estimator):
         for f in self.input_features:
             strings = _col_strings(batch[f.name])
             counts = Counter(v for v in strings if v is not None)
-            top = [v for v, c in counts.most_common(top_k) if c >= min_support]
-            vocab = {v: i for i, v in enumerate(sorted(top))}
+            top = top_values_by_count(counts, top_k, min_support)
+            vocab = {v: i for i, v in enumerate(top)}
             vocabs[f.name] = vocab
-            for v in sorted(top):
+            for v in top:
                 cols_meta.append(VectorColumnMeta(
                     f.name, f.kind.__name__, indicator_value=v))
             if self.get("track_other", True):

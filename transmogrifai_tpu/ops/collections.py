@@ -11,6 +11,7 @@ from typing import Dict, List
 import jax.numpy as jnp
 import numpy as np
 
+from .categorical import top_values_by_count
 from ..columns import Column, ColumnBatch
 from ..stages.base import Estimator, TransformerModel
 from ..types import OPVector
@@ -67,11 +68,11 @@ class MultiPickListVectorizer(Estimator):
             for s in batch[f.name].values:
                 for v in (s or ()):
                     counts[v] += 1
-            top = [v for v, c in counts.most_common(self.get("top_k"))
-                   if c >= self.get("min_support")]
-            vocab = {v: i for i, v in enumerate(sorted(top))}
+            top = top_values_by_count(counts, self.get("top_k"),
+                                      self.get("min_support"))
+            vocab = {v: i for i, v in enumerate(top)}
             vocabs[f.name] = vocab
-            for v in sorted(top):
+            for v in top:
                 cols_meta.append(VectorColumnMeta(
                     f.name, f.kind.__name__, indicator_value=v))
             if self.get("track_other", True):

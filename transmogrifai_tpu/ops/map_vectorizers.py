@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from .categorical import top_values_by_count
 from ..columns import Column, ColumnBatch, indicator_2d
 from ..stages.base import Estimator, TransformerModel
 from ..types import OPVector
@@ -144,19 +145,16 @@ class SmartTextMapVectorizer(Estimator):
             kindname = f.kind.__name__
             for k in keys:
                 st = stats.key_stats.get(k, TextStats())
-                if st.cardinality <= 1:
-                    strategies[k] = "ignore"
-                    if self.get("track_nulls", True):
-                        cols_meta.append(VectorColumnMeta(
-                            f.name, kindname, grouping=k,
-                            indicator_value=NULL_INDICATOR))
-                elif st.cardinality <= max_card:
+                if st.cardinality <= max_card:
+                    # the reference pivots even single-value keys
+                    # (SmartTextVectorizer.scala:92-96)
                     strategies[k] = "pivot"
-                    top = [v for v, c in st.value_counts.most_common(
-                        self.get("top_k")) if c >= self.get("min_support")]
-                    vocab = {v: i for i, v in enumerate(sorted(top))}
+                    top = top_values_by_count(st.value_counts,
+                                              self.get("top_k"),
+                                              self.get("min_support"))
+                    vocab = {v: i for i, v in enumerate(top)}
                     vocabs[k] = vocab
-                    for v in sorted(top):
+                    for v in top:
                         cols_meta.append(VectorColumnMeta(
                             f.name, kindname, grouping=k, indicator_value=v))
                     cols_meta.append(VectorColumnMeta(
@@ -165,6 +163,14 @@ class SmartTextMapVectorizer(Estimator):
                     cols_meta.append(VectorColumnMeta(
                         f.name, kindname, grouping=k,
                         indicator_value=NULL_INDICATOR))
+                elif st.length_std_dev < self.get("min_length_std_dev", 0.0):
+                    # ID-like key: high cardinality, near-constant value
+                    # length (off by default, like the scalar SmartText)
+                    strategies[k] = "ignore"
+                    if self.get("track_nulls", True):
+                        cols_meta.append(VectorColumnMeta(
+                            f.name, kindname, grouping=k,
+                            indicator_value=NULL_INDICATOR))
                 else:
                     strategies[k] = "hash"
                     for j in range(self.get("num_hashes")):
@@ -236,11 +242,11 @@ class TextMapPivotVectorizer(Estimator):
             kindname = f.kind.__name__
             for k in keys:
                 cnt = Counter(str(m[k]) for m in maps if m.get(k) is not None)
-                top = [v for v, c in cnt.most_common(self.get("top_k"))
-                       if c >= self.get("min_support")]
-                vocab = {v: i for i, v in enumerate(sorted(top))}
+                top = top_values_by_count(cnt, self.get("top_k"),
+                                          self.get("min_support"))
+                vocab = {v: i for i, v in enumerate(top)}
                 vocabs[k] = vocab
-                for v in sorted(top):
+                for v in top:
                     cols_meta.append(VectorColumnMeta(
                         f.name, kindname, grouping=k, indicator_value=v))
                 cols_meta.append(VectorColumnMeta(
@@ -313,11 +319,11 @@ class MultiPickListMapVectorizer(Estimator):
                 for m in maps:
                     for v in (m.get(k) or ()):
                         cnt[str(v)] += 1
-                top = [v for v, c in cnt.most_common(self.get("top_k"))
-                       if c >= self.get("min_support")]
-                vocab = {v: i for i, v in enumerate(sorted(top))}
+                top = top_values_by_count(cnt, self.get("top_k"),
+                                          self.get("min_support"))
+                vocab = {v: i for i, v in enumerate(top)}
                 vocabs[k] = vocab
-                for v in sorted(top):
+                for v in top:
                     cols_meta.append(VectorColumnMeta(
                         f.name, kindname, grouping=k, indicator_value=v))
                 cols_meta.append(VectorColumnMeta(

@@ -16,6 +16,7 @@ from typing import Any, Dict, List
 import jax.numpy as jnp
 import numpy as np
 
+from .categorical import top_values_by_count
 from ..columns import Column, ColumnBatch
 from ..stages.base import Estimator, TransformerModel
 from ..types import (Binary, Date, DateTime, Geolocation, Integral,
@@ -195,11 +196,11 @@ class MapVectorizer(Estimator):
                 for m in maps:
                     for v in (m.get(k) or ()):
                         cnt[v] += 1
-                top = [v for v, c in cnt.most_common(self.get("top_k"))
-                       if c >= self.get("min_support")]
-                vocab = {v: i for i, v in enumerate(sorted(top))}
+                top = top_values_by_count(cnt, self.get("top_k"),
+                                          self.get("min_support"))
+                vocab = {v: i for i, v in enumerate(top)}
                 vocabs[k] = vocab
-                for v in sorted(top):
+                for v in top:
                     cols_meta.append(VectorColumnMeta(
                         f.name, kindname, grouping=k, indicator_value=v))
                 cols_meta.append(VectorColumnMeta(
@@ -226,11 +227,11 @@ class MapVectorizer(Estimator):
             vocabs = {}
             for k in keys:
                 cnt = Counter(str(m[k]) for m in maps if m.get(k) is not None)
-                top = [v for v, c in cnt.most_common(self.get("top_k"))
-                       if c >= self.get("min_support")]
-                vocab = {v: i for i, v in enumerate(sorted(top))}
+                top = top_values_by_count(cnt, self.get("top_k"),
+                                          self.get("min_support"))
+                vocab = {v: i for i, v in enumerate(top)}
                 vocabs[k] = vocab
-                for v in sorted(top):
+                for v in top:
                     cols_meta.append(VectorColumnMeta(
                         f.name, kindname, grouping=k, indicator_value=v))
                 cols_meta.append(VectorColumnMeta(

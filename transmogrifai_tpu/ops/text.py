@@ -24,7 +24,7 @@ from ..stages.base import Estimator, Transformer, TransformerModel
 from ..types import OPVector, Real, Text, TextList
 from ..vector_meta import (NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMeta,
                            VectorMeta)
-from .categorical import _col_strings, encode_with_vocab
+from .categorical import _col_strings, encode_with_vocab, top_values_by_count
 
 _TOKEN_RE = re.compile(r"[A-Za-z0-9_']+")
 
@@ -177,6 +177,19 @@ class TextStats:
     def cardinality(self) -> int:
         return len(self.value_counts)
 
+    @property
+    def length_std_dev(self) -> float:
+        """Standard deviation of the FULL (cleaned) value lengths — exactly
+        the reference's TextStats.lengthStdDev (SmartTextVectorizer.scala:
+        126 builds lengthCounts from text.length, :190-193 the stddev);
+        drives the ID-like Ignore branch."""
+        n = sum(self.length_counts.values())
+        if n == 0:
+            return 0.0
+        mean = sum(l * c for l, c in self.length_counts.items()) / n
+        var = sum(c * (l - mean) ** 2 for l, c in self.length_counts.items()) / n
+        return var ** 0.5
+
     def combine(self, other: "TextStats") -> "TextStats":
         return TextStats(self.value_counts + other.value_counts,
                          self.length_counts + other.length_counts)
@@ -228,18 +241,21 @@ class SmartTextVectorizerModel(TransformerModel):
 class SmartTextVectorizer(Estimator):
     """Cardinality-adaptive text vectorization (≙ SmartTextVectorizer.scala:61):
     one TextStats pass; per feature, cardinality ≤ max_cardinality → pivot
-    one-hot (like categorical), 1 unique value → ignore, else tokenize+hash."""
+    one-hot (like categorical); else value-length stddev below
+    ``min_length_std_dev`` (ID-like; branch off by default) → ignore; else
+    tokenize+hash."""
 
     out_kind = OPVector
 
     def __init__(self, max_cardinality: int = 30, top_k: int = 20,
                  min_support: int = 10, num_hashes: int = 512,
                  track_nulls: bool = True, auto_detect_languages: bool = False,
-                 **params):
+                 min_length_std_dev: float = 0.0, **params):
         super().__init__(max_cardinality=max_cardinality, top_k=top_k,
                          min_support=min_support, num_hashes=num_hashes,
                          track_nulls=track_nulls,
-                         auto_detect_languages=auto_detect_languages, **params)
+                         auto_detect_languages=auto_detect_languages,
+                         min_length_std_dev=min_length_std_dev, **params)
 
     def fit(self, batch: ColumnBatch) -> TransformerModel:
         strategies: Dict[str, str] = {}
@@ -249,24 +265,30 @@ class SmartTextVectorizer(Estimator):
         for f in self.input_features:
             strings = _col_strings(batch[f.name])
             stats = TextStats.of_column(strings, max_card)
-            if stats.cardinality <= 1:
-                strategies[f.name] = "ignore"
-                if self.get("track_nulls", True):
-                    cols_meta.append(VectorColumnMeta(
-                        f.name, f.kind.__name__, indicator_value=NULL_INDICATOR))
-            elif stats.cardinality <= max_card:
+            if stats.cardinality <= max_card:
+                # card <= maxCardinality -> pivot (the reference pivots even
+                # single-value columns; SmartTextVectorizer.scala:92-96)
                 strategies[f.name] = "pivot"
-                top = [v for v, c in stats.value_counts.most_common(self.get("top_k"))
-                       if c >= self.get("min_support")]
-                vocab = {v: i for i, v in enumerate(sorted(top))}
+                top = top_values_by_count(stats.value_counts,
+                                          self.get("top_k"),
+                                          self.get("min_support"))
+                vocab = {v: i for i, v in enumerate(top)}
                 vocabs[f.name] = vocab
-                for v in sorted(top):
+                for v in top:
                     cols_meta.append(VectorColumnMeta(
                         f.name, f.kind.__name__, indicator_value=v))
                 cols_meta.append(VectorColumnMeta(
                     f.name, f.kind.__name__, indicator_value=OTHER_INDICATOR))
                 cols_meta.append(VectorColumnMeta(
                     f.name, f.kind.__name__, indicator_value=NULL_INDICATOR))
+            elif stats.length_std_dev < self.get("min_length_std_dev", 0.0):
+                # ID-like: high cardinality with near-constant token length
+                # (SmartTextVectorizer.scala:94 Ignore branch; off by default
+                # like the reference's MinTextLengthStdDev = 0)
+                strategies[f.name] = "ignore"
+                if self.get("track_nulls", True):
+                    cols_meta.append(VectorColumnMeta(
+                        f.name, f.kind.__name__, indicator_value=NULL_INDICATOR))
             else:
                 strategies[f.name] = "hash"
                 for j in range(self.get("num_hashes")):

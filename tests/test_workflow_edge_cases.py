@@ -61,9 +61,13 @@ def test_data_balancer_on_skewed_labels():
              .set_result_features(sel.get_output()).train())
     sm = model.selected_model
     prep = sm.summary.data_prep_results
-    assert prep.get("positiveFraction") == pytest.approx(0.03)
-    # the balancer actually down-sampled the majority class
-    assert 0.0 < prep.get("downSampleFraction", 1.0) < 1.0
+    # reference DataBalancerSummary fields
+    total = prep.get("positiveLabels", 0) + prep.get("negativeLabels", 0)
+    assert prep.get("positiveLabels", 0) / max(total, 1) == pytest.approx(
+        0.03, abs=0.01)
+    # the balancer resampled: up-sampled minority and/or down-sampled majority
+    assert (prep.get("upSamplingFraction", 0.0) > 1.0
+            or 0.0 < prep.get("downSamplingFraction", 1.0) < 1.0)
     m = model.evaluate(Evaluators.BinaryClassification.auROC())
     assert m["AuROC"] > 0.85
 
@@ -126,3 +130,65 @@ def test_duplicate_stage_uid_rejected():
             Workflow().set_result_features(pred)
     finally:
         sel_stage.uid = old_uid
+
+
+def test_data_balancer_reference_proportions():
+    """getProportions parity (DataBalancer.scala:84-115): integer up-sample
+    ladder + majority down-sample, or both-downsample at the cap."""
+    from transmogrifai_tpu.tuning import DataBalancer
+
+    # tiny minority: the biggest ladder rung (100x) fits
+    down, up = DataBalancer.get_proportions(100, 99_900, 0.1, 1_000_000)
+    assert up == 100.0
+    np.testing.assert_allclose(down, (100 * 100 / 0.1 - 10_000) / 99_900)
+
+    # mid-size minority: 4x+ overshoots the target fraction, 3x fits
+    down, up = DataBalancer.get_proportions(3_000, 97_000, 0.1, 1_000_000)
+    assert up == 3.0
+    np.testing.assert_allclose(down, (3_000 * 3 / 0.1 - 9_000) / 97_000)
+
+    # minority already >= cap * fraction: both classes down-sample
+    down, up = DataBalancer.get_proportions(200_000, 800_000, 0.5, 100_000)
+    np.testing.assert_allclose(up, 100_000 * 0.5 / 200_000)
+    np.testing.assert_allclose(down, 0.5 * 100_000 / 800_000)
+
+
+def test_data_balancer_resampling_hits_target_fraction():
+    from transmogrifai_tpu.columns import Column, ColumnBatch
+    from transmogrifai_tpu.tuning import DataBalancer
+    from transmogrifai_tpu.types import RealNN
+
+    rng = np.random.default_rng(0)
+    n = 20_000
+    y = (rng.random(n) < 0.01).astype(np.float32)   # 1% positives
+    batch = ColumnBatch({"label": Column(RealNN, y)}, n)
+    b = DataBalancer(sample_fraction=0.1, seed=7)
+    out = b.validation_prepare(batch, "label")
+    y2 = np.asarray(out["label"].values)
+    frac = float((y2 > 0.5).mean())
+    assert 0.07 < frac < 0.14, frac                  # near the 10% target
+    info = b.summary.info
+    assert info["upSamplingFraction"] >= 2.0         # genuinely up-sampled
+    assert 0 < info["downSamplingFraction"] < 1.0
+
+    # weight-space variant agrees on expected class masses
+    w = np.ones(n, np.float32)
+    w2 = b.validation_prepare_weights(y, w)
+    pos_mass = float(w2[y > 0.5].sum())
+    neg_mass = float(w2[y <= 0.5].sum())
+    frac_w = pos_mass / max(pos_mass + neg_mass, 1e-9)
+    assert 0.07 < frac_w < 0.14, frac_w
+
+
+def test_data_balancer_already_balanced_is_noop():
+    from transmogrifai_tpu.columns import Column, ColumnBatch
+    from transmogrifai_tpu.tuning import DataBalancer
+    from transmogrifai_tpu.types import RealNN
+
+    rng = np.random.default_rng(1)
+    y = (rng.random(1000) < 0.4).astype(np.float32)
+    batch = ColumnBatch({"label": Column(RealNN, y)}, 1000)
+    b = DataBalancer(sample_fraction=0.1)
+    out = b.validation_prepare(batch, "label")
+    assert len(out) == 1000                          # untouched
+    assert b.summary.info["upSamplingFraction"] == 0.0

@@ -69,8 +69,21 @@ class DataSplitter(Splitter):
 
 
 class DataBalancer(Splitter):
-    """≙ DataBalancer.scala: up/down-sample a binary label towards
-    ``sample_fraction`` positives, capped at ``max_training_sample`` rows."""
+    """≙ DataBalancer.scala: resample a binary label towards a minimum
+    ``sample_fraction`` of the minority class, capped at
+    ``max_training_sample`` rows.
+
+    Reference semantics reproduced exactly (DataBalancer.scala:76-160):
+
+    * already balanced (minority fraction ≥ ``sample_fraction``) → no
+      resampling; only a global down-sample when the data exceeds the cap;
+    * minority below the cap's share → UP-sample it by the largest integer
+      multiplier from {100, 50, 10, 5, 4, 3, 2} that stays under both the
+      target fraction and the cap (with replacement), then down-sample the
+      majority to hit the fraction;
+    * otherwise down-sample BOTH classes to the capped size at the target
+      fraction.
+    """
 
     def __init__(self, sample_fraction: float = 0.1,
                  max_training_sample: int = 1_000_000, seed: int = 42,
@@ -79,50 +92,118 @@ class DataBalancer(Splitter):
         self.sample_fraction = float(sample_fraction)
         self.max_training_sample = int(max_training_sample)
 
-    def pre_validation_prepare(self, batch, label):
-        y = np.asarray(batch[label].values, dtype=np.float64)
-        pos = float((y > 0.5).sum())
-        n = len(y)
+    @staticmethod
+    def get_proportions(small: float, big: float, sample_f: float,
+                        max_training_sample: int) -> Tuple[float, float]:
+        """(downSample, upSample) fractions (≙ getProportions,
+        DataBalancer.scala:84-115)."""
+
+        def check_up(mult: int) -> bool:
+            return (mult * small * (1.0 - sample_f) < sample_f * big
+                    and max_training_sample * sample_f > small * mult)
+
+        if small < max_training_sample * sample_f:
+            up = next((float(m) for m in (100, 50, 10, 5, 4, 3, 2)
+                       if check_up(m)), 1.0)
+            down = (small * up / sample_f - small * up) / big
+            return down, up
+        up = (max_training_sample * sample_f) / small
+        down = (1.0 - sample_f) * max_training_sample / big
+        return down, up
+
+    def _plan(self, y: np.ndarray) -> Dict[str, Any]:
+        """≙ estimate (DataBalancer.scala:130-175): decide fractions and
+        record the DataBalancerSummary fields."""
+        pos = int((y > 0.5).sum())
+        neg = int(len(y) - pos)
+        total = max(pos + neg, 1)
+        sample_f = self.sample_fraction
+        is_pos_small = pos < neg
+        small, big = (pos, neg) if is_pos_small else (neg, pos)
+        if small / total >= sample_f:
+            frac = (self.max_training_sample / total
+                    if self.max_training_sample < total else 1.0)
+            plan = {"balanced": True, "fraction": frac,
+                    "is_pos_small": is_pos_small, "up": 0.0, "down": frac}
+        else:
+            down, up = self.get_proportions(small, big, sample_f,
+                                            self.max_training_sample)
+            plan = {"balanced": False, "is_pos_small": is_pos_small,
+                    "up": up, "down": down}
         self.summary = SplitterSummary("DataBalancer", {
-            "positiveFraction": pos / max(n, 1), "n": n})
+            "positiveLabels": pos, "negativeLabels": neg,
+            "desiredFraction": sample_f,
+            "upSamplingFraction": 0.0 if plan["balanced"] else plan["up"],
+            "downSamplingFraction": plan["down"]})
+        return plan
+
+    def pre_validation_prepare(self, batch, label):
+        self._plan(np.asarray(batch[label].values, dtype=np.float64))
         return batch
 
-    def _balance_keep(self, y: np.ndarray, idx: np.ndarray, rng) -> np.ndarray:
-        """Indices (subset of ``idx``) kept after majority-class down-sampling
-        towards ``sample_fraction`` + the ``max_training_sample`` cap."""
-        pos_idx = idx[y[idx] > 0.5]
-        neg_idx = idx[y[idx] <= 0.5]
-        small, big = ((pos_idx, neg_idx) if len(pos_idx) <= len(neg_idx)
-                      else (neg_idx, pos_idx))
-        frac = len(small) / max(len(idx), 1)
-        if 0 < frac < self.sample_fraction:
-            # down-sample the majority class to reach the target fraction
-            target_big = int(len(small) * (1.0 - self.sample_fraction) / self.sample_fraction)
-            big = rng.choice(big, size=max(min(target_big, len(big)), 1), replace=False)
-        keep = np.concatenate([small, big])
-        if len(keep) > self.max_training_sample:
-            keep = rng.choice(keep, size=self.max_training_sample, replace=False)
-        return keep
-
     def validation_prepare(self, batch, label):
+        """Physically resample rows (≙ rebalance, DataBalancer.scala:
+        sample with replacement for up > 1, plain subsample otherwise)."""
         y = np.asarray(batch[label].values, dtype=np.float64)
-        n = len(y)
+        plan = self._plan(y)
         rng = np.random.default_rng(self.seed)
-        keep = self._balance_keep(y, np.arange(n), rng)
+        n = len(y)
+        if plan["balanced"]:
+            if plan["fraction"] >= 1.0:
+                return batch
+            keep = np.flatnonzero(rng.random(n) < plan["fraction"])
+            return batch.take_rows(keep)
+        small_mask = ((y > 0.5) == plan["is_pos_small"])
+        small_idx = np.flatnonzero(small_mask)
+        big_idx = np.flatnonzero(~small_mask)
+        big_keep = big_idx[rng.random(len(big_idx)) < plan["down"]]
+        up = plan["up"]
+        if up > 1.0:
+            # with replacement at rate `up` ≈ per-row Poisson(up) copies
+            reps = rng.poisson(up, len(small_idx))
+            small_keep = np.repeat(small_idx, reps)
+        elif up == 1.0:
+            small_keep = small_idx
+        else:
+            small_keep = small_idx[rng.random(len(small_idx)) < up]
+        keep = np.concatenate([small_keep, big_keep])
         rng.shuffle(keep)
-        if self.summary is not None:
-            self.summary.info["downSampleFraction"] = len(keep) / max(n, 1)
         return batch.take_rows(keep)
 
     def validation_prepare_weights(self, y, w):
-        rng = np.random.default_rng(self.seed)
+        """Weight-space variant for the static-shape CV path: up-sampling
+        becomes a per-row Poisson weight multiplier (the bootstrap analog of
+        sampling with replacement); down-sampling zeroes a random subset."""
         idx = np.flatnonzero(w > 0)
         if not len(idx):
             return w
-        keep = self._balance_keep(y, idx, rng)
+        plan = self._plan_cached(y, idx)
+        rng = np.random.default_rng(self.seed)
         out = np.zeros_like(w)
-        out[keep] = w[keep]
+        if plan["balanced"]:
+            if plan["fraction"] >= 1.0:
+                return w
+            keep = idx[rng.random(len(idx)) < plan["fraction"]]
+            out[keep] = w[keep]
+            return out
+        small_mask = ((y[idx] > 0.5) == plan["is_pos_small"])
+        small_idx = idx[small_mask]
+        big_idx = idx[~small_mask]
+        big_keep = big_idx[rng.random(len(big_idx)) < plan["down"]]
+        out[big_keep] = w[big_keep]
+        up = plan["up"]
+        if up > 1.0:
+            reps = rng.poisson(up, len(small_idx)).astype(w.dtype)
+            out[small_idx] = w[small_idx] * reps
+        elif up == 1.0:
+            out[small_idx] = w[small_idx]
+        else:
+            small_keep = small_idx[rng.random(len(small_idx)) < up]
+            out[small_keep] = w[small_keep]
         return out
+
+    def _plan_cached(self, y: np.ndarray, idx: np.ndarray) -> Dict[str, Any]:
+        return self._plan(np.asarray(y, dtype=np.float64)[idx])
 
 
 class DataCutter(Splitter):

@@ -92,19 +92,23 @@ const char* parse_record(const char* p, const char* end,
             f.end = p;
         }
         fields.push_back(f);
-        if (p >= end) return p;
-        if (*p == ',') {
-            ++p;
-            continue;
+        for (;;) {
+            if (p >= end) return p;
+            if (*p == ',') {
+                ++p;
+                break;  // next field of this record
+            }
+            if (*p == '\r') {
+                ++p;
+                if (p < end && *p == '\n') ++p;
+                return p;
+            }
+            if (*p == '\n') return ++p;
+            // stray text after a closing quote (malformed row): drop it and
+            // consume the following separator/terminator in THIS field's
+            // iteration so no phantom empty field shifts later columns
+            while (p < end && *p != ',' && *p != '\n' && *p != '\r') ++p;
         }
-        if (*p == '\r') {
-            ++p;
-            if (p < end && *p == '\n') ++p;
-            return p;
-        }
-        if (*p == '\n') return ++p;
-        // stray character after a closing quote (malformed): skip to sep
-        while (p < end && *p != ',' && *p != '\n' && *p != '\r') ++p;
     }
 }
 
@@ -114,8 +118,18 @@ bool parse_double(const Field& f, double* out, bool* is_int) {
     while (b < e && (*b == ' ' || *b == '\t')) ++b;
     while (e > b && (e[-1] == ' ' || e[-1] == '\t')) --e;
     if (b == e) return false;
+    // from_chars rejects an explicit '+' sign that float() accepts — consume
+    // it when a number follows, so "+1.5" stays numeric on both paths while
+    // "+-5" stays text (float() raises on it)
+    if (*b == '+' && e - b > 1 &&
+        ((b[1] >= '0' && b[1] <= '9') || b[1] == '.'))
+        ++b;
     auto res = std::from_chars(b, e, *out);
     if (res.ec != std::errc() || res.ptr != e) return false;
+    // literal "nan"/"inf" markers are ambiguous (missing-data sentinel vs
+    // value) — treat them as non-numeric so the column keeps its raw text,
+    // matching infer_feature_kind's finite-only numeric inference
+    if (!std::isfinite(*out)) return false;
     long long iv;
     auto ri = std::from_chars(b, e, iv);
     *is_int = (ri.ec == std::errc() && ri.ptr == e);

@@ -237,3 +237,91 @@ def test_native_csv_binary_schema_text_booleans(tmp_path):
     batch = r.generate_batch([label] + preds)
     vals = np.asarray(batch["flag"].values)
     assert vals.tolist() == [True, False, True, False]
+
+
+def test_native_csv_plus_sign_and_nan_markers(tmp_path, monkeypatch):
+    """'+1.5' stays numeric and literal 'NaN'/'inf' markers stay text on BOTH
+    ingestion paths (fastcsv.cpp parse_double ↔ infer_feature_kind)."""
+    import transmogrifai_tpu.native as native_mod
+    from transmogrifai_tpu.readers.csv import CSVReader
+
+    p = tmp_path / "p.csv"
+    p.write_text("plus,marker,pm,v\n+1.5,NaN,+-5,1.0\n"
+                 "+2.25,inf,+2,2.0\n-3.0,7,3,3.0\n")
+    fast = CSVReader(str(p))
+    if fast._store is None:
+        pytest.skip("native toolchain unavailable")
+    monkeypatch.setenv("TRANSMOGRIFAI_NATIVE", "0")
+    native_mod._CACHE.clear()
+    slow = CSVReader(str(p))
+    native_mod._CACHE.clear()
+
+    assert fast.schema == slow.schema
+    assert issubclass(fast.schema["plus"], T.Real)
+    assert issubclass(fast.schema["marker"], T.Text)  # markers keep raw text
+    assert issubclass(fast.schema["pm"], T.Text)      # '+-5' is not numeric
+    assert fast.read() == slow.read()
+    assert [x["plus"] for x in fast.read()] == [1.5, 2.25, -3.0]
+    assert [x["marker"] for x in fast.read()] == ["NaN", "inf", "7"]
+    assert [x["pm"] for x in fast.read()] == ["+-5", "+2", "3"]
+
+
+def test_native_csv_stray_text_after_quote_no_shift(tmp_path):
+    """Malformed rows (stray text after a closing quote) must not emit a
+    phantom empty field that shifts later columns."""
+    from transmogrifai_tpu.readers.csv import CSVReader
+
+    p = tmp_path / "s.csv"
+    p.write_text('a,b,c\n1,"x"junk,3.0\n2,y,4.0\n')
+    r = CSVReader(str(p))
+    if r._store is None:
+        pytest.skip("native toolchain unavailable")
+    recs = r.read()
+    # column c keeps its numeric values — no shift from the malformed row
+    assert [x["c"] for x in recs] == [3.0, 4.0]
+    assert [x["b"] for x in recs] == ["x", "y"]
+
+
+def test_csv_integral_inference_checks_full_column(tmp_path, monkeypatch):
+    """A column that is integer for the first 1000 rows and float after must
+    infer Real on the record path too (no silent int(float(v)) truncation)."""
+    import transmogrifai_tpu.native as native_mod
+    from transmogrifai_tpu.readers.csv import CSVReader
+
+    p = tmp_path / "i.csv"
+    rows = ["x,v"] + [f"{i},{i}.0" for i in range(1200)]
+    rows[1101] = "1100.5,1100.0"   # float appears after the 1000-row sample
+    p.write_text("\n".join(rows) + "\n")
+
+    fast = CSVReader(str(p))
+    monkeypatch.setenv("TRANSMOGRIFAI_NATIVE", "0")
+    native_mod._CACHE.clear()
+    slow = CSVReader(str(p))
+    native_mod._CACHE.clear()
+
+    assert issubclass(slow.schema["x"], T.Real)
+    assert slow.schema == fast.schema or fast._store is None
+    assert [r["x"] for r in slow.read()[1098:1102]] == [1098.0, 1099.0,
+                                                        1100.5, 1101.0]
+
+
+def test_native_csv_binary_inference_checks_full_column(tmp_path, monkeypatch):
+    """A 0/1-for-1000-rows column with a later 2 must infer Integral (not
+    Binary) on BOTH paths — no silent 2→True coercion on the native path."""
+    import transmogrifai_tpu.native as native_mod
+    from transmogrifai_tpu.readers.csv import CSVReader
+
+    p = tmp_path / "bfull.csv"
+    rows = ["flag,v"] + [f"{i % 2},{i}.5" for i in range(1200)]
+    rows[1101] = "2,1100.5"
+    p.write_text("\n".join(rows) + "\n")
+    fast = CSVReader(str(p))
+    monkeypatch.setenv("TRANSMOGRIFAI_NATIVE", "0")
+    native_mod._CACHE.clear()
+    slow = CSVReader(str(p))
+    native_mod._CACHE.clear()
+
+    assert issubclass(slow.schema["flag"], T.Integral)
+    assert fast._store is None or fast.schema == slow.schema
+    if fast._store is not None:
+        assert [r["flag"] for r in fast.read()[1099:1102]] == [1, 2, 1]

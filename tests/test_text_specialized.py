@@ -273,3 +273,23 @@ def test_lang_detector_russian_swedish():
     assert max(ru, key=ru.get) == "ru"
     sv = detect_languages("och i att det som en på är av för med den")
     assert max(sv, key=sv.get) == "sv"
+
+
+def test_phone_validation_envelope():
+    """Pin the documented accept/reject envelope of the length-only phone
+    validator (ops/text_specialized.py): what it knowingly false-accepts vs
+    what it reliably rejects."""
+    # known false-accepts (libphonenumber would reject; we accept by length)
+    assert parse_phone("+1 000 000 0000") == "+10000000000"
+    assert parse_phone("000 000 0000", "US") == "+10000000000"
+    assert parse_phone("+999 12345") == "+99912345"          # unknown cc, lax
+    # reliable rejections
+    assert parse_phone("+1 555 1234") is None                # NANP wrong length
+    assert parse_phone("+44 123") is None                    # GB too short
+    assert parse_phone("555-0199", "US") is None             # 7 digits national
+    assert parse_phone("hello world", "US") is None
+    assert parse_phone("12345", "ZZ") is None                # unknown region
+    assert parse_phone("+999 12345", strict=True) is None    # unknown cc strict
+    # reliable accepts
+    assert parse_phone("+81 3-1234-5678") == "+81312345678"  # JP in range
+    assert parse_phone("030 123456", "DE") == "+49030123456"

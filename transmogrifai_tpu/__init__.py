@@ -18,10 +18,16 @@ if _os.environ.get("TRANSMOGRIFAI_COMPILATION_CACHE", "1") != "0":
     try:
         import jax as _jax
 
+        # Scope the cache per backend platform: CPU AOT entries carry host
+        # machine-feature assumptions, and a cache populated by an
+        # accelerator-process's host compiler must not be loaded by a pure
+        # CPU process (xla cpu_aot_loader rejects them with SIGILL warnings).
+        _plat = ((_os.environ.get("JAX_PLATFORMS") or "default")
+                 .split(",")[0].strip() or "default")
         _jax.config.update(
             "jax_compilation_cache_dir",
             _os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                            "/tmp/transmogrifai_tpu_jax_cache"))
+                            f"/tmp/transmogrifai_tpu_jax_cache_{_plat}"))
         # cache even small programs: a warm train run launches ~90 distinct
         # executables and re-compiling the sub-second ones still costs
         # multiple seconds of wall per run

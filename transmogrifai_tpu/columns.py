@@ -25,14 +25,14 @@ from .types import (
 from .vector_meta import VectorMeta
 
 
-_DEVICE_CACHE: Dict[int, Any] = {}   # id(host array) → (weakref, device array)
+_DEVICE_CACHE: Dict[int, Any] = {}   # id(host arr) → (weakref, device arr, lossless)
 _DEVICE_CACHE_BYTES = [0]
 # HBM the cache may pin (FIFO-evicted beyond this; override via env)
 _DEVICE_CACHE_CAP = int(__import__("os").environ.get(
     "TRANSMOGRIFAI_DEVICE_CACHE_BYTES", 2 << 30))
 
 
-def to_device_f32(values) -> Any:
+def to_device_f32(values, exact: bool = False) -> Any:
     """Host→device transfer of real-valued bulk data for compute.
 
     On accelerator backends the WIRE format is bf16 — half the bytes over the
@@ -43,6 +43,11 @@ def to_device_f32(values) -> Any:
     bf16's 8-bit mantissa, which is noise relative to feature measurement
     error.  Opt out with TRANSMOGRIFAI_WIRE_F32=1.  CPU backends (tests,
     goldens) always transfer exact f32.
+
+    ``exact=True`` marks value-critical data (sample/fold weights, labels):
+    the bf16 wire is used only when it is verified lossless for the actual
+    array contents (0/1 fold masks, small integers); otherwise the transfer
+    falls back to exact f32.
 
     Large arrays are cached (weakref-keyed on the host buffer) so a column
     used by several stages — vectorizer fit, compiled transform, evaluate —
@@ -63,14 +68,26 @@ def to_device_f32(values) -> Any:
     big = arr.size >= (1 << 16) and arr.dtype in (np.float32, np.float64)
     if big:
         ent = _DEVICE_CACHE.get(id(arr))
-        if ent is not None and ent[0]() is arr:
+        # a cached bf16-wire entry only satisfies an exact request when the
+        # transfer was verified lossless at insertion time
+        if ent is not None and ent[0]() is arr and (not exact or ent[2]):
             return ent[1]
-    if (big and jax.default_backend() != "cpu"
-            and os.environ.get("TRANSMOGRIFAI_WIRE_F32") != "1"):
+    lossless = True
+    use_bf16 = (big and jax.default_backend() != "cpu"
+                and os.environ.get("TRANSMOGRIFAI_WIRE_F32") != "1")
+    if use_bf16:
         import ml_dtypes
         wire = arr.astype(ml_dtypes.bfloat16)
+        if exact:
+            lossless = bool(np.array_equal(
+                wire.astype(np.float32), arr.astype(np.float32)))
+            use_bf16 = lossless
+        else:
+            lossless = False     # unverified; conservative for exact reuse
+    if use_bf16:
         dev = jax.device_put(wire).astype(jnp.float32)
     else:
+        lossless = True
         dev = jnp.asarray(arr, jnp.float32)
     if big:
         key = id(arr)
@@ -84,12 +101,17 @@ def to_device_f32(values) -> Any:
             ref = weakref.ref(arr, _drop)
         except TypeError:  # pragma: no cover — un-weakref-able array subtype
             return dev
+        # replacing an entry (e.g. exact request over a cached lossy wire):
+        # release the old bytes so the counter stays truthful
+        prev = _DEVICE_CACHE.pop(key, None)
+        if prev is not None:
+            _DEVICE_CACHE_BYTES[0] -= int(prev[1].size) * 4
         while (_DEVICE_CACHE_BYTES[0] + nbytes > _DEVICE_CACHE_CAP
                and _DEVICE_CACHE):
             oldest = next(iter(_DEVICE_CACHE))   # dicts preserve insertion order
-            _, old = _DEVICE_CACHE.pop(oldest)
+            _, old, _ = _DEVICE_CACHE.pop(oldest)
             _DEVICE_CACHE_BYTES[0] -= int(old.size) * 4
-        _DEVICE_CACHE[key] = (ref, dev)
+        _DEVICE_CACHE[key] = (ref, dev, lossless)
         _DEVICE_CACHE_BYTES[0] += nbytes
     return dev
 

@@ -11,6 +11,7 @@ the reference's tracing model, which maps 1:1 onto JAX's trace-then-compile.
 from __future__ import annotations
 
 import itertools
+import math
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
 
 import numpy as np
@@ -364,8 +365,9 @@ def infer_feature_kind(values: Sequence[Any]) -> Type[FeatureType]:
             return False
     def _is_float(v):
         try:
-            float(str(v))
-            return True
+            # finite only: literal "nan"/"inf" markers stay text, matching
+            # the native parser's (fastcsv.cpp parse_double) inference
+            return math.isfinite(float(str(v)))
         except ValueError:
             return False
     if all(isinstance(v, bool) for v in non_null):

@@ -52,7 +52,7 @@ def _grouped_grid_fit(est, X, y, fold_weights, grids, *, loss: str,
                 float(m.get("tol", 1e-6)))].append(gi)
     Xj = to_device_f32(X)
     yj = jnp.asarray(y, jnp.float32)
-    Wj = to_device_f32(fold_weights)
+    Wj = to_device_f32(fold_weights, exact=True)
     nc = 1 if n_classes <= 2 else n_classes
     for (max_iter, fit_intercept, standardization, tol), gidx in groups.items():
         pens = [l2l1({**est._params, **grids[gi]}) for gi in gidx]

@@ -979,7 +979,7 @@ class _ForestEstimatorBase(PredictorEstimator):
         else:
             impurity = "variance"
             base_stats = jnp.stack([jnp.ones(N), yj, yj * yj], axis=1)
-        fold_w = to_device_f32(fold_weights)
+        fold_w = to_device_f32(fold_weights, exact=True)
         Xj = to_device_f32(X)
         splits_cache: dict = {}
 
@@ -1115,7 +1115,7 @@ class _GBTEstimatorBase(PredictorEstimator):
 
         Xj = to_device_f32(X)
         yj = jnp.asarray(y, jnp.float32)
-        fold_w = to_device_f32(fold_weights)
+        fold_w = to_device_f32(fold_weights, exact=True)
         fmask = jnp.ones((D,), jnp.float32) > 0
         splits_cache: dict = {}
 

@@ -167,6 +167,18 @@ class UrlMapToPickListMapTransformer(Transformer):
 # ---------------------------------------------------------------------------
 # Phone validation (≙ PhoneNumberParser.scala; libphonenumber replaced by a
 # compact calling-code → national-number-length metadata table)
+#
+# Deliberate v1 trade-off: validation is LENGTH-ONLY per region/calling code.
+# Unlike libphonenumber (the reference's 566-LoC wrapper + full metadata), we
+# do not model digit-pattern rules, so these classes FALSE-ACCEPT:
+#   * all-zero / reserved national numbers of a valid length
+#     ("+1 000 000 0000" validates; libphonenumber rejects it),
+#   * NANP numbers whose area code starts with 0/1,
+#   * numbers in unlisted regions passed internationally with ``strict=False``
+#     (any 4-15 digits after an unknown '+<cc>' are accepted, per E.164 shape).
+# Rejections (wrong length for the matched calling code / default region,
+# non-digit garbage, unknown default region) are reliable.  The envelope is
+# pinned by tests/test_text_specialized.py::test_phone_validation_envelope.
 # ---------------------------------------------------------------------------
 
 # region → (calling code, min national digits, max national digits)

@@ -2,7 +2,12 @@
 readers/src/main/scala/com/salesforce/op/readers/CSVReaders.scala and
 CSVAutoReaders.scala; inference ≙ FeatureBuilder.fromDataFrame auto-typing).
 
-Two paths share identical semantics:
+Two paths share the same typed-column semantics on well-formed input
+(numeric inference is finite-only and Integral holds for every row on both;
+see ``infer_schema_from_records``).  Known divergence, malformed rows only:
+stray text after a closing quote (``a,"b"x,c``) is dropped by the native
+parser (→ ``b``) but appended by Python's csv module (→ ``bx``); neither
+path shifts later columns.
 
 * **native columnar** (default): the C++ parser (`native/fastcsv.cpp`) goes
   bytes → typed columns in one pass — no per-row Python objects — and
@@ -58,7 +63,15 @@ def infer_schema_from_records(records: Sequence[Dict[str, Any]],
     cols = records[0].keys()
     subset = records[:sample]
     for c in cols:
-        schema[c] = infer_feature_kind([r.get(c) for r in subset])
+        kind = infer_feature_kind([r.get(c) for r in subset])
+        # Integral/Binary inferred from the sample must hold for EVERY row —
+        # the native parser's is_int covers the whole file, and a column that
+        # turns float after the sample would silently truncate through
+        # _typed_scalar's int(float(v)).  One cheap full pass keeps the two
+        # ingestion paths agreeing.
+        if kind in (Integral, Binary) and len(records) > sample:
+            kind = infer_feature_kind([r.get(c) for r in records])
+        schema[c] = kind
     return schema
 
 
@@ -162,9 +175,22 @@ class CSVReader(DataReader):
                 pyvals = [None if np.isnan(v)
                           else (int(v) if as_int else float(v))
                           for v in vals]
+                kind = infer_feature_kind(pyvals)
+                # Binary's {0,1} constraint must hold for EVERY row, not just
+                # the sample (Integral already does: is_int is whole-file) —
+                # mirrors infer_schema_from_records' full-column re-check
+                if kind is Binary and len(col) > sample:
+                    present = col[~np.isnan(col)]
+                    if not bool(np.isin(present, (0.0, 1.0)).all()):
+                        kind = Integral if as_int else Real
             else:
-                pyvals = col[:sample]
-            schema[name] = infer_feature_kind(pyvals)
+                kind = infer_feature_kind(col[:sample])
+                # text column (some field failed numeric parse): a clean
+                # numeric-looking sample must be re-verified over all rows,
+                # as the record path does
+                if kind in (Integral, Binary) and len(col) > sample:
+                    kind = infer_feature_kind(col)
+            schema[name] = kind
         return schema
 
     def _store_column(self, name: str, kind: Type[FeatureType],

@@ -498,15 +498,10 @@ class OpValidator:
                                    data_sharding(mesh, 2, row_axis=1))
             else:
                 # one shared transfer; family fits see a no-op conversion.
-                # bf16 wire only when the weights are exactly representable
-                # (0/1 fold masks; balancer keep/drop weights) — custom
-                # splitters may emit arbitrary weights, which go exact
-                import ml_dtypes
-                if np.array_equal(
-                        W, W.astype(ml_dtypes.bfloat16).astype(np.float32)):
-                    W = to_device_f32(W)
-                else:
-                    W = jnp.asarray(W)
+                # exact=True: bf16 wire only when verified lossless (0/1 fold
+                # masks; balancer keep/drop weights) — custom splitters may
+                # emit arbitrary weights, which go exact f32
+                W = to_device_f32(W, exact=True)
             def fit_candidate(cand):
                 try:
                     return cand.estimator.fit_arrays_grid(

@@ -211,6 +211,20 @@ def _lda_wire(stage, batch):
         ColumnBatch({"v": col}, n)
 
 
+def external_fit(X, y, sample_weight=None, alpha=1.0):
+    """Module-level numpy fit for the ExternalEstimator contract case."""
+    w = sample_weight if sample_weight is not None else np.ones(len(y), np.float32)
+    Xb = np.concatenate([X, np.ones((len(y), 1), np.float32)], axis=1)
+    A = (Xb * w[:, None]).T @ Xb + alpha * np.eye(Xb.shape[1], dtype=np.float32)
+    b = (Xb * w[:, None]).T @ y
+    sol = np.linalg.solve(A, b).astype(np.float32)
+    return {"coef": sol[:-1], "intercept": sol[-1:]}
+
+
+def external_predict(params, X):
+    return (X @ params["coef"] + params["intercept"][0]).astype(np.float32)
+
+
 def _descaler_case():
     from transmogrifai_tpu.ops.bucketizers import (DescalerTransformer,
                                                    ScalerTransformer)
@@ -267,6 +281,7 @@ def _cases():
         ParsePhoneDefaultCountry, SetNGramSimilarity, TextNGramSimilarity,
         UrlMapToPickListMapTransformer, UrlToPickListTransformer,
         ValidEmailTransformer)
+    from transmogrifai_tpu.models.external import ExternalEstimator
     from transmogrifai_tpu.models.linear import (
         OpGeneralizedLinearRegression, OpLinearRegression, OpLinearSVC,
         OpLogisticRegression, OpMultilayerPerceptronClassifier, OpNaiveBayes)
@@ -387,6 +402,10 @@ def _cases():
              [("p", Prediction)]),
         # models — classification
         Case(_mk(OpLogisticRegression, **model_kw),
+             [("label", RealNN), ("v", OPVector)], label_input=True),
+        Case(_mk(ExternalEstimator,
+                 fit_spec="test_stage_contract:external_fit",
+                 predict_spec="test_stage_contract:external_predict"),
              [("label", RealNN), ("v", OPVector)], label_input=True),
         Case(_mk(OpLinearSVC, **model_kw),
              [("label", RealNN), ("v", OPVector)], label_input=True),

@@ -3,6 +3,7 @@ sparkwrappers.specific OpPredictorWrapper machinery)."""
 
 from .base import (PredictionModel, PredictorEstimator, extract_xy,
                    prediction_column)
+from .external import ExternalEstimator, ExternalModel, wrap_estimator
 from .linear import (LinearPredictionModel, MLPClassificationModel,
                      NaiveBayesModel, OpGeneralizedLinearRegression,
                      OpLinearRegression, OpLinearSVC, OpLogisticRegression,
@@ -15,7 +16,7 @@ from .trees import (OpDecisionTreeClassifier, OpDecisionTreeRegressor,
 MODEL_REGISTRY = {
     cls.__name__: cls for cls in [
         LinearPredictionModel, NaiveBayesModel, MLPClassificationModel,
-        TreeEnsembleModel,
+        TreeEnsembleModel, ExternalEstimator, ExternalModel,
         OpLogisticRegression, OpLinearSVC, OpLinearRegression, OpNaiveBayes,
         OpGeneralizedLinearRegression, OpMultilayerPerceptronClassifier,
         OpRandomForestClassifier, OpRandomForestRegressor,
@@ -27,5 +28,5 @@ MODEL_REGISTRY = {
 
 __all__ = list(MODEL_REGISTRY) + [
     "PredictionModel", "PredictorEstimator", "extract_xy", "prediction_column",
-    "MODEL_REGISTRY",
+    "MODEL_REGISTRY", "wrap_estimator",
 ]

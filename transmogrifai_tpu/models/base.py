@@ -61,12 +61,19 @@ class PredictionModel(TransformerModel):
     def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
         raise NotImplementedError
 
+    def supports_device_scores(self) -> bool:
+        """True when this model can score a device-resident matrix in HBM.
+        Delegating wrappers (SelectedModel) override to ask the wrapped
+        model, so host-only inner models (e.g. ExternalModel) fall back to
+        the host predict path instead of raising mid-transform."""
+        return hasattr(self, "device_scores")
+
     def transform(self, batch: ColumnBatch) -> Column:
         import jax
 
         feats = self.input_features[1]
         xv = batch[feats.name].values
-        if isinstance(xv, jax.Array) and hasattr(self, "device_scores"):
+        if isinstance(xv, jax.Array) and self.supports_device_scores():
             # device-resident matrix: score in HBM and keep the per-row
             # results as device arrays — pulling X over the (slow) host link
             # to predict on numpy costs more than all the compute.

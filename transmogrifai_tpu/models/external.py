@@ -1,0 +1,198 @@
+"""External-model bridge — wrap ANY array-in/array-out estimator as a model
+stage usable in ``ModelCandidate`` (reference: the sparkwrappers layer —
+core/.../stages/sparkwrappers/generic/SwUnaryEstimator.scala wraps arbitrary
+Spark estimators, specific/OpPredictorWrapper.scala:67 adapts predictors to
+the (RealNN, OPVector) → Prediction contract; this is how XGBoost entered the
+reference's selector).
+
+The TPU-native contract is functional, not class-reflective: the external
+model is a pair of pure functions over numpy arrays
+
+    fit(X, y, sample_weight=None, **hyperparams) -> params: dict[str, array]
+    predict(params: dict, X) -> prediction array | dict
+
+``params`` must contain only arrays / JSON-safe scalars — it checkpoints into
+the standard ``params.npz`` + manifest layout with NO pickling.  Reload
+resolves the functions by import path (``module:qualname``, ≙
+ReflectionUtils.classForName), which ``wrap_estimator`` derives automatically
+for module-level callables.
+
+``predict`` may return:
+  * a 1-D array — used as ``prediction`` directly (regressors),
+  * a 2-D array — class probabilities; ``prediction`` = argmax,
+  * a dict with ``prediction`` / ``probability`` / ``rawPrediction`` keys.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from .base import PredictionModel, PredictorEstimator
+
+# ctor/config keys that are NOT hyperparameters of the wrapped model
+_RESERVED = ("fit_spec", "predict_spec", "uid")
+
+
+def resolve_callable(spec: str) -> Callable:
+    """``"module:qualname"`` → the callable it names."""
+    mod_name, _, qual = spec.partition(":")
+    if not mod_name or not qual:
+        raise ValueError(
+            f"external-model spec {spec!r} must look like 'module:qualname'")
+    obj: Any = importlib.import_module(mod_name)
+    for part in qual.split("."):
+        obj = getattr(obj, part)
+    if not callable(obj):
+        raise TypeError(f"external-model spec {spec!r} is not callable")
+    return obj
+
+
+def spec_of(fn: Callable) -> Optional[str]:
+    """Derive the import spec of a module-level callable; None when the
+    callable is a lambda / closure / local and cannot be re-imported."""
+    mod = getattr(fn, "__module__", None)
+    qual = getattr(fn, "__qualname__", "")
+    if not mod or not qual or "<" in qual:
+        return None
+    try:
+        if resolve_callable(f"{mod}:{qual}") is fn:
+            return f"{mod}:{qual}"
+    except Exception:  # noqa: BLE001 — nested/renamed attribute
+        pass
+    return None
+
+
+def _normalize_prediction(out: Any) -> Dict[str, np.ndarray]:
+    if isinstance(out, dict):
+        res = {k: np.asarray(v) for k, v in out.items() if v is not None}
+        if "prediction" not in res:
+            prob = res.get("probability")
+            if prob is None:
+                raise ValueError(
+                    "external predict() dict needs 'prediction' or "
+                    "'probability'")
+            res["prediction"] = np.argmax(prob, axis=1).astype(np.float32)
+        return res
+    arr = np.asarray(out)
+    if arr.ndim == 2:
+        return {"prediction": np.argmax(arr, axis=1).astype(np.float32),
+                "probability": arr, "rawPrediction": arr}
+    return {"prediction": arr.astype(np.float32)}
+
+
+class ExternalModel(PredictionModel):
+    """Fitted wrapped model.  ``fitted`` holds exactly what the user's
+    ``fit`` returned; ``predict_spec`` (ctor param) re-binds ``predict`` on
+    reload — no pickle anywhere."""
+
+    def __init__(self, **params):
+        super().__init__(**params)
+        # bound post-construction by ExternalEstimator's model factory;
+        # reload paths resolve lazily via predict_spec instead
+        self._predict_fn: Optional[Callable] = None
+
+    def _predict(self) -> Callable:
+        if self._predict_fn is None:
+            spec = self.get("predict_spec")
+            if not spec:
+                raise RuntimeError(
+                    "ExternalModel has no predict function: construct via "
+                    "wrap_estimator with an importable (module-level) predict "
+                    "callable, or set predict_spec='module:qualname'")
+            self._predict_fn = resolve_callable(spec)
+        return self._predict_fn
+
+    def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        out = self._predict()(dict(self.fitted), np.asarray(X, np.float32))
+        return _normalize_prediction(out)
+
+    def check_serializable(self) -> None:
+        if not self.get("predict_spec"):
+            raise ValueError(
+                "cannot save an ExternalModel whose predict function is not "
+                "importable: define predict at module level (so "
+                "'module:qualname' resolves to it) or set predict_spec "
+                "explicitly before saving")
+
+    def save_extra(self):
+        self.check_serializable()
+        return super().save_extra()
+
+
+class ExternalEstimator(PredictorEstimator):
+    """(label, features) → Prediction stage around user fit/predict functions
+    (≙ SwUnaryEstimator + OpPredictorWrapper).  Grid-searchable: every
+    non-reserved param — including grid points set by the ModelSelector — is
+    forwarded to ``fit`` as a keyword hyperparameter."""
+
+    model_cls = ExternalModel
+
+    def __init__(self, fit_fn: Optional[Callable] = None,
+                 predict_fn: Optional[Callable] = None, **params):
+        super().__init__(**params)
+        self._fit_fn = fit_fn
+        self._predict_fn = predict_fn
+        # derive import specs so the fitted stage serializes pickle-free
+        if fit_fn is not None and not self.get("fit_spec"):
+            s = spec_of(fit_fn)
+            if s:
+                self.set("fit_spec", s)
+        if predict_fn is not None and not self.get("predict_spec"):
+            s = spec_of(predict_fn)
+            if s:
+                self.set("predict_spec", s)
+
+        # models built anywhere (CV metric path constructs them via
+        # est.model_cls) get the LIVE predict callable, so non-importable
+        # callables still train/score in-memory; only save() requires a spec
+        def _model_factory(**kw) -> ExternalModel:
+            m = ExternalModel(**kw)
+            if m._predict_fn is None:
+                m._predict_fn = self._predict_fn
+            return m
+
+        self.model_cls = _model_factory  # shadows the class attr
+
+    def _fit(self) -> Callable:
+        if self._fit_fn is None:
+            spec = self.get("fit_spec")
+            if not spec:
+                raise RuntimeError(
+                    "ExternalEstimator has no fit function: pass fit_fn= or "
+                    "fit_spec='module:qualname'")
+            self._fit_fn = resolve_callable(spec)
+        return self._fit_fn
+
+    def _hyperparams(self) -> Dict[str, Any]:
+        return {k: v for k, v in self._params.items() if k not in _RESERVED}
+
+    def fit_arrays(self, X, y, sample_weight=None) -> Dict[str, Any]:
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y, np.float32)
+        if sample_weight is not None:
+            sample_weight = np.asarray(sample_weight, np.float32)
+        fitted = self._fit()(X, y, sample_weight=sample_weight,
+                             **self._hyperparams())
+        if not isinstance(fitted, dict):
+            raise TypeError(
+                f"external fit() must return a dict of arrays, got "
+                f"{type(fitted).__name__}")
+        return fitted
+
+
+def wrap_estimator(fit: Callable, predict: Callable,
+                   **hyperparams) -> ExternalEstimator:
+    """Turn a (fit, predict) pair into a selector-ready estimator stage.
+
+    >>> cand = ModelCandidate(wrap_estimator(my_fit, my_predict),
+    ...                       grid(alpha=[0.1, 1.0]), "MyModel")
+
+    For ``model.save()`` to round-trip, ``fit`` and ``predict`` must be
+    module-level callables (re-importable by path); otherwise training and
+    scoring work in-memory but ``save`` of the winning model will fail with
+    an actionable error.
+    """
+    return ExternalEstimator(fit_fn=fit, predict_fn=predict, **hyperparams)

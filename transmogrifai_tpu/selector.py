@@ -154,6 +154,13 @@ class SelectedModel(PredictionModel):
     def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
         return self._best_model.predict_arrays(X)
 
+    def supports_device_scores(self) -> bool:
+        inner = self._best_model
+        if inner is None:
+            return False
+        sup = getattr(inner, "supports_device_scores", None)
+        return sup() if sup is not None else hasattr(inner, "device_scores")
+
     def device_scores(self, Xd, full: bool = False):
         return self._best_model.device_scores(Xd, full=full)
 
@@ -164,6 +171,9 @@ class SelectedModel(PredictionModel):
     def save_extra(self):
         if self._best_model is None:
             return {}, {}
+        check = getattr(self._best_model, "check_serializable", None)
+        if check is not None:
+            check()  # e.g. ExternalModel without an importable predict spec
         from .models import MODEL_REGISTRY  # ensure class is resolvable
 
         def _is_arr(v):

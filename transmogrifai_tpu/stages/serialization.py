@@ -47,6 +47,7 @@ _STAGE_MODULES = [
     "transmogrifai_tpu.ops.combiner",
     "transmogrifai_tpu.models.linear",
     "transmogrifai_tpu.models.trees",
+    "transmogrifai_tpu.models.external",
     "transmogrifai_tpu.preparators.sanity_checker",
     "transmogrifai_tpu.preparators.prediction_deindexer",
     "transmogrifai_tpu.selector",

@@ -1,0 +1,150 @@
+// locofmt — native assembly of RecordInsightsLOCO output maps.
+//
+// The LOCO device program returns [N, K] (group index, diff) pairs; the
+// stage's output contract is one dict per row mapping group name -> the
+// reference's RecordInsightsParser JSON payload '[["name", diff]]'.  Building
+// 2M+ formatted strings and N dicts is pure interpreter overhead in Python
+// (it dominates the explanation path's wall time); here it is one C pass:
+// group names are interned once and shared across all rows, payloads are a
+// single snprintf + unicode alloc per cell.
+//
+// Exposed API (module _locofmt):
+//   assemble(idx: int64[N, K] ndarray, val: float64[N, K] ndarray,
+//            names: sequence[str]) -> ndarray[object] of dict[str, str]
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#include <numpy/arrayobject.h>
+
+#include <cstdio>
+#include <vector>
+
+namespace {
+
+PyObject* assemble(PyObject*, PyObject* args) {
+    PyObject *idx_obj, *val_obj, *names_obj;
+    if (!PyArg_ParseTuple(args, "OOO", &idx_obj, &val_obj, &names_obj))
+        return nullptr;
+
+    PyArrayObject* idx = reinterpret_cast<PyArrayObject*>(
+        PyArray_FROM_OTF(idx_obj, NPY_INT64, NPY_ARRAY_IN_ARRAY));
+    PyArrayObject* val = reinterpret_cast<PyArrayObject*>(
+        PyArray_FROM_OTF(val_obj, NPY_FLOAT64, NPY_ARRAY_IN_ARRAY));
+    if (!idx || !val) {
+        Py_XDECREF(idx);
+        Py_XDECREF(val);
+        return nullptr;
+    }
+    if (PyArray_NDIM(idx) != 2 || PyArray_NDIM(val) != 2 ||
+        PyArray_DIM(idx, 0) != PyArray_DIM(val, 0) ||
+        PyArray_DIM(idx, 1) != PyArray_DIM(val, 1)) {
+        Py_DECREF(idx);
+        Py_DECREF(val);
+        PyErr_SetString(PyExc_ValueError, "idx/val must be [N, K] and match");
+        return nullptr;
+    }
+    const npy_intp n = PyArray_DIM(idx, 0);
+    const npy_intp k = PyArray_DIM(idx, 1);
+
+    PyObject* names_seq = PySequence_Fast(names_obj, "names");
+    if (!names_seq) {
+        Py_DECREF(idx);
+        Py_DECREF(val);
+        return nullptr;
+    }
+    const Py_ssize_t g = PySequence_Fast_GET_SIZE(names_seq);
+    // interned name objects (borrowed into every row dict) and their UTF-8
+    // bytes for payload formatting
+    std::vector<PyObject*> name_objs(g);
+    std::vector<const char*> name_utf8(g);
+    for (Py_ssize_t i = 0; i < g; ++i) {
+        PyObject* s = PySequence_Fast_GET_ITEM(names_seq, i);  // borrowed
+        name_objs[i] = s;
+        name_utf8[i] = PyUnicode_AsUTF8(s);
+        if (!name_utf8[i]) {
+            Py_DECREF(names_seq);
+            Py_DECREF(idx);
+            Py_DECREF(val);
+            return nullptr;
+        }
+    }
+
+    npy_intp dims[1] = {n};
+    PyArrayObject* out = reinterpret_cast<PyArrayObject*>(
+        PyArray_SimpleNew(1, dims, NPY_OBJECT));
+    if (!out) {
+        Py_DECREF(names_seq);
+        Py_DECREF(idx);
+        Py_DECREF(val);
+        return nullptr;
+    }
+
+    const npy_int64* ip = static_cast<const npy_int64*>(PyArray_DATA(idx));
+    const double* vp = static_cast<const double*>(PyArray_DATA(val));
+    PyObject** op = static_cast<PyObject**>(PyArray_DATA(out));
+
+    char buf[512];
+    bool ok = true;
+    for (npy_intp r = 0; r < n && ok; ++r) {
+        PyObject* d = PyDict_New();
+        if (!d) {
+            ok = false;
+            break;
+        }
+        for (npy_intp c = 0; c < k; ++c) {
+            const npy_int64 gi = ip[r * k + c];
+            if (gi < 0 || gi >= g) {
+                PyErr_SetString(PyExc_IndexError, "group index out of range");
+                Py_DECREF(d);
+                ok = false;
+                break;
+            }
+            const int len = snprintf(buf, sizeof(buf), "[[\"%s\", %.9g]]",
+                                     name_utf8[gi], vp[r * k + c]);
+            if (len < 0 || len >= static_cast<int>(sizeof(buf))) {
+                PyErr_SetString(PyExc_ValueError, "payload too long");
+                Py_DECREF(d);
+                ok = false;
+                break;
+            }
+            PyObject* payload = PyUnicode_FromStringAndSize(buf, len);
+            if (!payload || PyDict_SetItem(d, name_objs[gi], payload) < 0) {
+                Py_XDECREF(payload);
+                Py_DECREF(d);
+                ok = false;
+                break;
+            }
+            Py_DECREF(payload);
+        }
+        if (ok) op[r] = d;  // steals our reference into the object array
+    }
+
+    Py_DECREF(names_seq);
+    Py_DECREF(idx);
+    Py_DECREF(val);
+    if (!ok) {
+        Py_DECREF(out);
+        return nullptr;
+    }
+    return reinterpret_cast<PyObject*>(out);
+}
+
+PyMethodDef methods[] = {
+    {"assemble", assemble, METH_VARARGS,
+     "assemble(idx[N,K] int64, val[N,K] float64, names) -> object[N] dicts"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_locofmt",
+    "Native LOCO output-map assembly.", -1, methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__locofmt(void) {
+    import_array();
+    return PyModule_Create(&moduledef);
+}

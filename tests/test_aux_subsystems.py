@@ -370,3 +370,64 @@ def test_rff_drops_shifted_map_keys_individually():
     assert "m" not in results.dropped          # one healthy key survives
     assert all("drift" not in (m or {}) for m in clean["m"].values)
     assert any("ok" in (m or {}) for m in clean["m"].values)
+
+
+class TestInsightsDepth:
+    """Reference-depth ModelInsights (≙ ModelInsights.scala:74-392): RFF
+    distributions, per-group Cramér's V, descaled contributions, training
+    echo — the round-3 VERDICT golden check."""
+
+    @pytest.fixture(scope="class")
+    def deep_model(self):
+        wf, pred = train_small_model(make_records())
+        wf.with_raw_feature_filter(min_fill_rate=0.1)
+        wf.set_parameters({"custom_tag": "insights-golden"})
+        return wf.train()
+
+    def test_distributions_surfaced(self, deep_model):
+        s = deep_model.summary()
+        by_name = {f["featureName"]: f for f in s["features"]}
+        assert "x1" in by_name
+        dists = by_name["x1"]["distributions"]
+        assert dists and dists[0]["count"] > 0
+        assert "distribution" in dists[0]
+        # the RFF-dropped sparse feature still appears, with its distribution
+        assert "sparse" in by_name
+        assert by_name["sparse"]["distributions"]
+
+    def test_cramers_v_joined_per_group(self, deep_model):
+        s = deep_model.summary()
+        by_name = {f["featureName"]: f for f in s["features"]}
+        cat_cols = by_name["cat"]["derivedFeatures"]
+        cram = [c["cramersV"] for c in cat_cols
+                if c.get("indicatorValue") is not None]
+        assert cram and all(v is not None and 0.0 <= v <= 1.0 for v in cram)
+        # only indicator columns carry a group Cramér's V (value columns of
+        # x1 don't; its null-indicator column does, like the reference's
+        # categorical tests over every indicator group)
+        for f in s["features"]:
+            for c in f["derivedFeatures"]:
+                if c.get("indicatorValue") is None:
+                    assert c["cramersV"] is None, c["name"]
+
+    def test_descaled_contributions(self, deep_model):
+        s = deep_model.summary()
+        kept = [c for f in s["features"] for c in f["derivedFeatures"]
+                if not c["dropped"]]
+        assert any(c["descaledContribution"] is not None for c in kept)
+        for c in kept:
+            if c["descaledContribution"] is not None:
+                want = abs(c["contribution"]) * np.sqrt(max(c["variance"], 0.0))
+                assert abs(c["descaledContribution"] - want) < 1e-9
+
+    def test_training_echo(self, deep_model):
+        s = deep_model.summary()
+        assert s["trainingParams"].get("custom_tag") == "insights-golden"
+        classes = {v["className"] for v in s["stageInfo"].values()}
+        assert "SanityCheckerModel" in classes
+        assert "SelectedModel" in classes
+
+    def test_pretty_includes_new_columns(self, deep_model):
+        text = deep_model.summary_pretty()
+        assert "Cramér's V" in text
+        assert "Fill Rate" in text

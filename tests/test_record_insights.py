@@ -1,0 +1,152 @@
+"""RecordInsightsLOCO device program: parity with the host path, time-period
+aggregation, strategies (≙ RecordInsightsLOCOTest)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.columns import Column, ColumnBatch
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.record_insights import RecordInsightsLOCO, _group_key
+from transmogrifai_tpu.types import OPVector, RealNN
+from transmogrifai_tpu.features import Feature
+from transmogrifai_tpu.vector_meta import VectorColumnMeta, VectorMeta
+
+
+def _fit_lr(n=400, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta = np.linspace(2.0, -2.0, d).astype(np.float32)
+    y = (X @ beta > 0).astype(np.float32)
+    est = OpLogisticRegression(max_iter=50)
+    label = Feature("label", RealNN, True, None, parents=())
+    vec = Feature("v", OPVector, False, None, parents=())
+    est.set_input(label, vec)
+    meta = VectorMeta("v", [
+        VectorColumnMeta(f"raw{i // 2}", "Real", index=i) for i in range(d)])
+    batch = ColumnBatch({"label": Column(RealNN, y),
+                         "v": Column(OPVector, X, meta=meta)}, n)
+    model = est.fit(batch)
+    return model, batch, vec, meta, X
+
+
+def _loco_out(model, batch, vec, force_host=False, **kw):
+    loco = RecordInsightsLOCO(model=model, **kw)
+    loco.set_input(vec)
+    if force_host:
+        loco._device_score_fn = lambda: None
+    return loco.transform(batch)
+
+
+def test_device_host_parity():
+    """The jitted masked-forward program and the numpy fallback agree on
+    group diffs (full-group run) and on ranking away from float ties."""
+    model, batch, vec, meta, X = _fit_lr()
+    # top_k = all groups: every diff value must match across paths
+    dev = _loco_out(model, batch, vec, top_k=4)
+    host = _loco_out(model, batch, vec, top_k=4, force_host=True)
+    assert len(dev) == len(host)
+    for rd, rh in zip(dev.values, host.values):
+        assert set(rd) == set(rh)
+        for kname in rd:
+            vd = json.loads(rd[kname])[0][1]
+            vh = json.loads(rh[kname])[0][1]
+            assert abs(vd - vh) < 1e-4, (kname, vd, vh)
+    # ranking: the winning group agrees wherever the top-2 margin is clear
+    # (f32 device vs f64 host may swap near-exact ties)
+    dev1 = _loco_out(model, batch, vec, top_k=1)
+    host1 = _loco_out(model, batch, vec, top_k=1, force_host=True)
+    for rd, rh, rfull in zip(dev1.values, host1.values, host.values):
+        diffs = sorted((abs(json.loads(v)[0][1]) for v in rfull.values()),
+                       reverse=True)
+        if diffs[0] - diffs[1] > 1e-3:
+            assert set(rd) == set(rh)
+
+
+def test_topk_and_strategies():
+    model, batch, vec, meta, X = _fit_lr()
+    out = _loco_out(model, batch, vec, top_k=2)
+    for row in out.values:
+        assert len(row) == 2
+    pos = _loco_out(model, batch, vec, top_k=4, strategy="positive")
+    neg = _loco_out(model, batch, vec, top_k=4, strategy="negative")
+    # all-groups selection: positive strategy ranks descending diffs,
+    # negative ascending — both see the same diff values per row
+    r0p = [json.loads(v)[0][1] for v in pos.values[0].values()]
+    r0n = [json.loads(v)[0][1] for v in neg.values[0].values()]
+    assert r0p == sorted(r0p, reverse=True)
+    assert r0n == sorted(r0n)
+    assert set(np.round(r0p, 5)) == set(np.round(r0n, 5))
+
+
+def test_date_time_period_aggregation():
+    """sin/cos date-circle columns aggregate per (parent, period), and other
+    period descriptors group the same way (≙ aggregateDiffs:186)."""
+    cols = [
+        VectorColumnMeta("ts", "Date", index=0, descriptor_value="sin(DayOfWeek)"),
+        VectorColumnMeta("ts", "Date", index=1, descriptor_value="cos(DayOfWeek)"),
+        VectorColumnMeta("ts", "Date", index=2, descriptor_value="sin(HourOfDay)"),
+        VectorColumnMeta("ts", "Date", index=3, descriptor_value="cos(HourOfDay)"),
+        VectorColumnMeta("x", "Real", index=4),
+    ]
+    keys = [_group_key(c) for c in cols]
+    assert keys == ["ts_DayOfWeek", "ts_DayOfWeek", "ts_HourOfDay",
+                    "ts_HourOfDay", "x"]
+
+    meta = VectorMeta("v", cols)
+    loco = RecordInsightsLOCO()
+    groups = loco._groups(meta, 5)
+    assert groups == {"ts_DayOfWeek": [0, 1], "ts_HourOfDay": [2, 3],
+                      "x": [4]}
+
+
+def test_meta_size_mismatch_raises():
+    meta = VectorMeta("v", [VectorColumnMeta("a", "Real", index=0)])
+    loco = RecordInsightsLOCO()
+    with pytest.raises(ValueError, match="meta"):
+        loco._groups(meta, 5)
+
+
+def test_missing_meta_falls_back_to_per_column():
+    loco = RecordInsightsLOCO()
+    assert loco._groups(None, 3) == {"f_0": [0], "f_1": [1], "f_2": [2]}
+
+
+def test_assemble_maps_native_matches_fallback(monkeypatch):
+    """The C formatter and the numpy fallback produce identical maps (up to
+    float text formatting, compared via json)."""
+    import transmogrifai_tpu.native as native_mod
+    from transmogrifai_tpu.record_insights import _assemble_maps
+
+    rng = np.random.default_rng(5)
+    n, k, g = 200, 4, 9
+    idx = rng.integers(0, g, size=(n, k))
+    val = rng.normal(size=(n, k))
+    names = [f"feat_{i}" for i in range(g)]
+    fast = _assemble_maps(idx, val, names, n)
+    monkeypatch.setenv("TRANSMOGRIFAI_NATIVE", "0")
+    native_mod._CACHE.clear()
+    slow = _assemble_maps(idx, val, names, n)
+    native_mod._CACHE.clear()
+    for a, b in zip(fast, slow):
+        assert set(a) == set(b)
+        for kk in a:
+            pa, pb = json.loads(a[kk]), json.loads(b[kk])
+            assert pa[0][0] == pb[0][0]
+            assert abs(pa[0][1] - pb[0][1]) < 1e-8
+
+
+def test_assemble_maps_escaped_names():
+    from transmogrifai_tpu.record_insights import _assemble_maps
+    out = _assemble_maps(np.zeros((1, 1), np.int64), np.ones((1, 1)),
+                         ['we"ird'], 1)
+    assert json.loads(out[0]['we"ird']) == [['we"ird', 1.0]]
+
+
+def test_assemble_maps_nonfinite_diffs_parse():
+    from transmogrifai_tpu.record_insights import _assemble_maps
+    val = np.array([[np.nan, 1.5]])
+    out = _assemble_maps(np.array([[0, 1]]), val, ["a", "b"], 1)
+    assert np.isnan(json.loads(out[0]["a"])[0][1])
+    assert json.loads(out[0]["b"])[0][1] == 1.5

@@ -3,9 +3,10 @@ core/src/main/scala/com/salesforce/op/ModelInsights.scala:74-392,
 extractFromStages:440) and the ASCII ``summaryPretty`` rendering
 (utils/table/Table.scala).
 
-Walks the fitted DAG, collecting per-derived-feature contributions,
-label correlations / variances from the SanityChecker metadata, the selected
-model summary + validation results, and the label profile.
+Walks the fitted DAG, collecting per-derived-feature contributions (raw and
+descaled), label correlations / variances / Cramér's V from the SanityChecker
+metadata, RawFeatureFilter feature distributions, the selected model summary +
+validation results, the label profile, and a training-stage echo.
 """
 
 from __future__ import annotations
@@ -24,6 +25,9 @@ class FeatureInsights:
     feature_name: str
     feature_type: str = ""
     derived_columns: List[Dict[str, Any]] = field(default_factory=list)
+    # RawFeatureFilter FeatureDistributions for this raw feature (per map
+    # key when the feature is a map) — ≙ ModelInsights.scala distributions
+    distributions: List[Dict[str, Any]] = field(default_factory=list)
 
     def max_contribution(self) -> float:
         vals = [abs(c.get("contribution") or 0.0) for c in self.derived_columns]
@@ -32,6 +36,11 @@ class FeatureInsights:
     def max_abs_correlation(self) -> float:
         vals = [abs(c["corr"]) for c in self.derived_columns
                 if c.get("corr") is not None and np.isfinite(c["corr"])]
+        return max(vals) if vals else float("nan")
+
+    def cramers_v(self) -> float:
+        vals = [c["cramersV"] for c in self.derived_columns
+                if c.get("cramersV") is not None and np.isfinite(c["cramersV"])]
         return max(vals) if vals else float("nan")
 
 
@@ -44,6 +53,7 @@ class ModelInsights:
     selected_model: Dict[str, Any] = field(default_factory=dict)
     problem_type: str = ""
     stage_info: Dict[str, Any] = field(default_factory=dict)
+    training_params: Dict[str, Any] = field(default_factory=dict)
 
     # -- extraction (≙ extractFromStages:440) -----------------------------
     @staticmethod
@@ -88,36 +98,75 @@ class ModelInsights:
             names = s.get("names", [])
             corrs = s.get("correlationsWithLabel", [])
             variances = s.get("variances", [])
+            cramers_by_group = (s.get("categoricalStats", {}) or {}).get(
+                "cramersV", {}) or {}
             dropped = set(s.get("dropped", []))
             reasons = s.get("dropReasons", {})
-            # the checker records its input vector meta for lineage
-            meta = None
-            if "input_vector_meta" in checker.metadata:
-                from .vector_meta import VectorMeta
-                meta = VectorMeta.from_json(checker.metadata["input_vector_meta"])
+            # the checker ALWAYS records its input vector meta (it is fed by
+            # VectorsCombiner); per-column lineage must come from it — a
+            # name-split guess would silently mis-attribute features whose
+            # names contain '_'
+            if "input_vector_meta" not in checker.metadata:
+                raise ValueError(
+                    "SanityChecker metadata has no input_vector_meta: the "
+                    "checker input vector carried no lineage. Feed the "
+                    "checker from VectorsCombiner/transmogrify (which attach "
+                    "OpVectorMetadata) to get ModelInsights.")
+            from .vector_meta import VectorMeta
+            meta = VectorMeta.from_json(checker.metadata["input_vector_meta"])
             kept_pos = 0
             for i, name in enumerate(names):
-                col_meta = (meta.columns[i] if meta is not None
-                            and i < len(meta.columns) else None)
-                parent = col_meta.parent_feature_name if col_meta else name.rsplit("_", 1)[0]
+                col_meta = meta.columns[i] if i < len(meta.columns) else None
+                if col_meta is None:
+                    raise ValueError(
+                        f"vector meta covers {len(meta.columns)} columns but "
+                        f"the SanityChecker summary names {len(names)}")
+                parent = col_meta.parent_feature_name
                 fi = by_parent.setdefault(parent, FeatureInsights(
-                    parent, col_meta.parent_feature_type if col_meta else ""))
+                    parent, col_meta.parent_feature_type))
                 is_dropped = name in dropped
                 contribution = None
+                descaled = None
                 if not is_dropped and kept_pos < len(contributions):
                     contribution = contributions[kept_pos]
+                    # descaled contribution: |effect| in label units —
+                    # |coef_j| · std_j for linear models, comparable across
+                    # differently-scaled features (≙ the reference's
+                    # descaled feature contributions, ModelInsights.scala)
+                    var_i = variances[i] if i < len(variances) else None
+                    if (contribution is not None and var_i is not None
+                            and np.isfinite(var_i)):
+                        descaled = float(contribution * np.sqrt(max(var_i, 0.0)))
                 if not is_dropped:
                     kept_pos += 1
+                gname = (parent if col_meta.grouping is None
+                         else f"{parent}({col_meta.grouping})")
+                cram = (cramers_by_group.get(gname)
+                        if col_meta.indicator_value is not None else None)
                 fi.derived_columns.append({
                     "name": name,
                     "corr": corrs[i] if i < len(corrs) else None,
                     "variance": variances[i] if i < len(variances) else None,
+                    "cramersV": cram,
                     "dropped": is_dropped,
                     "dropReasons": reasons.get(name, []),
                     "contribution": contribution,
-                    "indicatorValue": col_meta.indicator_value if col_meta else None,
-                    "grouping": col_meta.grouping if col_meta else None,
+                    "descaledContribution": descaled,
+                    "indicatorValue": col_meta.indicator_value,
+                    "grouping": col_meta.grouping,
                 })
+
+        # RawFeatureFilter feature distributions, joined per raw feature
+        # (≙ ModelInsights surfacing RawFeatureFilterResults distributions)
+        rff = getattr(workflow_model, "rff_results", None)
+        if rff is not None:
+            for d in rff.train_distributions:
+                fi = by_parent.get(d.name)
+                if fi is None:
+                    fi = by_parent.setdefault(
+                        d.name, FeatureInsights(d.name))
+                fi.distributions.append(d.to_json())
+
         ins.features = sorted(by_parent.values(),
                               key=lambda f: -f.max_contribution())
 
@@ -128,6 +177,17 @@ class ModelInsights:
             elif "summary" in sel.metadata:  # reloaded model: summary persisted
                 ins.selected_model = sel.metadata["summary"]
                 ins.problem_type = ins.selected_model.get("problemType", "")
+
+        # training echo: workflow parameters + per-stage ctor params
+        # (≙ trainingParams / stageInfo in the reference's insights JSON)
+        ins.training_params = dict(workflow_model.parameters or {})
+        for stage in workflow_model.stages:
+            ins.stage_info[stage.uid] = {
+                "className": type(stage).__name__,
+                "params": {k: v for k, v in stage.params.items()
+                           if isinstance(v, (str, int, float, bool))
+                           or v is None},
+            }
         return ins
 
     def to_json(self) -> Dict[str, Any]:
@@ -137,10 +197,12 @@ class ModelInsights:
                 "featureName": f.feature_name,
                 "featureType": f.feature_type,
                 "derivedFeatures": f.derived_columns,
+                "distributions": f.distributions,
             } for f in self.features],
             "selectedModelInfo": self.selected_model,
             "problemType": self.problem_type,
             "stageInfo": self.stage_info,
+            "trainingParams": self.training_params,
         }
 
     def pretty(self) -> str:
@@ -164,15 +226,24 @@ class ModelInsights:
         if self.features:
             rows = []
             for f in self.features[:25]:
+                fill = ""
+                if f.distributions:
+                    fr = f.distributions[0].get("fillRate")
+                    if fr is not None:
+                        fill = f"{fr:.3f}"
                 rows.append([
                     f.feature_name,
                     f"{f.max_contribution():.4f}",
                     ("%.4f" % f.max_abs_correlation()
                      if np.isfinite(f.max_abs_correlation()) else "-"),
+                    ("%.4f" % f.cramers_v()
+                     if np.isfinite(f.cramers_v()) else "-"),
+                    fill or "-",
                     str(sum(1 for c in f.derived_columns if c["dropped"])),
                 ])
             out.append(render_table(
-                ["Top Raw Feature", "Max Contribution", "Max |Corr|", "Dropped"],
+                ["Top Raw Feature", "Max Contribution", "Max |Corr|",
+                 "Cramér's V", "Fill Rate", "Dropped"],
                 rows, title="Top Model Contributions"))
         return "\n".join(out)
 

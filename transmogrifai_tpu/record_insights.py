@@ -3,16 +3,24 @@ core/src/main/scala/com/salesforce/op/stages/impl/insights/
 RecordInsightsLOCO.scala:100-240: computeDiff:147, aggregateDiffs:186).
 
 Leave-one-covariate-out: re-score each row with each raw-feature group's
-columns replaced by zero and record the prediction shift.  On TPU this is one
-batched forward pass per raw feature (groups of derived columns aggregate
-together, as the reference aggregates text/date indices per raw feature) —
-[G, N, D] masking is pure XLA, no per-row loop.
+columns zeroed and record the prediction shift.  Derived columns aggregate
+per raw parent feature, and date-circle columns (descriptor ``sin(p)`` /
+``cos(p)``) aggregate per (parent, time-period) — ≙ the reference's
+``aggregateDiffs`` date handling (RecordInsightsLOCO.scala:186).
+
+On a device-scorable model the whole computation is ONE jitted XLA program:
+the base forward plus a ``lax.map`` over the [G, D] group masks (each step a
+masked forward on the HBM-resident matrix — no [G, N, D] materialisation and
+no host copies), followed by per-row top-K selection on device.  Only the
+[N, K] winning (index, diff) pairs cross the host link.  Host-only models
+(e.g. wrapped external estimators) fall back to an equivalent numpy loop
+with the same output, so the two paths are parity-testable.
 """
 
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -20,10 +28,25 @@ from .columns import Column, ColumnBatch
 from .stages.base import Transformer
 from .types import OPVector, Prediction, TextMap
 
+_PERIODS = ("HourOfDay", "DayOfWeek", "DayOfMonth", "DayOfYear",
+            "WeekOfMonth", "WeekOfYear", "MonthOfYear", "QuarterOfYear")
+
+
+def _group_key(col_meta) -> str:
+    """Raw-feature aggregation key; date-circle columns split per period."""
+    parent = col_meta.parent_feature_name
+    desc = col_meta.descriptor_value or ""
+    if desc.startswith(("sin(", "cos(")) and desc.endswith(")"):
+        return f"{parent}_{desc[4:-1]}"
+    for p in _PERIODS:
+        if desc == p or desc.startswith(p + "_"):
+            return f"{parent}_{p}"
+    return parent
+
 
 class RecordInsightsLOCO(Transformer):
     """Inputs: (features OPVector); params carry the fitted model stage.
-    Output: TextMap of rawFeatureName → json [[col, diff...], ...] like the
+    Output: TextMap of groupKey → json [[col, diff...], ...] like the
     reference's RecordInsightsParser format.
     """
 
@@ -34,49 +57,177 @@ class RecordInsightsLOCO(Transformer):
     def __init__(self, model=None, top_k: int = 20, strategy: str = "abs", **params):
         super().__init__(top_k=top_k, strategy=strategy, **params)
         self.model = model
+        self._compiled: Dict[Tuple, Any] = {}
 
-    def transform(self, batch: ColumnBatch) -> Column:
-        (vec_f,) = self.input_features
-        col = batch[vec_f.name]
-        X = np.asarray(col.values, dtype=np.float32)
-        n, d = X.shape
-        meta = col.meta
-        groups: Dict[str, List[int]] = {}
+    # -- grouping ---------------------------------------------------------
+    def _groups(self, meta, d: int) -> Dict[str, List[int]]:
         if meta is not None and meta.size == d:
-            groups = meta.index_by_parent()
-        else:
-            groups = {f"f_{i}": [i] for i in range(d)}
+            out: Dict[str, List[int]] = {}
+            for c in meta.columns:
+                out.setdefault(_group_key(c), []).append(c.index)
+            return out
+        if meta is not None:
+            raise ValueError(
+                f"RecordInsightsLOCO: vector meta covers {meta.size} columns "
+                f"but the matrix has {d}")
+        return {f"f_{i}": [i] for i in range(d)}
 
-        base = self._score(X)                                # [N]
-        diffs: Dict[str, np.ndarray] = {}
-        for parent, idxs in groups.items():
-            Xm = X.copy()
-            Xm[:, idxs] = 0.0
-            diffs[parent] = base - self._score(Xm)           # [N]
+    # -- scoring ----------------------------------------------------------
+    def _device_score_fn(self) -> Optional[Callable]:
+        m = self.model
+        sup = getattr(m, "supports_device_scores", None)
+        if m is None or sup is None or not sup():
+            return None
 
-        top_k = int(self.get("top_k", 20))
-        strategy = self.get("strategy", "abs")
-        names = list(diffs)
-        D = np.stack([diffs[p] for p in names], axis=1)      # [N, G]
-        if strategy == "positive":
-            order = np.argsort(-D, axis=1)
-        elif strategy == "negative":
-            order = np.argsort(D, axis=1)
-        else:
-            order = np.argsort(-np.abs(D), axis=1)
-        out = np.empty(n, dtype=object)
-        k = min(top_k, len(names))
-        for i in range(n):
-            row = {}
-            for j in order[i, :k]:
-                row[names[j]] = float(D[i, j])
-            out[i] = {p: json.dumps([[p, v]]) for p, v in row.items()}
-        return Column(TextMap, out)
+        def score(Xd):
+            out = m.device_scores(Xd, full=False)
+            s = out.get("scores")
+            if s is not None:
+                return s
+            prob = out.get("probability")
+            if prob is not None:
+                return prob[:, -1]
+            return out["prediction"]
 
-    def _score(self, X: np.ndarray) -> np.ndarray:
+        return score
+
+    def _host_score(self, X: np.ndarray) -> np.ndarray:
         pred = self.model.predict_arrays(X)
         prob = pred.get("probability")
         if prob is not None:
             p = np.asarray(prob)
             return p[:, -1] if p.ndim == 2 else p
         return np.asarray(pred["prediction"], dtype=np.float64)
+
+    # -- the LOCO programs ------------------------------------------------
+    def _device_topk(self, xv, masks: np.ndarray, k: int,
+                     strategy: str) -> Tuple[np.ndarray, np.ndarray]:
+        """One jitted program: masked forwards (lax.map over groups), diffs,
+        per-row top-K — returns host [N, K] (group index, diff)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .columns import to_device_f32
+
+        score = self._device_score_fn()
+        d = int(xv.shape[1])
+        # fingerprint the mask contents: the same stage may see batches with
+        # different vector meta at identical shapes
+        key = (id(self.model), strategy, k, d, len(masks),
+               hash(masks.tobytes()))
+        ent = self._compiled.get(key)
+        if ent is not None:
+            prog, Md = ent
+        else:
+            def loco(Xd, Md):
+                base = score(Xd)                               # [N]
+
+                def one(m):
+                    return base - score(Xd * m[None, :])       # [N]
+
+                Dn = jax.lax.map(one, Md).T                    # [N, G]
+                if strategy == "positive":
+                    rank = Dn
+                elif strategy == "negative":
+                    rank = -Dn
+                else:
+                    rank = jnp.abs(Dn)
+                _, idx = jax.lax.top_k(rank, k)                # [N, K]
+                val = jnp.take_along_axis(Dn, idx, axis=1)
+                # group count < 2^15 always: ship indices as int16 — the
+                # [N, K] pulls are the only host traffic and the link is slow
+                return idx.astype(jnp.int16), val
+
+            prog = jax.jit(loco)
+            # masks depend only on (grouping, d) — cache the device copy
+            # with the program so repeat transforms ship nothing but X
+            Md = jnp.asarray(masks)
+            self._compiled[key] = (prog, Md)
+        Xd = to_device_f32(xv)
+        idx, val = jax.device_get(prog(Xd, Md))
+        return idx.astype(np.int64), val.astype(np.float64)
+
+    def _host_topk(self, X: np.ndarray, masks: np.ndarray, k: int,
+                   strategy: str) -> Tuple[np.ndarray, np.ndarray]:
+        base = self._host_score(X)
+        G = masks.shape[0]
+        Dn = np.empty((len(X), G), np.float64)
+        for g in range(G):
+            Dn[:, g] = base - self._host_score(X * masks[g][None, :])
+        if strategy == "positive":
+            rank = Dn
+        elif strategy == "negative":
+            rank = -Dn
+        else:
+            rank = np.abs(Dn)
+        # argpartition + per-row ordering of just the K winners
+        part = np.argpartition(-rank, k - 1, axis=1)[:, :k]
+        sub = np.take_along_axis(rank, part, axis=1)
+        order = np.argsort(-sub, axis=1, kind="stable")
+        idx = np.take_along_axis(part, order, axis=1)
+        val = np.take_along_axis(Dn, idx, axis=1)
+        return idx, val
+
+    # -- stage ------------------------------------------------------------
+    def transform(self, batch: ColumnBatch) -> Column:
+        (vec_f,) = self.input_features
+        col = batch[vec_f.name]
+        xv = col.values
+        n, d = int(xv.shape[0]), int(xv.shape[1])
+        groups = self._groups(col.meta, d)
+        names = list(groups)
+        G = len(names)
+        k = max(1, min(int(self.get("top_k", 20)), G))
+        strategy = self.get("strategy", "abs")
+
+        masks = np.ones((G, d), np.float32)
+        for gi, idxs in enumerate(groups.values()):
+            masks[gi, idxs] = 0.0
+
+        if self._device_score_fn() is not None:
+            idx, val = self._device_topk(xv, masks, k, strategy)
+        else:
+            X = np.asarray(xv, dtype=np.float32)
+            idx, val = self._host_topk(X, masks, k, strategy)
+
+        return Column(TextMap, _assemble_maps(idx, val, names, n))
+
+
+def _assemble_maps(idx: np.ndarray, val: np.ndarray,
+                   names: Sequence[str], n: int) -> np.ndarray:
+    """[N, K] (group index, diff) → object array of per-row
+    {name: '[["name", diff]]'} maps.  The native formatter does it in one C
+    pass (interned names, snprintf payloads); the numpy fallback builds the
+    payload strings with C-speed np.char ops and only loops for the dicts."""
+    # fast paths need json-safe names AND finite diffs (%g / str() would emit
+    # bare nan/inf, which json.loads rejects — json.dumps' NaN does parse)
+    clean = (not any('"' in p or "\\" in p for p in names)
+             and bool(np.isfinite(val).all()))
+    if clean:
+        from .native import load
+        native = load("locofmt")
+        if native is not None:
+            return native.assemble(np.ascontiguousarray(idx, np.int64),
+                                   np.ascontiguousarray(val, np.float64),
+                                   list(names))
+    names_u = np.asarray(names)                            # unicode [G]
+    nm = names_u[idx]                                      # [N, K]
+    if not clean:
+        payload = np.frompyfunc(_entry_json, 2, 1)(nm, val)
+    else:
+        val_str = val.astype(np.str_)                      # full-width repr
+        payload = np.char.add(
+            np.char.add(np.char.add('[["', nm), '", '),
+            np.char.add(val_str, "]]"))
+    out = np.empty(n, dtype=object)
+    out[:] = [dict(zip(a, b))
+              for a, b in zip(nm.tolist(), payload.tolist())]
+    return out
+
+
+def _entry_json(name: str, diff: float) -> str:
+    """``[[name, diff]]`` — the reference's RecordInsightsParser payload."""
+    diff = float(diff)
+    if '"' in name or "\\" in name or not np.isfinite(diff):
+        return json.dumps([[name, diff]])   # NaN/Infinity parse under json
+    return f'[["{name}", {diff}]]'

@@ -102,28 +102,30 @@ class ModelInsights:
                 "cramersV", {}) or {}
             dropped = set(s.get("dropped", []))
             reasons = s.get("dropReasons", {})
-            # the checker ALWAYS records its input vector meta (it is fed by
-            # VectorsCombiner); per-column lineage must come from it — a
-            # name-split guess would silently mis-attribute features whose
-            # names contain '_'
-            if "input_vector_meta" not in checker.metadata:
-                raise ValueError(
-                    "SanityChecker metadata has no input_vector_meta: the "
-                    "checker input vector carried no lineage. Feed the "
-                    "checker from VectorsCombiner/transmogrify (which attach "
-                    "OpVectorMetadata) to get ModelInsights.")
-            from .vector_meta import VectorMeta
-            meta = VectorMeta.from_json(checker.metadata["input_vector_meta"])
-            kept_pos = 0
-            for i, name in enumerate(names):
-                col_meta = meta.columns[i] if i < len(meta.columns) else None
-                if col_meta is None:
+            # per-column lineage comes from the checker's recorded vector
+            # meta (VectorsCombiner/transmogrify always attach it).  When a
+            # hand-built vector carried none, DON'T guess parents from name
+            # splitting (silently wrong for names containing '_') — attribute
+            # each column to itself and mark the lineage absent.
+            meta = None
+            if "input_vector_meta" in checker.metadata:
+                from .vector_meta import VectorMeta
+                meta = VectorMeta.from_json(
+                    checker.metadata["input_vector_meta"])
+                if len(meta.columns) != len(names):
                     raise ValueError(
                         f"vector meta covers {len(meta.columns)} columns but "
                         f"the SanityChecker summary names {len(names)}")
-                parent = col_meta.parent_feature_name
+            else:
+                ins.stage_info["lineage"] = "absent"
+            kept_pos = 0
+            for i, name in enumerate(names):
+                col_meta = meta.columns[i] if meta is not None else None
+                parent = (col_meta.parent_feature_name if col_meta is not None
+                          else name)
                 fi = by_parent.setdefault(parent, FeatureInsights(
-                    parent, col_meta.parent_feature_type))
+                    parent,
+                    col_meta.parent_feature_type if col_meta else ""))
                 is_dropped = name in dropped
                 contribution = None
                 descaled = None
@@ -139,10 +141,12 @@ class ModelInsights:
                         descaled = float(contribution * np.sqrt(max(var_i, 0.0)))
                 if not is_dropped:
                     kept_pos += 1
-                gname = (parent if col_meta.grouping is None
-                         else f"{parent}({col_meta.grouping})")
+                grouping = col_meta.grouping if col_meta else None
+                indicator = col_meta.indicator_value if col_meta else None
+                gname = (parent if grouping is None
+                         else f"{parent}({grouping})")
                 cram = (cramers_by_group.get(gname)
-                        if col_meta.indicator_value is not None else None)
+                        if indicator is not None else None)
                 fi.derived_columns.append({
                     "name": name,
                     "corr": corrs[i] if i < len(corrs) else None,
@@ -152,8 +156,8 @@ class ModelInsights:
                     "dropReasons": reasons.get(name, []),
                     "contribution": contribution,
                     "descaledContribution": descaled,
-                    "indicatorValue": col_meta.indicator_value,
-                    "grouping": col_meta.grouping,
+                    "indicatorValue": indicator,
+                    "grouping": grouping,
                 })
 
         # RawFeatureFilter feature distributions, joined per raw feature

@@ -111,14 +111,18 @@ class RecordInsightsLOCO(Transformer):
 
         score = self._device_score_fn()
         d = int(xv.shape[1])
-        # fingerprint the mask contents: the same stage may see batches with
-        # different vector meta at identical shapes
-        key = (id(self.model), strategy, k, d, len(masks),
+        # key on the model OBJECT (keeps it alive — id() reuse after gc must
+        # never hit a stale program baked with old weights) and on the mask
+        # contents: the same stage may see batches with different vector
+        # meta at identical shapes
+        key = (self.model, strategy, k, d, len(masks),
                hash(masks.tobytes()))
         ent = self._compiled.get(key)
         if ent is not None:
             prog, Md = ent
         else:
+            while len(self._compiled) >= 8:   # bound program+mask residency
+                self._compiled.pop(next(iter(self._compiled)))
             def loco(Xd, Md):
                 base = score(Xd)                               # [N]
 
@@ -134,9 +138,12 @@ class RecordInsightsLOCO(Transformer):
                     rank = jnp.abs(Dn)
                 _, idx = jax.lax.top_k(rank, k)                # [N, K]
                 val = jnp.take_along_axis(Dn, idx, axis=1)
-                # group count < 2^15 always: ship indices as int16 — the
-                # [N, K] pulls are the only host traffic and the link is slow
-                return idx.astype(jnp.int16), val
+                # the [N, K] pulls are the only host traffic and the link is
+                # slow: ship indices in the narrowest dtype that fits G
+                # (meta-less fallbacks make one group PER COLUMN, so G can
+                # exceed int16)
+                itype = jnp.int16 if Md.shape[0] <= 0x7FFF else jnp.int32
+                return idx.astype(itype), val
 
             prog = jax.jit(loco)
             # masks depend only on (grouping, d) — cache the device copy
@@ -201,7 +208,7 @@ def _assemble_maps(idx: np.ndarray, val: np.ndarray,
     payload strings with C-speed np.char ops and only loops for the dicts."""
     # fast paths need json-safe names AND finite diffs (%g / str() would emit
     # bare nan/inf, which json.loads rejects — json.dumps' NaN does parse)
-    clean = (not any('"' in p or "\\" in p for p in names)
+    clean = (all(_json_plain(p) for p in names)
              and bool(np.isfinite(val).all()))
     if clean:
         from .native import load
@@ -225,9 +232,15 @@ def _assemble_maps(idx: np.ndarray, val: np.ndarray,
     return out
 
 
+def _json_plain(name: str) -> bool:
+    """True when the name needs no JSON escaping (quotes, backslashes, and
+    control characters all do)."""
+    return '"' not in name and "\\" not in name and name.isprintable()
+
+
 def _entry_json(name: str, diff: float) -> str:
     """``[[name, diff]]`` — the reference's RecordInsightsParser payload."""
     diff = float(diff)
-    if '"' in name or "\\" in name or not np.isfinite(diff):
+    if not _json_plain(name) or not np.isfinite(diff):
         return json.dumps([[name, diff]])   # NaN/Infinity parse under json
     return f'[["{name}", {diff}]]'

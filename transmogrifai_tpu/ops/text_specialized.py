@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import base64
 import binascii
+import bisect
+import functools
 import re
 from collections import Counter
 from typing import Dict, List, Optional, Sequence, Set, Tuple
@@ -707,12 +709,22 @@ class JaccardSimilarity(Transformer):
 
 
 # ---------------------------------------------------------------------------
-# Language detection (≙ LangDetector.scala; Optimaize replaced by stop-word
-# profile scoring)
+# Language detection (≙ LangDetector.scala + the 69-language enum at
+# utils/.../text/LanguageDetector.scala:59; Optimaize replaced by Unicode
+# script analysis + per-language stop-word profiles)
+#
+# Two signals, like the reference's n-gram detector effectively combines:
+#   1. the SCRIPT a character belongs to (Hangul → ko, Thai → th, ...) — for
+#      single-language scripts this alone seals the call, and for
+#      script-families (Latin, Cyrillic, Arabic, Devanagari, Hebrew, Han)
+#      it restricts the candidate set;
+#   2. stop-word profile hit rates WITHIN the candidate set (space-separated
+#      scripts), or distinctive-character counts for Han (simplified vs
+#      traditional Chinese, kana → Japanese).
 # ---------------------------------------------------------------------------
 
 def _lang_profiles() -> Dict[str, Set[str]]:
-    """Packaged per-language stop-word profiles (18 languages) — loaded from
+    """Packaged per-language stop-word profiles (67 languages) — loaded from
     the resources module, the analog of Optimaize's language profiles shipped
     in the reference's models module (see resources/__init__.py)."""
     from ..resources import lang_profiles
@@ -721,24 +733,152 @@ def _lang_profiles() -> Dict[str, Set[str]]:
 
 _WORD_RE = re.compile(r"[^\W\d_]+", re.UNICODE)
 
+# script → (unicode ranges, candidate languages); None candidates = resolved
+# via the word profiles of the family
+_SCRIPTS: Dict[str, Tuple[Tuple[Tuple[int, int], ...], Tuple[str, ...]]] = {
+    "latin": (((0x41, 0x5A), (0x61, 0x7A), (0xC0, 0x24F),
+               (0x1E00, 0x1EFF)), ()),          # profiles decide
+    "cyrillic": (((0x400, 0x4FF),), ("ru", "uk", "bg", "sr", "mk", "be")),
+    "greek": (((0x370, 0x3FF), (0x1F00, 0x1FFF)), ("el",)),
+    "hebrew": (((0x590, 0x5FF),), ("he", "yi")),
+    "arabic": (((0x600, 0x6FF), (0x750, 0x77F)), ("ar", "fa", "ur", "ckb")),
+    "devanagari": (((0x900, 0x97F),), ("hi", "mr", "ne")),
+    "bengali": (((0x980, 0x9FF),), ("bn",)),
+    "gurmukhi": (((0xA00, 0xA7F),), ("pa",)),
+    "gujarati": (((0xA80, 0xAFF),), ("gu",)),
+    "tamil": (((0xB80, 0xBFF),), ("ta",)),
+    "telugu": (((0xC00, 0xC7F),), ("te",)),
+    "kannada": (((0xC80, 0xCFF),), ("kn",)),
+    "malayalam": (((0xD00, 0xD7F),), ("ml",)),
+    "thai": (((0xE00, 0xE7F),), ("th",)),
+    "khmer": (((0x1780, 0x17FF),), ("km",)),
+    "hangul": (((0xAC00, 0xD7AF), (0x1100, 0x11FF), (0x3130, 0x318F)),
+               ("ko",)),
+    "kana": (((0x3040, 0x309F), (0x30A0, 0x30FF)), ("ja",)),
+    "han": (((0x4E00, 0x9FFF), (0x3400, 0x4DBF)), ()),   # zh-cn/zh-tw/ja
+}
 
-def detect_languages(s: str) -> Dict[str, float]:
-    """Language → confidence via stop-word profile hit rates, normalized to
-    sum 1 over matching languages (≙ LangDetector.transformFn semantics:
-    empty/no-signal → empty map)."""
-    tokens = [t.lower() for t in _WORD_RE.findall(s)]
-    if not tokens:
-        return {}
-    scores = {}
+# distinctive Han characters: simplified-only vs traditional-only forms
+# (characters shared by both orthographies carry no signal and are excluded)
+_HAN_SIMPLIFIED = set("这个们来说时国会学对发经点吗里后见长门问马语书车")
+_HAN_TRADITIONAL = set("這個們來說時國會學對發經點嗎裡後見長門問馬語書車")
+_HAN_TRADITIONAL -= _HAN_SIMPLIFIED
+_HAN_SIMPLIFIED -= _HAN_TRADITIONAL
+
+
+def detectable_languages() -> Tuple[str, ...]:
+    """Codes detection is resourced for — the word-profile languages plus
+    the script-sealed ones; mirrors the reference's Language enum
+    (ISO 639-1/-3 + the zh-cn/zh-tw split)."""
+    script_only = {"zh-cn", "zh-tw", "ja", "ko", "th", "km"}
+    return tuple(sorted(script_only | set(_lang_profiles())))
+
+
+# flat sorted (lo, hi, script) boundaries: one bisect per lookup instead of
+# a linear scan over every script's ranges (texts pay this per character)
+_SCRIPT_BOUNDS = sorted(
+    (lo, hi, script)
+    for script, (ranges, _) in _SCRIPTS.items() for lo, hi in ranges)
+_SCRIPT_LOS = [b[0] for b in _SCRIPT_BOUNDS]
+
+
+@functools.lru_cache(maxsize=8192)
+def _script_of(ch: str) -> Optional[str]:
+    cp = ord(ch)
+    i = bisect.bisect_right(_SCRIPT_LOS, cp) - 1
+    if i >= 0:
+        lo, hi, script = _SCRIPT_BOUNDS[i]
+        if cp <= hi:
+            return script
+    return None
+
+
+def _profile_scores(tokens: List[str], candidates: Optional[Set[str]]
+                    ) -> Dict[str, float]:
+    scores: Dict[str, float] = {}
     for lang, profile in _lang_profiles().items():
+        if candidates is not None and lang not in candidates:
+            continue
         hits = sum(1 for t in tokens if t in profile)
         if hits:
             scores[lang] = hits / len(tokens)
-    total = sum(scores.values())
+    return scores
+
+
+def detect_languages(s: str) -> Dict[str, float]:
+    """Language → confidence, normalized to sum 1 over detected languages
+    (≙ LangDetector.transformFn semantics: empty/no-signal → empty map).
+
+    Covers the reference enum's breadth (LanguageDetector.scala:59): 67
+    word-profile languages across Latin/Cyrillic/Arabic/Devanagari/Hebrew
+    scripts plus script-sealed CJK, Thai, Khmer, Korean, Greek and Indic
+    languages and the zh-cn/zh-tw split via character forms."""
+    # letters by script
+    script_counts: Dict[str, int] = {}
+    han_simp = han_trad = 0
+    for ch in s:
+        if not ch.isalpha():
+            continue
+        sc = _script_of(ch)
+        if sc is None:
+            continue
+        script_counts[sc] = script_counts.get(sc, 0) + 1
+        if sc == "han":
+            if ch in _HAN_SIMPLIFIED:
+                han_simp += 1
+            elif ch in _HAN_TRADITIONAL:
+                han_trad += 1
+    total = sum(script_counts.values())
     if not total:
         return {}
-    return {k: v / total for k, v in sorted(scores.items(),
-                                            key=lambda kv: -kv[1])}
+
+    scores: Dict[str, float] = {}
+    # tokens per script family (space-separated scripts only)
+    tokens_by_script: Dict[str, List[str]] = {}
+    for t in _WORD_RE.findall(s):
+        sc = _script_of(t[0])
+        if sc is not None:
+            tokens_by_script.setdefault(sc, []).append(t.lower())
+
+    kana = script_counts.get("kana", 0)
+    for script, cnt in script_counts.items():
+        frac = cnt / total
+        ranges, candidates = _SCRIPTS[script]
+        if script == "han":
+            # kana anywhere → the Han characters are Japanese kanji
+            if kana:
+                scores["ja"] = scores.get("ja", 0.0) + frac
+            elif han_trad > han_simp:
+                scores["zh-tw"] = scores.get("zh-tw", 0.0) + frac
+            else:
+                scores["zh-cn"] = scores.get("zh-cn", 0.0) + frac
+            continue
+        if script == "kana":
+            scores["ja"] = scores.get("ja", 0.0) + frac
+            continue
+        if len(candidates) == 1:
+            lang = candidates[0]
+            scores[lang] = scores.get(lang, 0.0) + frac
+            continue
+        # script family resolved by word profiles (latin: open candidate set)
+        toks = tokens_by_script.get(script, [])
+        fam = _profile_scores(toks, set(candidates) or None) if toks else {}
+        fam_total = sum(fam.values())
+        if fam_total:
+            for lang, sc_ in fam.items():
+                scores[lang] = scores.get(lang, 0.0) + frac * sc_ / fam_total
+        elif candidates:
+            # no stop-word hit: fall back to the family's most common
+            # language (ambiguous-script default, like Optimaize's priors)
+            lang = candidates[0]
+            scores[lang] = scores.get(lang, 0.0) + frac
+        # latin with no hits contributes nothing (no-signal)
+
+    total_score = sum(scores.values())
+    if not total_score:
+        return {}
+    return {k: v / total_score for k, v in sorted(scores.items(),
+                                                  key=lambda kv: -kv[1])}
 
 
 class LangDetector(Transformer):

@@ -6,7 +6,11 @@ Where the reference ships OpenNLP binaries (NER/sentence/tokenizer/POS) and
 Optimaize language profiles, this package ships JSON data files consumed by
 the specialized text stages (ops/text_specialized.py):
 
-  * ``lang_profiles.json``  — per-language stop-word profiles (18 languages)
+  * ``lang_profiles.json``  — per-language stop-word profiles (67 languages
+    across Latin/Cyrillic/Greek/Hebrew/Arabic/Indic scripts; script-sealed
+    languages — zh-cn/zh-tw/ja/ko/th/km — are handled by Unicode script
+    analysis in ops/text_specialized.py, ≙ the reference's 69-language enum
+    at utils/.../text/LanguageDetector.scala:59)
     for LangDetector (≙ Optimaize profiles).
   * ``name_gender.json``    — first-name → gender dictionary for
     HumanNameDetector (≙ NameDetectUtils.DefaultGenderDictionary).

@@ -1,0 +1,160 @@
+// fasttok — native tokenize + hash for the text vectorization hot path.
+//
+// The reference runs Lucene analyzers + murmur hashing on JVM executors
+// (SmartTextVectorizer.scala:80-123, OpHashingTF); this framework's host
+// prologue tokenizes and bucket-hashes each text cell before the count
+// matrix is scatter-added on device.  In Python that is a regex findall +
+// FNV per token across millions of cells — the dominant host cost of the
+// transmogrification path.  Here it is one C pass over the UTF-8 bytes.
+//
+// Exposed API (module _fasttok):
+//   tokenize_hash(strings: sequence[str|None], num_hashes: int,
+//                 min_token_len: int)
+//       -> (lens: int32[N] ndarray, buckets: int32[total] ndarray,
+//           fallback: list[int])
+//
+// Tokenization matches ops/text.py exactly for ASCII content: tokens are
+// maximal runs of [A-Za-z0-9_'], A-Z lowered before hashing (the Python
+// tokenizer's regex classes are ASCII, so multi-byte UTF-8 sequences always
+// split tokens there too).  Strings containing non-ASCII bytes are NOT
+// processed — their indices return in ``fallback`` (lens[i] = -1) and the
+// caller routes them through the Python tokenizer, because unicode case
+// folding (e.g. Kelvin sign -> 'k') can differ from ASCII-only lowering.
+// Bucket = FNV-1a 32-bit of the token bytes, modulo num_hashes.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#include <numpy/arrayobject.h>
+
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+inline bool is_token_byte(unsigned char c) {
+    return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+           (c >= '0' && c <= '9') || c == '_' || c == '\'';
+}
+
+PyObject* tokenize_hash(PyObject*, PyObject* args) {
+    PyObject* strings;
+    Py_ssize_t num_hashes, min_len = 1;
+    if (!PyArg_ParseTuple(args, "On|n", &strings, &num_hashes, &min_len))
+        return nullptr;
+    if (num_hashes <= 0) {
+        PyErr_SetString(PyExc_ValueError, "num_hashes must be positive");
+        return nullptr;
+    }
+    PyObject* seq = PySequence_Fast(strings, "strings");
+    if (!seq) return nullptr;
+    const Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+
+    npy_intp dim_n = n;
+    PyArrayObject* lens = reinterpret_cast<PyArrayObject*>(
+        PyArray_SimpleNew(1, &dim_n, NPY_INT32));
+    PyObject* fallback = PyList_New(0);
+    if (!lens || !fallback) {
+        Py_XDECREF(reinterpret_cast<PyObject*>(lens));
+        Py_XDECREF(fallback);
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    npy_int32* lp = static_cast<npy_int32*>(PyArray_DATA(lens));
+    std::vector<npy_int32> buckets;
+    buckets.reserve(static_cast<size_t>(n) * 8);
+
+    for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject* s = PySequence_Fast_GET_ITEM(seq, i);  // borrowed
+        if (s == Py_None) {
+            lp[i] = 0;
+            continue;
+        }
+        Py_ssize_t blen;
+        const char* data = PyUnicode_AsUTF8AndSize(s, &blen);
+        if (!data) {
+            Py_DECREF(reinterpret_cast<PyObject*>(lens));
+            Py_DECREF(fallback);
+            Py_DECREF(seq);
+            return nullptr;
+        }
+        // non-ASCII content: defer to the Python tokenizer for exact
+        // unicode case-folding parity
+        bool ascii = true;
+        for (Py_ssize_t k = 0; k < blen; ++k)
+            if (static_cast<unsigned char>(data[k]) >= 0x80) {
+                ascii = false;
+                break;
+            }
+        if (!ascii) {
+            lp[i] = -1;
+            PyObject* idx = PyLong_FromSsize_t(i);
+            if (!idx || PyList_Append(fallback, idx) < 0) {
+                Py_XDECREF(idx);
+                Py_DECREF(reinterpret_cast<PyObject*>(lens));
+                Py_DECREF(fallback);
+                Py_DECREF(seq);
+                return nullptr;
+            }
+            Py_DECREF(idx);
+            continue;
+        }
+        npy_int32 count = 0;
+        Py_ssize_t k = 0;
+        while (k < blen) {
+            while (k < blen &&
+                   !is_token_byte(static_cast<unsigned char>(data[k])))
+                ++k;
+            Py_ssize_t start = k;
+            uint32_t h = 2166136261u;
+            while (k < blen &&
+                   is_token_byte(static_cast<unsigned char>(data[k]))) {
+                unsigned char c = static_cast<unsigned char>(data[k]);
+                if (c >= 'A' && c <= 'Z') c += 32;  // ASCII lower
+                h = (h ^ c) * 16777619u;
+                ++k;
+            }
+            if (k - start >= min_len && k > start) {
+                buckets.push_back(static_cast<npy_int32>(
+                    h % static_cast<uint32_t>(num_hashes)));
+                ++count;
+            }
+        }
+        lp[i] = count;
+    }
+    Py_DECREF(seq);
+
+    npy_intp dim_t = static_cast<npy_intp>(buckets.size());
+    PyArrayObject* out_b = reinterpret_cast<PyArrayObject*>(
+        PyArray_SimpleNew(1, &dim_t, NPY_INT32));
+    if (!out_b) {
+        Py_DECREF(reinterpret_cast<PyObject*>(lens));
+        Py_DECREF(fallback);
+        return nullptr;
+    }
+    if (!buckets.empty())
+        memcpy(PyArray_DATA(out_b), buckets.data(),
+               buckets.size() * sizeof(npy_int32));
+    return Py_BuildValue("NNN", lens, out_b, fallback);
+}
+
+PyMethodDef methods[] = {
+    {"tokenize_hash", tokenize_hash, METH_VARARGS,
+     "tokenize_hash(strings, num_hashes, min_token_len=1) -> "
+     "(lens int32[N], buckets int32[total], fallback list[int])"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_fasttok",
+    "Native text tokenize+hash (host prologue of the hashing trick).", -1,
+    methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__fasttok(void) {
+    import_array();
+    return PyModule_Create(&moduledef);
+}

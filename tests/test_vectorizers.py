@@ -181,3 +181,73 @@ def test_one_hot_layout_orders_by_count_then_value():
     indicators = [c.indicator_value for c in meta.columns]
     # z(5) first, then the a/m tie broken by value, q dropped by min_support
     assert indicators[:3] == ["z", "a", "m"], indicators
+
+
+def test_hash_counts_device_matches_host():
+    """The device scatter-add hashing path must equal the host np.add.at path
+    exactly (integer counts), including empty rows and the binary variant."""
+    from transmogrifai_tpu.ops.text import (hash_counts_on_device,
+                                            hash_tokens_to_counts)
+    rng = np.random.default_rng(7)
+    vocab = [f"t{i}" for i in range(300)]
+    tl = [[vocab[j] for j in rng.integers(0, 300, size=rng.integers(0, 9))]
+          for _ in range(500)]
+    tl[3] = []  # empty row
+    host = hash_tokens_to_counts(tl, 64)
+    dev = np.asarray(hash_counts_on_device(tl, 64))
+    np.testing.assert_array_equal(host, dev)
+    hostb = hash_tokens_to_counts(tl, 64, binary=True)
+    devb = np.asarray(hash_counts_on_device(tl, 64, binary=True))
+    np.testing.assert_array_equal(hostb, devb)
+
+
+def test_smart_text_device_assembly_matches_host(monkeypatch):
+    """SmartTextVectorizer's device-assembled output equals the host path."""
+    import transmogrifai_tpu.ops.text as text_mod
+    from transmogrifai_tpu.features import Feature
+    from transmogrifai_tpu.ops.text import SmartTextVectorizer
+
+    rng = np.random.default_rng(8)
+    vocab = [f"w{i}" for i in range(2000)]
+    vals = np.asarray(
+        [None if rng.random() < 0.2 else
+         " ".join(vocab[j] for j in rng.integers(0, 2000, size=5))
+         for _ in range(400)], dtype=object)
+    pick = np.asarray([None if rng.random() < 0.1 else f"p{rng.integers(3)}"
+                       for _ in range(400)], dtype=object)
+    f1 = Feature("txt", T.Text, False, None, parents=())
+    f2 = Feature("pck", T.Text, False, None, parents=())
+    batch = ColumnBatch({"txt": column_from_values(T.Text, vals),
+                         "pck": column_from_values(T.Text, pick)}, 400)
+    est = SmartTextVectorizer(num_hashes=32).set_input(f1, f2)
+    model = est.fit(batch)
+    host = np.asarray(model.transform(batch).values)
+    monkeypatch.setattr(text_mod, "_DEVICE_ASSEMBLE_ELEMS", 1)
+    dev = np.asarray(model.transform(batch).values)
+    np.testing.assert_array_equal(host, dev)
+
+
+def test_native_tokenize_hash_matches_python():
+    """fasttok's one-pass tokenize+hash equals the Python tokenizer+FNV path,
+    including None, empty, punctuation-only, and non-ASCII fallback rows."""
+    import transmogrifai_tpu.native as native_mod
+    from transmogrifai_tpu.ops.text import (fnv1a_32, hash_tokens_flat,
+                                            strings_to_hash_flat,
+                                            tokenize_text)
+    strings = [
+        "The quick brown Fox_27 jumps", None, "", "  ... !!!",
+        "don't SHOUT at me", "mixed CaSe tok123 _under_",
+        "unicode café touché naïve",       # non-ASCII fallback
+        "Über straße",                          # fallback w/ casing
+        "plain ascii again", "a b c d e f g",
+    ]
+    native = native_mod.load("fasttok")
+    if native is None:
+        pytest.skip("native toolchain unavailable")
+    lens_n, flat_n = strings_to_hash_flat(strings, 97)
+    lens_p, flat_p = hash_tokens_flat(
+        [tokenize_text(s) for s in strings], 97)
+    np.testing.assert_array_equal(lens_n, lens_p)
+    np.testing.assert_array_equal(flat_n, flat_p)
+    # spot-check one token's bucket
+    assert fnv1a_32("fox_27") % 97 in set(flat_n.tolist())

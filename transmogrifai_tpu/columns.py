@@ -31,6 +31,46 @@ _DEVICE_CACHE_BYTES = [0]
 _DEVICE_CACHE_CAP = int(__import__("os").environ.get(
     "TRANSMOGRIFAI_DEVICE_CACHE_BYTES", 2 << 30))
 
+# feature matrices at/above this element count store as bf16 on accelerators
+_MATRIX_BF16_ELEMS = 1 << 26       # 64M elements = 256 MB in f32
+
+
+def device_matrix(values):
+    """Feature matrix for device compute: device-resident f32/bf16 arrays
+    pass through untouched (bf16 is STORAGE — every consumer accumulates in
+    f32, with the operand converts fused into its matmuls); anything else
+    transfers via the f32 wire path."""
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(values, jax.Array) and values.dtype in (jnp.float32,
+                                                          jnp.bfloat16):
+        return values
+    return to_device_f32(values)
+
+
+def feature_matrix_dtype(n_elems: int):
+    """Storage dtype for a device-resident feature matrix of ``n_elems``.
+
+    On accelerators, large matrices store as bf16 — the TPU-native
+    storage/compute split (bf16 storage, f32 MXU accumulation): counts and
+    one-hot indicators are exactly representable, real-valued features were
+    already bf16-quantized by the host wire, and every downstream matmul
+    upcasts its operands into f32 accumulation.  Halving residency is what
+    lets two copies of a wide transmogrified matrix (raw + checked) coexist
+    with the CV working set on a 16 GB chip.  Opt out with
+    TRANSMOGRIFAI_MATRIX_F32=1; CPU backends always store f32."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    if (n_elems >= _MATRIX_BF16_ELEMS
+            and jax.default_backend() != "cpu"
+            and os.environ.get("TRANSMOGRIFAI_MATRIX_F32") != "1"):
+        return jnp.bfloat16
+    return jnp.float32
+
 
 def to_device_f32(values, exact: bool = False) -> Any:
     """Host→device transfer of real-valued bulk data for compute.

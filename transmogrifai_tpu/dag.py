@@ -45,6 +45,18 @@ def dag_stages(dag: List[StageLayer]) -> List[PipelineStage]:
     return [s for layer in dag for s in layer]
 
 
+def prune_batch(batch: ColumnBatch, remaining_stages, keep_names) -> ColumnBatch:
+    """Release columns no remaining stage consumes (HBM liveness — the TPU
+    analog of the reference's persist/unpersist discipline): a device-resident
+    intermediate like a hashed text block is GBs at scale, and holding it
+    alive past its last consumer is what out-of-memories a 16 GB chip."""
+    needed = set(keep_names)
+    for s in remaining_stages:
+        needed.update(f.name for f in s.input_features)
+    drop = [n for n in batch.names() if n not in needed]
+    return batch.drop(drop) if drop else batch
+
+
 def fit_layer(batch: ColumnBatch, layer: StageLayer) -> Tuple[ColumnBatch, List[Transformer]]:
     """Fit all estimators of a layer, then apply every transformer of the layer
     (≙ fitAndTransformLayer, FitStagesUtil.scala:253)."""

@@ -44,7 +44,11 @@ def extract_xy(batch: ColumnBatch, label_feature, features_feature
     y = np.asarray(ycol.values, dtype=np.float32)
     xv = xcol.values
     if isinstance(xv, jax.Array):
-        X = xv if xv.dtype == jnp.float32 else xv.astype(jnp.float32)
+        # bf16 feature-matrix STORAGE passes through — fitters fuse the
+        # upcast into their matmuls; forcing f32 here would materialize a
+        # second full copy in HBM
+        X = (xv if xv.dtype in (jnp.float32, jnp.bfloat16)
+             else xv.astype(jnp.float32))
     else:
         X = np.asarray(xv, dtype=np.float32)
     return X, y
@@ -79,7 +83,8 @@ class PredictionModel(TransformerModel):
             # to predict on numpy costs more than all the compute.
             # full=True makes device_scores mirror predict_arrays' key set,
             # so the Prediction schema is residency-independent.
-            X = xv if xv.dtype == jnp.float32 else xv.astype(jnp.float32)
+            X = (xv if xv.dtype in (jnp.float32, jnp.bfloat16)
+                 else xv.astype(jnp.float32))
             out = self.device_scores(X, full=True)
             return prediction_column(out["prediction"],
                                      out.get("probability"),

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..columns import to_device_f32
+from ..columns import device_matrix, to_device_f32
 from .base import PredictionModel, PredictorEstimator
 from .solvers import (FitResult, fista_fit, linear_grid_fit, naive_bayes_fit,
                       ridge_fit, ridge_grid_fit, standardize, unscale_params)
@@ -50,7 +50,7 @@ def _grouped_grid_fit(est, X, y, fold_weights, grids, *, loss: str,
         groups[(int(m.get("max_iter", 100)), bool(m.get("fit_intercept", True)),
                 bool(m.get("standardization", True)),
                 float(m.get("tol", 1e-6)))].append(gi)
-    Xj = to_device_f32(X)
+    Xj = device_matrix(X)
     yj = jnp.asarray(y, jnp.float32)
     Wj = to_device_f32(fold_weights, exact=True)
     nc = 1 if n_classes <= 2 else n_classes

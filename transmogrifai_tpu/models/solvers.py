@@ -113,7 +113,7 @@ def _spectral_norm_sq_weighted(X: jnp.ndarray, wn: jnp.ndarray,
     Xs = (X - mean)/scale, never materializing Xs or the weighted product —
     one shared HBM-resident X serves every (fold × grid) lane."""
     d = X.shape[1]
-    v = jnp.full((d,), 1.0 / jnp.sqrt(d), X.dtype)
+    v = jnp.full((d,), 1.0 / jnp.sqrt(d), jnp.float32)
 
     def mv(v):
         u = (X @ (v / scale)) - mean @ (v / scale)     # Xs @ v  [N]
@@ -155,18 +155,18 @@ def fista_fit(X: jnp.ndarray, y: jnp.ndarray, sample_weight: jnp.ndarray,
     n, d = X.shape
     C = n_classes
     loss_fn = LOSSES[loss]
-    w = sample_weight.astype(X.dtype)
+    w = sample_weight.astype(jnp.float32)
 
     if loss == "softmax":
-        target = jax.nn.one_hot(y.astype(jnp.int32), C, dtype=X.dtype)
+        target = jax.nn.one_hot(y.astype(jnp.int32), C, dtype=jnp.float32)
     elif loss == "squared_hinge":
-        target = jnp.where(y > 0.5, 1.0, -1.0).astype(X.dtype)
+        target = jnp.where(y > 0.5, 1.0, -1.0).astype(jnp.float32)
     else:
-        target = y.astype(X.dtype)
+        target = y.astype(jnp.float32)
 
     std = scale is not None
-    mu = mean if std else jnp.zeros((d,), X.dtype)
-    sc = scale if std else jnp.ones((d,), X.dtype)
+    mu = mean if std else jnp.zeros((d,), jnp.float32)
+    sc = scale if std else jnp.ones((d,), jnp.float32)
 
     def xs_mv(coef):
         """Xs @ coef without materializing Xs ([N] or [N, C])."""
@@ -260,10 +260,10 @@ def fista_fit(X: jnp.ndarray, y: jnp.ndarray, sample_weight: jnp.ndarray,
             jnp.abs(jnp.atleast_1d(new_i - intercept)))
         return k + 1, new_c, new_i, zc_next, zi_next, t_new, step, delta
 
-    init = (jnp.zeros((), jnp.int32), jnp.zeros(shape, X.dtype),
-            jnp.zeros(b_shape, X.dtype), jnp.zeros(shape, X.dtype),
-            jnp.zeros(b_shape, X.dtype), jnp.ones((), X.dtype),
-            step0.astype(X.dtype), jnp.full((), jnp.inf, X.dtype))
+    init = (jnp.zeros((), jnp.int32), jnp.zeros(shape, jnp.float32),
+            jnp.zeros(b_shape, jnp.float32), jnp.zeros(shape, jnp.float32),
+            jnp.zeros(b_shape, jnp.float32), jnp.ones((), jnp.float32),
+            step0.astype(jnp.float32), jnp.full((), jnp.inf, jnp.float32))
     k, coef, intercept, *_ = jax.lax.while_loop(cond, body, init)
     obj = smooth_val(coef, intercept) + l1 * jnp.sum(jnp.abs(coef))
     return FitResult(coef, jnp.atleast_1d(intercept), k, obj)
@@ -276,7 +276,7 @@ def ridge_fit(X: jnp.ndarray, y: jnp.ndarray, sample_weight: jnp.ndarray,
     fast path for OpLinearRegression): one X^T X matmul on the MXU + a [D,D]
     Cholesky solve."""
     n, d = X.shape
-    w = sample_weight.astype(X.dtype)
+    w = sample_weight.astype(jnp.float32)
     wsum = jnp.sum(w)
     if fit_intercept:
         xm = (w @ X) / wsum
@@ -286,10 +286,10 @@ def ridge_fit(X: jnp.ndarray, y: jnp.ndarray, sample_weight: jnp.ndarray,
     else:
         Xc, yc = X, y
     Xw = Xc * w[:, None]
-    A = (Xc.T @ Xw) / wsum + l2 * jnp.eye(d, dtype=X.dtype)
+    A = (Xc.T @ Xw) / wsum + l2 * jnp.eye(d, dtype=jnp.float32)
     b = (Xw.T @ yc) / wsum
     coef = jax.scipy.linalg.solve(A, b, assume_a="pos")
-    intercept = (ym - xm @ coef) if fit_intercept else jnp.zeros((), X.dtype)
+    intercept = (ym - xm @ coef) if fit_intercept else jnp.zeros((), jnp.float32)
     resid = yc - Xc @ coef
     obj = 0.5 * jnp.sum(w * resid * resid) / wsum + 0.5 * l2 * jnp.sum(coef * coef)
     return FitResult(coef, jnp.atleast_1d(intercept), jnp.zeros((), jnp.int32), obj)
@@ -301,8 +301,8 @@ def naive_bayes_fit(X: jnp.ndarray, y: jnp.ndarray, sample_weight: jnp.ndarray,
     """Multinomial naive Bayes (≙ OpNaiveBayes): class-conditional log
     likelihoods from per-class feature sums.  Expects non-negative features.
     Returns (log_prior [C], log_prob [C, D])."""
-    yoh = jax.nn.one_hot(y.astype(jnp.int32), n_classes, dtype=X.dtype)  # [N,C]
-    w = sample_weight.astype(X.dtype)
+    yoh = jax.nn.one_hot(y.astype(jnp.int32), n_classes, dtype=jnp.float32)  # [N,C]
+    w = sample_weight.astype(jnp.float32)
     cls_count = (w @ yoh)                                 # [C]
     feat_count = (yoh * w[:, None]).T @ jnp.maximum(X, 0.0)  # [C,D]
     log_prior = jnp.log(cls_count + 1e-12) - jnp.log(jnp.sum(cls_count) + 1e-12)
@@ -338,7 +338,7 @@ def linear_grid_fit(X: jnp.ndarray, y: jnp.ndarray, fold_weights: jnp.ndarray,
         if standardization:
             mean, scale = standardize_moments(X, w, center=fit_intercept)
         else:
-            mean, scale = (jnp.zeros((d,), X.dtype), jnp.ones((d,), X.dtype))
+            mean, scale = (jnp.zeros((d,), jnp.float32), jnp.ones((d,), jnp.float32))
         # λ_max of the fold's weighted Gram is grid-independent: compute it
         # once per fold and share it across the vmapped grid lanes
         wn = w / jnp.sum(w)
@@ -376,7 +376,7 @@ def ridge_grid_fit(X: jnp.ndarray, y: jnp.ndarray, fold_weights: jnp.ndarray,
         g = jnp.mean(X, axis=0)
         X = X - g
     else:
-        g = jnp.zeros((d,), X.dtype)
+        g = jnp.zeros((d,), jnp.float32)
 
     def one_fold(w):
         s = jnp.sum(w)
@@ -390,7 +390,7 @@ def ridge_grid_fit(X: jnp.ndarray, y: jnp.ndarray, fold_weights: jnp.ndarray,
             var = jnp.diagonal(G) - m * m
             scale = jnp.sqrt(jnp.maximum(var, 1e-12))
         else:
-            scale = jnp.ones((d,), X.dtype)
+            scale = jnp.ones((d,), jnp.float32)
         if fit_intercept:
             # center by the weighted mean: Gc = G - m m^T, bc = p - m*ym
             Gc = G - jnp.outer(m, m)
@@ -398,14 +398,14 @@ def ridge_grid_fit(X: jnp.ndarray, y: jnp.ndarray, fold_weights: jnp.ndarray,
             y0 = ym
             mean_u = m
         else:
-            Gc, bc, y0 = G, p, jnp.zeros((), X.dtype)
-            mean_u = jnp.zeros((d,), X.dtype)
+            Gc, bc, y0 = G, p, jnp.zeros((), jnp.float32)
+            mean_u = jnp.zeros((d,), jnp.float32)
         # standardized basis: A = D^-1 Gc D^-1, b = D^-1 bc
         A0 = Gc / (scale[:, None] * scale[None, :])
         b = bc / scale
 
         def one_pt(l2):
-            A = A0 + l2 * jnp.eye(d, dtype=X.dtype)
+            A = A0 + l2 * jnp.eye(d, dtype=jnp.float32)
             coef = jax.scipy.linalg.solve(A, b, assume_a="pos")
             obj = 0.5 * (yy - y0 * y0 - 2.0 * b @ coef + coef @ (A0 @ coef)
                          ) + 0.5 * l2 * jnp.sum(coef * coef)

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..columns import to_device_f32
+from ..columns import device_matrix, to_device_f32
 from .base import PredictionModel, PredictorEstimator
 
 MAX_BINS_DEFAULT = 32
@@ -594,7 +594,7 @@ def fit_forest(X: np.ndarray, y: np.ndarray, *, task: str, n_classes: int,
     lax.map when deep trees would blow HBM)."""
     N, D = X.shape
     splits = build_bin_splits(X, max_bins)
-    Xj = to_device_f32(X)
+    Xj = device_matrix(X)
     B = bin_data(Xj, jnp.asarray(splits))
     w0 = jnp.ones(N, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight)
     yj = jnp.asarray(y, jnp.float32)
@@ -669,7 +669,7 @@ def fit_gbt(X: np.ndarray, y: np.ndarray, *, task: str, n_rounds: int,
     N, D = X.shape
     splits = build_bin_splits(X, max_bins)
     splits_j = jnp.asarray(splits)
-    Xj = to_device_f32(X)
+    Xj = device_matrix(X)
     B = bin_data(Xj, splits_j)
     w0 = jnp.ones(N, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight)
     yj = jnp.asarray(y, jnp.float32)
@@ -980,7 +980,7 @@ class _ForestEstimatorBase(PredictorEstimator):
             impurity = "variance"
             base_stats = jnp.stack([jnp.ones(N), yj, yj * yj], axis=1)
         fold_w = to_device_f32(fold_weights, exact=True)
-        Xj = to_device_f32(X)
+        Xj = device_matrix(X)
         splits_cache: dict = {}
 
         def mval(gi, name, default):
@@ -1113,7 +1113,7 @@ class _GBTEstimatorBase(PredictorEstimator):
             groups[(int(m.get("max_iter", 20)), int(m.get("max_depth", 5)),
                     int(m.get("max_bins", MAX_BINS_DEFAULT)))].append(gi)
 
-        Xj = to_device_f32(X)
+        Xj = device_matrix(X)
         yj = jnp.asarray(y, jnp.float32)
         fold_w = to_device_f32(fold_weights, exact=True)
         fmask = jnp.ones((D,), jnp.float32) > 0

@@ -24,12 +24,21 @@ class VectorsCombiner(Transformer):
         return f"features_{self.uid[-6:]}"
 
     def transform(self, batch: ColumnBatch) -> Column:
+        from ..columns import feature_matrix_dtype
+
+        import jax
+
         arrays, metas = [], []
+        width = 0
         for f in self.input_features:
             col = batch[f.name]
-            v = to_device_f32(col.values)
+            v = col.values
+            if not (isinstance(v, jax.Array)
+                    and v.dtype in (jnp.float32, jnp.bfloat16)):
+                v = to_device_f32(v)
             if v.ndim == 1:
                 v = v[:, None]
+            width += v.shape[1]
             arrays.append(v)
             if col.meta is not None:
                 metas.append(col.meta)
@@ -38,4 +47,7 @@ class VectorsCombiner(Transformer):
                     VectorColumnMeta(f.name, f.kind.__name__)
                     for _ in range(v.shape[1])]))
         meta = VectorMeta.flatten(self.output_name(), metas)
+        n = len(batch)
+        dtype = feature_matrix_dtype(n * width)
+        arrays = [a if a.dtype == dtype else a.astype(dtype) for a in arrays]
         return Column(OPVector, jnp.concatenate(arrays, axis=1), meta=meta)

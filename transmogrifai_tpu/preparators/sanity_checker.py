@@ -34,19 +34,26 @@ DEFAULT_CORRELATION_TYPE = "pearson"
 
 
 @jax.jit
+@jax.jit
 def _col_stats(X: jnp.ndarray, y: jnp.ndarray):
     """Single fused pass: per-column count/mean/var/min/max + Pearson corr with
     the label (≙ Statistics.colStats + computeCorrelationsWithLabel,
-    OpStatistics.scala:71)."""
-    n = X.shape[0]
-    mean = jnp.mean(X, axis=0)
-    var = jnp.var(X, axis=0, ddof=1)
-    mn = jnp.min(X, axis=0)
-    mx = jnp.max(X, axis=0)
-    ym = jnp.mean(y)
-    yc = y - ym
+    OpStatistics.scala:71).
+
+    Jitted so the centred intermediates fuse into the reductions instead of
+    materializing eagerly (an eager pass holds 2-3 full [N, D] temporaries —
+    GBs at transmogrified widths).  ``X`` may arrive in bf16 storage; all
+    accumulation is forced to f32."""
+    Xf = X.astype(jnp.float32)
+    yf = y.astype(jnp.float32)
+    mean = jnp.mean(Xf, axis=0)
+    var = jnp.var(Xf, axis=0, ddof=1)
+    mn = jnp.min(Xf, axis=0)
+    mx = jnp.max(Xf, axis=0)
+    ym = jnp.mean(yf)
+    yc = yf - ym
     ysd = jnp.sqrt(jnp.sum(yc * yc))
-    Xc = X - mean
+    Xc = Xf - mean
     cov = yc @ Xc
     xsd = jnp.sqrt(jnp.sum(Xc * Xc, axis=0))
     corr = cov / jnp.maximum(xsd * ysd, 1e-12)
@@ -174,7 +181,9 @@ class SanityChecker(Estimator):
         # [D]-sized results transfer (≙ colStats on executors)
         Xd = (vals if isinstance(vals, jax.Array)
               else jnp.asarray(np.asarray(vals, np.float32)))
-        if Xd.dtype != jnp.float32:
+        if Xd.dtype not in (jnp.float32, jnp.bfloat16):
+            # bf16 feature-matrix storage passes through untouched — the
+            # jitted stats force f32 accumulation internally
             Xd = Xd.astype(jnp.float32)
         n, d = Xd.shape
         meta = vec.meta or VectorMeta(vec_f.name, [])

@@ -559,9 +559,12 @@ class OpValidator:
                     if is_dev:
                         # gather ONLY the validation slice on device, then
                         # pull — the full matrix is folds-times bigger and
-                        # the link is the bottleneck
+                        # the link is the bottleneck.  Cast bf16-stored
+                        # matrices to f32 on device first: numpy kernels on
+                        # ml_dtypes bf16 are limited/slow on host
                         xv = np.asarray(jnp.take(
-                            X, jnp.asarray(va_idx), axis=0))
+                            X, jnp.asarray(va_idx), axis=0
+                        ).astype(jnp.float32))
                     else:
                         if X_host is None:
                             X_host = np.asarray(X)

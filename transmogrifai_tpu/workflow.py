@@ -234,8 +234,13 @@ class Workflow(_WorkflowCore):
         return model
 
     def _fit_plain(self, batch, dag):
+        from .dag import prune_batch
         fitted_dag = []
-        for layer in dag:
+        # columns that outlive the DAG: raw inputs (label profile, re-scoring),
+        # result outputs (evaluate), and the row key
+        keep = ({f.name for f in self.raw_features}
+                | {f.name for f in self.result_features} | {"key"})
+        for i, layer in enumerate(dag):
             new_layer = []
             for st in layer:
                 if st.uid in self._model_stages:
@@ -244,6 +249,8 @@ class Workflow(_WorkflowCore):
                     new_layer.append(st)
             batch, fitted = fit_layer(batch, new_layer)
             fitted_dag.append(fitted)
+            batch = prune_batch(
+                batch, (s for l in dag[i + 1:] for s in l), keep)
         return batch, fitted_dag
 
     def _fit_with_workflow_cv(self, batch, dag):

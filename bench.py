@@ -1,22 +1,30 @@
-"""Headline benchmark: AutoML ModelSelector CV-grid training wall-clock on a
-HIGGS-like synthetic binary task (BASELINE.md north star).
+"""Headline benchmarks: AutoML ``OpWorkflow.train()`` wall-clock on TPU.
 
-Workload (fixed across rounds for comparability):
-  N=1,000,000 rows x D=28 features (HIGGS dimensionality), 3-fold CV over
-  {4 logistic-regression, 1 random-forest, 1 GBT} candidates through the real
-  Workflow/ModelSelector API, then final refit + train evaluation — i.e. the
-  equivalent of the reference's ``OpWorkflow.train()`` with
-  BinaryClassificationModelSelector (README.md:33-64).
+Two workloads, each printed as ONE JSON line
+``{"metric": ..., "value": seconds, "unit": "s", "vs_baseline": ratio}``:
 
-Prints ONE JSON line:
-  {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": ratio}
+1. **dense** (BASELINE.md north star): N x 28 dense real features at
+   HIGGS-realistic difficulty (best-model AuROC ~0.8, matching real HIGGS —
+   the round-2 synthetic was near-separable at 0.98, which flatters every
+   solver), 3-fold CV over {4 LR, RF, GBT} through the real
+   Workflow/ModelSelector API, then final refit + train evaluation.
+2. **transmog** (the reference's flagship path, Transmogrifier.scala:92 +
+   SmartTextVectorizer.scala:61): N rows of mixed raw types — 3 free-text
+   columns through SmartTextVectorizer's 512-bin hashing path, 2 PickLists
+   through top-K one-hot, a 3-key RealMap expansion, 4 Reals with 20% nulls —
+   with RawFeatureFilter on, into a small LR selector.  Its cost profile
+   (host tokenization/hashing, pivot fits, null tracking) is completely
+   different from the dense path and was unmeasured before round 3.
 
-vs_baseline: ratio of the measured baseline wall to ours (>1 = we are
-faster).  The reference publishes no numbers (BASELINE.md), so the baseline is
-the measured local-proxy wall in BASELINE.json["published"]
-["higgs1m_train_wall_s"] (see BASELINE_MEASURED.json for provenance).  The
-ratio only applies at the full 1M-row workload (accelerator runs); the reduced
-CPU smoke run reports 1.0.
+vs_baseline: ratio of the measured local-proxy wall to ours (>1 = we are
+faster).  The reference publishes no numbers (BASELINE.md); the proxies are
+measured by scripts/measure_baseline.py with the reference's parallelism=8
+honored via a process pool (OpValidator.scala:372-378) and recorded in
+BASELINE_MEASURED.json.  Ratios only apply at the pinned workload sizes on an
+accelerator; reduced CPU smoke runs report 1.0.
+
+Env knobs: BENCH_ROWS (dense rows), BENCH_TRANSMOG_ROWS, BENCH_WORKLOAD
+(dense|transmog|all, default all).
 """
 
 import json
@@ -26,51 +34,122 @@ import time
 
 import numpy as np
 
+DENSE_D = 28
 
-def make_data(n: int, d: int, seed: int = 0):
+
+def make_data(n: int, d: int = DENSE_D, seed: int = 0):
+    """HIGGS-difficulty synthetic: linear signal damped to sqrt(d) scale plus
+    mild interactions, unit noise — best-model AuROC lands near 0.80 like the
+    real HIGGS benchmark (calibrated against sklearn LR/GBT)."""
     rng = np.random.default_rng(seed)
     X = rng.normal(size=(n, d)).astype(np.float32)
-    w = rng.normal(size=d).astype(np.float32)
-    # nonlinear decision surface so trees have something to find
-    logits = X @ w + 0.8 * (X[:, 0] * X[:, 1]) - 0.5 * (X[:, 2] ** 2) + 0.3
+    w = rng.normal(size=d).astype(np.float32) / np.sqrt(d)
+    logits = (X @ w + 0.35 * (X[:, 0] * X[:, 1]) - 0.25 * (X[:, 2] ** 2)
+              + 0.1 + 0.3 * np.sin(2 * X[:, 3]))
     y = (logits + rng.normal(size=n).astype(np.float32) > 0).astype(np.float32)
     return X, y
 
 
-def main():
-    import jax
+def make_transmog_columns(n: int, seed: int = 1):
+    """Mixed-type raw columns for the transmogrification workload.
 
-    platform = jax.devices()[0].platform
-    on_accel = platform not in ("cpu",)
-    N = 1_000_000 if on_accel else 100_000
-    # BENCH_ROWS overrides for scale probes (the headline metric and the
-    # vs_baseline ratio stay pinned to the 1M workload for comparability)
-    rows_env = os.environ.get("BENCH_ROWS", "").strip()
-    if rows_env:
-        try:
-            N = int(float(rows_env))  # accept 4e6-style values
-        except (ValueError, OverflowError):
-            sys.exit(f"BENCH_ROWS={rows_env!r} is not a usable row count")
-        if N < 1000:
-            sys.exit(f"BENCH_ROWS={N} too small (need >= 1000)")
-    D = 28
+    Returns (cols dict for ColumnBatch, schema dict) — built columnar to keep
+    generation out of the measured window.
+    """
+    from transmogrifai_tpu import types as T
+    from transmogrifai_tpu.columns import Column, column_from_values
 
+    rng = np.random.default_rng(seed)
+    vocab = np.asarray([f"tok{i}" for i in range(50_000)])
+    common = np.asarray([f"word{i}" for i in range(40)])
+
+    def text_col(p_null=0.2, lo=4, hi=9):
+        lens = rng.integers(lo, hi, size=n)
+        toks = vocab[rng.integers(0, len(vocab), size=(n, hi))]
+        salt = common[rng.integers(0, len(common), size=(n, 2))]
+        out = np.empty(n, dtype=object)
+        null = rng.random(n) < p_null
+        for i in range(n):
+            if null[i]:
+                out[i] = None
+            else:
+                out[i] = " ".join(np.concatenate([salt[i], toks[i, :lens[i]]]))
+        return out, null
+
+    t1, _ = text_col()
+    t2, _ = text_col()
+    t3, _ = text_col(p_null=0.3, lo=3, hi=6)
+
+    cats1 = np.asarray([f"c{i}" for i in range(20)])
+    cats2 = np.asarray([f"k{i}" for i in range(50)])
+    c1_idx = rng.integers(0, len(cats1), size=n)
+    c1 = cats1[c1_idx].astype(object)
+    c1[rng.random(n) < 0.1] = None
+    c2 = cats2[rng.integers(0, len(cats2), size=n)].astype(object)
+    c2[rng.random(n) < 0.2] = None
+
+    rvals = rng.normal(size=(n, 4)).astype(np.float32)
+    rnull = rng.random((n, 4)) < 0.2
+
+    mvals = rng.normal(size=(n, 3)).astype(np.float32)
+    mkeys = ("a", "b", "c")
+    mpresent = rng.random((n, 3)) < 0.8
+    rmap = np.empty(n, dtype=object)
+    for i in range(n):
+        rmap[i] = {k: float(mvals[i, j]) for j, k in enumerate(mkeys)
+                   if mpresent[i, j]}
+
+    logits = (0.8 * (c1_idx % 3 == 0).astype(np.float32)
+              + np.where(rnull[:, 0], 0.0, rvals[:, 0])
+              + 0.5 * np.where(mpresent[:, 0], mvals[:, 0], 0.0))
+    y = (logits + rng.normal(size=n).astype(np.float32) > 0.4).astype(np.float32)
+
+    cols = {
+        "label": Column(T.RealNN, y),
+        "text1": column_from_values(T.Text, t1),
+        "text2": column_from_values(T.Text, t2),
+        "text3": column_from_values(T.Text, t3),
+        "cat1": column_from_values(T.PickList, c1),
+        "cat2": column_from_values(T.PickList, c2),
+        "rmap": Column(T.RealMap, rmap),
+    }
+    for j in range(4):
+        vals = [None if rnull[i, j] else float(rvals[i, j]) for i in range(n)]
+        cols[f"r{j}"] = column_from_values(T.Real, vals)
+    schema = {"label": T.RealNN, "text1": T.Text, "text2": T.Text,
+              "text3": T.Text, "cat1": T.PickList, "cat2": T.PickList,
+              "rmap": T.RealMap, "r0": T.Real, "r1": T.Real, "r2": T.Real,
+              "r3": T.Real}
+    return cols, schema
+
+
+def _baseline(key):
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BASELINE.json")) as fh:
+            return (json.load(fh).get("published") or {}).get(key)
+    except Exception:
+        return None
+
+
+def run_dense(N: int, on_accel: bool, platform: str):
     from transmogrifai_tpu.columns import Column, ColumnBatch
     from transmogrifai_tpu.evaluators import Evaluators
     from transmogrifai_tpu.features import FeatureBuilder
     from transmogrifai_tpu.models.linear import OpLogisticRegression
-    from transmogrifai_tpu.models.trees import OpGBTClassifier, OpRandomForestClassifier
+    from transmogrifai_tpu.models.trees import (OpGBTClassifier,
+                                                OpRandomForestClassifier)
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
     from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
                                             ModelCandidate, grid)
-    from transmogrifai_tpu.types import OPVector, RealNN
-    from transmogrifai_tpu.vector_meta import VectorColumnMeta, VectorMeta
+    from transmogrifai_tpu.types import RealNN
     from transmogrifai_tpu.workflow import Workflow
 
+    D = DENSE_D
     X, y = make_data(N, D)
 
     label = FeatureBuilder.RealNN("label").as_response()
     feats = [FeatureBuilder.RealNN(f"f{i}").as_predictor() for i in range(D)]
-    from transmogrifai_tpu.ops.transmogrify import transmogrify
     fv = transmogrify(feats)
     checked = label.sanity_check(fv, remove_bad_features=True)
 
@@ -105,20 +184,11 @@ def main():
 
     metrics = model.evaluate(Evaluators.BinaryClassification.auROC(),
                              batch=batch)
-
-    baseline = None
-    try:
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BASELINE.json")) as fh:
-            baseline = (json.load(fh).get("published") or {}).get(
-                "higgs1m_train_wall_s")
-    except Exception:
-        pass
-    # the published baseline was measured at the 1M-row workload; the ratio is
-    # only meaningful for an accelerator run at the same size
-    vs = (baseline / wall) if (baseline and on_accel and N == 1_000_000) else 1.0
-
-    result = {
+    baseline = _baseline("higgs1m_train_wall_s")
+    lpt8 = _baseline("higgs1m_8core_lpt_s")
+    at_ref = on_accel and N == 1_000_000
+    vs = (baseline / wall) if (baseline and at_ref) else 1.0
+    return {
         "metric": f"OpWorkflow.train wall (HIGGS-like {N}x{D}, 3-fold CV, "
                   f"6 candidates, {platform})",
         "value": round(wall, 2),
@@ -130,9 +200,102 @@ def main():
             "rows": N, "features": D, "platform": platform,
             "cv_fits": 3 * 6,
             "cv_fit_rows_per_s": round(3 * 6 * (2 * N / 3) / wall),
+            # the proxy re-scheduled on 8 workers (reference parallelism=8,
+            # hardware this host lacks) — the conservative comparison
+            "vs_baseline_8core_lpt": (round(lpt8 / wall, 3)
+                                      if (lpt8 and at_ref) else None),
         },
     }
-    print(json.dumps(result))
+
+
+def run_transmog(N: int, on_accel: bool, platform: str):
+    from transmogrifai_tpu.columns import ColumnBatch
+    from transmogrifai_tpu.evaluators import Evaluators
+    from transmogrifai_tpu.features import features_from_schema
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                            ModelCandidate, grid)
+    from transmogrifai_tpu.workflow import Workflow
+
+    cols, schema = make_transmog_columns(N)
+    batch = ColumnBatch(cols, N)
+
+    label, predictors = features_from_schema(schema, response="label")
+    fv = transmogrify(predictors)
+    checked = label.sanity_check(fv, remove_bad_features=True)
+    selector = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(),
+                       grid(reg_param=[0.01, 0.1], max_iter=[50]),
+                       "OpLogisticRegression")])
+    selector.set_input(label, checked)
+    pred = selector.get_output()
+
+    wf = (Workflow().set_input_batch(batch).set_result_features(pred)
+          .with_raw_feature_filter(min_fill_rate=0.01))
+
+    t0 = time.time()
+    model = wf.train()
+    wall = time.time() - t0
+
+    metrics = model.evaluate(Evaluators.BinaryClassification.auROC(),
+                             batch=batch)
+    fv_width = None
+    try:
+        # width from the fitted coefficients (the feature matrix itself is
+        # liveness-pruned from the train batch once the selector consumed it)
+        fv_width = int(np.asarray(
+            model.selected_model.best_model.fitted["coef"]).shape[0])
+    except Exception:
+        pass
+    baseline = _baseline("transmog1m_train_wall_s")
+    lpt8 = _baseline("transmog1m_8core_lpt_s")
+    at_ref = on_accel and N == 1_000_000
+    vs = (baseline / wall) if (baseline and at_ref) else 1.0
+    return {
+        "metric": f"OpWorkflow.train wall (transmogrification {N} rows: "
+                  f"3 text->hash512 + 2 picklist + realmap + 4 real w/nulls, "
+                  f"RFF on, {platform})",
+        "value": round(wall, 2),
+        "unit": "s",
+        "vs_baseline": round(vs, 3),
+        "aux": {
+            "train_auroc": round(float(metrics["AuROC"]), 4),
+            "rows": N, "platform": platform,
+            "feature_vector_width": fv_width,
+            "raw_features": len(schema) - 1,
+            "vs_baseline_8core_lpt": (round(lpt8 / wall, 3)
+                                      if (lpt8 and at_ref) else None),
+        },
+    }
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    workload = os.environ.get("BENCH_WORKLOAD", "all").strip() or "all"
+
+    def rows(env, default_accel, default_cpu):
+        v = os.environ.get(env, "").strip()
+        if not v:
+            return default_accel if on_accel else default_cpu
+        try:
+            r = int(float(v))
+        except (ValueError, OverflowError):
+            sys.exit(f"{env}={v!r} is not a usable row count")
+        if r < 1000:
+            sys.exit(f"{env}={r} too small (need >= 1000)")
+        return r
+
+    if workload in ("dense", "all"):
+        print(json.dumps(run_dense(rows("BENCH_ROWS", 1_000_000, 100_000),
+                                   on_accel, platform)), flush=True)
+    if workload in ("transmog", "all"):
+        print(json.dumps(run_transmog(
+            rows("BENCH_TRANSMOG_ROWS", 1_000_000, 20_000),
+            on_accel, platform)), flush=True)
 
 
 if __name__ == "__main__":

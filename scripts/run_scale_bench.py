@@ -1,0 +1,53 @@
+"""Run the dense bench at HIGGS scale points (4M / 8M / 11M — the
+BASELINE.json north star) and record a committed artifact.
+
+Each size runs twice in fresh processes: the first pays any XLA compiles for
+the new shapes ("cold"), the second measures the steady state ("warm").
+Partial results are flushed after every run so a TPU-worker crash still
+leaves an artifact.
+
+Usage: python scripts/run_scale_bench.py [out.json] [sizes...]
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    out_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        ROOT, "BENCH_11M.json")
+    sizes = ([int(float(a)) for a in sys.argv[2:]]
+             or [4_000_000, 8_000_000, 11_000_000])
+    out = {"workload": "dense HIGGS-difficulty (bench.py run_dense)",
+           "runs": []}
+    for n in sizes:
+        for phase in ("cold", "warm"):
+            env = {**os.environ, "BENCH_WORKLOAD": "dense",
+                   "BENCH_ROWS": str(n)}
+            t0 = time.time()
+            p = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
+                               capture_output=True, text=True, env=env,
+                               cwd=ROOT)
+            rec = {"rows": n, "phase": phase, "rc": p.returncode,
+                   "proc_wall_s": round(time.time() - t0, 1)}
+            line = next((ln for ln in reversed(p.stdout.splitlines())
+                         if ln.startswith("{")), None)
+            if line:
+                rec["result"] = json.loads(line)
+            if p.returncode != 0:
+                rec["stderr_tail"] = p.stderr[-2000:]
+            out["runs"].append(rec)
+            with open(out_path, "w") as fh:
+                json.dump(out, fh, indent=2)
+            print(json.dumps(rec), flush=True)
+            if p.returncode != 0:
+                print(f"size {n} {phase} failed; continuing", flush=True)
+
+
+if __name__ == "__main__":
+    main()

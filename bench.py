@@ -167,6 +167,20 @@ def run_dense(N: int, on_accel: bool, platform: str):
                             min_instances_per_node=[10]),
                        "OpGBTClassifier"),
     ]
+    fams = os.environ.get("BENCH_FAMILIES", "").strip()
+    filtered = False
+    if fams:  # debugging knob: e.g. BENCH_FAMILIES=lr,gbt
+        want = {f.strip().lower() for f in fams.split(",") if f.strip()}
+        key = {"OpLogisticRegression": "lr", "OpRandomForestClassifier": "rf",
+               "OpGBTClassifier": "gbt"}
+        unknown = want - set(key.values())
+        if unknown:
+            sys.exit(f"BENCH_FAMILIES: unknown families {sorted(unknown)}; "
+                     f"valid: {sorted(set(key.values()))}")
+        models = [m for m in models if key[m.model_name] in want]
+        if not models:
+            sys.exit("BENCH_FAMILIES selected no candidates")
+        filtered = want != set(key.values())
     selector = BinaryClassificationModelSelector(models=models)
     selector.set_input(label, checked)
     pred = selector.get_output()
@@ -184,13 +198,15 @@ def run_dense(N: int, on_accel: bool, platform: str):
 
     metrics = model.evaluate(Evaluators.BinaryClassification.auROC(),
                              batch=batch)
+    n_cands = sum(len(c.grid) for c in models)
     baseline = _baseline("higgs1m_train_wall_s")
     lpt8 = _baseline("higgs1m_8core_lpt_s")
-    at_ref = on_accel and N == 1_000_000
+    # the published baseline covers the FULL candidate set only
+    at_ref = on_accel and N == 1_000_000 and not filtered
     vs = (baseline / wall) if (baseline and at_ref) else 1.0
     return {
         "metric": f"OpWorkflow.train wall (HIGGS-like {N}x{D}, 3-fold CV, "
-                  f"6 candidates, {platform})",
+                  f"{n_cands} candidates, {platform})",
         "value": round(wall, 2),
         "unit": "s",
         "vs_baseline": round(vs, 3),
@@ -198,8 +214,8 @@ def run_dense(N: int, on_accel: bool, platform: str):
             "train_auroc": round(float(metrics["AuROC"]), 4),
             "best_model": model.selected_model.summary.best_model_name,
             "rows": N, "features": D, "platform": platform,
-            "cv_fits": 3 * 6,
-            "cv_fit_rows_per_s": round(3 * 6 * (2 * N / 3) / wall),
+            "cv_fits": 3 * n_cands,
+            "cv_fit_rows_per_s": round(3 * n_cands * (2 * N / 3) / wall),
             # the proxy re-scheduled on 8 workers (reference parallelism=8,
             # hardware this host lacks) — the conservative comparison
             "vs_baseline_8core_lpt": (round(lpt8 / wall, 3)

@@ -6,7 +6,8 @@ OpRandomForestRegressor.scala, OpGBTRegressor.scala, OpXGBoostClassifier.scala:4
 Architecture (LightGBM-style, built for the MXU/HBM rather than translated
 from Spark's per-partition `findBestSplits`):
 
-* features are quantile-binned once into an int32 matrix ``B [N, D]`` held in
+* features are quantile-binned once into a compact int matrix ``B [N, D]``
+  (int8 when bins fit, else int32) held in
   HBM — every tree/round reuses it;
 * trees grow level-wise with **static shapes**: level ``l`` has ``2^l`` nodes,
   per-(node, feature, bin) statistics are built with ``jax.ops.segment_sum``
@@ -78,8 +79,32 @@ def build_bin_splits(X: np.ndarray, max_bins: int = MAX_BINS_DEFAULT) -> np.ndar
 
 @jax.jit
 def bin_data(X: jnp.ndarray, splits: jnp.ndarray) -> jnp.ndarray:
-    """bin b of x = number of split points < x  → int32 [N, D]."""
-    return jnp.sum(X[:, :, None] > splits[None, :, :], axis=-1).astype(jnp.int32)
+    """bin b of x = number of split points < x  → int32 [N, D].
+
+    Chunked over rows: the one-shot broadcast materializes an [N, D, bins]
+    boolean — ~9.5 GB at 11M x 28 x 31, which hard-faults a 16 GB worker.
+    Row chunks keep the transient under ~1 GB while producing the same
+    device-resident [N, D] result.  Bin ids store as int8 when they fit
+    (max_bins ≤ 127 always holds for the reference's MaxBin=32 default) —
+    the binned matrix and its padded/chunked views are the largest resident
+    tree buffers at 10M+ rows."""
+    n, d = X.shape
+    nb = splits.shape[1]
+    dt = jnp.int8 if nb < 127 else jnp.int32
+    limit = 1 << 28                      # transient bool elements per chunk
+    rows = max(1, limit // max(d * nb, 1))
+    if n <= rows:
+        return jnp.sum(X[:, :, None] > splits[None, :, :],
+                       axis=-1).astype(dt)
+    # lax.map keeps the traced body constant-size regardless of N (a python
+    # loop of slices would grow the HLO linearly with the chunk count)
+    n_blocks = -(-n // rows)
+    pad = n_blocks * rows - n
+    Xp = jnp.pad(X, ((0, pad), (0, 0))).reshape(n_blocks, rows, d)
+    out = jax.lax.map(
+        lambda xb: jnp.sum(xb[:, :, None] > splits[None, :, :],
+                           axis=-1).astype(dt), Xp)
+    return out.reshape(n_blocks * rows, d)[:n]
 
 
 # --------------------------------------------------------------------------
@@ -218,8 +243,11 @@ def _fit_tree_compact(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
         feat_arr, thr_arr, leaf_flag, leaf_val, row_node = carry
         offset = (1 << lvl) - 1                              # traced
         nodes = offset + jnp.arange(P_n, dtype=jnp.int32)
-        oh = (row_node[:, None] == nodes[None, :]).astype(jnp.float32)
-        node_stats = jnp.einsum("np,ns->ps", oh, stats)      # [P_n, S]
+        # routing one-hot in MXU dtype: [N, P_n] is GBs at 10M+ rows and
+        # deep windows; 0/1 is exact in bf16 and both consumers accumulate f32
+        oh = (row_node[:, None] == nodes[None, :]).astype(mxu)
+        node_stats = jnp.einsum("np,ns->ps", oh, stats,
+                                preferred_element_type=jnp.float32)
         lv = leaf_fn(node_stats).astype(jnp.float32)
         leaf_val2 = jax.lax.dynamic_update_slice(leaf_val, lv, (offset, 0))
 
@@ -282,10 +310,11 @@ def _fit_tree_compact(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
         f_of_row = (oh @ best_feat.astype(jnp.float32)).astype(jnp.int32)
         bin_of_row = oh @ best_bin.astype(jnp.float32)
         dead_of_row = oh @ node_is_leaf.astype(jnp.float32)
-        at_level = jnp.sum(oh, axis=1) > 0.5
-        f_oh = (f_of_row[:, None] == jnp.arange(D_pad)[None, :]
-                ).astype(jnp.float32)
-        b_of_row = jnp.einsum("nd,nd->n", f_oh, B_pad.astype(jnp.float32))
+        at_level = jnp.sum(oh.astype(jnp.float32), axis=1) > 0.5
+        # per-row bin of the split feature: a [N] gather beats the [N, D]
+        # one-hot einsum it replaces (two full-matrix f32 transients)
+        b_of_row = jnp.take_along_axis(
+            B_pad, f_of_row[:, None], axis=1)[:, 0].astype(jnp.float32)
         go_right = (b_of_row > bin_of_row).astype(jnp.int32)
         child = 2 * row_node + 1 + go_right
         advance = at_level & (dead_of_row < 0.5)
@@ -304,8 +333,9 @@ def _fit_tree_compact(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
     n_last = 2 ** max_depth
     off = n_last - 1
     nodes = off + jnp.arange(n_last, dtype=jnp.int32)
-    oh = (row_node[:, None] == nodes[None, :]).astype(jnp.float32)
-    node_stats = jnp.einsum("np,ns->ps", oh, stats)
+    oh = (row_node[:, None] == nodes[None, :]).astype(mxu)
+    node_stats = jnp.einsum("np,ns->ps", oh, stats,
+                            preferred_element_type=jnp.float32)
     lv = leaf_fn(node_stats).astype(jnp.float32)
     leaf_val = leaf_val.at[off:].set(lv)
     leaf_flag = leaf_flag.at[off:].set(True)
@@ -323,7 +353,7 @@ def _fit_tree_unrolled(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
                        features_per_node: "Optional[int]" = None) -> TreeArrays:
     """Grow one tree level-wise on binned data.
 
-    B [N, D] int32; stats [N, S] pre-weighted per-row statistics (col 0 must be
+    B [N, D] int (int8/int32 bin ids); stats [N, S] pre-weighted per-row statistics (col 0 must be
     the row weight/count); feature_mask [D] 0/1.  Returns perfect-heap arrays
     with ``T = 2^(max_depth+1) - 1`` nodes.
 
@@ -709,8 +739,12 @@ def _tree_batch_budget(N: int, n_bins: int) -> Tuple[int, int]:
     Measured on v5e at 1Mx28: wide feature chunks with a narrow tree batch
     (chunk=16, batch=4) run ~2.5x faster than narrow chunks with a wide batch
     (2, 8) — fewer scan iterations beat more vmap lanes, and XLA compile time
-    is flat across the grid."""
-    budget = 6 << 30
+    is flat across the grid.  TRANSMOGRIFAI_TREE_BUDGET_GB overrides the
+    histogram budget (smaller = safer on workers that hard-fault under
+    sustained near-capacity HBM pressure at 10M+ rows)."""
+    import os
+    budget = int(float(os.environ.get(
+        "TRANSMOGRIFAI_TREE_BUDGET_GB", 6)) * (1 << 30))
     per_col = max(2 * N, 1)       # bf16 bytes of one [N] column
     p_cols = 256                  # routing matrix P [N, P_n*S] upper bound
     # prefer 4 concurrent lanes at wide chunks; shrink chunk, then lanes

@@ -228,7 +228,10 @@ class HashingVectorizerModel(TransformerModel):
         num_hashes = self.get("num_hashes")
         binary = self.get("binary", False)
         n = len(batch)
-        n_elems = n * num_hashes * len(self.input_features)
+        # output width: shared hash space folds every feature into ONE block
+        width = (num_hashes if self.get("shared_hash_space", False)
+                 else num_hashes * len(self.input_features))
+        n_elems = n * width
         on_device = n_elems >= _DEVICE_ASSEMBLE_ELEMS
         dtype = feature_matrix_dtype(n_elems)
         blocks = []

@@ -759,11 +759,14 @@ _SCRIPTS: Dict[str, Tuple[Tuple[Tuple[int, int], ...], Tuple[str, ...]]] = {
 }
 
 # distinctive Han characters: simplified-only vs traditional-only forms
-# (characters shared by both orthographies carry no signal and are excluded)
+# (characters shared by both orthographies carry no signal and are excluded
+# symmetrically — compute the overlap FIRST so neither set keeps a shared
+# character)
 _HAN_SIMPLIFIED = set("这个们来说时国会学对发经点吗里后见长门问马语书车")
 _HAN_TRADITIONAL = set("這個們來說時國會學對發經點嗎裡後見長門問馬語書車")
-_HAN_TRADITIONAL -= _HAN_SIMPLIFIED
-_HAN_SIMPLIFIED -= _HAN_TRADITIONAL
+_HAN_SHARED = _HAN_SIMPLIFIED & _HAN_TRADITIONAL
+_HAN_SIMPLIFIED -= _HAN_SHARED
+_HAN_TRADITIONAL -= _HAN_SHARED
 
 
 def detectable_languages() -> Tuple[str, ...]:

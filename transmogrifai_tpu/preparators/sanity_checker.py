@@ -34,7 +34,6 @@ DEFAULT_CORRELATION_TYPE = "pearson"
 
 
 @jax.jit
-@jax.jit
 def _col_stats(X: jnp.ndarray, y: jnp.ndarray):
     """Single fused pass: per-column count/mean/var/min/max + Pearson corr with
     the label (≙ Statistics.colStats + computeCorrelationsWithLabel,

@@ -1,0 +1,90 @@
+"""Wrap a third-party estimator into the selector, then explain the model —
+the round-trip the reference does with sparkwrappers + ModelInsights + LOCO
+(≙ helloworld apps + OpPredictorWrapper.scala:67 + ModelInsights.scala:74 +
+RecordInsightsLOCO.scala:100).
+
+Run: python examples/op_custom_model_and_insights.py
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from transmogrifai_tpu.columns import Column, ColumnBatch
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models import wrap_estimator
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.record_insights import RecordInsightsLOCO
+from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                        ModelCandidate, grid)
+from transmogrifai_tpu.types import RealNN
+from transmogrifai_tpu.workflow import Workflow
+
+
+# -- the "third-party" model: plain numpy ridge-scored logistic -------------
+
+def ridge_fit(X, y, sample_weight=None, alpha=1.0):
+    w = sample_weight if sample_weight is not None else np.ones(len(y), np.float32)
+    Xb = np.concatenate([X, np.ones((len(y), 1), np.float32)], axis=1)
+    A = (Xb * w[:, None]).T @ Xb + alpha * np.eye(Xb.shape[1], dtype=np.float32)
+    b = (Xb * w[:, None]).T @ (2.0 * y - 1.0)
+    sol = np.linalg.solve(A, b)
+    return {"coef": sol[:-1].astype(np.float32),
+            "intercept": sol[-1:].astype(np.float32)}
+
+
+def ridge_predict(params, X):
+    margin = X @ params["coef"] + params["intercept"][0]
+    p = 1.0 / (1.0 + np.exp(-np.clip(margin, -30, 30)))
+    return np.stack([1.0 - p, p], axis=1)
+
+
+def main():
+    rng = np.random.default_rng(7)
+    n, d = 2000, 8
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta = rng.normal(size=d).astype(np.float32)
+    y = (X @ beta + 0.5 * rng.normal(size=n) > 0).astype(np.float32)
+
+    label = FeatureBuilder.RealNN("label").as_response()
+    feats = [FeatureBuilder.RealNN(f"f{i}").as_predictor() for i in range(d)]
+    checked = label.sanity_check(transmogrify(feats),
+                                 remove_bad_features=True)
+    selector = BinaryClassificationModelSelector(models=[
+        ModelCandidate(wrap_estimator(ridge_fit, ridge_predict),
+                       grid(alpha=[0.1, 10.0]), "NumpyRidge"),
+        ModelCandidate(OpLogisticRegression(),
+                       grid(reg_param=[0.01, 0.1]), "LR"),
+    ])
+    selector.set_input(label, checked)
+    pred = selector.get_output()
+
+    cols = {"label": Column(RealNN, y)}
+    for i in range(d):
+        cols[f"f{i}"] = Column(RealNN, X[:, i])
+    batch = ColumnBatch(cols, n)
+    model = (Workflow().set_input_batch(batch)
+             .set_result_features(pred).train())
+
+    print(model.summary_pretty())
+    m = model.evaluate(Evaluators.BinaryClassification.auROC(), batch=batch)
+    print(f"\ntrain AuROC: {m['AuROC']:.4f}")
+
+    # per-row explanations on the first rows
+    scored = model.score(keep_intermediate_features=True)
+    loco = RecordInsightsLOCO(model=model.selected_model, top_k=3)
+    loco.set_input(model.selected_model.input_features[1])
+    out = loco.transform(scored)
+    print("\nrow 0 top-3 feature attributions:")
+    for name, payload in out.values[0].items():
+        print(f"  {name}: {json.loads(payload)[0][1]:+.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -192,3 +192,38 @@ def test_data_balancer_already_balanced_is_noop():
     out = b.validation_prepare(batch, "label")
     assert len(out) == 1000                          # untouched
     assert b.summary.info["upSamplingFraction"] == 0.0
+
+
+def test_fit_releases_intermediate_columns():
+    """DAG column liveness (the persist/unpersist analog): after train(), the
+    retained batch holds only raw inputs, result outputs, and the key — the
+    wide intermediate vectors (combiner/checker outputs) are released, which
+    is what keeps two copies of a transmogrified matrix from pinning HBM."""
+    from transmogrifai_tpu.columns import Column, ColumnBatch
+
+    rng = np.random.default_rng(0)
+    n, d = 300, 4
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    label = FeatureBuilder.RealNN("label").as_response()
+    feats = [FeatureBuilder.RealNN(f"f{i}").as_predictor() for i in range(d)]
+    checked = label.sanity_check(transmogrify(feats), remove_bad_features=True)
+    sel = BinaryClassificationModelSelector(models=_lr())
+    sel.set_input(label, checked)
+    pred = sel.get_output()
+    cols = {"label": Column(T.RealNN, y)}
+    for i in range(d):
+        cols[f"f{i}"] = Column(T.RealNN, X[:, i])
+    model = (Workflow().set_input_batch(ColumnBatch(cols, n))
+             .set_result_features(pred).train())
+
+    kept = set(model.train_batch.names())
+    expected = {"label", *(f"f{i}" for i in range(d)), pred.name}
+    assert expected <= kept
+    extras = kept - expected - {"key"}
+    assert not extras, f"intermediates not released: {extras}"
+    # the pruned batch still supports evaluation and re-scoring from raw
+    m = model.evaluate(Evaluators.BinaryClassification.auROC())
+    assert 0.5 <= m["AuROC"] <= 1.0
+    scored = model.score()
+    assert len(scored[pred.name].values["prediction"]) == n

@@ -221,16 +221,27 @@ class SanityChecker(Estimator):
                     groups.setdefault((c.parent_feature_name, c.grouping), []
                                       ).append(c.index)
         y_classes = np.unique(ys_host)
-        yoh = ((ys[:, None] == jnp.asarray(y_classes)[None, :])
-               .astype(jnp.float32) if groups else None)  # [N, C] on device
+        cont_all = None
+        pos_of = {}
+        if groups:
+            # ONE device matmul + pull over the UNION of indicator columns
+            # covers every group's contingency — per-group gathers would pay
+            # a dispatch + stream sync each on high-latency links, and
+            # contracting all D columns would pull width-proportional bytes
+            # (≙ categoricalTests, batched)
+            union = sorted({i for idxs in groups.values() for i in idxs})
+            pos_of = {i: p for p, i in enumerate(union)}
+            yoh = (ys[:, None] == jnp.asarray(y_classes)[None, :]
+                   ).astype(jnp.float32)                 # [N, C] on device
+            cont_all = np.asarray(
+                yoh.T @ Xs[:, jnp.asarray(union)])       # [C, |union|]
         cramers: Dict[str, float] = {}
         group_fail: Dict[int, List[str]] = {}
         max_rule_conf = float(self.get("max_rule_confidence", 1.0))
         min_rule_supp = float(self.get("min_required_rule_support", 1.0))
         contingency_by_group: Dict[str, Dict] = {}
         for (parent, grouping), idxs in groups.items():
-            G = Xs[:, np.asarray(idxs)]                  # [N, k] 0/1 indicators
-            contingency = np.asarray(yoh.T @ G)          # [C, k] — tiny transfer
+            contingency = cont_all[:, [pos_of[i] for i in idxs]]  # [C, k]
             # full contingency panel: Cramér's V + chi2 + PMI/MI + rule
             # confidences (≙ OpStatistics.contingencyStats:300; reference
             # rows=choices so transpose)

@@ -123,6 +123,21 @@ def make_transmog_columns(n: int, seed: int = 1):
     return cols, schema
 
 
+def _phase_split(model):
+    """Host/device phase split from the train PhaseTimer (VERDICT r3 #4):
+    feature_engineering_s = non-selector fit layers, selector_s = the CV
+    grid layer, rff_s = RawFeatureFilter.  Selector wall absorbs queued
+    device work (the in-order stream syncs when metrics are pulled)."""
+    am = getattr(model, "app_metrics", None)
+    if am is None:
+        return {}
+    fe = sum(p.wall_s for p in am.phases if p.name.startswith("fit:"))
+    sel = sum(p.wall_s for p in am.phases if p.name == "selector")
+    rff = sum(p.wall_s for p in am.phases if p.name == "rff")
+    return {"feature_engineering_s": round(fe, 2),
+            "selector_s": round(sel, 2), "rff_s": round(rff, 2)}
+
+
 def _baseline(key):
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -220,6 +235,7 @@ def run_dense(N: int, on_accel: bool, platform: str):
             # hardware this host lacks) — the conservative comparison
             "vs_baseline_8core_lpt": (round(lpt8 / wall, 3)
                                       if (lpt8 and at_ref) else None),
+            **_phase_split(model),
         },
     }
 
@@ -282,6 +298,7 @@ def run_transmog(N: int, on_accel: bool, platform: str):
             "raw_features": len(schema) - 1,
             "vs_baseline_8core_lpt": (round(lpt8 / wall, 3)
                                       if (lpt8 and at_ref) else None),
+            **_phase_split(model),
         },
     }
 

@@ -1,0 +1,165 @@
+// mapprof — one native pass over a numeric-valued map column.
+//
+// The reference expands map features per key on Spark executors
+// (OPMapVectorizer.scala, RawFeatureFilter's PreparedFeatures); here the
+// host-side analog used to walk a million Python dicts once per consumer
+// (RawFeatureFilter ranges + histograms, MapVectorizer fit fills +
+// transform).  This module expands the column ONCE into columnar arrays
+// that every consumer reuses:
+//
+//   expand(maps) -> (keys list[str] first-occurrence order,
+//                    vals float64[N, K]  (NaN where absent/None),
+//                    present uint8[N, K] (value present and not None),
+//                    in_dict int64[K]    (key in dict, even with None value),
+//                    nonempty uint8[N]   (row is a non-empty dict))
+//
+// Only float/int values are supported (bool and everything else raises
+// TypeError — callers fall back to the exact Python path, which treats
+// bools inconsistently across consumers and must stay pinned).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#include <numpy/arrayobject.h>
+
+#include <cmath>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+PyObject* expand(PyObject*, PyObject* args) {
+    PyObject* maps;
+    if (!PyArg_ParseTuple(args, "O", &maps)) return nullptr;
+    PyObject* seq = PySequence_Fast(maps, "maps");
+    if (!seq) return nullptr;
+    const Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+
+    std::unordered_map<std::string, int32_t> key_ids;
+    std::vector<PyObject*> key_objs;             // borrowed
+    std::vector<std::vector<double>> cols;       // NaN-initialized columns
+    std::vector<std::vector<uint8_t>> pres;
+    std::vector<int64_t> in_dict;
+
+    npy_intp dim_n = n;
+    PyArrayObject* nonempty = reinterpret_cast<PyArrayObject*>(
+        PyArray_ZEROS(1, &dim_n, NPY_UINT8, 0));
+    if (!nonempty) { Py_DECREF(seq); return nullptr; }
+    npy_uint8* ne = static_cast<npy_uint8*>(PyArray_DATA(nonempty));
+
+    const double nan = std::nan("");
+    bool fail = false;
+    for (Py_ssize_t i = 0; i < n && !fail; ++i) {
+        PyObject* m = PySequence_Fast_GET_ITEM(seq, i);  // borrowed
+        if (m == Py_None) continue;
+        if (!PyDict_Check(m)) {
+            PyErr_SetString(PyExc_TypeError, "non-dict map value");
+            fail = true;
+            break;
+        }
+        if (PyDict_Size(m) > 0) ne[i] = 1;
+        PyObject *k, *v;
+        Py_ssize_t pos = 0;
+        while (PyDict_Next(m, &pos, &k, &v)) {
+            Py_ssize_t blen;
+            const char* kdata =
+                PyUnicode_Check(k) ? PyUnicode_AsUTF8AndSize(k, &blen)
+                                   : nullptr;
+            if (!kdata) {
+                if (!PyErr_Occurred())
+                    PyErr_SetString(PyExc_TypeError, "non-str map key");
+                fail = true;
+                break;
+            }
+            std::string key(kdata, static_cast<size_t>(blen));
+            auto it = key_ids.find(key);
+            int32_t id;
+            if (it == key_ids.end()) {
+                id = static_cast<int32_t>(key_objs.size());
+                key_ids.emplace(std::move(key), id);
+                key_objs.push_back(k);
+                cols.emplace_back(static_cast<size_t>(n), nan);
+                pres.emplace_back(static_cast<size_t>(n), uint8_t{0});
+                in_dict.push_back(0);
+            } else {
+                id = it->second;
+            }
+            in_dict[id] += 1;
+            if (v == Py_None) continue;
+            double val;
+            if (PyFloat_Check(v)) {
+                val = PyFloat_AS_DOUBLE(v);
+            } else if (PyLong_Check(v) && !PyBool_Check(v)) {
+                val = PyLong_AsDouble(v);
+                if (val == -1.0 && PyErr_Occurred()) { fail = true; break; }
+            } else {
+                PyErr_SetString(PyExc_TypeError, "non-numeric map value");
+                fail = true;
+                break;
+            }
+            cols[id][static_cast<size_t>(i)] = val;
+            pres[id][static_cast<size_t>(i)] = 1;
+        }
+    }
+    Py_DECREF(seq);
+    if (fail) {
+        Py_DECREF(reinterpret_cast<PyObject*>(nonempty));
+        return nullptr;
+    }
+
+    const npy_intp K = static_cast<npy_intp>(key_objs.size());
+    npy_intp dims2[2] = {dim_n, K};
+    PyArrayObject* vals = reinterpret_cast<PyArrayObject*>(
+        PyArray_SimpleNew(2, dims2, NPY_FLOAT64));
+    PyArrayObject* present = reinterpret_cast<PyArrayObject*>(
+        PyArray_SimpleNew(2, dims2, NPY_UINT8));
+    PyArrayObject* indict = reinterpret_cast<PyArrayObject*>(
+        PyArray_SimpleNew(1, &K, NPY_INT64));
+    PyObject* keys = PyList_New(K);
+    if (!vals || !present || !indict || !keys) {
+        Py_XDECREF(reinterpret_cast<PyObject*>(vals));
+        Py_XDECREF(reinterpret_cast<PyObject*>(present));
+        Py_XDECREF(reinterpret_cast<PyObject*>(indict));
+        Py_XDECREF(keys);
+        Py_DECREF(reinterpret_cast<PyObject*>(nonempty));
+        return nullptr;
+    }
+    double* vd = static_cast<double*>(PyArray_DATA(vals));
+    npy_uint8* pd = static_cast<npy_uint8*>(PyArray_DATA(present));
+    for (npy_intp j = 0; j < K; ++j) {
+        const auto& col = cols[static_cast<size_t>(j)];
+        const auto& pr = pres[static_cast<size_t>(j)];
+        for (npy_intp i = 0; i < dim_n; ++i) {
+            vd[i * K + j] = col[static_cast<size_t>(i)];
+            pd[i * K + j] = pr[static_cast<size_t>(i)];
+        }
+        Py_INCREF(key_objs[static_cast<size_t>(j)]);
+        PyList_SET_ITEM(keys, j, key_objs[static_cast<size_t>(j)]);
+    }
+    if (K)
+        memcpy(PyArray_DATA(indict), in_dict.data(),
+               in_dict.size() * sizeof(int64_t));
+    return Py_BuildValue("NNNNN", keys, vals, present, indict, nonempty);
+}
+
+PyMethodDef methods[] = {
+    {"expand", expand, METH_VARARGS,
+     "expand(maps) -> (keys, vals f64[N,K], present u8[N,K], "
+     "in_dict i64[K], nonempty u8[N])"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_mapprof",
+    "One-pass columnar expansion of numeric map columns.", -1, methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__mapprof(void) {
+    import_array();
+    return PyModule_Create(&moduledef);
+}

@@ -1,0 +1,301 @@
+// textprof — ONE native pass over a text column for every host consumer.
+//
+// The transmogrification path used to scan each text column many times:
+// RawFeatureFilter's presence + crc32 value binning (filters.py), the
+// SmartTextVectorizer TextStats fit pass (ops/text.py), and the
+// tokenize+hash transform pass (fasttok.cpp).  Each scan walked a million
+// PyUnicode objects.  This module computes *parameter-free* per-row
+// products in one walk, so callers rebin/reuse without rescanning:
+//
+//   scan(strings) -> dict
+//     null:     uint8[N]   1 where value is None
+//     empty:    uint8[N]   1 where value == "" (present-but-empty: RFF
+//                          counts it as missing, TextStats counts it)
+//     lengths:  int32[N]   code-point length (0 for null)
+//     crc:      uint32[N]  zlib-compatible crc32 of the utf-8 bytes
+//                          (0 for null; rebin with % text_bins)
+//     tok_lens: int32[N]   tokens per row (-1 = non-ASCII row, caller
+//                          splices the Python tokenizer's output)
+//     tok_hash: uint32[T]  full FNV-1a 32-bit per token (rebin with
+//                          % num_hashes for any hash width)
+//     fallback: list[int]  rows with tok_lens == -1
+//
+//   intern(strings, cap) -> (uniq list[str], counts int64[U], codes int32[N])
+//     Value interning in first-occurrence order.  codes: -1 null, -2 value
+//     seen only after the table froze.  cap < 0: exact counting of every
+//     value (OneHotEstimator's Counter).  cap >= 0: the TextStats monoid's
+//     freeze semantics (SmartTextVectorizer.scala:182-230 analog pinned in
+//     ops/text.py TextStats.of_column): once the table holds cap+1 distinct
+//     values ALL counting stops; lengths elsewhere keep accumulating.
+//
+// Tokenization matches ops/text.py exactly for ASCII content (maximal runs
+// of [A-Za-z0-9_'], A-Z lowered before hashing); rows containing non-ASCII
+// bytes defer to the Python tokenizer for unicode case-folding parity.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#include <numpy/arrayobject.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+inline bool is_token_byte(unsigned char c) {
+    return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+           (c >= '0' && c <= '9') || c == '_' || c == '\'';
+}
+
+// zlib-compatible CRC-32 (IEEE 802.3 reflected, init/final 0xFFFFFFFF) —
+// must match Python's zlib.crc32 bit-for-bit (filters._stable_text_bin).
+struct Crc32Table {
+    uint32_t t[256];
+    Crc32Table() {
+        for (uint32_t i = 0; i < 256; ++i) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; ++k)
+                c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+            t[i] = c;
+        }
+    }
+};
+
+uint32_t crc32_of(const char* data, Py_ssize_t n) {
+    static const Crc32Table table;
+    uint32_t c = 0xFFFFFFFFu;
+    for (Py_ssize_t i = 0; i < n; ++i)
+        c = table.t[(c ^ static_cast<unsigned char>(data[i])) & 0xFFu] ^
+            (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+PyObject* scan(PyObject*, PyObject* args) {
+    PyObject* strings;
+    Py_ssize_t min_len = 1;
+    if (!PyArg_ParseTuple(args, "O|n", &strings, &min_len)) return nullptr;
+    PyObject* seq = PySequence_Fast(strings, "strings");
+    if (!seq) return nullptr;
+    const Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+
+    npy_intp dim_n = n;
+    PyArrayObject* nulls = reinterpret_cast<PyArrayObject*>(
+        PyArray_ZEROS(1, &dim_n, NPY_UINT8, 0));
+    PyArrayObject* empty = reinterpret_cast<PyArrayObject*>(
+        PyArray_ZEROS(1, &dim_n, NPY_UINT8, 0));
+    PyArrayObject* lengths = reinterpret_cast<PyArrayObject*>(
+        PyArray_ZEROS(1, &dim_n, NPY_INT32, 0));
+    PyArrayObject* crc = reinterpret_cast<PyArrayObject*>(
+        PyArray_ZEROS(1, &dim_n, NPY_UINT32, 0));
+    PyArrayObject* tok_lens = reinterpret_cast<PyArrayObject*>(
+        PyArray_SimpleNew(1, &dim_n, NPY_INT32));
+    PyObject* fallback = PyList_New(0);
+    if (!nulls || !empty || !lengths || !crc || !tok_lens || !fallback) {
+        Py_XDECREF(reinterpret_cast<PyObject*>(nulls));
+        Py_XDECREF(reinterpret_cast<PyObject*>(empty));
+        Py_XDECREF(reinterpret_cast<PyObject*>(lengths));
+        Py_XDECREF(reinterpret_cast<PyObject*>(crc));
+        Py_XDECREF(reinterpret_cast<PyObject*>(tok_lens));
+        Py_XDECREF(fallback);
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    npy_uint8* nu = static_cast<npy_uint8*>(PyArray_DATA(nulls));
+    npy_uint8* em = static_cast<npy_uint8*>(PyArray_DATA(empty));
+    npy_int32* ln = static_cast<npy_int32*>(PyArray_DATA(lengths));
+    npy_uint32* cr = static_cast<npy_uint32*>(PyArray_DATA(crc));
+    npy_int32* tl = static_cast<npy_int32*>(PyArray_DATA(tok_lens));
+
+    std::vector<npy_uint32> tok_hash;
+    tok_hash.reserve(static_cast<size_t>(n) * 8);
+
+    bool fail = false;
+    for (Py_ssize_t i = 0; i < n && !fail; ++i) {
+        PyObject* s = PySequence_Fast_GET_ITEM(seq, i);  // borrowed
+        if (s == Py_None) {
+            nu[i] = 1;
+            tl[i] = 0;
+            continue;
+        }
+        Py_ssize_t blen;
+        const char* data = PyUnicode_AsUTF8AndSize(s, &blen);
+        if (!data) { fail = true; break; }
+        ln[i] = static_cast<npy_int32>(PyUnicode_GET_LENGTH(s));
+        if (blen == 0) em[i] = 1;
+        cr[i] = crc32_of(data, blen);
+        bool ascii = true;
+        for (Py_ssize_t k = 0; k < blen; ++k)
+            if (static_cast<unsigned char>(data[k]) >= 0x80) {
+                ascii = false;
+                break;
+            }
+        if (!ascii) {
+            tl[i] = -1;
+            PyObject* idx = PyLong_FromSsize_t(i);
+            if (!idx || PyList_Append(fallback, idx) < 0) {
+                Py_XDECREF(idx);
+                fail = true;
+                break;
+            }
+            Py_DECREF(idx);
+            continue;
+        }
+        npy_int32 count = 0;
+        Py_ssize_t k = 0;
+        while (k < blen) {
+            while (k < blen &&
+                   !is_token_byte(static_cast<unsigned char>(data[k])))
+                ++k;
+            Py_ssize_t start = k;
+            uint32_t h = 2166136261u;
+            while (k < blen &&
+                   is_token_byte(static_cast<unsigned char>(data[k]))) {
+                unsigned char c = static_cast<unsigned char>(data[k]);
+                if (c >= 'A' && c <= 'Z') c += 32;  // ASCII lower
+                h = (h ^ c) * 16777619u;
+                ++k;
+            }
+            if (k - start >= min_len && k > start) {
+                tok_hash.push_back(static_cast<npy_uint32>(h));
+                ++count;
+            }
+        }
+        tl[i] = count;
+    }
+    Py_DECREF(seq);
+    if (fail) {
+        Py_DECREF(reinterpret_cast<PyObject*>(nulls));
+        Py_DECREF(reinterpret_cast<PyObject*>(empty));
+        Py_DECREF(reinterpret_cast<PyObject*>(lengths));
+        Py_DECREF(reinterpret_cast<PyObject*>(crc));
+        Py_DECREF(reinterpret_cast<PyObject*>(tok_lens));
+        Py_DECREF(fallback);
+        return nullptr;
+    }
+
+    npy_intp dim_t = static_cast<npy_intp>(tok_hash.size());
+    PyArrayObject* th = reinterpret_cast<PyArrayObject*>(
+        PyArray_SimpleNew(1, &dim_t, NPY_UINT32));
+    if (!th) {
+        Py_DECREF(reinterpret_cast<PyObject*>(nulls));
+        Py_DECREF(reinterpret_cast<PyObject*>(empty));
+        Py_DECREF(reinterpret_cast<PyObject*>(lengths));
+        Py_DECREF(reinterpret_cast<PyObject*>(crc));
+        Py_DECREF(reinterpret_cast<PyObject*>(tok_lens));
+        Py_DECREF(fallback);
+        return nullptr;
+    }
+    if (!tok_hash.empty())
+        memcpy(PyArray_DATA(th), tok_hash.data(),
+               tok_hash.size() * sizeof(npy_uint32));
+
+    return Py_BuildValue("{s:N,s:N,s:N,s:N,s:N,s:N,s:N}",
+                         "null", nulls, "empty", empty, "lengths", lengths,
+                         "crc", crc, "tok_lens", tok_lens, "tok_hash", th,
+                         "fallback", fallback);
+}
+
+PyObject* intern_values(PyObject*, PyObject* args) {
+    PyObject* strings;
+    Py_ssize_t cap = -1;
+    if (!PyArg_ParseTuple(args, "O|n", &strings, &cap)) return nullptr;
+    PyObject* seq = PySequence_Fast(strings, "strings");
+    if (!seq) return nullptr;
+    const Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+
+    npy_intp dim_n = n;
+    PyArrayObject* codes = reinterpret_cast<PyArrayObject*>(
+        PyArray_SimpleNew(1, &dim_n, NPY_INT32));
+    if (!codes) { Py_DECREF(seq); return nullptr; }
+    npy_int32* cd = static_cast<npy_int32*>(PyArray_DATA(codes));
+
+    std::unordered_map<std::string, int32_t> table;
+    std::vector<PyObject*> uniq;         // borrowed refs into seq items
+    std::vector<int64_t> counts;
+    bool fail = false;
+
+    for (Py_ssize_t i = 0; i < n && !fail; ++i) {
+        PyObject* s = PySequence_Fast_GET_ITEM(seq, i);  // borrowed
+        if (s == Py_None) {
+            cd[i] = -1;
+            continue;
+        }
+        Py_ssize_t blen;
+        const char* data = PyUnicode_AsUTF8AndSize(s, &blen);
+        if (!data) { fail = true; break; }
+        // TextStats freeze (ops/text.py of_column pins it): counting —
+        // inserts AND increments of existing keys — happens only while the
+        // table holds <= cap distinct values; the (cap+1)-th value may
+        // still insert, after which every increment stops
+        const bool can_count =
+            cap < 0 || static_cast<Py_ssize_t>(uniq.size()) <= cap;
+        std::string key(data, static_cast<size_t>(blen));
+        auto it = table.find(key);
+        if (it != table.end()) {
+            cd[i] = it->second;
+            if (can_count) counts[it->second] += 1;
+            continue;
+        }
+        if (!can_count) {
+            cd[i] = -2;
+            continue;
+        }
+        int32_t id = static_cast<int32_t>(uniq.size());
+        table.emplace(std::move(key), id);
+        uniq.push_back(s);
+        counts.push_back(1);
+        cd[i] = id;
+    }
+    if (fail) {
+        Py_DECREF(reinterpret_cast<PyObject*>(codes));
+        Py_DECREF(seq);
+        return nullptr;
+    }
+
+    PyObject* uniq_list = PyList_New(static_cast<Py_ssize_t>(uniq.size()));
+    npy_intp dim_u = static_cast<npy_intp>(uniq.size());
+    PyArrayObject* cnts = reinterpret_cast<PyArrayObject*>(
+        PyArray_SimpleNew(1, &dim_u, NPY_INT64));
+    if (!uniq_list || !cnts) {
+        Py_XDECREF(uniq_list);
+        Py_XDECREF(reinterpret_cast<PyObject*>(cnts));
+        Py_DECREF(reinterpret_cast<PyObject*>(codes));
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    for (size_t u = 0; u < uniq.size(); ++u) {
+        Py_INCREF(uniq[u]);
+        PyList_SET_ITEM(uniq_list, static_cast<Py_ssize_t>(u), uniq[u]);
+    }
+    if (!counts.empty())
+        memcpy(PyArray_DATA(cnts), counts.data(),
+               counts.size() * sizeof(int64_t));
+    Py_DECREF(seq);
+    return Py_BuildValue("NNN", uniq_list, cnts, codes);
+}
+
+PyMethodDef methods[] = {
+    {"scan", scan, METH_VARARGS,
+     "scan(strings, min_token_len=1) -> dict of parameter-free per-row "
+     "products (null/empty/lengths/crc/tok_lens/tok_hash/fallback)"},
+    {"intern", intern_values, METH_VARARGS,
+     "intern(strings, cap=-1) -> (uniq, counts int64[U], codes int32[N]); "
+     "cap>=0 applies the TextStats freeze semantics"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_textprof",
+    "One-pass native text column profile.", -1, methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__textprof(void) {
+    import_array();
+    return PyModule_Create(&moduledef);
+}

@@ -224,6 +224,11 @@ def _stable_text_bin(item, text_bins: int) -> int:
 
 def _value_presence(col: Column) -> np.ndarray:
     if col.is_host_object():
+        if is_text_kind(col.kind):
+            # cached one-pass profile (ops/text_profile.py) — the same scan
+            # the vectorizers reuse, so RFF costs no extra column walk
+            from .ops.text_profile import column_profile
+            return column_profile(col).presence
         return np.array([v is not None and v != "" and v != [] and v != {}
                          for v in col.values])
     if col.mask is not None:
@@ -253,6 +258,17 @@ def numeric_ranges(feature: Feature, col: Column
     if is_map_kind(kind):
         from .types import map_value_kind
         if not is_numeric_kind(map_value_kind(kind)):
+            return out
+        from .ops.map_profile import map_expansion
+        exp = map_expansion(col)
+        if exp is not None:
+            # cached one-pass expansion (bool-free: bools fall through to
+            # the Python path below, where rng_of treats them as NaN)
+            for j, k in enumerate(exp.keys):
+                v = exp.vals[:, j]
+                v = v[np.isfinite(v)]
+                if v.size:
+                    out[k] = (float(v.min()), float(v.max()))
             return out
         keys = sorted({k for m in col.values if m for k in m})
         for k in keys:
@@ -299,6 +315,25 @@ def compute_distribution(feature: Feature, col: Column, bins: int,
     if is_map_kind(kind):
         from .types import map_value_kind
         vkind = map_value_kind(kind)
+        exp = None
+        if is_numeric_kind(vkind):
+            from .ops.map_profile import map_expansion
+            exp = map_expansion(col)
+        if exp is not None:
+            idx = exp.key_index()
+            for k in sorted(exp.keys):
+                j = idx[k]
+                sub_present = exp.present[:, j]
+                dist = _histogram_of(exp.vals[:, j], sub_present, vkind,
+                                     bins, text_bins,
+                                     value_range=ranges.get(k))
+                out.append(FeatureDistribution(
+                    feature.name, key=k, count=n,
+                    nulls=int((~sub_present).sum()), distribution=dist))
+            if not exp.keys:
+                out.append(FeatureDistribution(feature.name, count=n, nulls=n,
+                                               distribution=np.zeros(bins)))
+            return out
         keys = sorted({k for m in col.values if m for k in m})
         for k in keys:
             vals = [m.get(k) if m else None for m in col.values]
@@ -314,10 +349,15 @@ def compute_distribution(feature: Feature, col: Column, bins: int,
             out.append(FeatureDistribution(feature.name, count=n, nulls=n,
                                            distribution=np.zeros(bins)))
         return out
-    dist = _histogram_of(list(np.asarray(col.values, dtype=object))
-                         if col.is_host_object() else np.asarray(col.values),
-                         present, kind, bins, text_bins,
-                         value_range=ranges.get(None))
+    if is_text_kind(kind) and col.is_host_object():
+        # hashed whole-value bins straight from the cached column profile
+        from .ops.text_profile import column_profile
+        dist = column_profile(col).crc_hist(text_bins)
+    else:
+        dist = _histogram_of(list(np.asarray(col.values, dtype=object))
+                             if col.is_host_object() else np.asarray(col.values),
+                             present, kind, bins, text_bins,
+                             value_range=ranges.get(None))
     out.append(FeatureDistribution(feature.name, count=n,
                                    nulls=int((~present).sum()),
                                    distribution=dist))

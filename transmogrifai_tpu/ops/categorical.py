@@ -56,6 +56,22 @@ def encode_with_vocab(values: np.ndarray, vocab: Dict[str, int], other_id: int) 
     return out
 
 
+def encode_column(col: Column, vocab: Dict[str, int], other_id: int) -> np.ndarray:
+    """``encode_with_vocab`` through the cached one-pass column profile:
+    the per-row dict probe collapses to one small table lookup over the
+    interned codes (native/textprof.cpp)."""
+    if not col.is_host_object():
+        return encode_with_vocab(_col_strings(col), vocab, other_id)
+    from .text_profile import column_profile
+    iv = column_profile(col).values(-1)
+    if not iv.uniq:    # all-null column
+        return np.full(len(iv.codes), other_id + 1, np.int32)
+    table = np.fromiter((vocab.get(v, other_id) for v in iv.uniq), np.int32,
+                        count=len(iv.uniq))
+    return np.where(iv.codes < 0, np.int32(other_id + 1),
+                    table[np.maximum(iv.codes, 0)]).astype(np.int32)
+
+
 class OneHotModel(TransformerModel):
     out_kind = OPVector
     is_device_op = False  # host vocab lookup, then device one-hot
@@ -67,7 +83,7 @@ class OneHotModel(TransformerModel):
         for f in self.input_features:
             vocab: Dict[str, int] = self.fitted["vocabs"][f.name]
             other_id = len(vocab)
-            ids = encode_with_vocab(_col_strings(batch[f.name]), vocab, other_id)
+            ids = encode_column(batch[f.name], vocab, other_id)
             # full encoding always has [vocab..., OTHER, NULL]; select only the
             # slots this model tracks so columns stay aligned with the meta
             cols = list(range(other_id))
@@ -75,9 +91,15 @@ class OneHotModel(TransformerModel):
                 cols.append(other_id)
             if track_nulls:
                 cols.append(other_id + 1)
-            onehot = (jnp.asarray(ids[:, None] == np.asarray(cols)[None, :],
-                                  jnp.float32) if cols
-                      else jnp.zeros((len(ids), 0), jnp.float32))
+            # ship the narrowest id dtype and expand on DEVICE — a host-built
+            # [N, width] f32 block costs width×4 bytes/row over the slow link
+            if cols:
+                wire = (ids.astype(np.uint8) if other_id + 1 < 256 else ids)
+                onehot = (jnp.asarray(wire).astype(jnp.int32)[:, None]
+                          == jnp.asarray(np.asarray(cols, np.int32))[None, :]
+                          ).astype(jnp.float32)
+            else:
+                onehot = jnp.zeros((len(ids), 0), jnp.float32)
             outs.append(onehot)
         return Column(OPVector, jnp.concatenate(outs, axis=1) if outs else
                       jnp.zeros((len(batch), 0)), meta=self.fitted["meta"])
@@ -101,8 +123,13 @@ class OneHotEstimator(Estimator):
         cols_meta: List[VectorColumnMeta] = []
         top_k, min_support = self.get("top_k"), self.get("min_support")
         for f in self.input_features:
-            strings = _col_strings(batch[f.name])
-            counts = Counter(v for v in strings if v is not None)
+            col = batch[f.name]
+            if col.is_host_object():
+                from .text_profile import column_profile
+                counts = column_profile(col).values(-1).value_counts()
+            else:
+                counts = Counter(
+                    v for v in _col_strings(col) if v is not None)
             top = top_values_by_count(counts, top_k, min_support)
             vocab = {v: i for i, v in enumerate(top)}
             vocabs[f.name] = vocab

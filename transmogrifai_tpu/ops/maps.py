@@ -37,9 +37,12 @@ class MapVectorizerModel(TransformerModel):
 
     def transform(self, batch: ColumnBatch) -> Column:
         (f,) = self.input_features
-        maps = _map_values(batch[f.name])
-        n = len(maps)
+        n = len(batch[f.name])
         vk = map_value_kind(f.kind)
+        maps: List[Dict[str, Any]] = []
+        if not (is_numeric_kind(vk) and not issubclass(vk, Binary)
+                and not issubclass(vk, (Date, DateTime))):
+            maps = _map_values(batch[f.name])
         keys: List[str] = self.fitted["keys"]
         track_nulls = self.get("track_nulls", True)
         blocks: List[np.ndarray] = []
@@ -69,19 +72,49 @@ class MapVectorizerModel(TransformerModel):
                     cols.append((~present).astype(np.float32)[:, None])
                 blocks.append(np.concatenate(cols, axis=1).astype(np.float32))
         elif is_numeric_kind(vk):
+            from .map_profile import map_expansion
             fills = self.fitted["fills"]
-            for k in keys:
-                fill = fills.get(k, 0.0)
-                col = np.zeros((n, 2 if track_nulls else 1), np.float32)
-                for i, m in enumerate(maps):
-                    v = m.get(k)
-                    if v is None:
-                        col[i, 0] = fill
-                        if track_nulls:
-                            col[i, 1] = 1.0
-                    else:
-                        col[i, 0] = float(v)
-                blocks.append(col)
+            exp = map_expansion(batch[f.name])
+            if exp is not None:
+                # cached one-pass columnar expansion, assembled on DEVICE:
+                # the wire carries compact [N, K] values + presence instead
+                # of a host-built [N, K·2] f32 block
+                idx = exp.key_index()
+                K = len(keys)
+                vals_np = np.zeros((n, K), np.float32)
+                pres_np = np.zeros((n, K), np.float32)
+                for jj, k in enumerate(keys):
+                    j = idx.get(k)
+                    if j is not None:
+                        vals_np[:, jj] = exp.vals[:, j]
+                        pres_np[:, jj] = exp.present[:, j]
+                fill_vec = np.asarray([fills.get(k, 0.0) for k in keys],
+                                      np.float32)
+                from ..columns import to_device_f32
+                vd = to_device_f32(vals_np)
+                pd = to_device_f32(pres_np, exact=True)
+                filled = jnp.where(pd > 0, vd, jnp.asarray(fill_vec)[None, :])
+                if track_nulls:
+                    block = jnp.stack([filled, 1.0 - pd], axis=2
+                                      ).reshape(n, 2 * K)
+                else:
+                    block = filled
+                blocks.append(block)
+            else:
+                if not maps:
+                    maps = _map_values(batch[f.name])
+                for k in keys:
+                    fill = fills.get(k, 0.0)
+                    col = np.zeros((n, 2 if track_nulls else 1), np.float32)
+                    for i, m in enumerate(maps):
+                        v = m.get(k)
+                        if v is None:
+                            col[i, 0] = fill
+                            if track_nulls:
+                                col[i, 1] = 1.0
+                        else:
+                            col[i, 0] = float(v)
+                    blocks.append(col)
         elif issubclass(vk, MultiPickList):
             vocabs = self.fitted["vocabs"]
             for k in keys:
@@ -128,6 +161,11 @@ class MapVectorizerModel(TransformerModel):
                         j = vocab.get(str(v), len(vocab))
                         col[i, j] = 1.0
                 blocks.append(col)
+        import jax
+        if any(isinstance(b, jax.Array) for b in blocks):
+            arr = (blocks[0] if len(blocks) == 1 else
+                   jnp.concatenate([jnp.asarray(b) for b in blocks], axis=1))
+            return Column(OPVector, arr, meta=self.fitted["meta"])
         arr = (np.concatenate(blocks, axis=1) if blocks
                else np.zeros((n, 0), np.float32))
         return Column(OPVector, jnp.asarray(arr), meta=self.fitted["meta"])
@@ -148,14 +186,29 @@ class MapVectorizer(Estimator):
 
     def fit(self, batch: ColumnBatch) -> TransformerModel:
         (f,) = self.input_features
-        maps = _map_values(batch[f.name])
         vk = map_value_kind(f.kind)
-        key_counts: Counter = Counter()
-        for m in maps:
-            key_counts.update(m.keys())
+        exp = None
+        numeric_plain = (is_numeric_kind(vk) and not issubclass(vk, Binary)
+                         and not issubclass(vk, (Date, DateTime)))
+        if numeric_plain:
+            from .map_profile import map_expansion
+            exp = map_expansion(batch[f.name])
+        maps = [] if exp is not None else _map_values(batch[f.name])
         allow = self.get("allow_list")
         block = set(self.get("block_list") or ())
-        keys = sorted(k for k, _ in key_counts.most_common(self.get("max_keys"))
+        if exp is not None:
+            # in_dict replicates Counter(m.keys()); most_common's stable
+            # descending order = sort by (-count, first-occurrence)
+            order = sorted(range(len(exp.keys)),
+                           key=lambda j: (-int(exp.in_dict[j]), j))
+            top = [exp.keys[j] for j in order[:self.get("max_keys")]]
+        else:
+            key_counts: Counter = Counter()
+            for m in maps:
+                key_counts.update(m.keys())
+            top = [k for k, _ in
+                   key_counts.most_common(self.get("max_keys"))]
+        keys = sorted(k for k in top
                       if (allow is None or k in allow) and k not in block)
         fitted: Dict[str, Any] = {"keys": keys}
         cols_meta: List[VectorColumnMeta] = []
@@ -181,9 +234,17 @@ class MapVectorizer(Estimator):
                         f.name, kindname, grouping=k, indicator_value=NULL_INDICATOR))
         elif is_numeric_kind(vk):
             fills: Dict[str, float] = {}
+            idx = exp.key_index() if exp is not None else {}
             for k in keys:
-                vals = [float(m[k]) for m in maps if m.get(k) is not None]
-                fills[k] = float(np.mean(vals)) if vals else 0.0
+                if exp is not None:
+                    j = idx.get(k)
+                    pres = (exp.present[:, j] if j is not None
+                            else np.zeros(0, bool))
+                    fills[k] = (float(exp.vals[pres, j].mean())
+                                if j is not None and pres.any() else 0.0)
+                else:
+                    vals = [float(m[k]) for m in maps if m.get(k) is not None]
+                    fills[k] = float(np.mean(vals)) if vals else 0.0
                 cols_meta.append(VectorColumnMeta(f.name, kindname, grouping=k))
                 if tn:
                     cols_meta.append(VectorColumnMeta(

@@ -21,12 +21,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..columns import Column, ColumnBatch, indicator_2d
+from ..columns import Column, ColumnBatch
 from ..stages.base import Estimator, Transformer, TransformerModel
 from ..types import OPVector, Real, Text, TextList
 from ..vector_meta import (NULL_INDICATOR, OTHER_INDICATOR, VectorColumnMeta,
                            VectorMeta)
-from .categorical import _col_strings, encode_with_vocab, top_values_by_count
+from .categorical import _col_strings, top_values_by_count
 
 _TOKEN_RE = re.compile(r"[A-Za-z0-9_']+")
 
@@ -139,6 +139,45 @@ def _scatter_counts_device(ids, lens_padded, n, num_hashes, binary):
     return (counts > 0).astype(jnp.float32) if binary else counts
 
 
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _scatter_counts_packed(words, lens_padded, n, num_hashes, binary):
+    """Packed-wire variant: each int32 word carries THREE 10-bit bucket ids
+    (token order preserved), tripling the effective host-link bandwidth of
+    the hashing trick — the ids unpack with two shifts on device."""
+    ids = jnp.stack([words & 0x3FF, (words >> 10) & 0x3FF,
+                     (words >> 20) & 0x3FF], axis=1).reshape(-1)
+    rows = jnp.repeat(jnp.arange(n + 1), lens_padded,
+                      total_repeat_length=ids.shape[0])
+    counts = jnp.zeros((n + 1, num_hashes + 1), jnp.float32)
+    counts = counts.at[rows, ids].add(1.0)
+    counts = counts[:n, :num_hashes]
+    return (counts > 0).astype(jnp.float32) if binary else counts
+
+
+def _size_class(n: int, floor: int = 1024) -> int:
+    """Smallest {2^k, 1.5·2^k} >= n — tighter than pure powers of two (max
+    33% padding instead of 100%) while keeping the jit-recompile count
+    bounded at two shapes per octave."""
+    if n <= floor:
+        return floor
+    k = int(np.ceil(np.log2(n)))
+    for cap in ((1 << (k - 1)) + (1 << (k - 2)), 1 << k):
+        if cap >= n:
+            return cap
+    return 1 << k
+
+
+def _pack_ids3(flat: np.ndarray, num_hashes: int) -> np.ndarray:
+    """Bucket ids (< 1024) → int32 words of three 10-bit lanes, padded with
+    the sentinel bin ``num_hashes`` to a full final word."""
+    total = int(flat.size)
+    w = (total + 2) // 3
+    ids = np.full(3 * w, num_hashes, dtype=np.int32)
+    ids[:total] = flat
+    return (ids[0::3] | (ids[1::3] << 10) | (ids[2::3] << 20)).astype(
+        np.int32)
+
+
 def hash_counts_on_device(token_lists: Sequence[Sequence[str]],
                           num_hashes: int, binary: bool = False,
                           dtype=None):
@@ -154,16 +193,34 @@ def hash_counts_on_device(token_lists: Sequence[Sequence[str]],
 
 def device_counts_from_flat(lens: np.ndarray, flat: np.ndarray,
                             num_hashes: int, binary: bool = False,
-                            dtype=None):
+                            dtype=None, device_ids=None):
     n = len(lens)
     total = int(flat.size)
-    cap = 1 << max(10, int(np.ceil(np.log2(max(total, 1)))))
-    ids_p = np.full(cap, num_hashes, dtype=np.int32)     # sentinel bin
-    ids_p[:total] = flat
-    lens_p = np.append(lens, np.int32(cap - total)).astype(np.int32)
-    out = _scatter_counts_device(jnp.asarray(ids_p), jnp.asarray(lens_p),
-                                 n, num_hashes, bool(binary))
+    if num_hashes < 1024:
+        # packed wire: 3 ids per int32 word (sentinel bin fits 10 bits)
+        if device_ids is None:
+            words = _pack_ids3(flat, num_hashes)
+            cap = _size_class(words.size)
+            words_p = np.full(cap, _sentinel3(num_hashes), dtype=np.int32)
+            words_p[:words.size] = words
+            device_ids = jnp.asarray(words_p)
+        cap = int(device_ids.shape[0])
+        lens_p = np.append(lens, np.int32(3 * cap - total)).astype(np.int32)
+        out = _scatter_counts_packed(device_ids, jnp.asarray(lens_p),
+                                     n, num_hashes, bool(binary))
+    else:
+        cap = 1 << max(10, int(np.ceil(np.log2(max(total, 1)))))
+        ids_p = np.full(cap, num_hashes, dtype=np.int32)     # sentinel bin
+        ids_p[:total] = flat
+        lens_p = np.append(lens, np.int32(cap - total)).astype(np.int32)
+        out = _scatter_counts_device(jnp.asarray(ids_p), jnp.asarray(lens_p),
+                                     n, num_hashes, bool(binary))
     return out if dtype is None or out.dtype == dtype else out.astype(dtype)
+
+
+def _sentinel3(num_hashes: int) -> np.int32:
+    """An int32 word whose three 10-bit lanes all hold the sentinel bin."""
+    return np.int32(num_hashes | (num_hashes << 10) | (num_hashes << 20))
 
 
 # device assembly kicks in when the dense block would exceed this many
@@ -173,13 +230,10 @@ _DEVICE_ASSEMBLE_ELEMS = 1 << 22
 
 
 def _one_hot_on_device(ids: np.ndarray, width: int, dtype=jnp.float32):
-    idsd = jnp.asarray(ids.astype(np.int32))
+    # narrowest wire dtype — the host link, not the expand, is the cost
+    wire = ids.astype(np.uint8) if width < 256 else ids.astype(np.int32)
+    idsd = jnp.asarray(wire).astype(jnp.int32)
     return (idsd[:, None] == jnp.arange(width)[None, :]).astype(dtype)
-
-
-def _indicator_on_device(flags, dtype=jnp.float32) -> Any:
-    arr = np.fromiter((bool(v) for v in flags), np.bool_)
-    return jnp.asarray(arr)[:, None].astype(dtype)
 
 
 class TextTokenizer(Transformer):
@@ -242,8 +296,14 @@ class HashingVectorizerModel(TransformerModel):
                 lens, flat = hash_tokens_flat(
                     [v or [] for v in col.values], num_hashes)
             else:
-                lens, flat = strings_to_hash_flat(_col_strings(col),
-                                                  num_hashes)
+                from .text_profile import column_profile
+                prof = column_profile(col)
+                lens, flat = prof.buckets(num_hashes)
+                if on_device:
+                    blocks.append(device_counts_from_flat(
+                        lens, flat, num_hashes, binary=binary, dtype=dtype,
+                        device_ids=prof.device_ids(num_hashes)))
+                    continue
             blocks.append(
                 device_counts_from_flat(lens, flat, num_hashes,
                                         binary=binary, dtype=dtype)
@@ -334,6 +394,7 @@ class SmartTextVectorizerModel(TransformerModel):
 
     def transform(self, batch: ColumnBatch) -> Column:
         from ..columns import feature_matrix_dtype
+        from .text_profile import column_profile
 
         num_hashes = self.get("num_hashes")
         n = len(batch)
@@ -346,11 +407,12 @@ class SmartTextVectorizerModel(TransformerModel):
         blocks = []
         for f in self.input_features:
             strat = strategies[f.name]
-            strings = _col_strings(batch[f.name])
+            prof = column_profile(batch[f.name])
             if strat == "pivot":
+                from .categorical import encode_column
                 vocab = self.fitted["vocabs"][f.name]
                 other = len(vocab)
-                ids = encode_with_vocab(strings, vocab, other)
+                ids = encode_column(batch[f.name], vocab, other)
                 width = other + 2  # OTHER + null
                 blocks.append(
                     _one_hot_on_device(ids, width, dtype) if on_device else
@@ -358,24 +420,25 @@ class SmartTextVectorizerModel(TransformerModel):
                                np.float32))
             elif strat == "ignore":
                 if self.get("track_nulls", True):
-                    flags = [s is None for s in strings]
                     blocks.append(
-                        _indicator_on_device(flags, dtype) if on_device
-                        else indicator_2d(flags))
+                        jnp.asarray(prof.null)[:, None].astype(dtype)
+                        if on_device else
+                        prof.null.astype(np.float32)[:, None])
             else:  # hash
-                lens, flat = strings_to_hash_flat(strings, num_hashes)
+                lens, flat = prof.buckets(num_hashes)
                 if on_device:
-                    h = device_counts_from_flat(lens, flat, num_hashes,
-                                                dtype=dtype)
+                    h = device_counts_from_flat(
+                        lens, flat, num_hashes, dtype=dtype,
+                        device_ids=prof.device_ids(num_hashes))
                     if self.get("track_nulls", True):
                         h = jnp.concatenate(
-                            [h, _indicator_on_device(
-                                (s is None for s in strings), dtype)], axis=1)
+                            [h, jnp.asarray(prof.null)[:, None].astype(dtype)],
+                            axis=1)
                 else:
                     h = _counts_from_flat(lens, flat, num_hashes, False)
                     if self.get("track_nulls", True):
-                        nulls = indicator_2d(s is None for s in strings)
-                        h = np.concatenate([h, nulls], axis=1)
+                        h = np.concatenate(
+                            [h, prof.null.astype(np.float32)[:, None]], axis=1)
                 blocks.append(h)
         if on_device and blocks:
             return Column(OPVector, jnp.concatenate(blocks, axis=1),
@@ -405,13 +468,21 @@ class SmartTextVectorizer(Estimator):
                          min_length_std_dev=min_length_std_dev, **params)
 
     def fit(self, batch: ColumnBatch) -> TransformerModel:
+        from collections import Counter
+
+        from .text_profile import column_profile
+
         strategies: Dict[str, str] = {}
         vocabs: Dict[str, Dict[str, int]] = {}
         cols_meta: List[VectorColumnMeta] = []
         max_card = self.get("max_cardinality")
         for f in self.input_features:
-            strings = _col_strings(batch[f.name])
-            stats = TextStats.of_column(strings, max_card)
+            # ONE cached native pass serves the TextStats fit reduction, the
+            # transform's tokenize+hash, and RawFeatureFilter's stats
+            prof = column_profile(batch[f.name])
+            iv = prof.values(max_card)
+            stats = TextStats(Counter(iv.value_counts()),
+                              Counter(prof.length_counts()))
             if stats.cardinality <= max_card:
                 # card <= maxCardinality -> pivot (the reference pivots even
                 # single-value columns; SmartTextVectorizer.scala:92-96)

@@ -1,0 +1,254 @@
+"""One-pass text column profile shared by every host consumer of a text
+column (reference parity targets: RawFeatureFilter's presence + hashed value
+distribution RawFeatureFilter.scala:137, SmartTextVectorizer's TextStats fit
+pass SmartTextVectorizer.scala:80-123, OpHashingTF's tokenize+hash transform).
+
+The transmogrification hot path used to rescan each text column once per
+consumer — a Python-object walk over millions of cells each time.  Here ONE
+native pass (native/textprof.cpp) computes *parameter-free* per-row
+products, cached on the Column instance:
+
+* ``null``/``empty``/``lengths``  — presence + TextStats length stats
+* ``crc``      — full zlib crc32 per value; rebin with ``% text_bins`` for
+  any RawFeatureFilter configuration
+* ``tok_lens``/``tok_hash`` — tokens per row + full 32-bit FNV-1a per
+  token; rebucket with ``% num_hashes`` for any hash width
+
+Value interning (``values(cap)``) is the only cap-dependent product and is
+cached per cap.  All consumers fall back to pure Python when the native
+toolchain is absent — identical results, slower.
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class InternedValues:
+    """First-occurrence-ordered distinct values with counts and row codes.
+
+    ``codes``: -1 null, -2 seen only after the freeze cap, else index into
+    ``uniq``.  ``frozen`` is True when the TextStats freeze engaged (counts
+    stopped accumulating; ``uniq`` holds cap+1 values).
+    """
+
+    uniq: List[str]
+    counts: np.ndarray       # int64[U]
+    codes: np.ndarray        # int32[N]
+    cap: int
+    frozen: bool
+
+    def value_counts(self) -> Dict[str, int]:
+        return {v: int(c) for v, c in zip(self.uniq, self.counts)}
+
+
+@dataclass
+class TextProfile:
+    null: np.ndarray         # bool[N]
+    empty: np.ndarray        # bool[N]
+    lengths: np.ndarray      # int32[N] (code points; 0 for null)
+    crc: np.ndarray          # uint32[N] (0 for null)
+    tok_lens: np.ndarray     # int32[N]
+    tok_hash: np.ndarray     # uint32[total] full FNV-1a per token
+    _interned: Dict[int, InternedValues] = field(default_factory=dict)
+    _strings: Optional[np.ndarray] = None   # kept for lazy interning
+    _device_packed: Dict[int, object] = field(default_factory=dict)
+
+    @property
+    def presence(self) -> np.ndarray:
+        """Present = non-null and non-empty (filters._value_presence)."""
+        return ~(self.null | self.empty)
+
+    def crc_hist(self, text_bins: int) -> np.ndarray:
+        """Hashed whole-value distribution over present rows — exactly
+        filters._histogram_of's text branch (crc32 % text_bins)."""
+        bins = (self.crc[self.presence] % np.uint32(text_bins)).astype(
+            np.int64)
+        return np.bincount(bins, minlength=text_bins).astype(np.float64)
+
+    def length_counts(self) -> Dict[int, int]:
+        """≙ TextStats.length_counts (lengths of all non-null values)."""
+        ls = self.lengths[~self.null]
+        if not ls.size:
+            return {}
+        uniq, cnt = np.unique(ls, return_counts=True)
+        return {int(l): int(c) for l, c in zip(uniq, cnt)}
+
+    def buckets(self, num_hashes: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(lens int32[N], flat bucket ids int32[total]) for the hashing
+        trick at any ``num_hashes`` — one modulo over the cached full
+        hashes instead of a re-tokenize."""
+        return (self.tok_lens,
+                (self.tok_hash % np.uint32(num_hashes)).astype(np.int32))
+
+    def device_ids(self, num_hashes: int):
+        """Packed token-bucket ids resident on device (3 × 10-bit ids per
+        int32 word; ops/text.py pack/scatter pair), cached per hash width.
+        ``prefetch`` starts the async host→device transfer early so the
+        slow link overlaps RFF/fit host work instead of serializing after
+        it.  None when the width needs the unpacked path."""
+        if num_hashes >= 1024:
+            return None
+        dev = self._device_packed.get(num_hashes)
+        if dev is None:
+            import jax
+
+            from .text import _pack_ids3, _sentinel3, _size_class
+            _, flat = self.buckets(num_hashes)
+            words = _pack_ids3(flat, num_hashes)
+            cap = _size_class(words.size)
+            wp = np.full(cap, _sentinel3(num_hashes), np.int32)
+            wp[:words.size] = words
+            dev = jax.device_put(wp)      # async; consumers queue on it
+            self._device_packed[num_hashes] = dev
+        return dev
+
+    def prefetch(self, num_hashes: int) -> None:
+        try:
+            self.device_ids(num_hashes)
+        except Exception:  # pragma: no cover — prefetch is best-effort
+            pass
+
+    def values(self, cap: int = -1) -> InternedValues:
+        """Interned distinct values; ``cap`` >= 0 applies the TextStats
+        freeze semantics (ops/text.py TextStats.of_column), cap < 0 counts
+        exactly (OneHotEstimator's Counter).
+
+        A cached interning is reused across cap requests whenever the
+        results are provably identical: a non-frozen capped run equals the
+        exact run, and an exact run with U distinct values equals any
+        capped run with cap >= U (the freeze never engages)."""
+        if cap in self._interned:
+            return self._interned[cap]
+        for iv in self._interned.values():
+            if not iv.frozen and (cap < 0 or len(iv.uniq) <= cap):
+                return iv
+        self._interned[cap] = _intern(self._strings, cap)
+        return self._interned[cap]
+
+
+def _py_scan(strings: Sequence, min_token_len: int = 1) -> TextProfile:
+    """Pure-Python scan — same products as native/textprof.cpp scan()."""
+    from .text import fnv1a_32, tokenize_text
+
+    n = len(strings)
+    null = np.zeros(n, bool)
+    empty = np.zeros(n, bool)
+    lengths = np.zeros(n, np.int32)
+    crc = np.zeros(n, np.uint32)
+    tok_lens = np.zeros(n, np.int32)
+    hashes: List[int] = []
+    for i, s in enumerate(strings):
+        if s is None:
+            null[i] = True
+            continue
+        lengths[i] = len(s)
+        b = s.encode("utf-8")
+        if not b:
+            empty[i] = True
+        crc[i] = zlib.crc32(b)
+        toks = tokenize_text(s, min_token_len)
+        tok_lens[i] = len(toks)
+        hashes.extend(fnv1a_32(t) for t in toks)
+    return TextProfile(null, empty, lengths, crc, tok_lens,
+                       np.asarray(hashes, np.uint32))
+
+
+def _py_intern(strings: Sequence, cap: int) -> InternedValues:
+    table: Dict[str, int] = {}
+    uniq: List[str] = []
+    counts: List[int] = []
+    codes = np.empty(len(strings), np.int32)
+    for i, s in enumerate(strings):
+        if s is None:
+            codes[i] = -1
+            continue
+        # TextStats freeze (of_column): counting — inserts and increments
+        # alike — happens only while the table holds <= cap distinct values
+        can_count = cap < 0 or len(uniq) <= cap
+        j = table.get(s)
+        if j is not None:
+            codes[i] = j
+            if can_count:
+                counts[j] += 1
+            continue
+        if not can_count:
+            codes[i] = -2
+            continue
+        j = len(uniq)
+        table[s] = j
+        uniq.append(s)
+        counts.append(1)
+        codes[i] = j
+    return InternedValues(uniq, np.asarray(counts, np.int64), codes, cap,
+                          frozen=cap >= 0 and len(uniq) > cap)
+
+
+def _intern(strings, cap: int) -> InternedValues:
+    from ..native import load
+
+    native = load("textprof")
+    if native is None:
+        return _py_intern(strings, cap)
+    uniq, counts, codes = native.intern(list(strings), cap)
+    return InternedValues(list(uniq), counts, codes, cap,
+                          frozen=cap >= 0 and len(uniq) > cap)
+
+
+def scan_strings(strings, min_token_len: int = 1) -> TextProfile:
+    """Profile a string sequence (native pass when available)."""
+    from ..native import load
+    from .text import fnv1a_32, tokenize_text
+
+    native = load("textprof")
+    if native is None:
+        prof = _py_scan(strings, min_token_len)
+    else:
+        d = native.scan(list(strings), min_token_len)
+        lens = d["tok_lens"]
+        hashes = d["tok_hash"]
+        fallback = d["fallback"]
+        if fallback:
+            # non-ASCII rows: splice the Python tokenizer's hashes in place
+            # for exact unicode case-folding parity
+            fb = {i: np.asarray(
+                [fnv1a_32(t) for t in tokenize_text(strings[i],
+                                                    min_token_len)],
+                np.uint32) for i in fallback}
+            out_lens = lens.copy()
+            pieces: List[np.ndarray] = []
+            pos = 0
+            for i, L in enumerate(lens):
+                if L < 0:
+                    out_lens[i] = len(fb[i])
+                    pieces.append(fb[i])
+                elif L:
+                    pieces.append(hashes[pos:pos + L])
+                    pos += L
+            hashes = (np.concatenate(pieces).astype(np.uint32) if pieces
+                      else np.zeros(0, np.uint32))
+            lens = out_lens
+        prof = TextProfile(d["null"].astype(bool), d["empty"].astype(bool),
+                           d["lengths"], d["crc"], lens, hashes)
+    prof._strings = strings if isinstance(strings, np.ndarray) \
+        else np.asarray(list(strings), dtype=object)
+    return prof
+
+
+def column_profile(col) -> TextProfile:
+    """Profile of a text-kind Column, computed once and cached on the
+    instance (Columns are immutable throughout the framework)."""
+    prof = getattr(col, "_text_profile", None)
+    if prof is None:
+        from .categorical import _col_strings
+        prof = scan_strings(_col_strings(col))
+        try:
+            object.__setattr__(col, "_text_profile", prof)
+        except Exception:  # pragma: no cover — exotic column subtype
+            pass
+    return prof

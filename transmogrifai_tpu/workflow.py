@@ -200,25 +200,31 @@ class Workflow(_WorkflowCore):
     # -- training ----------------------------------------------------------
     def train(self) -> "WorkflowModel":
         """≙ OpWorkflow.train:344."""
+        from .profiling import PhaseTimer
         from .sanitizer import (audit_dag_purity, audit_stage_serialization,
                                 nan_guard)
 
+        timer = PhaseTimer()
         batch = self.generate_raw_data()
+        self._prefetch_text_profiles(batch)
         rff_results = None
         if self._raw_feature_filter is not None:
-            batch, dropped, rff_results = self._raw_feature_filter.filter_batch(
-                batch, self.raw_features)
-            self.blacklisted = dropped
-            self._apply_blacklist()
+            with timer.phase("rff"):
+                batch, dropped, rff_results = \
+                    self._raw_feature_filter.filter_batch(
+                        batch, self.raw_features)
+                self.blacklisted = dropped
+                self._apply_blacklist()
         dag = compute_dag(self.result_features)
         if self._sanitizers.get("serialization"):
             audit_stage_serialization(dag_stages(dag))
         raw_batch = batch if self._sanitizers.get("purity") else None
         with nan_guard(self._sanitizers.get("nan", False)):
             if self._workflow_cv:
-                batch, fitted_dag = self._fit_with_workflow_cv(batch, dag)
+                batch, fitted_dag = self._fit_with_workflow_cv(batch, dag,
+                                                               timer)
             else:
-                batch, fitted_dag = self._fit_plain(batch, dag)
+                batch, fitted_dag = self._fit_plain(batch, dag, timer)
         if raw_batch is not None:
             audit_dag_purity(fitted_dag, raw_batch)
         model = WorkflowModel(
@@ -231,10 +237,47 @@ class Workflow(_WorkflowCore):
         model.reader = self.reader
         model._input_batch = self._input_batch
         model.train_batch = batch
+        model.app_metrics = timer.app_metrics("train")
         return model
 
-    def _fit_plain(self, batch, dag):
+    def _prefetch_text_profiles(self, batch) -> None:
+        """Profile text columns feeding hashing vectorizers ONCE, up front,
+        and start the async host→device transfer of their packed token ids —
+        the slow host link then overlaps RawFeatureFilter + fit host work
+        instead of serializing after it (the TPU analog of the reference
+        keeping tokenization on executors, SmartTextVectorizer.scala:80).
+        Large batches only: tiny workflows would pay dispatch latency for
+        nothing."""
+        if len(batch) < 100_000:
+            return
+        from .ops.text import HashingVectorizer, SmartTextVectorizer
+        try:
+            for st in dag_stages(compute_dag(self.result_features)):
+                if not isinstance(st, (SmartTextVectorizer,
+                                       HashingVectorizer)):
+                    continue
+                num_hashes = int(st.get("num_hashes") or 0)
+                for f in st.input_features:
+                    col = batch.get(f.name)
+                    if col is None or not col.is_host_object():
+                        continue
+                    vals = col.values
+                    if len(vals) and not isinstance(
+                            next((v for v in vals if v is not None), ""),
+                            str):
+                        continue    # token lists take the legacy path
+                    from .ops.text_profile import column_profile
+                    prof = column_profile(col)
+                    if num_hashes:
+                        prof.prefetch(num_hashes)
+        except Exception:  # noqa: BLE001 — prefetch must never break train
+            pass
+
+    def _fit_plain(self, batch, dag, timer=None):
         from .dag import prune_batch
+        from .profiling import PhaseTimer
+        from .selector import ModelSelector
+        timer = timer or PhaseTimer()
         fitted_dag = []
         # columns that outlive the DAG: raw inputs (label profile, re-scoring),
         # result outputs (evaluate), and the row key
@@ -247,48 +290,67 @@ class Workflow(_WorkflowCore):
                     new_layer.append(self._model_stages[st.uid])
                 else:
                     new_layer.append(st)
-            batch, fitted = fit_layer(batch, new_layer)
+            # phase attribution for the bench host/device split: any layer
+            # holding a ModelSelector is "selector" (the CV grid); everything
+            # else is feature engineering (≙ OpSparkListener per-stage timing)
+            kinds = sorted({type(s).__name__ for s in new_layer})
+            tag = ("selector" if any(isinstance(s, ModelSelector)
+                                     for s in new_layer)
+                   else "fit:" + "+".join(kinds))
+            with timer.phase(tag):
+                batch, fitted = fit_layer(batch, new_layer)
             fitted_dag.append(fitted)
             batch = prune_batch(
                 batch, (s for l in dag[i + 1:] for s in l), keep)
         return batch, fitted_dag
 
-    def _fit_with_workflow_cv(self, batch, dag):
+    def _fit_with_workflow_cv(self, batch, dag, timer=None):
         """≙ OpWorkflow.fitStages workflow-CV branch :411-457: cut the DAG at
         the model selector, fit 'before' once, refit 'during' inside each fold."""
+        from .profiling import PhaseTimer
         from .selector import ModelSelector
+        timer = timer or PhaseTimer()
         selector = None
         for st in dag_stages(dag):
             if isinstance(st, ModelSelector):
                 selector = st
                 break
         if selector is None:
-            return self._fit_plain(batch, dag)
+            return self._fit_plain(batch, dag, timer)
         before, during, after = cut_dag(dag, selector)
         fitted_dag = []
         for layer in before:
-            batch, fitted = fit_layer(batch, layer)
+            with timer.phase(
+                    "fit:" + "+".join(sorted({type(s).__name__
+                                              for s in layer}))):
+                batch, fitted = fit_layer(batch, layer)
             fitted_dag.append(fitted)
         # 'during' estimators are refit per fold by the validator; fit them on
         # the full data first (the final model's feature stages) so every
         # 'after' stage — selector or side branch, in any within-layer order —
         # sees its inputs materialized
         for dl in during:
-            batch, f2 = fit_layer(batch, dl)
+            with timer.phase(
+                    "fit:" + "+".join(sorted({type(s).__name__
+                                              for s in dl}))):
+                batch, f2 = fit_layer(batch, dl)
             fitted_dag.append(f2)
         for layer in after:
             new_layer = []
             for st in layer:
                 if st is selector:
-                    model = selector.fit(batch, in_fold_dag=during)
-                    new_layer.append(model)
-                    batch = model.transform_batch(batch)
+                    with timer.phase("selector"):
+                        model = selector.fit(batch, in_fold_dag=during)
+                        new_layer.append(model)
+                        batch = model.transform_batch(batch)
                 else:
-                    if isinstance(st, Estimator):
-                        m = st.fit(batch)
-                    else:
-                        m = st
-                    batch = m.transform_batch(batch)
+                    tag = "fit:" + type(st).__name__
+                    with timer.phase(tag):
+                        if isinstance(st, Estimator):
+                            m = st.fit(batch)
+                        else:
+                            m = st
+                        batch = m.transform_batch(batch)
                     new_layer.append(m)
             fitted_dag.append(new_layer)
         return batch, fitted_dag
@@ -316,6 +378,7 @@ class WorkflowModel(_WorkflowCore):
         self.parameters = dict(parameters or {})
         self.rff_results = rff_results
         self.train_batch: Optional[ColumnBatch] = None
+        self.app_metrics = None     # AppMetrics from train() (profiling.py)
 
     # -- access ------------------------------------------------------------
     @property

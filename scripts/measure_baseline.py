@@ -112,17 +112,14 @@ def measure_dense(N=1_000_000, D=28):
     return _cv_select(X, y, candidates, f"dense {N}x{D}")
 
 
-def measure_transmog(N=1_000_000):
-    """Feature engineering + selector on the mixed-type workload: hashing
+def _assemble_transmog(cols, N):
+    """sklearn-proxy feature assembly for the mixed-type workload (hashing
     vectorizer per text column, top-K one-hot for picklists, map expansion +
-    null indicators, then the same 2-point LR grid."""
+    null indicators, mean-filled reals) — shared by the train and score
+    proxies."""
     import scipy.sparse as sp
     from sklearn.feature_extraction.text import HashingVectorizer
 
-    cols, schema = make_transmog_columns(N)
-    y = np.asarray(cols["label"].values, dtype=np.float32)
-
-    t_feat = time.time()
     blocks = []
     # text -> 512-bin hashing (≙ SmartTextVectorizer high-cardinality path)
     for name in ("text1", "text2", "text3"):
@@ -166,7 +163,16 @@ def measure_transmog(N=1_000_000):
         v[~mask] = mean
         blocks.append(sp.csr_matrix(
             np.stack([v, (~mask).astype(np.float32)], axis=1)))
-    X = sp.hstack(blocks).tocsr()
+    return sp.hstack(blocks).tocsr()
+
+
+def measure_transmog(N=1_000_000):
+    """Feature engineering + selector on the mixed-type workload, then the
+    same 2-point LR grid."""
+    cols, schema = make_transmog_columns(N)
+    y = np.asarray(cols["label"].values, dtype=np.float32)
+    t_feat = time.time()
+    X = _assemble_transmog(cols, N)
     feat_s = time.time() - t_feat
     print(f"[transmog {N}] feature assembly {feat_s:.1f}s "
           f"width {X.shape[1]}", flush=True)
@@ -177,6 +183,27 @@ def measure_transmog(N=1_000_000):
     out["feature_assembly_s"] = round(feat_s, 1)
     out["feature_width"] = int(X.shape[1])
     return out
+
+
+def measure_score(N=1_000_000):
+    """Scoring-path proxy (≙ OpWorkflowModel.score over a fresh reader):
+    train one LR on the assembled transmog features, then measure feature
+    assembly + predict_proba on a FRESH batch — rows/s end to end, matching
+    bench.py run_score's honest re-paid host prologue."""
+    cols, _ = make_transmog_columns(N)
+    y = np.asarray(cols["label"].values, dtype=np.float32)
+    X = _assemble_transmog(cols, N)
+    clf = _lr(N, 0.01)
+    clf.fit(X, y)
+    cols2, _ = make_transmog_columns(N, seed=7)
+    t0 = time.time()
+    X2 = _assemble_transmog(cols2, N)
+    p = clf.predict_proba(X2)[:, 1]
+    float(p[:8].sum())
+    wall = time.time() - t0
+    print(f"[score {N}] {wall:.1f}s = {round(N / wall)} rows/s", flush=True)
+    return {"rows": N, "wall_s": round(wall, 1),
+            "rows_per_s": round(N / wall)}
 
 
 def main():
@@ -207,6 +234,13 @@ def main():
             f"{rows} rows mixed: 3 text->hash512(+null), 2 picklist->"
             "one-hot top-20(+other+null), realmap 3 keys(+null), 4 real "
             "mean-fill(+null); 3-fold CV 2xLR + refit")
+    if which in ("score", "all"):
+        r = measure_score(rows)
+        out["score1m_rows_per_s"] = r["rows_per_s"]
+        out["score"] = r
+        out["score"]["workload"] = (
+            f"LR trained on the transmog features; score a FRESH {rows}-row "
+            "batch: assembly + predict_proba, end to end")
     with open(path, "w") as fh:
         json.dump(out, fh, indent=2)
     print(json.dumps({k: v for k, v in out.items()

@@ -206,3 +206,43 @@ def test_compact_tree_matches_unrolled():
             np.testing.assert_allclose(
                 np.asarray(a.leaf)[idx], np.asarray(b.leaf)[idx], rtol=1e-5,
                 err_msg=tag)
+
+
+def test_predict_trees_raw_vmap_matches_single():
+    """Regression: the unvisited-node threshold sentinel must survive the
+    VMAPPED one-hot walk — float-max accumulated across batched lanes
+    overflowed to inf→NaN and silently sent every row left, degrading the
+    batched-CV GBT margins (round-4 find)."""
+    import jax
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models.trees import predict_trees_raw
+
+    T = 7
+    feature = np.full(T, -1, np.int32)
+    feature[0] = 0
+    threshold = np.full(T, np.inf, np.float32)
+    threshold[0] = 0.0
+    is_leaf = np.ones(T, bool)
+    is_leaf[0] = False
+    leaf = np.zeros((T, 1), np.float32)
+    leaf[1] = -1.0
+    leaf[2] = 1.0
+    X = jnp.asarray([[-5.0], [5.0]], jnp.float32)
+
+    single = predict_trees_raw(
+        X, jnp.asarray(feature)[None], jnp.asarray(threshold)[None],
+        jnp.asarray(is_leaf)[None], jnp.asarray(leaf)[None], 2)[:, 0, 0]
+    assert np.allclose(np.asarray(single), [-1.0, 1.0])
+
+    def one(args):
+        f, t, l, v = args
+        return predict_trees_raw(X, f[None], t[None], l[None], v[None],
+                                 2)[:, 0, 0]
+
+    st = lambda a: jnp.stack([jnp.asarray(a)] * 3)  # noqa: E731
+    for runner in (jax.vmap(one),
+                   lambda a: jax.lax.map(one, a, batch_size=4)):
+        out = runner((st(feature), st(threshold), st(is_leaf), st(leaf)))
+        assert np.allclose(np.asarray(out), np.asarray(single)[None, :]), \
+            np.asarray(out)

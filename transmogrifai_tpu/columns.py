@@ -72,6 +72,24 @@ def feature_matrix_dtype(n_elems: int):
     return jnp.float32
 
 
+def pack_bits(arr) -> np.ndarray:
+    """Boolean/0-1 array → packed uint8 wire (8 rows per byte, little-endian
+    bit order so the device unpack is a shift+mask)."""
+    return np.packbits(np.asarray(arr).astype(bool).reshape(-1),
+                       bitorder="little")
+
+
+def unpack_bits_device(words, n: int, shape=None):
+    """Device-side inverse of ``pack_bits`` → float32 0/1 array of ``n``
+    elements (optionally reshaped).  Traceable."""
+    import jax.numpy as jnp
+
+    bits = (words[:, None].astype(jnp.int32)
+            >> jnp.arange(8, dtype=jnp.int32)[None, :]) & 1
+    flat = bits.reshape(-1)[:n].astype(jnp.float32)
+    return flat if shape is None else flat.reshape(shape)
+
+
 def to_device_f32(values, exact: bool = False) -> Any:
     """Host→device transfer of real-valued bulk data for compute.
 

@@ -11,11 +11,16 @@ device→host transfer of the requested results per ``score()`` call
 numeric workflow that is ONE fused program; text-heavy DAGs get a device
 segment before and after their string stages.
 
-String/object-valued stages (tokenizers, validators, pick-list maps) cannot
-live in an XLA program; they run eagerly between the compiled segments.  A
-stage whose ``is_device_op`` flag is optimistic but whose transform turns
-out not to be traceable is demoted automatically (one retry, then it joins
-the host segments for the lifetime of the program).
+Stages over strings/objects join device segments through the STAGED
+protocol (``Transformer.transform_staged``): their host prologue runs
+before the segment and contributes compact wire arrays (token ids, vocab
+codes) to the frontier, and their traceable body runs inside the fused
+program — so even a text-heavy vectorizer layer compiles into one XLA
+program.  Stages with neither a device nor a staged form run eagerly
+between the compiled segments.  A stage whose ``is_device_op``/staging flag
+is optimistic but whose transform turns out not to be traceable is demoted
+automatically (one retry, then it joins the host segments for the lifetime
+of the program).
 """
 
 from __future__ import annotations
@@ -27,6 +32,8 @@ import numpy as np
 
 from .columns import Column, ColumnBatch
 from .stages.base import Transformer
+
+_WIRE_SEP = "\x00"      # wire-entry names: "<uid>\x00<key>" — never a column
 
 
 class _StageTraceError(Exception):
@@ -43,41 +50,51 @@ class ScoreProgram:
 
     ``program = ScoreProgram(stages, result_names)`` then
     ``scored = program(batch)`` — equivalent to ``apply_dag`` but every
-    maximal contiguous run of device-traceable stages executes as one jitted
-    XLA program (host stages eager in between).  jax's jit cache keys on the
-    frontier shapes, so calls with a fixed schema compile each segment
-    exactly once.
+    maximal contiguous run of device-traceable (or staged) stages executes
+    as one jitted XLA program (host stages eager in between).  jax's jit
+    cache keys on the frontier shapes, so calls with a fixed schema compile
+    each segment exactly once.
     """
 
     def __init__(self, dag: Sequence, result_names: Sequence[str]):
         # accept a layered DAG or a flat stage list; within a layer, order
-        # host ops before device ops (any within-layer order is topologically
-        # legal) so device segments coalesce instead of fragmenting
+        # host ops before device/staged ops (any within-layer order is
+        # topologically legal) so device segments coalesce instead of
+        # fragmenting
         layers = ([list(l) for l in dag]
                   if dag and isinstance(dag[0], (list, tuple)) else [list(dag)])
         self.stages: List[Transformer] = []
         for layer in layers:
-            self.stages.extend(sorted(layer, key=lambda s: s.is_device_op))
+            self.stages.extend(sorted(
+                layer, key=lambda s: bool(s.is_device_op
+                                          or s.supports_staging)))
         self.result_names = list(result_names)
         self._demoted: Set[str] = set()   # uids proven untraceable
-        self._jitted: Dict[Tuple[str, ...], Any] = {}
-        self._metas: Dict[Tuple[str, ...], Dict[str, Any]] = {}
+        self._jitted: Dict[Tuple, Any] = {}
+        self._metas: Dict[Tuple, Dict[str, Any]] = {}
 
     # -- partition ----------------------------------------------------------
     def _partition(self, batch: ColumnBatch) -> List[Tuple[bool, List[Transformer]]]:
         """Split stages (already in topo order) into alternating
         (is_device_segment, stages) groups: every maximal contiguous stretch
-        of device ops over array-resident inputs becomes its own jitted
-        segment, with host stages eager in between (a text-heavy DAG can have
-        device vectorizers BEFORE its string stages and the fused model tail
-        after — both compile)."""
+        of device ops over array-resident inputs — plus staged stages whose
+        inputs are materialized before the segment — becomes its own jitted
+        segment, with host stages eager in between."""
         arrayish: Dict[str, bool] = {
             name: batch[name].is_device for name in batch.names()}
         segments: List[Tuple[bool, List[Transformer]]] = []
+        seg_outputs: Set[str] = set()   # outputs of the OPEN device segment
         for st in self.stages:
-            ok = (st.is_device_op and st.uid not in self._demoted
-                  and all(arrayish.get(f.name, False)
-                          for f in st.input_features))
+            dev_ok = (st.is_device_op and st.uid not in self._demoted
+                      and all(arrayish.get(f.name, False)
+                              for f in st.input_features))
+            # a staged stage's host prologue runs BEFORE the segment, so its
+            # inputs must not be produced inside the same segment
+            staged_ok = (not dev_ok and st.supports_staging
+                         and st.uid not in self._demoted
+                         and not any(f.name in seg_outputs
+                                     for f in st.input_features))
+            ok = dev_ok or staged_ok
             for f in st.output_features:
                 # host stages may still emit array columns (e.g. one-hot on
                 # strings); simulate with the same rule Column.is_device uses
@@ -86,6 +103,9 @@ class ScoreProgram:
                 segments[-1][1].append(st)
             else:
                 segments.append((ok, [st]))
+                seg_outputs = set()
+            if ok:
+                seg_outputs.update(f.name for f in st.output_features)
         return segments
 
     # -- execution ----------------------------------------------------------
@@ -124,47 +144,88 @@ class ScoreProgram:
     def _apply_run(self, batch: ColumnBatch, run: List[Transformer],
                    later: List[Transformer], keep_intermediate: bool
                    ) -> ColumnBatch:
-        key = tuple(st.uid for st in run) + (keep_intermediate,)
-        frontier = sorted({f.name for st in run for f in st.input_features
-                           if f.name in batch})
+        # staged = stages whose inputs are NOT all array-resident right now;
+        # their host prologue supplies wire arrays instead of columns
+        staged_fns: Dict[str, Any] = {}
+        wires: Dict[str, Any] = {}
+        for st in run:
+            if all(batch[f.name].is_device for f in st.input_features
+                   if f.name in batch):
+                continue
+            res = None
+            try:
+                res = st.transform_staged(batch)
+            except Exception as e:  # noqa: BLE001 — demotion signal
+                raise _StageTraceError(st.uid, e) from e
+            if res is None:
+                raise _StageTraceError(st.uid, TypeError(
+                    "stage has host inputs and no staged form"))
+            wire, fn = res
+            staged_fns[st.uid] = fn
+            for k, v in wire.items():
+                wires[st.uid + _WIRE_SEP + k] = v
+
+        key = (tuple(st.uid for st in run), keep_intermediate, len(batch))
+        frontier = sorted({f.name for st in run
+                           if st.uid not in staged_fns
+                           for f in st.input_features if f.name in batch})
+        # canonical positional names at the jit boundary: stage uids are
+        # process-global counters, so real column/wire names differ between
+        # otherwise identical workflows — with them as pytree keys every new
+        # process MISSES the persistent compilation cache and pays a full
+        # XLA recompile of the fused program
+        canon_in = {n: f"a{i}" for i, n in enumerate(
+            frontier + sorted(wires))}
         # _partition simulates host-stage outputs by kind; validate against
         # the actual columns and demote consumers of any misprediction (e.g.
         # a numeric-kinded host stage that emitted an object array)
         host_cols = [n for n in frontier if not batch[n].is_device]
         if host_cols:
-            offender = next(st for st in run if any(
-                f.name in host_cols for f in st.input_features))
+            offender = next(st for st in run if st.uid not in staged_fns
+                            and any(f.name in host_cols
+                                    for f in st.input_features))
             raise _StageTraceError(offender.uid, TypeError(
                 f"frontier columns {host_cols} are host-resident"))
         out_names = self._wanted_outputs(run, later, keep_intermediate)
         kinds = {n: batch[n].kind for n in frontier}
         metas_in = {n: batch[n].meta for n in frontier}
+        n_rows_static = len(batch)
 
         if key not in self._jitted:
             metas_out: Dict[str, Any] = {}
+            fns_at_trace = dict(staged_fns)
+            inv_in = {c: n for n, c in canon_in.items()}
+            canon_out = {n: f"o{i}" for i, n in enumerate(out_names)}
 
-            def traced(arrays: Dict[str, Tuple[Any, Any]]):
-                # row count from the traced arrays (NOT the captured batch:
-                # jit retraces on new shapes and closures would be stale)
-                v0 = next(iter(arrays.values()))[0]
-                n_rows = (next(iter(v0.values())).shape[0]
-                          if isinstance(v0, dict) else v0.shape[0])
+            def traced(arrays_c: Dict[str, Tuple[Any, Any]]):
+                arrays = {inv_in[c]: vm for c, vm in arrays_c.items()}
                 cols = {n: Column(kinds[n], v, m, meta=metas_in[n])
-                        for n, (v, m) in arrays.items()}
-                b = ColumnBatch(dict(cols), n_rows)
+                        for n, (v, m) in arrays.items()
+                        if _WIRE_SEP not in n}
+                b = ColumnBatch(dict(cols), n_rows_static)
                 for st in run:
                     try:
-                        b = st.transform_batch(b)
+                        if st.uid in fns_at_trace:
+                            sub = {k.split(_WIRE_SEP, 1)[1]: v
+                                   for k, (v, _) in arrays.items()
+                                   if k.startswith(st.uid + _WIRE_SEP)}
+                            out_col = fns_at_trace[st.uid](sub)
+                            (f,) = st.output_features
+                            b = b.with_columns({f.name: out_col})
+                        else:
+                            b = st.transform_batch(b)
+                    except _StageTraceError:
+                        raise
                     except Exception as e:  # noqa: BLE001 — demotion signal
                         raise _StageTraceError(st.uid, e) from e
                 out = {}
                 for n in out_names:
                     c = b[n]
                     metas_out[n] = (c.meta, c.kind)
-                    out[n] = (c.values, c.mask)
+                    out[canon_out[n]] = (c.values, c.mask)
                 return out
 
-            self._jitted[key] = jax.jit(traced)
+            self._jitted[key] = (jax.jit(traced), canon_out)
             self._metas[key] = metas_out
 
         def _prep(v):
@@ -175,10 +236,14 @@ class ScoreProgram:
                 return to_device_f32(v)
             return v
 
-        arrays = {n: (_prep(batch[n].values), batch[n].mask)
+        arrays = {canon_in[n]: (_prep(batch[n].values), batch[n].mask)
                   for n in frontier}
+        arrays.update({canon_in[k]: (_prep(v), None)
+                       for k, v in wires.items()})
+        jitted, canon_out_map = self._jitted[key]
         try:
-            out = self._jitted[key](arrays)
+            out_c = jitted(arrays)
+            out = {n: out_c[c] for n, c in canon_out_map.items()}
         except _StageTraceError:
             self._jitted.pop(key, None)
             self._metas.pop(key, None)

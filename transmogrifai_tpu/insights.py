@@ -254,7 +254,9 @@ class ModelInsights:
 
 def _model_contributions(sel) -> List[float]:
     """Per-kept-column contribution of the winning model: |coef| for linear
-    models, split-gain-free occupancy for trees (feature usage counts)."""
+    models, accumulated impurity gain for trees (count-weighted, ≙ Spark's
+    featureImportances feeding ModelInsights.scala:74-392), with split-usage
+    frequency as the fallback for external models without gains."""
     if sel is None or sel.best_model is None:
         return []
     fitted = sel.best_model.fitted
@@ -263,7 +265,11 @@ def _model_contributions(sel) -> List[float]:
         if coef.ndim == 2:
             return np.abs(coef).max(axis=1).tolist()
         return np.abs(coef).tolist()
-    if "feature" in fitted:  # tree ensemble: usage frequency per feature
+    if "feature_gain" in fitted:
+        gain = np.asarray(fitted["feature_gain"], dtype=np.float64)
+        tot = gain.sum()
+        return (gain / tot if tot > 0 else gain).tolist()
+    if "feature" in fitted:  # fallback: usage frequency per feature
         feats = np.asarray(fitted["feature"]).ravel()
         feats = feats[feats >= 0]
         if feats.size == 0:

@@ -57,6 +57,36 @@ def _mxu_dtype():
 # binning
 # --------------------------------------------------------------------------
 
+# (weakref(X), {max_bins: (splits, B)}) keyed by id(X): every tree family in
+# a CV grid shares ONE binned matrix per (matrix, max_bins) instead of each
+# building its own — at 11M rows a duplicate B is ~0.3 GB of HBM and a full
+# binning pass, and cumulative residency is what hard-faults the worker
+# (VERDICT r3 #2).  Entries drop when the feature matrix is collected.
+_SHARED_BINS: Dict[int, Any] = {}
+
+
+def shared_binned(X, max_bins: int):
+    """(splits, B) for a device matrix, cached across model families."""
+    import weakref
+
+    key = id(X)
+    ent = _SHARED_BINS.get(key)
+    if ent is not None and ent[0]() is X and max_bins in ent[1]:
+        return ent[1][max_bins]
+    Xj = device_matrix(X)
+    sp = build_bin_splits(X, max_bins)
+    B = bin_data(Xj, jnp.asarray(sp))
+    if ent is None or ent[0]() is not X:
+        try:
+            ref = weakref.ref(X, lambda _r, _k=key: _SHARED_BINS.pop(_k, None))
+        except TypeError:
+            return sp, B
+        ent = (ref, {})
+        _SHARED_BINS[key] = ent
+    ent[1][max_bins] = (sp, B)
+    return sp, B
+
+
 def build_bin_splits(X: np.ndarray, max_bins: int = MAX_BINS_DEFAULT) -> np.ndarray:
     """Per-feature quantile split points → [D, max_bins-1] float32, padded
     with +inf (≙ Spark's findSplits quantile sketch).  Device-resident inputs
@@ -116,6 +146,9 @@ class TreeArrays(NamedTuple):
     threshold: jnp.ndarray  # [T] float32 (raw split threshold)
     is_leaf: jnp.ndarray    # [T] bool
     leaf: jnp.ndarray       # [T, V] float32 leaf values
+    gain: jnp.ndarray       # [D] per-feature impurity-gain sum over splits
+                            # (count-weighted, ≙ Spark featureImportances /
+                            # ModelInsights.scala:74-392 contributions)
 
 
 def _gain_variance(left, right, parent, lam):
@@ -240,7 +273,7 @@ def _fit_tree_compact(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
               and features_per_node < D)
 
     def level_body(lvl, carry):
-        feat_arr, thr_arr, leaf_flag, leaf_val, row_node = carry
+        feat_arr, thr_arr, leaf_flag, leaf_val, row_node, gain_acc = carry
         offset = (1 << lvl) - 1                              # traced
         nodes = offset + jnp.arange(P_n, dtype=jnp.int32)
         # routing one-hot in MXU dtype: [N, P_n] is GBs at 10M+ rows and
@@ -311,6 +344,11 @@ def _fit_tree_compact(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
         bin_of_row = oh @ best_bin.astype(jnp.float32)
         dead_of_row = oh @ node_is_leaf.astype(jnp.float32)
         at_level = jnp.sum(oh.astype(jnp.float32), axis=1) > 0.5
+        # per-feature gain accumulation for importances: only nodes that
+        # actually split contribute (zero-row window slots and pruned nodes
+        # carry -inf/min gains and are excluded by node_is_leaf)
+        gain_acc2 = gain_acc.at[best_feat].add(
+            jnp.where(node_is_leaf, 0.0, best_gain))
         # per-row bin of the split feature: a [N] gather beats the [N, D]
         # one-hot einsum it replaces (two full-matrix f32 transients)
         b_of_row = jnp.take_along_axis(
@@ -319,15 +357,17 @@ def _fit_tree_compact(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
         child = 2 * row_node + 1 + go_right
         advance = at_level & (dead_of_row < 0.5)
         row_node2 = jnp.where(advance, child, row_node)
-        return (feat_arr2, thr_arr2, leaf_flag2, leaf_val2, row_node2)
+        return (feat_arr2, thr_arr2, leaf_flag2, leaf_val2, row_node2,
+                gain_acc2)
 
     init = (jnp.full((T,), -1, jnp.int32),
             jnp.full((T,), jnp.inf, jnp.float32),
             jnp.zeros((T,), bool),
             jnp.zeros((T, V), jnp.float32),
-            jnp.zeros((N,), jnp.int32))
-    feat_arr, thr_arr, leaf_flag, leaf_val, row_node = jax.lax.fori_loop(
-        0, max_depth, level_body, init)
+            jnp.zeros((N,), jnp.int32),
+            jnp.zeros((D_pad,), jnp.float32))
+    (feat_arr, thr_arr, leaf_flag, leaf_val, row_node,
+     gain_acc) = jax.lax.fori_loop(0, max_depth, level_body, init)
 
     # epilogue: the bottom level is all leaves (static offset/shape)
     n_last = 2 ** max_depth
@@ -341,7 +381,7 @@ def _fit_tree_compact(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
     leaf_flag = leaf_flag.at[off:].set(True)
     feat_arr = feat_arr.at[off:].set(-1)
     thr_arr = thr_arr.at[off:].set(jnp.inf)
-    return TreeArrays(feat_arr, thr_arr, leaf_flag, leaf_val)
+    return TreeArrays(feat_arr, thr_arr, leaf_flag, leaf_val, gain_acc[:D])
 
 
 def _fit_tree_unrolled(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
@@ -394,6 +434,7 @@ def _fit_tree_unrolled(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
 
     row_node = jnp.zeros((N,), jnp.int32)
     parent_dead = jnp.zeros((1,), bool)  # nodes whose ancestor is a leaf
+    gain_acc = jnp.zeros((D_pad,), jnp.float32)
 
     for level in range(max_depth + 1):
         n_l = 2 ** level
@@ -486,6 +527,8 @@ def _fit_tree_unrolled(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
             scan_chunk, init, (B_chunks, m_chunks, nm_chunks, base_idxs))
 
         node_is_leaf = (best_gain <= min_gain) | (~jnp.isfinite(best_gain)) | parent_dead
+        gain_acc = gain_acc.at[best_feat].add(
+            jnp.where(node_is_leaf, 0.0, best_gain))
         thr = splits_pad[best_feat, jnp.clip(best_bin, 0, splits.shape[1] - 1)]
         feat_arr = jax.lax.dynamic_update_slice(
             feat_arr, jnp.where(node_is_leaf, -1, best_feat), (offset,))
@@ -507,7 +550,7 @@ def _fit_tree_unrolled(B: jnp.ndarray, splits: jnp.ndarray, stats: jnp.ndarray,
         row_node = 2 * row_node + go_right.astype(jnp.int32)
         parent_dead = jnp.repeat(node_is_leaf, 2)
 
-    return TreeArrays(feat_arr, thr_arr, leaf_flag, leaf_val)
+    return TreeArrays(feat_arr, thr_arr, leaf_flag, leaf_val, gain_acc[:D])
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
@@ -551,10 +594,14 @@ def _predict_trees_block(X, feature, threshold, is_leaf, leaf,
     d_iota = jnp.arange(D, dtype=jnp.int32)
     feature_f = feature.astype(dt)
     # unvisited nodes carry +inf thresholds; 0 * inf = NaN would poison the
-    # one-hot contraction, so map them to float-max (same compare semantics)
+    # one-hot contraction.  The sentinel must ALSO survive summation: under
+    # vmap the batched contraction can accumulate several sentinel lanes, and
+    # float-max + float-max overflows to inf → NaN downstream (this silently
+    # degraded every batched-CV GBT margin update).  1e30 keeps the compare
+    # semantics (any real threshold is far smaller) with ~1e8 of headroom.
     threshold_f = jnp.where(jnp.isfinite(threshold),
                             threshold.astype(dt),
-                            jnp.asarray(jnp.finfo(dt).max, dt))
+                            jnp.asarray(1e30, dt))
     leaf_flag = is_leaf.astype(dt)
     node = jnp.zeros((X.shape[0], feature.shape[0]), jnp.int32)
 
@@ -623,9 +670,7 @@ def fit_forest(X: np.ndarray, y: np.ndarray, *, task: str, n_classes: int,
     """Random forest: all trees in one vmapped XLA program (chunked via
     lax.map when deep trees would blow HBM)."""
     N, D = X.shape
-    splits = build_bin_splits(X, max_bins)
-    Xj = device_matrix(X)
-    B = bin_data(Xj, jnp.asarray(splits))
+    splits, B = shared_binned(X, max_bins)
     w0 = jnp.ones(N, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight)
     yj = jnp.asarray(y, jnp.float32)
     key = jax.random.PRNGKey(seed)
@@ -662,6 +707,7 @@ def fit_forest(X: np.ndarray, y: np.ndarray, *, task: str, n_classes: int,
             "threshold": np.asarray(trees.threshold),
             "is_leaf": np.asarray(trees.is_leaf),
             "leaf": np.asarray(trees.leaf),
+            "feature_gain": np.asarray(trees.gain).sum(axis=0),
             "bin_splits": splits}
 
 
@@ -697,10 +743,9 @@ def fit_gbt(X: np.ndarray, y: np.ndarray, *, task: str, n_rounds: int,
     """Gradient boosting (XGBoost-style second-order): Python loop over rounds
     around a jitted tree fit; grad/hess computed on device."""
     N, D = X.shape
-    splits = build_bin_splits(X, max_bins)
+    splits, B = shared_binned(X, max_bins)
     splits_j = jnp.asarray(splits)
     Xj = device_matrix(X)
-    B = bin_data(Xj, splits_j)
     w0 = jnp.ones(N, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight)
     yj = jnp.asarray(y, jnp.float32)
     fmask = jnp.ones((D,), jnp.float32) > 0
@@ -723,7 +768,9 @@ def fit_gbt(X: np.ndarray, y: np.ndarray, *, task: str, n_rounds: int,
     return {"kind": "gbt", "task": task, "n_classes": 2,
             "max_depth": max_depth, "eta": eta, "base": float(base),
             "feature": feature, "threshold": threshold,
-            "is_leaf": is_leaf, "leaf": leaf, "bin_splits": splits}
+            "is_leaf": is_leaf, "leaf": leaf,
+            "feature_gain": np.asarray(rounds.gain[:, 0]).sum(axis=0),
+            "bin_splits": splits}
 
 
 # --------------------------------------------------------------------------
@@ -1014,7 +1061,6 @@ class _ForestEstimatorBase(PredictorEstimator):
             impurity = "variance"
             base_stats = jnp.stack([jnp.ones(N), yj, yj * yj], axis=1)
         fold_w = to_device_f32(fold_weights, exact=True)
-        Xj = device_matrix(X)
         splits_cache: dict = {}
 
         def mval(gi, name, default):
@@ -1023,8 +1069,7 @@ class _ForestEstimatorBase(PredictorEstimator):
         for (n_trees, max_depth, max_bins, strategy, bootstrap,
              seed), gidx in groups.items():
             if max_bins not in splits_cache:
-                sp = build_bin_splits(X, max_bins)
-                splits_cache[max_bins] = (sp, bin_data(Xj, jnp.asarray(sp)))
+                splits_cache[max_bins] = shared_binned(X, max_bins)
             splits, B = splits_cache[max_bins]
             Gg = len(gidx)
             Kt = K * Gg * n_trees
@@ -1071,7 +1116,9 @@ class _ForestEstimatorBase(PredictorEstimator):
                         "feature": feature[s:s + n_trees],
                         "threshold": threshold[s:s + n_trees],
                         "is_leaf": is_leaf[s:s + n_trees],
-                        "leaf": leaf[s:s + n_trees], "bin_splits": splits}
+                        "leaf": leaf[s:s + n_trees],
+                        "feature_gain": trees.gain[s:s + n_trees].sum(axis=0),
+                        "bin_splits": splits}
         return out
 
 
@@ -1158,8 +1205,7 @@ class _GBTEstimatorBase(PredictorEstimator):
 
         for (n_rounds, max_depth, max_bins), gidx in groups.items():
             if max_bins not in splits_cache:
-                sp = build_bin_splits(X, max_bins)
-                splits_cache[max_bins] = (sp, bin_data(Xj, jnp.asarray(sp)))
+                splits_cache[max_bins] = shared_binned(X, max_bins)
             splits, B = splits_cache[max_bins]
             Gg = len(gidx)
             Kc = K * Gg
@@ -1202,6 +1248,7 @@ class _GBTEstimatorBase(PredictorEstimator):
                         "eta": float(etas[kc]), "base": float(base_np[kc]),
                         "feature": feature[kc], "threshold": threshold[kc],
                         "is_leaf": is_leaf[kc], "leaf": leaf[kc],
+                        "feature_gain": rounds.gain[:, kc].sum(axis=0),
                         "bin_splits": splits}
         return out
 

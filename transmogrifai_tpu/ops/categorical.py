@@ -75,6 +75,47 @@ def encode_column(col: Column, vocab: Dict[str, int], other_id: int) -> np.ndarr
 class OneHotModel(TransformerModel):
     out_kind = OPVector
     is_device_op = False  # host vocab lookup, then device one-hot
+    supports_staging = True
+
+    def transform_staged(self, batch: ColumnBatch):
+        """Host prologue: vocab-encode each feature through the cached
+        column profile (narrow uint8 wire).  Device body: one-hot expand +
+        concat — fuses into the surrounding XLA program."""
+        track_other = self.get("track_other", True)
+        track_nulls = self.get("track_nulls", True)
+        wire = {}
+        plan = []
+        for i, f in enumerate(self.input_features):
+            if f.name in batch and not batch[f.name].is_host_object():
+                return None
+            vocab: Dict[str, int] = self.fitted["vocabs"][f.name]
+            other_id = len(vocab)
+            ids = encode_column(batch[f.name], vocab, other_id)
+            cols = list(range(other_id))
+            if track_other:
+                cols.append(other_id)
+            if track_nulls:
+                cols.append(other_id + 1)
+            wire[f"ids{i}"] = (ids.astype(np.uint8) if other_id + 1 < 256
+                               else ids)
+            plan.append((f"ids{i}", np.asarray(cols, np.int32)))
+        n = len(batch)
+        meta = self.fitted["meta"]
+
+        def body(w):
+            outs = []
+            for key, cols in plan:
+                if len(cols):
+                    ids = jnp.asarray(w[key]).astype(jnp.int32)
+                    outs.append((ids[:, None] == jnp.asarray(cols)[None, :]
+                                 ).astype(jnp.float32))
+                else:
+                    outs.append(jnp.zeros((w[key].shape[0], 0), jnp.float32))
+            return Column(OPVector,
+                          jnp.concatenate(outs, axis=1) if outs else
+                          jnp.zeros((n, 0), jnp.float32), meta=meta)
+
+        return wire, body
 
     def transform(self, batch: ColumnBatch) -> Column:
         outs = []

@@ -31,9 +31,74 @@ def _map_values(col) -> List[Dict[str, Any]]:
     return [v if isinstance(v, dict) else {} for v in col.values]
 
 
+def _numeric_map_arrays(exp, keys: List[str], fills: Dict[str, float]):
+    """(vals [N, K] f32, presence [N, K] f32, fill vector [K]) in fitted-key
+    order from a cached columnar expansion — the single source for both the
+    eager and the staged numeric-map transform paths."""
+    n = len(exp.nonempty)
+    K = len(keys)
+    idx = exp.key_index()
+    vals_np = np.zeros((n, K), np.float32)
+    pres_np = np.zeros((n, K), np.float32)
+    for jj, k in enumerate(keys):
+        j = idx.get(k)
+        if j is not None:
+            vals_np[:, jj] = exp.vals[:, j]
+            pres_np[:, jj] = exp.present[:, j]
+    fill_vec = np.asarray([fills.get(k, 0.0) for k in keys], np.float32)
+    return vals_np, pres_np, fill_vec
+
+
+def _fill_and_interleave(vd, pd, fill_vec, track_nulls: bool):
+    """Device body shared by the eager and staged paths: fill absent values,
+    optionally interleave [value, null] per key (matches the fitted meta)."""
+    K = fill_vec.shape[0]
+    filled = jnp.where(pd > 0, vd, jnp.asarray(fill_vec)[None, :])
+    if not track_nulls:
+        return filled
+    return jnp.stack([filled, 1.0 - pd], axis=2).reshape(vd.shape[0], 2 * K)
+
+
 class MapVectorizerModel(TransformerModel):
     out_kind = OPVector
     is_device_op = False
+    supports_staging = True
+
+    def transform_staged(self, batch: ColumnBatch):
+        """Staged form for plain-numeric maps: host prologue pulls the
+        cached columnar expansion (values + presence in fitted-key order);
+        device body fills + interleaves null indicators — traceable, so the
+        block fuses into the surrounding XLA program."""
+        (f,) = self.input_features
+        vk = map_value_kind(f.kind)
+        if not (is_numeric_kind(vk) and not issubclass(vk, Binary)
+                and not issubclass(vk, (Date, DateTime))):
+            return None
+        from .map_profile import map_expansion
+        col = batch[f.name]
+        if not col.is_host_object():
+            return None
+        exp = map_expansion(col)
+        if exp is None:
+            return None          # bool/mixed values: exact eager path
+        keys: List[str] = self.fitted["keys"]
+        track_nulls = self.get("track_nulls", True)
+        K = len(keys)
+        vals_np, pres_np, fill_vec = _numeric_map_arrays(
+            exp, keys, self.fitted["fills"])
+        meta = self.fitted["meta"]
+        from ..columns import pack_bits, unpack_bits_device
+
+        def body(w):
+            vd = w["vals"]
+            pd = unpack_bits_device(w["pres"], vd.shape[0] * K,
+                                    (vd.shape[0], K)) if K else \
+                jnp.zeros_like(vd)
+            return Column(OPVector,
+                          _fill_and_interleave(vd, pd, fill_vec, track_nulls),
+                          meta=meta)
+
+        return {"vals": vals_np, "pres": pack_bits(pres_np)}, body
 
     def transform(self, batch: ColumnBatch) -> Column:
         (f,) = self.input_features
@@ -79,27 +144,13 @@ class MapVectorizerModel(TransformerModel):
                 # cached one-pass columnar expansion, assembled on DEVICE:
                 # the wire carries compact [N, K] values + presence instead
                 # of a host-built [N, K·2] f32 block
-                idx = exp.key_index()
-                K = len(keys)
-                vals_np = np.zeros((n, K), np.float32)
-                pres_np = np.zeros((n, K), np.float32)
-                for jj, k in enumerate(keys):
-                    j = idx.get(k)
-                    if j is not None:
-                        vals_np[:, jj] = exp.vals[:, j]
-                        pres_np[:, jj] = exp.present[:, j]
-                fill_vec = np.asarray([fills.get(k, 0.0) for k in keys],
-                                      np.float32)
+                vals_np, pres_np, fill_vec = _numeric_map_arrays(
+                    exp, keys, fills)
                 from ..columns import to_device_f32
                 vd = to_device_f32(vals_np)
                 pd = to_device_f32(pres_np, exact=True)
-                filled = jnp.where(pd > 0, vd, jnp.asarray(fill_vec)[None, :])
-                if track_nulls:
-                    block = jnp.stack([filled, 1.0 - pd], axis=2
-                                      ).reshape(n, 2 * K)
-                else:
-                    block = filled
-                blocks.append(block)
+                blocks.append(_fill_and_interleave(vd, pd, fill_vec,
+                                                   track_nulls))
             else:
                 if not maps:
                     maps = _map_values(batch[f.name])

@@ -391,6 +391,94 @@ class TextStats:
 class SmartTextVectorizerModel(TransformerModel):
     out_kind = OPVector
     is_device_op = False
+    supports_staging = True
+
+    def transform_staged(self, batch: ColumnBatch):
+        """Host prologue: cached column profiles → compact wire (packed
+        token words, per-row lens, vocab codes, null bits).  Device body:
+        scatter-add hash counts + one-hot pivots + null indicators, concat —
+        traceable, so the whole block fuses into the surrounding program."""
+        from ..columns import (feature_matrix_dtype, pack_bits,
+                               unpack_bits_device)
+        from .categorical import encode_column
+        from .text_profile import column_profile
+
+        num_hashes = self.get("num_hashes")
+        if num_hashes >= 1024:
+            return None          # packed 10-bit wire only
+        n = len(batch)
+        strategies = self.fitted["strategies"]
+        track_nulls = self.get("track_nulls", True)
+        est_width = sum(
+            num_hashes if strategies[f.name] == "hash" else 32
+            for f in self.input_features)
+        dtype = feature_matrix_dtype(n * est_width)
+        wire: Dict[str, Any] = {}
+        plan: List[Tuple[str, Any, Tuple[Optional[str], ...]]] = []
+        for i, f in enumerate(self.input_features):
+            col = batch[f.name]
+            if not col.is_host_object():
+                return None      # exotic residency: eager path
+            strat = strategies[f.name]
+            prof = column_profile(col)
+            if strat == "pivot":
+                vocab = self.fitted["vocabs"][f.name]
+                other = len(vocab)
+                ids = encode_column(col, vocab, other)
+                wire[f"ids{i}"] = (ids.astype(np.uint8) if other + 1 < 256
+                                   else ids)
+                plan.append(("pivot", other + 2, (f"ids{i}",)))
+            elif strat == "ignore":
+                if track_nulls:
+                    wire[f"null{i}"] = pack_bits(prof.null)
+                    plan.append(("null", None, (f"null{i}",)))
+            else:
+                words = prof.device_ids(num_hashes)
+                total = int(prof.tok_hash.size)
+                cap = int(words.shape[0])
+                wire[f"words{i}"] = words
+                wire[f"lens{i}"] = np.append(
+                    prof.tok_lens, np.int32(3 * cap - total)).astype(np.int32)
+                nk = None
+                if track_nulls:
+                    nk = f"null{i}"
+                    wire[nk] = pack_bits(prof.null)
+                plan.append(("hash", num_hashes, (f"words{i}", f"lens{i}", nk)))
+        meta = self.fitted["meta"]
+
+        def body(w):
+            blocks = []
+            for kind, info, keys in plan:
+                if kind == "pivot":
+                    ids = jnp.asarray(w[keys[0]]).astype(jnp.int32)
+                    blocks.append((ids[:, None] == jnp.arange(info)[None, :]
+                                   ).astype(dtype))
+                elif kind == "null":
+                    blocks.append(unpack_bits_device(
+                        w[keys[0]], n)[:, None].astype(dtype))
+                else:
+                    words, lens_p = w[keys[0]], w[keys[1]]
+                    h = info
+                    ids = jnp.stack([words & 0x3FF, (words >> 10) & 0x3FF,
+                                     (words >> 20) & 0x3FF], axis=1).reshape(-1)
+                    nr = lens_p.shape[0] - 1
+                    rows = jnp.repeat(jnp.arange(nr + 1), lens_p,
+                                      total_repeat_length=ids.shape[0])
+                    counts = jnp.zeros((nr + 1, h + 1), jnp.float32)
+                    counts = counts.at[rows, ids].add(1.0)[:nr, :h].astype(dtype)
+                    if keys[2] is not None:
+                        counts = jnp.concatenate(
+                            [counts,
+                             unpack_bits_device(w[keys[2]], nr)[:, None]
+                             .astype(dtype)],
+                            axis=1)
+                    blocks.append(counts)
+            if not blocks:
+                return Column(OPVector, jnp.zeros((n, 0), jnp.float32),
+                              meta=meta)
+            return Column(OPVector, jnp.concatenate(blocks, axis=1), meta=meta)
+
+        return wire, body
 
     def transform(self, batch: ColumnBatch) -> Column:
         from ..columns import feature_matrix_dtype

@@ -60,6 +60,18 @@ def _col_stats(X: jnp.ndarray, y: jnp.ndarray):
 
 
 @jax.jit
+def _col_stats_with_contingency(X, y, union_idx, y_classes):
+    """``_col_stats`` + the categorical contingency contraction in ONE
+    program (one executable load, two result pulls) — the per-group
+    Cramér's V tables come from a single [C, |union|] matmul over the union
+    of indicator columns (≙ SanityChecker.scala:575 categoricalTests)."""
+    mean, var, mn, mx, corr = _col_stats(X, y)
+    yoh = (y[:, None] == y_classes[None, :]).astype(jnp.float32)
+    cont = yoh.T @ X[:, union_idx].astype(jnp.float32)
+    return jnp.stack([mean, var, mn, mx, corr]), cont
+
+
+@jax.jit
 def _rank_transform(a: jnp.ndarray) -> jnp.ndarray:
     """Average-rank transform per column for Spearman correlation — one
     sort + searchsorted per column, fully on device (ties get the average of
@@ -199,17 +211,10 @@ class SanityChecker(Estimator):
             Xs, ys_host = Xd[idx], y[idx]
         else:
             Xs, ys_host = Xd, y
-        ys = jnp.asarray(ys_host)
-
-        corr_type = self.get("correlation_type", DEFAULT_CORRELATION_TYPE)
-        if corr_type == "spearman":
-            mean, var, mn, mx, _ = _col_stats(Xs, ys)
-            corr_arr = np.asarray(_col_stats(
-                _rank_transform(Xs), _rank_transform(ys))[4])
-        else:
-            mean, var, mn, mx, corr = _col_stats(Xs, ys)
-            corr_arr = np.asarray(corr)
-        mean, var, mn, mx = (np.asarray(a) for a in (mean, var, mn, mx))
+        from ..columns import to_device_f32
+        # exact bf16-when-lossless wire, weakref-cached: the selector's grid
+        # fits reuse the SAME label transfer
+        ys = to_device_f32(ys_host, exact=True)
 
         # Cramér's V + association rules per categorical indicator group
         # (≙ categoricalTests): group = columns with an indicatorValue sharing
@@ -223,18 +228,41 @@ class SanityChecker(Estimator):
         y_classes = np.unique(ys_host)
         cont_all = None
         pos_of = {}
+        corr_type = self.get("correlation_type", DEFAULT_CORRELATION_TYPE)
+        union: List[int] = []
+        if len(y_classes) > 100:
+            # contingency tables need a CATEGORICAL label: a continuous
+            # (regression) response would one-hot into an [N, ~N] block;
+            # Cramér's V is meaningless there, so skip the tests entirely
+            groups = {}
         if groups:
-            # ONE device matmul + pull over the UNION of indicator columns
+            # ONE device contraction over the UNION of indicator columns
             # covers every group's contingency — per-group gathers would pay
             # a dispatch + stream sync each on high-latency links, and
             # contracting all D columns would pull width-proportional bytes
             # (≙ categoricalTests, batched)
             union = sorted({i for idxs in groups.values() for i in idxs})
             pos_of = {i: p for p, i in enumerate(union)}
-            yoh = (ys[:, None] == jnp.asarray(y_classes)[None, :]
-                   ).astype(jnp.float32)                 # [N, C] on device
-            cont_all = np.asarray(
-                yoh.T @ Xs[:, jnp.asarray(union)])       # [C, |union|]
+        if corr_type != "spearman" and groups:
+            # stats + contingency in ONE compiled program, TWO pulls.
+            # Guard: groups only exist for categorical indicator columns, so
+            # the label one-hot [N, C] stays small — never build it for a
+            # continuous (regression) label with ~N distinct values
+            stacked, cont = _col_stats_with_contingency(
+                Xs, ys, jnp.asarray(union, jnp.int32),
+                jnp.asarray(y_classes, jnp.float32))
+            mean, var, mn, mx, corr_arr = np.asarray(stacked)
+            cont_all = np.asarray(cont)
+        else:
+            mean, var, mn, mx, corr = _col_stats(Xs, ys)
+            corr_arr = (np.asarray(_col_stats(
+                _rank_transform(Xs), _rank_transform(ys))[4])
+                if corr_type == "spearman" else np.asarray(corr))
+            mean, var, mn, mx = (np.asarray(a) for a in (mean, var, mn, mx))
+            if groups:
+                yoh = (ys[:, None] == jnp.asarray(y_classes)[None, :]
+                       ).astype(jnp.float32)
+                cont_all = np.asarray(yoh.T @ Xs[:, jnp.asarray(union)])
         cramers: Dict[str, float] = {}
         group_fail: Dict[int, List[str]] = {}
         max_rule_conf = float(self.get("max_rule_confidence", 1.0))

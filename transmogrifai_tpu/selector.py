@@ -267,7 +267,8 @@ class ModelSelector(Estimator):
             import jax.numpy as jnp
 
             F = shape[0]
-            W = np.ones((F, X.shape[0]), np.float32)
+            # all-ones fold weights materialize ON DEVICE — zero wire bytes
+            W = jnp.ones((F, X.shape[0]), jnp.float32)
             mesh = getattr(self.validator, "last_mesh", None)
             if mesh is not None:
                 # match the CV call's shardings exactly — the jit cache keys

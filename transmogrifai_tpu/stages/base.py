@@ -161,9 +161,28 @@ class Transformer(PipelineStage):
     """
 
     is_device_op: bool = True
+    # stages whose transform splits into host prologue + traceable body
+    # (see transform_staged) — lets ScoreProgram fuse string-input stages
+    # into device segments
+    supports_staging: bool = False
 
     def transform(self, batch: ColumnBatch) -> Any:
         raise NotImplementedError
+
+    def transform_staged(self, batch: ColumnBatch):
+        """Host-prologue / device-body split for XLA program fusion.
+
+        Returns ``(wire, fn)`` — ``wire`` maps names to compact arrays
+        computed on host (token ids, vocab codes, packed presence; the ONLY
+        data the body may read besides fitted constants) and ``fn(wire) →
+        Column`` is jax-traceable — or None when no staged form applies to
+        this batch.  ScoreProgram uses it to pull host-input transforms
+        into fused device segments, so a whole vectorizer layer compiles
+        into ONE XLA program instead of one dispatch per stage (SURVEY
+        §2.6 P5; ≙ applyOpTransformations' single bulk row map,
+        FitStagesUtil.scala:96).  The body must derive row counts from
+        wire shapes, never close over them."""
+        return None
 
     def input_columns(self, batch: ColumnBatch) -> List[Column]:
         return [batch[f.name] for f in self.input_features]

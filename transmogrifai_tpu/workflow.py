@@ -274,6 +274,17 @@ class Workflow(_WorkflowCore):
             pass
 
     def _fit_plain(self, batch, dag, timer=None):
+        """Fit the DAG with DEFERRED transform application: estimators fit
+        layer-by-layer as before, but fitted transforms apply lazily — each
+        run of pending transforms compiles into ONE fused XLA program
+        (ScoreProgram with staged stages) the moment a downstream estimator
+        needs their outputs.  The whole vectorizer layer + combiner becomes
+        a single program instead of one dispatch/compile per stage — the fit
+        path's analog of the reference's single bulk row map
+        (FitStagesUtil.scala:96)."""
+        import itertools
+
+        from .compiled import ScoreProgram
         from .dag import prune_batch
         from .profiling import PhaseTimer
         from .selector import ModelSelector
@@ -283,6 +294,20 @@ class Workflow(_WorkflowCore):
         # result outputs (evaluate), and the row key
         keep = ({f.name for f in self.raw_features}
                 | {f.name for f in self.result_features} | {"key"})
+        pending: List[Transformer] = []      # fitted, not yet applied
+        pending_out: set = set()
+
+        def flush(b):
+            if not pending:
+                return b
+            prog = ScoreProgram(
+                [[m] for m in pending],
+                [f.name for m in pending for f in m.output_features])
+            b = prog(b, keep_intermediate=True)
+            pending.clear()
+            pending_out.clear()
+            return b
+
         for i, layer in enumerate(dag):
             new_layer = []
             for st in layer:
@@ -290,18 +315,35 @@ class Workflow(_WorkflowCore):
                     new_layer.append(self._model_stages[st.uid])
                 else:
                     new_layer.append(st)
-            # phase attribution for the bench host/device split: any layer
-            # holding a ModelSelector is "selector" (the CV grid); everything
-            # else is feature engineering (≙ OpSparkListener per-stage timing)
             kinds = sorted({type(s).__name__ for s in new_layer})
             tag = ("selector" if any(isinstance(s, ModelSelector)
                                      for s in new_layer)
                    else "fit:" + "+".join(kinds))
             with timer.phase(tag):
-                batch, fitted = fit_layer(batch, new_layer)
-            fitted_dag.append(fitted)
+                models = []
+                for st in new_layer:
+                    if isinstance(st, Estimator):
+                        if any(f.name in pending_out
+                               for f in st.input_features):
+                            batch = flush(batch)
+                        m = st.fit(batch)
+                    elif isinstance(st, Transformer):
+                        m = st
+                    else:
+                        raise TypeError(
+                            f"stage {st} is neither Transformer nor Estimator")
+                    models.append(m)
+                    pending.append(m)
+                    pending_out.update(f.name for f in m.output_features)
+            fitted_dag.append(models)
             batch = prune_batch(
-                batch, (s for l in dag[i + 1:] for s in l), keep)
+                batch, itertools.chain(
+                    pending, (s for l in dag[i + 1:] for s in l)), keep)
+        with timer.phase("fit:apply_tail"):
+            batch = flush(batch)
+        # the tail flush materialized every pending output; release the
+        # intermediates one last time (HBM liveness)
+        batch = prune_batch(batch, (), keep)
         return batch, fitted_dag
 
     def _fit_with_workflow_cv(self, batch, dag, timer=None):

@@ -240,6 +240,27 @@ class ScoreProgram:
                   for n in frontier}
         arrays.update({canon_in[k]: (_prep(v), None)
                        for k, v in wires.items()})
+        # multi-device: row-shard every per-row input over the mesh 'data'
+        # axis — the fused program then runs as one GSPMD computation
+        # (SURVEY §2.6 P1 on the scoring path; ≙ applyOpTransformations'
+        # executor row map, FitStagesUtil.scala:96).  Non-row wires (packed
+        # token words, per-row+1 lens) stay replicated.
+        from .parallel.mesh import data_sharding, maybe_data_mesh
+        mesh = maybe_data_mesh(n_rows_static)
+        if mesh is not None:
+            try:
+                def _shard(x):
+                    if (x is not None and getattr(x, "ndim", 0) >= 1
+                            and x.shape[0] == n_rows_static):
+                        return jax.device_put(x, data_sharding(mesh, x.ndim))
+                    return x
+                arrays = {k: (_shard(v), _shard(m))
+                          for k, (v, m) in arrays.items()}
+            except Exception:  # noqa: BLE001 — sharding is an optimization;
+                # a failed reshard (e.g. RESOURCE_EXHAUSTED near capacity)
+                # must fall back to the unsharded program, never break
+                # scoring
+                pass
         jitted, canon_out_map = self._jitted[key]
         try:
             out_c = jitted(arrays)

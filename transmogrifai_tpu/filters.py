@@ -215,6 +215,40 @@ def merge_sketches(a: Dict, b: Dict) -> Dict:
     return out
 
 
+_HIST_FNS: Dict[int, Any] = {}
+
+
+def _sharded_numeric_hist(mesh, arr, keep, lo, hi, bins: int) -> np.ndarray:
+    """np.histogram over [lo, hi] with the COUNT REDUCTION sharded over the
+    mesh 'data' axis (XLA inserts the psum).  Bin indices are computed on
+    host in float64 with np.histogram's own edge semantics, so the
+    distributions are bit-identical with the mesh on or off — a float32
+    device binning would move edge-adjacent large-magnitude values (epoch
+    timestamps) across bins and make drop decisions mesh-dependent."""
+    import jax
+    import jax.numpy as jnp
+
+    from .parallel.mesh import data_sharding
+
+    edges = np.linspace(lo, hi, bins + 1)
+    idx = np.searchsorted(edges, arr, side="right") - 1
+    idx = np.where(arr == hi, bins - 1, idx)        # last bin is inclusive
+    valid = keep & (idx >= 0) & (idx < bins)
+    idx = np.where(valid, idx, 0).astype(np.int32)
+
+    fn = _HIST_FNS.get(bins)
+    if fn is None:
+        @jax.jit
+        def fn(i, m):
+            oh = (i[:, None] == jnp.arange(bins)[None, :]
+                  ).astype(jnp.float32)
+            return jnp.sum(oh * m.astype(jnp.float32)[:, None], axis=0)
+        _HIST_FNS[bins] = fn
+    i = jax.device_put(jnp.asarray(idx), data_sharding(mesh, 1))
+    m = jax.device_put(jnp.asarray(valid), data_sharding(mesh, 1))
+    return np.asarray(fn(i, m)).astype(np.float64)
+
+
 def _stable_text_bin(item, text_bins: int) -> int:
     """Process-stable hash bin (crc32, not Python's randomized hash()) so
     sketches/distributions built in different processes stay mergeable and
@@ -371,16 +405,23 @@ def _histogram_of(vals, present: np.ndarray, kind, bins: int,
             [float(v) if (v is not None and not isinstance(v, str)) else np.nan
              for v in vals] if isinstance(vals, list) else vals,
             dtype=np.float64)
-        arr = arr[present & np.isfinite(arr)]
-        if arr.size == 0:
+        keep = present & np.isfinite(arr)
+        if not keep.any():
             return np.zeros(bins)
         if value_range is not None:
             lo, hi = value_range
         else:
-            lo, hi = float(arr.min()), float(arr.max())
+            lo, hi = float(arr[keep].min()), float(arr[keep].max())
         if lo == hi:
             hi = lo + 1.0
-        h, _ = np.histogram(arr, bins=bins, range=(lo, hi))
+        # multi-device: the binning reduction runs as one GSPMD program with
+        # rows sharded over 'data' (≙ RawFeatureFilter's executor-side
+        # FeatureDistribution reduce, RawFeatureFilter.scala:137)
+        from .parallel.mesh import maybe_data_mesh
+        mesh = maybe_data_mesh(int(arr.size))
+        if mesh is not None:
+            return _sharded_numeric_hist(mesh, arr, keep, lo, hi, bins)
+        h, _ = np.histogram(arr[keep], bins=bins, range=(lo, hi))
         return h.astype(np.float64)
     # text-ish: hash values into text_bins (≙ text hashed into bins)
     h = np.zeros(text_bins)

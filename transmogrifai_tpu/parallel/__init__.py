@@ -9,14 +9,15 @@ reference's parallelism mechanisms (SURVEY.md §2.6):
 """
 
 from .mesh import (candidate_sharding, data_sharding, make_mesh,
-                   replicated_sharding)
+                   maybe_data_mesh, replicated_sharding)
 from .dist_fit import (fit_logreg_grid_sharded, sharded_col_stats,
                        sharded_forest_fit, sharded_gbt_round,
                        sharded_train_step)
 from .multihost import init_distributed, is_multihost
 
 __all__ = [
-    "make_mesh", "data_sharding", "candidate_sharding", "replicated_sharding",
+    "make_mesh", "maybe_data_mesh", "data_sharding", "candidate_sharding",
+    "replicated_sharding",
     "fit_logreg_grid_sharded", "sharded_col_stats", "sharded_forest_fit",
     "sharded_gbt_round", "sharded_train_step", "init_distributed",
     "is_multihost",

@@ -43,6 +43,33 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(arr, axis_names)
 
 
+def maybe_data_mesh(n_rows: int) -> Optional[Mesh]:
+    """The process-wide data-axis mesh policy, shared by every stage that
+    row-shards (validator CV grid, SanityChecker stats, RawFeatureFilter
+    reductions, the compiled score program): a mesh when several devices are
+    visible and the batch is big enough to shard profitably.  Force on/off
+    with TRANSMOGRIFAI_TPU_MESH=1/0; row threshold via
+    TRANSMOGRIFAI_TPU_MESH_MIN_ROWS.  Returns None when sharding would not
+    apply (single device, small batch, or rows not divisible — static shapes
+    stay exact, no padding surprises)."""
+    import os
+
+    n_dev = len(jax.devices())
+    flag = os.environ.get("TRANSMOGRIFAI_TPU_MESH")
+    if flag == "0" or n_dev < 2:
+        return None
+    min_rows = int(os.environ.get("TRANSMOGRIFAI_TPU_MESH_MIN_ROWS", 262144))
+    if flag != "1" and n_rows < min_rows:
+        return None
+    if n_rows % n_dev:
+        return None
+    # resolve through the package attribute (not this module's global) so
+    # callers/tests that instrument `parallel.make_mesh` see every mesh
+    # construction
+    from transmogrifai_tpu import parallel as _pkg
+    return _pkg.make_mesh()
+
+
 def data_sharding(mesh: Mesh, ndim: int = 2, row_axis: int = 0) -> NamedSharding:
     """Shard ``row_axis`` (default axis 0, rows) over 'data', replicate the
     rest — e.g. ``row_axis=1`` for [folds, rows] weight masks."""

@@ -215,6 +215,14 @@ class SanityChecker(Estimator):
         # exact bf16-when-lossless wire, weakref-cached: the selector's grid
         # fits reuse the SAME label transfer
         ys = to_device_f32(ys_host, exact=True)
+        # multi-device: row-shard the matrix over the mesh 'data' axis so the
+        # stats reductions run as ONE GSPMD program with psum collectives
+        # (≙ SanityChecker colStats on executors, SanityChecker.scala:575)
+        from ..parallel.mesh import data_sharding, maybe_data_mesh
+        mesh = maybe_data_mesh(int(Xs.shape[0]))
+        if mesh is not None:
+            Xs = jax.device_put(Xs, data_sharding(mesh, 2))
+            ys = jax.device_put(ys, data_sharding(mesh, 1))
 
         # Cramér's V + association rules per categorical indicator group
         # (≙ categoricalTests): group = columns with an indicatorValue sharing

@@ -57,7 +57,12 @@ class RecordInsightsLOCO(Transformer):
     def __init__(self, model=None, top_k: int = 20, strategy: str = "abs", **params):
         super().__init__(top_k=top_k, strategy=strategy, **params)
         self.model = model
-        self._compiled: Dict[Tuple, Any] = {}
+        # weak-keyed on the MODEL: entries (compiled program + device mask
+        # buffer) die with the model they were traced against, so swapping
+        # self.model never pins stale weights + masks in HBM
+        import weakref
+        self._compiled: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
 
     # -- grouping ---------------------------------------------------------
     def _groups(self, meta, d: int) -> Dict[str, List[int]]:
@@ -78,9 +83,19 @@ class RecordInsightsLOCO(Transformer):
         sup = getattr(m, "supports_device_scores", None)
         if m is None or sup is None or not sup():
             return None
+        # close over a WEAK ref: the compiled program is stored as a
+        # WeakKeyDictionary VALUE keyed by the model — a strong closure on
+        # the model would make the entry self-referential and immortal
+        # (the jitted program retains the weight arrays as jaxpr constants;
+        # it does not need the model object after tracing)
+        import weakref
+        mref = weakref.ref(m)
 
         def score(Xd):
-            out = m.device_scores(Xd, full=False)
+            mm = mref()
+            if mm is None:  # pragma: no cover — entry dies with the model
+                raise RuntimeError("model was collected")
+            out = mm.device_scores(Xd, full=False)
             s = out.get("scores")
             if s is not None:
                 return s
@@ -111,18 +126,16 @@ class RecordInsightsLOCO(Transformer):
 
         score = self._device_score_fn()
         d = int(xv.shape[1])
-        # key on the model OBJECT (keeps it alive — id() reuse after gc must
-        # never hit a stale program baked with old weights) and on the mask
-        # contents: the same stage may see batches with different vector
-        # meta at identical shapes
-        key = (self.model, strategy, k, d, len(masks),
-               hash(masks.tobytes()))
-        ent = self._compiled.get(key)
+        # inner key per model: mask CONTENTS included because the same stage
+        # may see batches with different vector meta at identical shapes
+        inner = self._compiled.setdefault(self.model, {})
+        key = (strategy, k, d, len(masks), hash(masks.tobytes()))
+        ent = inner.get(key)
         if ent is not None:
             prog, Md = ent
         else:
-            while len(self._compiled) >= 8:   # bound program+mask residency
-                self._compiled.pop(next(iter(self._compiled)))
+            while len(inner) >= 4:   # bound program+mask residency per model
+                inner.pop(next(iter(inner)))
             def loco(Xd, Md):
                 base = score(Xd)                               # [N]
 
@@ -149,7 +162,7 @@ class RecordInsightsLOCO(Transformer):
             # masks depend only on (grouping, d) — cache the device copy
             # with the program so repeat transforms ship nothing but X
             Md = jnp.asarray(masks)
-            self._compiled[key] = (prog, Md)
+            inner[key] = (prog, Md)
         Xd = to_device_f32(xv)
         idx, val = jax.device_get(prog(Xd, Md))
         return idx.astype(np.int64), val.astype(np.float64)

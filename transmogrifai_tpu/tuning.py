@@ -356,26 +356,9 @@ class OpValidator:
         return np.asarray(out, dtype=np.int64)
 
     def _maybe_mesh(self, n_rows: int):
-        """A data-axis mesh when several devices are visible and the batch is
-        big enough to shard profitably (force on/off with
-        TRANSMOGRIFAI_TPU_MESH=1/0; row threshold via
-        TRANSMOGRIFAI_TPU_MESH_MIN_ROWS)."""
-        import os
-
-        import jax
-
-        n_dev = len(jax.devices())
-        flag = os.environ.get("TRANSMOGRIFAI_TPU_MESH")
-        if flag == "0" or n_dev < 2:
-            return None
-        min_rows = int(os.environ.get("TRANSMOGRIFAI_TPU_MESH_MIN_ROWS",
-                                      262144))
-        if flag != "1" and n_rows < min_rows:
-            return None
-        if n_rows % n_dev:
-            return None  # keep static shapes exact; no padding surprises
-        from . import parallel
-        return parallel.make_mesh()
+        """Shared data-axis mesh policy (parallel.mesh.maybe_data_mesh)."""
+        from .parallel.mesh import maybe_data_mesh
+        return maybe_data_mesh(n_rows)
 
     # -- main entry -------------------------------------------------------
     def validate(self, candidates: Sequence[ModelCandidate], batch: ColumnBatch,

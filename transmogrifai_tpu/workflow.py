@@ -297,7 +297,12 @@ class Workflow(_WorkflowCore):
         pending: List[Transformer] = []      # fitted, not yet applied
         pending_out: set = set()
 
-        def flush(b):
+        def flush(b, remaining=()):
+            """Apply pending transforms as one fused program, then release
+            every column no remaining consumer needs — a deferred flush must
+            not extend intermediate liveness past what the eager layer-by-
+            layer fit had (e.g. the combined feature vector must be GONE
+            from HBM before the selector's CV grid runs)."""
             if not pending:
                 return b
             prog = ScoreProgram(
@@ -306,7 +311,7 @@ class Workflow(_WorkflowCore):
             b = prog(b, keep_intermediate=True)
             pending.clear()
             pending_out.clear()
-            return b
+            return prune_batch(b, remaining, keep)
 
         for i, layer in enumerate(dag):
             new_layer = []
@@ -321,11 +326,13 @@ class Workflow(_WorkflowCore):
                    else "fit:" + "+".join(kinds))
             with timer.phase(tag):
                 models = []
-                for st in new_layer:
+                for j, st in enumerate(new_layer):
                     if isinstance(st, Estimator):
                         if any(f.name in pending_out
                                for f in st.input_features):
-                            batch = flush(batch)
+                            batch = flush(batch, itertools.chain(
+                                new_layer[j:],
+                                (s for l in dag[i + 1:] for s in l)))
                         m = st.fit(batch)
                     elif isinstance(st, Transformer):
                         m = st
@@ -341,9 +348,6 @@ class Workflow(_WorkflowCore):
                     pending, (s for l in dag[i + 1:] for s in l)), keep)
         with timer.phase("fit:apply_tail"):
             batch = flush(batch)
-        # the tail flush materialized every pending output; release the
-        # intermediates one last time (HBM liveness)
-        batch = prune_batch(batch, (), keep)
         return batch, fitted_dag
 
     def _fit_with_workflow_cv(self, batch, dag, timer=None):

@@ -227,3 +227,64 @@ def test_fit_releases_intermediate_columns():
     assert 0.5 <= m["AuROC"] <= 1.0
     scored = model.score()
     assert len(scored[pred.name].values["prediction"]) == n
+
+
+def test_deferred_flush_fit_matches_eager_fit_dag():
+    """The fused fit path (_fit_plain: transforms deferred and flushed as
+    ScoreProgram runs at estimator boundaries) must produce the same fitted
+    state and scores as the plain eager layer-by-layer fit (dag.fit_dag) —
+    pinning the round-4 restructure."""
+    import numpy as np
+
+    from transmogrifai_tpu import types as T
+    from transmogrifai_tpu.columns import Column, ColumnBatch, column_from_values
+    from transmogrifai_tpu.dag import compute_dag, fit_dag
+    from transmogrifai_tpu.features import features_from_schema
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                            ModelCandidate, grid)
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(13)
+    n = 1200
+    words = [f"w{i}" for i in range(40)]
+    text = np.asarray([None if rng.random() < .2 else
+                       " ".join(rng.choice(words, 3)) for _ in range(n)],
+                      object)
+    cat = np.asarray([None if rng.random() < .1 else f"c{rng.integers(6)}"
+                      for _ in range(n)], object)
+    rmap = np.empty(n, object)
+    for i in range(n):
+        rmap[i] = {k: float(rng.normal()) for k in ("a", "b")
+                   if rng.random() < .8}
+    y = (rng.random(n) < .5).astype(np.float32)
+
+    def build():
+        cols = {"label": Column(T.RealNN, y),
+                "text": column_from_values(T.Text, text.copy()),
+                "cat": column_from_values(T.PickList, cat.copy()),
+                "rmap": Column(T.RealMap, rmap)}
+        schema = {"label": T.RealNN, "text": T.Text, "cat": T.PickList,
+                  "rmap": T.RealMap}
+        label, preds = features_from_schema(schema, response="label")
+        fv = transmogrify(preds, num_hashes=32)
+        checked = label.sanity_check(fv, remove_bad_features=True)
+        sel = BinaryClassificationModelSelector(models=[ModelCandidate(
+            OpLogisticRegression(), grid(reg_param=[0.01], max_iter=[20]),
+            "LR")])
+        sel.set_input(label, checked)
+        return ColumnBatch(cols, n), sel.get_output()
+
+    batch, pred = build()
+    model = Workflow().set_input_batch(batch).set_result_features(pred).train()
+    p_fused = np.asarray(model.score(batch=batch)[pred.name]
+                         .values["probability"])
+
+    # eager reference: same DAG fit layer-by-layer with immediate transforms
+    batch2, pred2 = build()
+    dag = compute_dag([pred2])
+    out_batch, _ = fit_dag(batch2, dag)
+    p_eager = np.asarray(out_batch[pred2.name].values["probability"])
+    assert np.allclose(p_fused, p_eager, atol=1e-5), \
+        float(np.abs(p_fused - p_eager).max())

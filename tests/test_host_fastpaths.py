@@ -226,3 +226,32 @@ def test_smart_text_fit_transform_matches_across_native(monkeypatch):
     v2, s2 = run(True)
     assert s1 == s2
     assert np.array_equal(v1, v2)
+
+
+def test_rff_histogram_mesh_invariant(monkeypatch):
+    """RawFeatureFilter's sharded numeric histogram must be BIT-identical to
+    the np.histogram single-device path — binning happens on host in
+    float64, only the count reduction shards (round-4 review finding:
+    float32 device binning moved edge-adjacent epoch timestamps across
+    bins, making drop decisions mesh-dependent)."""
+    import jax
+
+    from transmogrifai_tpu.filters import _histogram_of
+    from transmogrifai_tpu.types import Real
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device backend")
+    rng = np.random.default_rng(21)
+    # epoch-timestamp magnitudes with values planted exactly ON bin edges
+    arr = (1.7e9 + rng.integers(0, 1_000_000, size=4096)).astype(np.float64)
+    lo, hi = float(arr.min()), float(arr.max())
+    edges = np.linspace(lo, hi, 51)
+    arr[:50] = edges[:-1]          # exact left edges
+    arr[50] = hi                   # inclusive last edge
+    present = np.ones(arr.size, bool)
+
+    off = _histogram_of(arr, present, Real, 50, 10, value_range=(lo, hi))
+    monkeypatch.setenv("TRANSMOGRIFAI_TPU_MESH", "1")
+    on = _histogram_of(arr, present, Real, 50, 10, value_range=(lo, hi))
+    assert np.array_equal(off, on)
+    assert float(on.sum()) == arr.size

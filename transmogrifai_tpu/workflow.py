@@ -241,35 +241,50 @@ class Workflow(_WorkflowCore):
         return model
 
     def _prefetch_text_profiles(self, batch) -> None:
-        """Profile text columns feeding hashing vectorizers ONCE, up front,
-        and start the async host→device transfer of their packed token ids —
-        the slow host link then overlaps RawFeatureFilter + fit host work
-        instead of serializing after it (the TPU analog of the reference
-        keeping tokenization on executors, SmartTextVectorizer.scala:80).
-        Large batches only: tiny workflows would pay dispatch latency for
-        nothing."""
+        """Start the async host→device transfers a training run will need,
+        up front: packed token ids for hashing vectorizers (profiled ONCE,
+        cached on the Column) and the bf16-wire copies of numeric raw
+        columns + the label.  The 5-12 MB/s host link then overlaps
+        RawFeatureFilter + fit host work instead of serializing after it
+        (the TPU analog of the reference keeping row work on executors,
+        SmartTextVectorizer.scala:80).  Large batches only: tiny workflows
+        would pay dispatch latency for nothing."""
         if len(batch) < 100_000:
             return
+        import jax
+
+        from .columns import to_device_f32
         from .ops.text import HashingVectorizer, SmartTextVectorizer
+        if jax.default_backend() == "cpu":
+            return      # no slow link to hide
         try:
             for st in dag_stages(compute_dag(self.result_features)):
-                if not isinstance(st, (SmartTextVectorizer,
-                                       HashingVectorizer)):
+                if isinstance(st, (SmartTextVectorizer, HashingVectorizer)):
+                    num_hashes = int(st.get("num_hashes") or 0)
+                    for f in st.input_features:
+                        col = batch.get(f.name)
+                        if col is None or not col.is_host_object():
+                            continue
+                        vals = col.values
+                        if len(vals) and not isinstance(
+                                next((v for v in vals if v is not None), ""),
+                                str):
+                            continue    # token lists take the legacy path
+                        from .ops.text_profile import column_profile
+                        prof = column_profile(col)
+                        if num_hashes:
+                            prof.prefetch(num_hashes)
+            # numeric raw columns + label: the weakref transfer cache makes
+            # these THE copies every later consumer (frontier _prep,
+            # vectorizer fits, selector y) reuses
+            for f in self.raw_features:
+                col = batch.get(f.name)
+                if col is None or col.is_host_object():
                     continue
-                num_hashes = int(st.get("num_hashes") or 0)
-                for f in st.input_features:
-                    col = batch.get(f.name)
-                    if col is None or not col.is_host_object():
-                        continue
-                    vals = col.values
-                    if len(vals) and not isinstance(
-                            next((v for v in vals if v is not None), ""),
-                            str):
-                        continue    # token lists take the legacy path
-                    from .ops.text_profile import column_profile
-                    prof = column_profile(col)
-                    if num_hashes:
-                        prof.prefetch(num_hashes)
+                v = col.values
+                if (isinstance(v, np.ndarray)
+                        and v.dtype in (np.float32, np.float64)):
+                    to_device_f32(v, exact=f.is_response)
         except Exception:  # noqa: BLE001 — prefetch must never break train
             pass
 

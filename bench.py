@@ -381,6 +381,34 @@ def run_score(N: int, on_accel: bool, platform: str):
     }
 
 
+def last_json_line(stdout: str):
+    """The last JSON result line of a bench process' stdout (shared with
+    scripts/run_scale_bench.py)."""
+    return next((ln for ln in reversed(stdout.splitlines())
+                 if ln.startswith("{")), None)
+
+
+def _retry_in_subprocess(workload: str) -> bool:
+    """Re-run ONE workload in a fresh process after a TPU-worker crash —
+    the tunneled worker occasionally hard-faults and the jax client cannot
+    recover in-process (see BENCH_11M_ATTEMPTS_r4.json); a fresh client
+    usually can.  Prints the child's JSON line with a retry marker in aux
+    (the rerun is honest wall-clock but cold-process, so consumers must be
+    able to tell); returns success."""
+    import subprocess
+    env = {**os.environ, "BENCH_WORKLOAD": workload, "BENCH_NO_RETRY": "1"}
+    p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       capture_output=True, text=True, env=env)
+    line = last_json_line(p.stdout)
+    if p.returncode == 0 and line:
+        rec = json.loads(line)
+        rec.setdefault("aux", {})["retried_in_subprocess"] = True
+        print(json.dumps(rec), flush=True)
+        return True
+    sys.stderr.write(p.stderr[-2000:])
+    return False
+
+
 def main():
     import jax
 
@@ -400,17 +428,41 @@ def main():
             sys.exit(f"{env}={r} too small (need >= 1000)")
         return r
 
-    if workload in ("dense", "all"):
-        print(json.dumps(run_dense(rows("BENCH_ROWS", 1_000_000, 100_000),
-                                   on_accel, platform)), flush=True)
-    if workload in ("transmog", "all"):
-        print(json.dumps(run_transmog(
+    jobs = [
+        ("dense", lambda: run_dense(rows("BENCH_ROWS", 1_000_000, 100_000),
+                                    on_accel, platform)),
+        ("transmog", lambda: run_transmog(
             rows("BENCH_TRANSMOG_ROWS", 1_000_000, 20_000),
-            on_accel, platform)), flush=True)
-    if workload in ("score", "all"):
-        print(json.dumps(run_score(
+            on_accel, platform)),
+        ("score", lambda: run_score(
             rows("BENCH_SCORE_ROWS", 1_000_000, 20_000),
-            on_accel, platform)), flush=True)
+            on_accel, platform)),
+    ]
+    can_retry = (os.environ.get("BENCH_NO_RETRY") != "1" and on_accel)
+    broken = False
+    failures = 0
+    for name, fn in jobs:
+        if workload not in (name, "all"):
+            continue
+        if not broken:
+            try:
+                print(json.dumps(fn()), flush=True)
+                continue
+            except Exception as e:  # noqa: BLE001 — worker-crash isolation
+                import traceback
+                traceback.print_exc()
+                # only a worker/runtime fault warrants a fresh-process
+                # retry — an UNAVAILABLE client poisons every later jax
+                # call in this process; deterministic bugs must just fail
+                is_worker_fault = ("UNAVAILABLE" in str(e)
+                                   or type(e).__name__ == "JaxRuntimeError")
+                broken = can_retry and is_worker_fault
+                if not broken:
+                    raise
+        if not _retry_in_subprocess(name):
+            failures += 1
+    if failures:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -18,6 +18,8 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
+from bench import last_json_line  # noqa: E402
+
 
 def main():
     out_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
@@ -48,7 +50,6 @@ def main():
                                cwd=ROOT)
             rec = {"rows": n, "phase": phase, "rc": p.returncode,
                    "proc_wall_s": round(time.time() - t0, 1)}
-            from bench import last_json_line
             line = last_json_line(p.stdout)
             if line:
                 rec["result"] = json.loads(line)

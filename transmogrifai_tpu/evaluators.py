@@ -176,6 +176,14 @@ class OpEvaluatorBase:
         None when unavailable (caller falls back to the host path)."""
         return None
 
+    def evaluate_masked_grid(self, y_dev, S, W):
+        """Default metric for K candidate SCORE COLUMNS at once: S [N, K]
+        (any rank-preserving score, e.g. linear margins), W [K, N] per-
+        candidate validation masks → [K] device scalars.  One program + one
+        batched pull replaces K per-candidate metric dispatches in the CV
+        grid.  None when this evaluator has no grid implementation."""
+        return None
+
 
 class OpBinaryClassificationEvaluator(OpEvaluatorBase):
     """≙ OpBinaryClassificationEvaluator.scala:67-185."""
@@ -239,6 +247,15 @@ class OpBinaryClassificationEvaluator(OpEvaluatorBase):
                 return (2 * precision * recall / (precision + recall)
                         if precision + recall > 0 else 0.0)
             return (fp + fn_) / max(tp + fp + tn + fn_, 1.0)
+        return None
+
+    def evaluate_masked_grid(self, y_dev, S, W):
+        from .metrics_device import masked_aupr_grid, masked_auroc_grid
+        m = self.default_metric
+        if m == "AuROC":
+            return masked_auroc_grid(y_dev, S, W)
+        if m == "AuPR":
+            return masked_aupr_grid(y_dev, S, W)
         return None
 
     @staticmethod

@@ -73,6 +73,26 @@ def masked_aupr(y: jnp.ndarray, scores: jnp.ndarray, w: jnp.ndarray):
 
 
 @jax.jit
+def masked_auroc_grid(y: jnp.ndarray, S: jnp.ndarray, W: jnp.ndarray):
+    """``masked_auroc`` for K candidate score columns at once: S [N, K] →
+    [K] AUCs in ONE program (the CV grid's per-candidate metric dispatches
+    collapse to a single one).  ``W`` is either one shared [N] mask (a
+    fold's validation rows — no K-fold duplication of mask HBM) or
+    per-candidate [K, N] masks."""
+    if W.ndim == 1:
+        return jax.vmap(lambda s: masked_auroc(y, s, W), in_axes=1)(S)
+    return jax.vmap(lambda s, w: masked_auroc(y, s, w), in_axes=(1, 0))(S, W)
+
+
+@jax.jit
+def masked_aupr_grid(y: jnp.ndarray, S: jnp.ndarray, W: jnp.ndarray):
+    """``masked_aupr`` over K score columns (see masked_auroc_grid)."""
+    if W.ndim == 1:
+        return jax.vmap(lambda s: masked_aupr(y, s, W), in_axes=1)(S)
+    return jax.vmap(lambda s, w: masked_aupr(y, s, w), in_axes=(1, 0))(S, W)
+
+
+@jax.jit
 def masked_binary_confusion(y: jnp.ndarray, yhat: jnp.ndarray, w: jnp.ndarray):
     """Returns [tp, fp, tn, fn] weighted counts as ONE stacked array (a single
     scalar-block transfer over the host link)."""

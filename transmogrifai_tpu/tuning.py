@@ -252,6 +252,26 @@ class DataCutter(Splitter):
 # validators
 # --------------------------------------------------------------------------
 
+_GRID_MARGINS_JIT = None
+
+
+def _grid_margins(X, C, b):
+    """[N, K] linear margins for K candidates in one dispatch; bf16 feature
+    storage converts inside the matmul (f32 accumulation), nothing [N, D]
+    materializes."""
+    global _GRID_MARGINS_JIT
+    if _GRID_MARGINS_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fn(X, C, b):
+            return jnp.einsum("nd,kd->nk", X, C,
+                              preferred_element_type=jnp.float32) + b[None, :]
+        _GRID_MARGINS_JIT = fn
+    return _GRID_MARGINS_JIT(X, C, b)
+
+
 _FOLD_MASK_FNS: Dict[int, Any] = {}
 
 
@@ -359,6 +379,59 @@ class OpValidator:
         """Shared data-axis mesh policy (parallel.mesh.maybe_data_mesh)."""
         from .parallel.mesh import maybe_data_mesh
         return maybe_data_mesh(n_rows)
+
+    def _record_grid_metrics_batched(self, cand, ci, fitted_grid, X, y_dev,
+                                     va_masks_dev, record) -> bool:
+        """Score a LINEAR family's whole (fold × grid) block with ONE matmul
+        + ONE vmapped metric program + deferred scalars — K per-candidate
+        metric dispatches (each a link round trip of queue latency) collapse
+        to a single pair.  AUC metrics are rank-invariant, so raw margins
+        replace per-model sigmoid scores exactly.  Returns False when the
+        family/evaluator has no batched form (caller keeps the per-candidate
+        path)."""
+        import jax
+        import jax.numpy as jnp
+
+        if (self.evaluator is None
+                or type(self.evaluator).evaluate_masked_grid
+                is OpEvaluatorBase.evaluate_masked_grid):
+            return False
+        F = len(va_masks_dev)
+        G = len(cand.grid)
+        coefs, intercepts = [], []
+        for f in range(F):
+            for gi in range(G):
+                fitted = fitted_grid[f][gi]
+                if (not isinstance(fitted, dict) or "coef" not in fitted
+                        or fitted.get("kind") not in ("binary", "svc",
+                                                      "regression")):
+                    return False
+                c = fitted["coef"]
+                if getattr(c, "ndim", 1) != 1:
+                    return False
+                coefs.append(c)
+                intercepts.append(fitted.get("intercept", 0.0))
+        try:
+            C = jnp.stack([jnp.asarray(c, jnp.float32) for c in coefs])
+            b = jnp.stack([jnp.asarray(i, jnp.float32).reshape(-1)[0]
+                           for i in intercepts])
+            S = _grid_margins(X, C, b)                     # [N, F*G]
+            # one grid-metric program per FOLD, sharing the fold's single
+            # [N] validation mask — stacking [F*G, N] masks would multiply
+            # mask HBM by the grid size in the near-capacity regime
+            per_fold = []
+            for f in range(F):
+                vals = self.evaluator.evaluate_masked_grid(
+                    y_dev, S[:, f * G:(f + 1) * G], va_masks_dev[f])
+                if vals is None or getattr(vals, "shape", (0,)) != (G,):
+                    return False       # wrong-shape result must not record
+                per_fold.append(vals)
+            for f in range(F):
+                for gi, params in enumerate(cand.grid):
+                    record(cand, ci, gi, params, per_fold[f][gi])
+            return True
+        except Exception:  # noqa: BLE001 — optimization only; fall back
+            return False
 
     # -- main entry -------------------------------------------------------
     def validate(self, candidates: Sequence[ModelCandidate], batch: ColumnBatch,
@@ -607,6 +680,11 @@ class OpValidator:
 
             for ci, cand in enumerate(candidates):
                 fitted_grid = fitted_grids[ci]
+                if (is_dev and mesh is None
+                        and self._record_grid_metrics_batched(
+                            cand, ci, fitted_grid, X, y_dev,
+                            va_masks_dev, record)):
+                    continue
                 for f, va_idx in enumerate(va_slices):
                     for gi, params in enumerate(cand.grid):
                         fitted = fitted_grid[f][gi]

@@ -205,8 +205,10 @@ class Workflow(_WorkflowCore):
                                 nan_guard)
 
         timer = PhaseTimer()
-        batch = self.generate_raw_data()
-        self._prefetch_text_profiles(batch)
+        with timer.phase("read"):
+            batch = self.generate_raw_data()
+        with timer.phase("prefetch"):
+            self._prefetch_text_profiles(batch)
         rff_results = None
         if self._raw_feature_filter is not None:
             with timer.phase("rff"):

@@ -302,3 +302,56 @@ def test_validator_batched_linear_metrics_match_fallback(monkeypatch):
     assert ma.keys() == mb.keys()
     for key in ma:
         assert ma[key] == pytest.approx(mb[key], abs=1e-6), key
+
+
+def test_validator_batched_tree_metrics_match_fallback(monkeypatch):
+    """The grouped tree-family metric path (concatenated tree stacks, leaf
+    sums as rank-equivalent scores) must reproduce the per-candidate device
+    metrics for RF and GBT."""
+    import pytest
+
+    from transmogrifai_tpu.columns import Column, ColumnBatch
+    from transmogrifai_tpu.models.trees import (OpGBTClassifier,
+                                                OpRandomForestClassifier)
+    from transmogrifai_tpu.tuning import ModelCandidate, OpCrossValidation
+    from transmogrifai_tpu.types import OPVector, RealNN
+    import transmogrifai_tpu.tuning as tu
+
+    rng = np.random.default_rng(17)
+    n, d = 4000, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = ((X[:, 0] + 0.5 * X[:, 1] * X[:, 2])
+         + rng.normal(scale=0.7, size=n) > 0).astype(np.float32)
+    batch = ColumnBatch({"label": Column(RealNN, y),
+                         "fv": Column(OPVector, X)}, n)
+    cands = [
+        ModelCandidate(OpRandomForestClassifier(),
+                       [dict(num_trees=8, max_depth=4),
+                        dict(num_trees=8, max_depth=3)], "RF"),
+        ModelCandidate(OpGBTClassifier(),
+                       [dict(max_iter=5, max_depth=3)], "GBT"),
+    ]
+
+    def run(disable_batched):
+        if disable_batched:
+            monkeypatch.setattr(
+                tu.OpValidator, "_record_grid_metrics_batched",
+                lambda self, *a, **k: False)
+        v = OpCrossValidation(num_folds=3,
+                              evaluator=Evaluators.BinaryClassification.auPR())
+        res = v.validate(cands, batch, "label", "fv")
+        monkeypatch.undo()
+        return res
+
+    a = run(False)
+    b = run(True)
+    assert a.best_params == b.best_params
+    assert a.best.model_name == b.best.model_name
+    ma = {(r.model_name, tuple(sorted(r.params.items()))): r.mean_metric
+          for r in a.all_results}
+    mb = {(r.model_name, tuple(sorted(r.params.items()))): r.mean_metric
+          for r in b.all_results}
+    assert ma.keys() == mb.keys()
+    for key in ma:
+        assert ma[key] == pytest.approx(mb[key], abs=2e-4), (
+            key, ma[key], mb[key])

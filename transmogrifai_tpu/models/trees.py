@@ -591,6 +591,30 @@ def _row_blocked(per_block_fn, X: jnp.ndarray):
     return out.reshape((n_blocks * BLOCK,) + out.shape[2:])[:N]
 
 
+@functools.partial(jax.jit, static_argnames=("max_depth", "members"))
+def predict_trees_sum_grouped(X: jnp.ndarray, feature: jnp.ndarray,
+                              threshold: jnp.ndarray, is_leaf: jnp.ndarray,
+                              leaf: jnp.ndarray, max_depth: int,
+                              members: int) -> jnp.ndarray:
+    """Leaf SUMS for ``members`` tree ensembles at once → [N, members, V].
+
+    The tree arrays are the members' stacks concatenated along the tree
+    axis (equal trees-per-member).  One program replaces one predict
+    dispatch per CV candidate; sums are rank-equivalent to each member's
+    probability/margin (gini leaves sum to 1 per tree; GBT margins are a
+    positive affine map of the leaf sum), which is all AUC metrics need."""
+    T_total = feature.shape[0]
+    per = T_total // members
+
+    def blk(xb):
+        lv = _predict_trees_block(xb, feature, threshold, is_leaf, leaf,
+                                  max_depth)                 # [B, T, V]
+        return lv.reshape(lv.shape[0], members, per,
+                          lv.shape[-1]).sum(axis=2)          # [B, K, V]
+
+    return _row_blocked(blk, X)
+
+
 @functools.partial(jax.jit, static_argnames=("max_depth", "op"))
 def predict_trees_agg(X: jnp.ndarray, feature: jnp.ndarray,
                       threshold: jnp.ndarray, is_leaf: jnp.ndarray,

@@ -398,6 +398,11 @@ class OpValidator:
             return False
         F = len(va_masks_dev)
         G = len(cand.grid)
+        kinds = {fitted.get("kind") if isinstance(fitted, dict) else None
+                 for row in fitted_grid for fitted in row}
+        if kinds <= {"forest", "gbt"}:
+            return self._record_tree_grid_metrics(cand, ci, fitted_grid, X,
+                                                  y_dev, va_masks_dev, record)
         coefs, intercepts = [], []
         for f in range(F):
             for gi in range(G):
@@ -429,6 +434,79 @@ class OpValidator:
             for f in range(F):
                 for gi, params in enumerate(cand.grid):
                     record(cand, ci, gi, params, per_fold[f][gi])
+            return True
+        except Exception:  # noqa: BLE001 — optimization only; fall back
+            return False
+
+    def _record_tree_grid_metrics(self, cand, ci, fitted_grid, X, y_dev,
+                                  va_masks_dev, record) -> bool:
+        """Tree-family analog of the batched linear metrics: within each
+        (fold, tree-shape) group, the members' tree stacks concatenate and
+        ONE blocked walk produces per-member leaf SUMS — rank-equivalent to
+        each candidate's probability (gini leaves sum to 1 per tree) or GBT
+        margin (positive affine in the leaf sum), so the AUC metrics match
+        the per-candidate path.  Replaces one predict+metric dispatch chain
+        per (fold × grid point) with one per (fold × shape group)."""
+        from collections import defaultdict
+
+        import jax.numpy as jnp
+
+        from .models.trees import predict_trees_sum_grouped
+
+        F = len(va_masks_dev)
+        G = len(cand.grid)
+        groups = defaultdict(list)
+        for f in range(F):
+            for gi in range(G):
+                fitted = fitted_grid[f][gi]
+                if not isinstance(fitted, dict) or fitted.get("kind") not in (
+                        "forest", "gbt"):
+                    return False
+                if fitted["kind"] == "forest" and fitted.get(
+                        "n_classes", 2) != 2:
+                    return False     # binary evaluator only
+                shp = tuple(np.shape(fitted["feature"]))
+                if len(shp) != 2:
+                    return False
+                groups[(f, fitted["kind"], shp,
+                        int(fitted["max_depth"]))].append((gi, fitted))
+        try:
+            results = {}
+            for (f, kind, _shp, md), members in groups.items():
+                K = len(members)
+                feat = jnp.concatenate(
+                    [jnp.asarray(m["feature"]) for _, m in members])
+                thr = jnp.concatenate(
+                    [jnp.asarray(m["threshold"]) for _, m in members])
+                lf = jnp.concatenate(
+                    [jnp.asarray(m["is_leaf"]) for _, m in members])
+                lv = jnp.concatenate(
+                    [jnp.asarray(m["leaf"]) for _, m in members])
+                sums = predict_trees_sum_grouped(X, feat, thr, lf, lv,
+                                                 md + 1, K)   # [N, K, V]
+                if kind == "forest":
+                    S = sums[..., 1]
+                else:
+                    # reproduce the per-candidate path's sigmoid(margin)
+                    # EXACTLY — raw sums rank identically in exact math, but
+                    # f32 sigmoid saturation creates tie groups the raw sums
+                    # would not, shifting AUC on confidently-separated data
+                    import jax
+                    eta = jnp.asarray([float(m["eta"]) for _, m in members],
+                                      jnp.float32)
+                    base = jnp.asarray([float(m["base"]) for _, m in members],
+                                       jnp.float32)
+                    S = jax.nn.sigmoid(base[None, :]
+                                       + eta[None, :] * sums[..., 0])
+                vals = self.evaluator.evaluate_masked_grid(
+                    y_dev, S, va_masks_dev[f])
+                if vals is None or getattr(vals, "shape", (0,)) != (K,):
+                    return False
+                for j, (gi, _) in enumerate(members):
+                    results[(f, gi)] = vals[j]
+            for f in range(F):
+                for gi, params in enumerate(cand.grid):
+                    record(cand, ci, gi, params, results[(f, gi)])
             return True
         except Exception:  # noqa: BLE001 — optimization only; fall back
             return False

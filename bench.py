@@ -388,31 +388,109 @@ def last_json_line(stdout: str):
                  if ln.startswith("{")), None)
 
 
-def _retry_in_subprocess(workload: str) -> bool:
+def _retry_in_subprocess(workload: str):
     """Re-run ONE workload in a fresh process after a TPU-worker crash —
     the tunneled worker occasionally hard-faults and the jax client cannot
     recover in-process (see BENCH_11M_ATTEMPTS_r4.json); a fresh client
     usually can.  Prints the child's JSON line with a retry marker in aux
     (the rerun is honest wall-clock but cold-process, so consumers must be
-    able to tell); returns success."""
+    able to tell); returns the record or None."""
     import subprocess
     env = {**os.environ, "BENCH_WORKLOAD": workload, "BENCH_NO_RETRY": "1"}
-    p = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                       capture_output=True, text=True, env=env)
+    try:
+        p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           capture_output=True, text=True, env=env,
+                           timeout=int(os.environ.get(
+                               "BENCH_CHILD_TIMEOUT_S", "2400")))
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"bench retry of {workload}: hung past timeout\n")
+        return None
     line = last_json_line(p.stdout)
     if p.returncode == 0 and line:
         rec = json.loads(line)
         rec.setdefault("aux", {})["retried_in_subprocess"] = True
         print(json.dumps(rec), flush=True)
-        return True
+        return rec
     sys.stderr.write(p.stderr[-2000:])
-    return False
+    return None
+
+
+# The round-4 driver bench died at `jax.devices()` (rc=1, zero JSON lines)
+# when the tunneled axon backend could not initialize — and the same outage
+# mode can also HANG init forever, so the probe must live in a subprocess the
+# parent can time out (VERDICT r4 weak #5 / next #1a).
+def _probe_platform():
+    """Resolve the default jax platform in fresh subprocesses with
+    retry+backoff.  Returns (platform|None, probe_info dict)."""
+    import subprocess
+    code = "import jax; print(jax.devices()[0].platform)"
+    attempts = []
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "150"))
+    backoffs = [float(b) for b in os.environ.get(
+        "BENCH_PROBE_BACKOFFS", "0,45,120").split(",")]
+    for backoff_s in backoffs:
+        if backoff_s:
+            time.sleep(backoff_s)
+        t0 = time.time()
+        try:
+            p = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=probe_timeout)
+            out = (p.stdout.strip().splitlines() or [""])[-1]
+            if p.returncode == 0 and out:
+                attempts.append({"wall_s": round(time.time() - t0, 1),
+                                 "result": out})
+                return out, {"attempts": attempts}
+            attempts.append({"wall_s": round(time.time() - t0, 1),
+                             "result": "error",
+                             "tail": p.stderr.strip()[-300:]})
+        except subprocess.TimeoutExpired:
+            attempts.append({"wall_s": round(time.time() - t0, 1),
+                             "result": "hang"})
+    return None, {"attempts": attempts}
+
+
+def _force_cpu_inprocess():
+    """Switch this process to the CPU backend without ever initializing the
+    (possibly hung) axon backend."""
+    import jax
+    import jax.extend.backend as jeb
+    jax.config.update("jax_platforms", "cpu")
+    jeb.clear_backends()
 
 
 def main():
     import jax
 
-    platform = jax.devices()[0].platform
+    outage_info = None
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # operator (or outage-fallback parent) pinned CPU: never probe the
+        # accelerator backend, and label the run by what actually executes
+        _force_cpu_inprocess()
+        platform = jax.devices()[0].platform
+    elif os.environ.get("BENCH_NO_RETRY") == "1":
+        # child process: the parent already resolved backend reachability
+        platform = jax.devices()[0].platform
+    else:
+        platform, probe_info = _probe_platform()
+        if platform is None:
+            # Tunnel outage: emit a cleanly-marked outage record and fall
+            # back to the reduced CPU smoke sizes so the artifact still
+            # carries real (honestly-labeled) numbers instead of rc=1.
+            outage_info = probe_info
+            print(json.dumps({
+                "metric": "accelerator backend unreachable "
+                          "(tunnel outage); falling back to CPU smoke",
+                "value": 0, "unit": "outage", "vs_baseline": 0.0,
+                "aux": probe_info}), flush=True)
+            os.environ["BENCH_FORCE_CPU"] = "1"
+            # keep the fallback bounded on this 1-core host: reduced rows
+            # unless the operator pinned sizes explicitly
+            os.environ.setdefault("BENCH_ROWS", "20000")
+            os.environ.setdefault("BENCH_TRANSMOG_ROWS", "10000")
+            os.environ.setdefault("BENCH_SCORE_ROWS", "10000")
+            _force_cpu_inprocess()
+            platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
     workload = os.environ.get("BENCH_WORKLOAD", "all").strip() or "all"
 
@@ -441,12 +519,15 @@ def main():
     can_retry = (os.environ.get("BENCH_NO_RETRY") != "1" and on_accel)
     broken = False
     failures = 0
+    records = {}
     for name, fn in jobs:
         if workload not in (name, "all"):
             continue
         if not broken:
             try:
-                print(json.dumps(fn()), flush=True)
+                rec = fn()
+                records[name] = rec
+                print(json.dumps(rec), flush=True)
                 continue
             except Exception as e:  # noqa: BLE001 — worker-crash isolation
                 import traceback
@@ -459,8 +540,37 @@ def main():
                 broken = can_retry and is_worker_fault
                 if not broken:
                     raise
-        if not _retry_in_subprocess(name):
+        rec = _retry_in_subprocess(name)
+        if rec is None:
             failures += 1
+        else:
+            records[name] = rec
+    if os.environ.get("BENCH_NO_RETRY") != "1" and len(records) > 1:
+        # final aggregate line so the driver's last-line `parsed` field
+        # carries the whole three-workload picture, with the dense CV-grid
+        # wall as the headline value (VERDICT r4 next #1a)
+        head = records.get("dense") or next(iter(records.values()))
+        agg = {"metric": "bench aggregate [headline: " + head["metric"] + "]",
+               "value": head["value"], "unit": head["unit"],
+               "vs_baseline": head["vs_baseline"],
+               "aux": {"workloads": records}}
+        if outage_info is not None:
+            agg["aux"]["accelerator_outage"] = outage_info
+        print(json.dumps(agg), flush=True)
+        try:  # standing perf artifact (VERDICT r4 next #7b)
+            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_STANDING.json")
+            hist = []
+            if os.path.exists(path):
+                with open(path) as fh:
+                    hist = json.load(fh).get("runs", [])
+            hist.append({"utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime()),
+                         "platform": platform, "workloads": records})
+            with open(path, "w") as fh:
+                json.dump({"runs": hist[-20:]}, fh, indent=1)
+        except Exception:  # an artifact write must never fail the bench
+            pass
     if failures:
         sys.exit(1)
 

@@ -89,22 +89,49 @@ def test_isotonic_calibrator_matches_sklearn():
 
 
 def test_pearson_spearman_match_scipy():
+    # Spearman's rank transform runs INSIDE the fused stats program
+    # (spearman=True static arg) — one executable, no host ranking
+    # (≙ SanityChecker.scala:535-640 Spearman option)
     scipy_stats = pytest.importorskip("scipy.stats")
     import jax.numpy as jnp
-    from transmogrifai_tpu.preparators.sanity_checker import (_col_stats,
-                                                              _rank_transform)
+    from transmogrifai_tpu.preparators.sanity_checker import _col_stats
     rng = np.random.default_rng(5)
     X = rng.normal(size=(800, 4)).astype(np.float32)
     X[:, 1] = X[:, 0] ** 3 + 0.2 * rng.normal(size=800)  # monotone nonlinear
+    X[:, 3] = np.round(X[:, 3] * 2)  # heavy ties: tie-averaged ranks matter
     y = (X[:, 0] + 0.3 * rng.normal(size=800)).astype(np.float32)
     pearson = np.asarray(_col_stats(jnp.asarray(X), jnp.asarray(y))[4])
-    spearman = np.asarray(_col_stats(_rank_transform(jnp.asarray(X)),
-                                     _rank_transform(jnp.asarray(y)))[4])
+    spearman = np.asarray(
+        _col_stats(jnp.asarray(X), jnp.asarray(y), spearman=True)[4])
     for j in range(4):
         assert pearson[j] == pytest.approx(
             scipy_stats.pearsonr(X[:, j], y)[0], abs=1e-4)
         assert spearman[j] == pytest.approx(
             scipy_stats.spearmanr(X[:, j], y)[0], abs=1e-4)
+
+
+def test_spearman_fused_with_contingency_matches_scipy():
+    # the grouped-categorical path previously fell back to a separate
+    # host-side second pass under spearman; now both ride one program
+    scipy_stats = pytest.importorskip("scipy.stats")
+    import jax.numpy as jnp
+    from transmogrifai_tpu.preparators.sanity_checker import (
+        _col_stats_with_contingency)
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(500, 3)).astype(np.float32)
+    ind = (rng.random((500, 2)) < 0.4).astype(np.float32)  # indicator cols
+    Xall = np.concatenate([X, ind], axis=1)
+    y = (rng.random(500) < 0.5).astype(np.float32)
+    stacked, cont = _col_stats_with_contingency(
+        jnp.asarray(Xall), jnp.asarray(y), jnp.asarray([3, 4], jnp.int32),
+        jnp.asarray([0.0, 1.0]), spearman=True)
+    corr = np.asarray(stacked)[4]
+    for j in range(5):
+        assert corr[j] == pytest.approx(
+            scipy_stats.spearmanr(Xall[:, j], y)[0], abs=1e-4)
+    # contingency stays a raw-count contraction: [class, col] sums
+    expect = np.stack([Xall[y == c][:, [3, 4]].sum(axis=0) for c in (0, 1)])
+    np.testing.assert_allclose(np.asarray(cont), expect, atol=1e-3)
 
 
 def test_cramers_v_matches_scipy_chi2():

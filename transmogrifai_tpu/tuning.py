@@ -16,10 +16,27 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+import logging
+
 import numpy as np
 
 from .columns import ColumnBatch
 from .evaluators import OpEvaluatorBase
+
+logger = logging.getLogger(__name__)
+
+# batched-metric fast-path fallbacks already logged, one per model family —
+# a silent fallback could hide a real fitted-state corruption behind the
+# (correct but slow) per-candidate path (VERDICT r4 next #7a)
+_logged_fallback_families = set()
+
+
+def _log_metric_fallback(family: str, exc: BaseException) -> None:
+    if family not in _logged_fallback_families:
+        _logged_fallback_families.add(family)
+        # warning, not debug: the default root logger must surface it
+        logger.warning("batched grid-metric fast path fell back to the "
+                       "per-candidate path for %s: %r", family, exc)
 
 
 # --------------------------------------------------------------------------
@@ -435,7 +452,8 @@ class OpValidator:
                 for gi, params in enumerate(cand.grid):
                     record(cand, ci, gi, params, per_fold[f][gi])
             return True
-        except Exception:  # noqa: BLE001 — optimization only; fall back
+        except Exception as e:  # noqa: BLE001 — optimization only; fall back
+            _log_metric_fallback(cand.model_name, e)
             return False
 
     def _record_tree_grid_metrics(self, cand, ci, fitted_grid, X, y_dev,
@@ -508,7 +526,8 @@ class OpValidator:
                 for gi, params in enumerate(cand.grid):
                     record(cand, ci, gi, params, results[(f, gi)])
             return True
-        except Exception:  # noqa: BLE001 — optimization only; fall back
+        except Exception as e:  # noqa: BLE001 — optimization only; fall back
+            _log_metric_fallback(cand.model_name, e)
             return False
 
     # -- main entry -------------------------------------------------------

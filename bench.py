@@ -138,8 +138,48 @@ def _phase_split(model):
     fe = sum(p.wall_s for p in am.phases if p.name.startswith("fit:"))
     sel = sum(p.wall_s for p in am.phases if p.name == "selector")
     rff = sum(p.wall_s for p in am.phases if p.name == "rff")
+    link = {}
+    for p in am.phases:
+        if p.host_link_bytes:
+            key = ("feature_engineering" if p.name.startswith("fit:")
+                   else p.name)
+            link[key] = link.get(key, 0) + p.host_link_bytes
     return {"feature_engineering_s": round(fe, 2),
-            "selector_s": round(sel, 2), "rff_s": round(rff, 2)}
+            "selector_s": round(sel, 2), "rff_s": round(rff, 2),
+            "host_link_mb_by_phase": {k: round(v / 1e6, 1)
+                                      for k, v in link.items()}}
+
+
+# nominal dense peak of one TPU v5e chip (bf16 MXU); override with
+# TRANSMOGRIFAI_PEAK_FLOPS for other parts.  Used only to place the bench
+# programs on a roofline — achieved numbers are the measurement.
+_DEFAULT_PEAK_FLOPS = 1.97e14
+
+
+def _roofline_aux(selector_wall_s, on_accel):
+    """Achieved-FLOP/s diagnostic (VERDICT r4 next #5) from the XLA cost
+    analyses the fit path recorded.  Program flops count ONE execution of
+    each recorded program (the batched grid fits run once per family;
+    per-round GBT programs are not counted), so `peak_fraction` is a floor
+    of true utilization — enough to tell compute-bound from link-bound."""
+    from transmogrifai_tpu.profiling import PROGRAM_COSTS
+    if not PROGRAM_COSTS:
+        return {}
+    peak = float(os.environ.get("TRANSMOGRIFAI_PEAK_FLOPS",
+                                _DEFAULT_PEAK_FLOPS))
+    fit_flops = sum(c.get("flops") or 0.0 for n, c in PROGRAM_COSTS.items()
+                    if n.endswith("_fit"))
+    out = {"programs": {n: {k: round(v, 3) if isinstance(v, float) else v
+                            for k, v in c.items()}
+                        for n, c in PROGRAM_COSTS.items()}}
+    if fit_flops and selector_wall_s:
+        ach = fit_flops / selector_wall_s
+        out["fit_flops_counted"] = fit_flops
+        out["achieved_fit_gflops_per_s"] = round(ach / 1e9, 1)
+        if on_accel:
+            out["peak_flops_assumed"] = peak
+            out["peak_fraction_floor"] = round(ach / peak, 4)
+    return out
 
 
 def _baseline(key):
@@ -232,6 +272,7 @@ def run_dense(N: int, on_accel: bool, platform: str):
     # the published baseline covers the FULL candidate set only
     at_ref = on_accel and N == 1_000_000 and not filtered
     vs = (baseline / wall) if (baseline and at_ref) else 1.0
+    phases = _phase_split(model)
     return {
         "metric": f"OpWorkflow.train wall (HIGGS-like {N}x{D}, 3-fold CV, "
                   f"{n_cands} candidates, {platform})",
@@ -249,7 +290,8 @@ def run_dense(N: int, on_accel: bool, platform: str):
             # hardware this host lacks) — the conservative comparison
             "vs_baseline_8core_lpt": (round(lpt8 / wall, 3)
                                       if (lpt8 and at_ref) else None),
-            **_phase_split(model),
+            **phases,
+            "roofline": _roofline_aux(phases.get("selector_s"), on_accel),
         },
     }
 
@@ -302,6 +344,7 @@ def run_transmog(N: int, on_accel: bool, platform: str):
     lpt8 = _baseline("transmog1m_8core_lpt_s")
     at_ref = on_accel and N == 1_000_000
     vs = (baseline / wall) if (baseline and at_ref) else 1.0
+    phases = _phase_split(model)
     return {
         "metric": f"OpWorkflow.train wall (transmogrification {N} rows: "
                   f"3 text->hash512 + 2 picklist + realmap + 4 real w/nulls, "
@@ -316,7 +359,8 @@ def run_transmog(N: int, on_accel: bool, platform: str):
             "raw_features": len(schema) - 1,
             "vs_baseline_8core_lpt": (round(lpt8 / wall, 3)
                                       if (lpt8 and at_ref) else None),
-            **_phase_split(model),
+            **phases,
+            "roofline": _roofline_aux(phases.get("selector_s"), on_accel),
         },
     }
 
@@ -361,6 +405,8 @@ def run_score(N: int, on_accel: bool, platform: str):
     model.score(batch=batch)
     cols2, _ = make_transmog_columns(N, seed=7)
     batch2 = ColumnBatch(cols2, N)
+    from transmogrifai_tpu.profiling import PROGRAM_COSTS, host_link_bytes
+    link0 = host_link_bytes()
     t0 = time.time()
     scored = model.score(batch=batch2)
     # force materialization of the predictions (async dispatch lies)
@@ -369,6 +415,14 @@ def run_score(N: int, on_accel: bool, platform: str):
     rows_per_s = round(N / wall)
     proxy = _baseline("score1m_rows_per_s")
     at_ref = on_accel and N == 1_000_000
+    roofline = {}
+    prog = PROGRAM_COSTS.get("fused_transform")
+    if prog and prog.get("flops"):
+        # end-to-end: the wall includes the host prologue, so this is the
+        # achieved rate of the WORKLOAD, not the program in isolation
+        roofline = {"fused_transform": prog,
+                    "achieved_gflops_per_s_end_to_end":
+                        round(prog["flops"] / wall / 1e9, 2)}
     return {
         "metric": f"WorkflowModel.score throughput (transmogrified width "
                   f"{fv_width}, {N} rows, warm, {platform})",
@@ -377,7 +431,9 @@ def run_score(N: int, on_accel: bool, platform: str):
         "vs_baseline": (round(rows_per_s / proxy, 3)
                         if (proxy and at_ref) else 1.0),
         "aux": {"rows": N, "wall_s": round(wall, 2),
-                "feature_vector_width": fv_width, "platform": platform},
+                "feature_vector_width": fv_width, "platform": platform,
+                "host_link_mb": round((host_link_bytes() - link0) / 1e6, 1),
+                "roofline": roofline},
     }
 
 
@@ -492,6 +548,9 @@ def main():
             _force_cpu_inprocess()
             platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
+    # roofline diagnostics: the fit/transform paths record XLA cost analyses
+    # of their dominant programs (profiling.record_program_cost)
+    os.environ.setdefault("TRANSMOGRIFAI_COST_ANALYSIS", "1")
     workload = os.environ.get("BENCH_WORKLOAD", "all").strip() or "all"
 
     def rows(env, default_accel, default_cpu):
@@ -523,6 +582,13 @@ def main():
     for name, fn in jobs:
         if workload not in (name, "all"):
             continue
+        try:
+            # rooflines are per-workload: flops recorded at one workload's
+            # shapes must not divide another workload's wall
+            from transmogrifai_tpu.profiling import PROGRAM_COSTS
+            PROGRAM_COSTS.clear()
+        except Exception:  # noqa: BLE001 — diagnostics only
+            pass
         if not broken:
             try:
                 rec = fn()

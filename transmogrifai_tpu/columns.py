@@ -148,6 +148,8 @@ def to_device_f32(values, exact: bool = False) -> Any:
         lossless = True
         dev = jnp.asarray(arr, jnp.float32)
     if big:
+        from .profiling import add_host_link_bytes
+        add_host_link_bytes(wire.nbytes if use_bf16 else arr.size * 4)
         key = id(arr)
         nbytes = int(dev.size) * 4
 

@@ -240,6 +240,13 @@ class ScoreProgram:
                   for n in frontier}
         arrays.update({canon_in[k]: (_prep(v), None)
                        for k, v in wires.items()})
+        # host-resident wire args copy to the device inside the jit call (or
+        # in the sharding block below); count them toward the phase's link
+        # bytes BEFORE _shard turns them into jax Arrays
+        from .profiling import add_host_link_bytes
+        add_host_link_bytes(sum(
+            a.nbytes for v, m in arrays.values() for a in (v, m)
+            if isinstance(a, np.ndarray)))
         # multi-device: row-shard every per-row input over the mesh 'data'
         # axis — the fused program then runs as one GSPMD computation
         # (SURVEY §2.6 P1 on the scoring path; ≙ applyOpTransformations'
@@ -262,6 +269,9 @@ class ScoreProgram:
                 # scoring
                 pass
         jitted, canon_out_map = self._jitted[key]
+        from .profiling import cost_analysis_enabled, record_program_cost
+        if cost_analysis_enabled():
+            record_program_cost("fused_transform", jitted, (arrays,))
         try:
             out_c = jitted(arrays)
             out = {n: out_c[c] for n, c in canon_out_map.items()}

@@ -58,14 +58,27 @@ def _grouped_grid_fit(est, X, y, fold_weights, grids, *, loss: str,
         pens = [l2l1({**est._params, **grids[gi]}) for gi in gidx]
         l2s = jnp.asarray([p[0] for p in pens], jnp.float32)
         l1s = jnp.asarray([p[1] for p in pens], jnp.float32)
+        from ..profiling import cost_analysis_enabled, record_program_cost
         if loss == "squared" and all(p[1] == 0.0 for p in pens):
             res = ridge_grid_fit(Xj, yj, Wj, l2s, fit_intercept=fit_intercept,
                                  standardization=standardization)
+            if cost_analysis_enabled():
+                record_program_cost(
+                    "ridge_grid_fit", ridge_grid_fit, (Xj, yj, Wj, l2s),
+                    dict(fit_intercept=fit_intercept,
+                         standardization=standardization))
         else:
             res = linear_grid_fit(Xj, yj, Wj, l2s, l1s, loss=loss,
                                   fit_intercept=fit_intercept,
                                   standardization=standardization,
                                   max_iter=max_iter, tol=tol, n_classes=nc)
+            if cost_analysis_enabled():
+                record_program_cost(
+                    "linear_grid_fit", linear_grid_fit,
+                    (Xj, yj, Wj, l2s, l1s),
+                    dict(loss=loss, fit_intercept=fit_intercept,
+                         standardization=standardization, max_iter=max_iter,
+                         tol=tol, n_classes=nc))
         coef = np.asarray(res.coef)
         inter = np.asarray(res.intercept)
         n_it = np.asarray(res.n_iter)

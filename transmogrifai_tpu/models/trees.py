@@ -746,9 +746,13 @@ def fit_forest(X: np.ndarray, y: np.ndarray, *, task: str, n_classes: int,
     est_bytes = 32 * N * max(S, 4) * 4 * n_trees
     use_vmap = max_depth <= 8 and n_trees <= 64 and est_bytes < 2 << 30
     fitter = _forest_fitter(impurity, max_depth, max_bins, use_vmap, fpn)
-    trees = fitter(B, jnp.asarray(splits), base_stats, boot, masks, tree_keys,
-                   jnp.float32(min_instances), jnp.float32(min_gain),
-                   jnp.float32(1.0))
+    fit_args = (B, jnp.asarray(splits), base_stats, boot, masks, tree_keys,
+                jnp.float32(min_instances), jnp.float32(min_gain),
+                jnp.float32(1.0))
+    trees = fitter(*fit_args)
+    from ..profiling import cost_analysis_enabled, record_program_cost
+    if cost_analysis_enabled():
+        record_program_cost("forest_fit", fitter, fit_args)
     return {"kind": "forest", "task": task, "n_classes": n_classes,
             "max_depth": max_depth,
             "feature": np.asarray(trees.feature),

@@ -105,6 +105,8 @@ class TextProfile:
             wp = np.full(cap, _sentinel3(num_hashes), np.int32)
             wp[:words.size] = words
             dev = jax.device_put(wp)      # async; consumers queue on it
+            from ..profiling import add_host_link_bytes
+            add_host_link_bytes(wp.nbytes)
             self._device_packed[num_hashes] = dev
         return dev
 

@@ -12,9 +12,55 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+
+# -- host-link transfer accounting (≙ the listener's IO byte counters) ------
+# Incremented at the transfer chokepoints (columns.to_device_f32 cache
+# misses, packed token-id prefetch, fused-program wire args); PhaseTimer
+# snapshots it per phase.  TRACKED transfers only — implicit jit-arg copies
+# of small arrays are not counted.
+_HOST_LINK_BYTES = [0]
+
+
+def add_host_link_bytes(n: int) -> None:
+    _HOST_LINK_BYTES[0] += int(n)
+
+
+def host_link_bytes() -> int:
+    return _HOST_LINK_BYTES[0]
+
+
+# -- XLA program cost registry (VERDICT r4 next #5) -------------------------
+# When TRANSMOGRIFAI_COST_ANALYSIS=1, the dominant compiled programs record
+# their XLA cost analysis (flops / bytes accessed) here, once per program
+# name; bench.py turns them into achieved-FLOP/s roofline fields.
+PROGRAM_COSTS: Dict[str, Dict[str, Any]] = {}
+
+
+def cost_analysis_enabled() -> bool:
+    return os.environ.get("TRANSMOGRIFAI_COST_ANALYSIS") == "1"
+
+
+def record_program_cost(name: str, jitted_fn, args=(), kwargs=None) -> None:
+    """Best-effort XLA cost analysis of ``jitted_fn`` at ``args``' shapes.
+    The explicit lower().compile() hits the in-process/persistent compile
+    cache, so the cost is one analysis pass, not a recompile."""
+    if not cost_analysis_enabled() or name in PROGRAM_COSTS:
+        return
+    try:
+        ca = jitted_fn.lower(*args, **(kwargs or {})).compile(
+        ).cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        PROGRAM_COSTS[name] = {
+            "flops": float(ca.get("flops", 0.0)),
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+        }
+    except Exception:  # noqa: BLE001 — diagnostics must never break a fit
+        pass
 
 
 @dataclass
@@ -24,11 +70,13 @@ class PhaseMetrics:
     wall_s: float
     device_bytes_in_use: Optional[int] = None
     peak_bytes_in_use: Optional[int] = None
+    host_link_bytes: Optional[int] = None
 
     def to_json(self) -> Dict[str, Any]:
         return {"name": self.name, "wallSeconds": round(self.wall_s, 4),
                 "deviceBytesInUse": self.device_bytes_in_use,
-                "peakBytesInUse": self.peak_bytes_in_use}
+                "peakBytesInUse": self.peak_bytes_in_use,
+                "hostLinkBytes": self.host_link_bytes}
 
 
 @dataclass
@@ -73,6 +121,7 @@ class PhaseTimer:
     @contextlib.contextmanager
     def phase(self, name: str):
         t0 = time.time()
+        link0 = host_link_bytes()
         try:
             yield
         finally:
@@ -80,7 +129,8 @@ class PhaseTimer:
             self.phases.append(PhaseMetrics(
                 name, time.time() - t0,
                 device_bytes_in_use=mem["bytes_in_use"],
-                peak_bytes_in_use=mem["peak_bytes_in_use"]))
+                peak_bytes_in_use=mem["peak_bytes_in_use"],
+                host_link_bytes=host_link_bytes() - link0))
 
     def app_metrics(self, tag: Optional[str] = None) -> AppMetrics:
         return AppMetrics(tag, time.time() - self._t0, list(self.phases))

@@ -1148,9 +1148,13 @@ class _ForestEstimatorBase(PredictorEstimator):
             chunk, batch_size = _tree_batch_budget(N, max_bins)
             fitter = _forest_grid_fitter(impurity, max_depth, max_bins,
                                          bootstrap, chunk, batch_size, fpn)
-            trees = fitter(B, jnp.asarray(splits), base_stats, fold_w,
-                           fold_ids, keys, mis, mgs, subs, masks,
-                           jnp.float32(1.0))
+            grid_args = (B, jnp.asarray(splits), base_stats, fold_w,
+                         fold_ids, keys, mis, mgs, subs, masks,
+                         jnp.float32(1.0))
+            trees = fitter(*grid_args)
+            from ..profiling import cost_analysis_enabled, record_program_cost
+            if cost_analysis_enabled():
+                record_program_cost("forest_grid_fit", fitter, grid_args)
             # keep the tree arrays device-resident: candidates slice views of
             # the [Kt, ...] stacks; they only cross the host link if a model
             # is serialized or scored on host data
@@ -1281,9 +1285,12 @@ class _GBTEstimatorBase(PredictorEstimator):
                                             chunk, batch_size, n_rounds)
             mis_d, mgs_d, lams_d, etas_d = (jnp.asarray(a) for a in
                                             (mis, mgs, lams, etas))
-            margins, rounds = fit_all(B, jnp.asarray(splits), Xj, yj,
-                                      margins, W, fmask, mis_d, mgs_d,
-                                      lams_d, etas_d)
+            gbt_args = (B, jnp.asarray(splits), Xj, yj, margins, W, fmask,
+                        mis_d, mgs_d, lams_d, etas_d)
+            margins, rounds = fit_all(*gbt_args)
+            from ..profiling import cost_analysis_enabled, record_program_cost
+            if cost_analysis_enabled():
+                record_program_cost("gbt_grid_fit", fit_all, gbt_args)
             # device-resident [Kc, R, T] stacks; sliced per candidate below
             feature = jnp.swapaxes(rounds.feature, 0, 1)
             threshold = jnp.swapaxes(rounds.threshold, 0, 1)

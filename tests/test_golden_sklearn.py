@@ -145,6 +145,19 @@ def test_cramers_v_matches_scipy_chi2():
     k = min(table.shape) - 1
     expected_v = np.sqrt(chi2 / (n * k))
     assert cs.cramers_v == pytest.approx(expected_v, abs=1e-9)
+    # the p-value comes from the stdlib-only incomplete-gamma implementation
+    # (scipy's import stall was ~2.6 s inside the measured train window)
+    expected_p = scipy_stats.chi2_contingency(table, correction=False)[1]
+    assert cs.p_value == pytest.approx(expected_p, abs=1e-12)
+
+
+def test_chi2_sf_matches_scipy_across_regimes():
+    scipy_stats = pytest.importorskip("scipy.stats")
+    from transmogrifai_tpu.utils.stats import chi2_sf
+    for chi in (0.0, 1e-3, 0.5, 1.0, 3.0, 7.88, 40.0, 300.0, 2000.0):
+        for dof in (1, 2, 5, 19, 100):
+            assert chi2_sf(chi, dof) == pytest.approx(
+                float(scipy_stats.chi2.sf(chi, dof)), abs=1e-12)
 
 
 def test_tree_feature_importances_match_sklearn_direction():

@@ -17,6 +17,52 @@ import numpy as np
 # contingency statistics (≙ OpStatistics)
 # ---------------------------------------------------------------------------
 
+def _igamc(a: float, x: float) -> float:
+    """Regularized upper incomplete gamma Q(a, x) — series expansion for
+    x < a+1, modified-Lentz continued fraction otherwise (the classical
+    numerics; |err| ~ 1e-14).  Stdlib-only on purpose: scipy's import alone
+    costs ~2.6 s on the 1-core bench host, and this p-value is the only
+    thing the hot path needed it for."""
+    import math
+    if x <= 0.0 or a <= 0.0:
+        return 1.0
+    norm = math.exp(-x + a * math.log(x) - math.lgamma(a))
+    if x < a + 1.0:
+        ap, term, total = a, 1.0 / a, 1.0 / a
+        for _ in range(500):
+            ap += 1.0
+            term *= x / ap
+            total += term
+            if abs(term) < abs(total) * 1e-16:
+                break
+        return max(0.0, min(1.0, 1.0 - total * norm))
+    tiny = 1e-300
+    b = x + 1.0 - a
+    c = 1.0 / tiny
+    d = 1.0 / b
+    h = d
+    for i in range(1, 500):
+        an = -i * (i - a)
+        b += 2.0
+        d = an * d + b
+        if abs(d) < tiny:
+            d = tiny
+        c = b + an / c
+        if abs(c) < tiny:
+            c = tiny
+        d = 1.0 / d
+        delta = d * c
+        h *= delta
+        if abs(delta - 1.0) < 1e-16:
+            break
+    return max(0.0, min(1.0, norm * h))
+
+
+def chi2_sf(chi2: float, dof: int) -> float:
+    """Chi-squared survival function P[X >= chi2] = Q(dof/2, chi2/2)."""
+    return _igamc(dof / 2.0, chi2 / 2.0)
+
+
 def chi_squared_test(contingency: np.ndarray) -> Tuple[float, float, float]:
     """(chi2 statistic, p-value, Cramér's V) on a contingency matrix with
     empty rows/cols filtered (≙ chiSquaredTest, OpStatistics.scala:188)."""
@@ -28,11 +74,7 @@ def chi_squared_test(contingency: np.ndarray) -> Tuple[float, float, float]:
     expected = np.outer(obs.sum(axis=1), obs.sum(axis=0)) / n
     chi2 = float(((obs - expected) ** 2 / np.maximum(expected, 1e-12)).sum())
     dof = (obs.shape[0] - 1) * (obs.shape[1] - 1)
-    try:
-        from scipy.stats import chi2 as chi2_dist
-        p = float(chi2_dist.sf(chi2, dof))
-    except ImportError:  # pragma: no cover
-        p = float("nan")
+    p = chi2_sf(chi2, dof)
     k = min(obs.shape) - 1
     v = float(np.sqrt(chi2 / (n * max(k, 1))))
     return chi2, p, v

@@ -259,6 +259,35 @@ def test_masked_grid_metrics_match_per_candidate():
                            atol=1e-6)
 
 
+def test_fold_grid_metric_panel_matches_per_fold():
+    """The one-program (fold × grid) panel must equal the per-fold grid
+    calls it replaces (masks stay [F, N], scores [N, F, G])."""
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.metrics_device import (masked_aupr_fold_grid,
+                                                  masked_aupr_grid,
+                                                  masked_auroc_fold_grid,
+                                                  masked_auroc_grid)
+
+    rng = np.random.default_rng(9)
+    n, F, G = 2048, 3, 4
+    y = jnp.asarray((rng.random(n) < 0.45).astype(np.float32))
+    S3 = jnp.asarray(rng.normal(size=(n, F, G)).astype(np.float32))
+    S3 = S3.at[:, 1, 0].set(jnp.round(S3[:, 1, 0]))     # ties
+    W = jnp.asarray((rng.random((F, n)) < 0.33).astype(np.float32))
+
+    p_roc = np.asarray(masked_auroc_fold_grid(y, S3, W))
+    p_pr = np.asarray(masked_aupr_fold_grid(y, S3, W))
+    assert p_roc.shape == (F, G) and p_pr.shape == (F, G)
+    for f in range(F):
+        np.testing.assert_allclose(
+            p_roc[f], np.asarray(masked_auroc_grid(y, S3[:, f, :], W[f])),
+            atol=1e-6)
+        np.testing.assert_allclose(
+            p_pr[f], np.asarray(masked_aupr_grid(y, S3[:, f, :], W[f])),
+            atol=1e-6)
+
+
 def test_validator_batched_linear_metrics_match_fallback(monkeypatch):
     """OpValidator's batched linear-family metric path must select the same
     winner with the same mean metrics as the per-candidate fallback."""

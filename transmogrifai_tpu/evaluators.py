@@ -184,6 +184,12 @@ class OpEvaluatorBase:
         grid.  None when this evaluator has no grid implementation."""
         return None
 
+    def evaluate_masked_fold_grid(self, y_dev, S, W):
+        """Default metric for the whole (fold × grid) panel in one program:
+        S [N, F, G] scores, W [F, N] fold validation masks → [F, G] device
+        values.  None when unavailable (caller falls back per fold)."""
+        return None
+
 
 class OpBinaryClassificationEvaluator(OpEvaluatorBase):
     """≙ OpBinaryClassificationEvaluator.scala:67-185."""
@@ -256,6 +262,16 @@ class OpBinaryClassificationEvaluator(OpEvaluatorBase):
             return masked_auroc_grid(y_dev, S, W)
         if m == "AuPR":
             return masked_aupr_grid(y_dev, S, W)
+        return None
+
+    def evaluate_masked_fold_grid(self, y_dev, S, W):
+        from .metrics_device import (masked_aupr_fold_grid,
+                                     masked_auroc_fold_grid)
+        m = self.default_metric
+        if m == "AuROC":
+            return masked_auroc_fold_grid(y_dev, S, W)
+        if m == "AuPR":
+            return masked_aupr_fold_grid(y_dev, S, W)
         return None
 
     @staticmethod

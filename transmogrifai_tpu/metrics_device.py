@@ -93,6 +93,26 @@ def masked_aupr_grid(y: jnp.ndarray, S: jnp.ndarray, W: jnp.ndarray):
 
 
 @jax.jit
+def masked_auroc_fold_grid(y: jnp.ndarray, S: jnp.ndarray, W: jnp.ndarray):
+    """The whole (fold × grid) AUC panel in ONE program: S [N, F, G] score
+    columns, W [F, N] per-fold validation masks → [F, G].  Replaces one
+    grid-metric dispatch (plus an eager S slice) per fold, without
+    duplicating mask HBM across grid points — the masks stay [F, N]."""
+    return jax.vmap(
+        lambda s, w: jax.vmap(lambda c: masked_auroc(y, c, w), in_axes=1)(s),
+        in_axes=(1, 0))(S, W)
+
+
+@jax.jit
+def masked_aupr_fold_grid(y: jnp.ndarray, S: jnp.ndarray, W: jnp.ndarray):
+    """``masked_aupr`` over the (fold × grid) panel (see
+    masked_auroc_fold_grid)."""
+    return jax.vmap(
+        lambda s, w: jax.vmap(lambda c: masked_aupr(y, c, w), in_axes=1)(s),
+        in_axes=(1, 0))(S, W)
+
+
+@jax.jit
 def masked_binary_confusion(y: jnp.ndarray, yhat: jnp.ndarray, w: jnp.ndarray):
     """Returns [tp, fp, tn, fn] weighted counts as ONE stacked array (a single
     scalar-block transfer over the host link)."""

@@ -438,16 +438,26 @@ class OpValidator:
             b = jnp.stack([jnp.asarray(i, jnp.float32).reshape(-1)[0]
                            for i in intercepts])
             S = _grid_margins(X, C, b)                     # [N, F*G]
-            # one grid-metric program per FOLD, sharing the fold's single
-            # [N] validation mask — stacking [F*G, N] masks would multiply
-            # mask HBM by the grid size in the near-capacity regime
-            per_fold = []
-            for f in range(F):
-                vals = self.evaluator.evaluate_masked_grid(
-                    y_dev, S[:, f * G:(f + 1) * G], va_masks_dev[f])
-                if vals is None or getattr(vals, "shape", (0,)) != (G,):
-                    return False       # wrong-shape result must not record
-                per_fold.append(vals)
+            # the whole (fold × grid) metric panel as ONE program when the
+            # evaluator supports it — masks stay [F, N] (no per-grid-point
+            # mask HBM duplication in the near-capacity regime), and the F
+            # per-fold dispatches + eager S slices collapse into one
+            W = (jnp.stack(list(va_masks_dev))
+                 if not hasattr(va_masks_dev, "ndim") else va_masks_dev)
+            panel = self.evaluator.evaluate_masked_fold_grid(
+                y_dev, S.reshape(S.shape[0], F, G), W)
+            if panel is not None and getattr(panel, "shape", ()) == (F, G):
+                per_fold = list(panel)
+            else:
+                # per-fold fallback: one grid-metric program per fold,
+                # sharing the fold's single [N] validation mask
+                per_fold = []
+                for f in range(F):
+                    vals = self.evaluator.evaluate_masked_grid(
+                        y_dev, S[:, f * G:(f + 1) * G], va_masks_dev[f])
+                    if vals is None or getattr(vals, "shape", (0,)) != (G,):
+                        return False   # wrong-shape result must not record
+                    per_fold.append(vals)
             for f in range(F):
                 for gi, params in enumerate(cand.grid):
                     record(cand, ci, gi, params, per_fold[f][gi])

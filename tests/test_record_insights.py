@@ -150,3 +150,98 @@ def test_assemble_maps_nonfinite_diffs_parse():
     out = _assemble_maps(np.array([[0, 1]]), val, ["a", "b"], 1)
     assert np.isnan(json.loads(out[0]["a"])[0][1])
     assert json.loads(out[0]["b"])[0][1] == 1.5
+
+
+# -- RecordInsightsCorr (≙ RecordInsightsCorrTest) --------------------------
+
+def _corr_setup(norm_type="minmax", correlation_type="pearson", top_k=3):
+    from transmogrifai_tpu.record_insights import RecordInsightsCorr
+    rng = np.random.default_rng(11)
+    n, d = 300, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    # two score columns correlated with different features
+    P = np.stack([X[:, 0] + 0.1 * rng.normal(size=n),
+                  -X[:, 3] + 0.1 * rng.normal(size=n)], axis=1).astype(
+        np.float32)
+    meta = VectorMeta("v", [
+        VectorColumnMeta(f"raw{i}", "Real", index=i) for i in range(d)])
+    pred_f = Feature("pred", OPVector, True, None, parents=())
+    vec_f = Feature("v", OPVector, False, None, parents=())
+    batch = ColumnBatch({"pred": Column(OPVector, P),
+                         "v": Column(OPVector, X, meta=meta)}, n)
+    est = RecordInsightsCorr(top_k=top_k, norm_type=norm_type,
+                             correlation_type=correlation_type)
+    est.set_input(pred_f, vec_f)
+    model = est.fit(batch)
+    return model, batch, X, P
+
+
+def _np_reference(X, P, norm_type, top_k):
+    """Straight-line numpy reimplementation of the reference semantics:
+    corr(score_p, col_d) * normalized(col_d) ranked by |.| per score."""
+    Xd = X.astype(np.float64)
+    Pd = P.astype(np.float64)
+    corr = np.zeros((P.shape[1], X.shape[1]))
+    for p in range(P.shape[1]):
+        for d_ in range(X.shape[1]):
+            corr[p, d_] = np.corrcoef(Pd[:, p], Xd[:, d_])[0, 1]
+    if norm_type == "minmax":
+        s1, s2, off = Xd.min(0), Xd.max(0) - Xd.min(0), 0.0
+    elif norm_type == "znorm":
+        s1, s2, off = Xd.mean(0), Xd.std(0, ddof=1), 0.0
+    else:
+        s1, s2, off = Xd.min(0), (Xd.max(0) - Xd.min(0)) / 2.0, 1.0
+    Xn = np.where(s2 == 0, 0.0, (Xd - s1) / np.where(s2 == 0, 1, s2) - off)
+    tops = []
+    for i in range(X.shape[0]):
+        per_pred = []
+        for p in range(P.shape[1]):
+            imp = corr[p] * Xn[i]
+            order = np.argsort(-np.abs(imp))[:top_k]
+            per_pred.append({int(j): imp[j] for j in order})
+        tops.append(per_pred)
+    return tops
+
+
+@pytest.mark.parametrize("norm_type", ["minmax", "znorm", "minmax_centered"])
+def test_record_insights_corr_matches_numpy(norm_type):
+    model, batch, X, P = _corr_setup(norm_type=norm_type)
+    out = model.transform(batch)
+    ref = _np_reference(X, P, norm_type, top_k=3)
+    names = batch["v"].meta.column_names()
+    for i in (0, 7, 123):
+        row = out.values[i]
+        for p in range(P.shape[1]):
+            for j, imp in ref[i][p].items():
+                name = names[j]
+                assert name in row, (i, p, name, row.keys())
+                pairs = json.loads(row[name])
+                got = dict((a, b) for a, b in pairs)
+                assert got[p] == pytest.approx(imp, abs=2e-4)
+
+
+def test_record_insights_corr_spearman_and_prediction_input():
+    """Spearman flag runs; Prediction-column input unpacks to probability."""
+    from transmogrifai_tpu.record_insights import RecordInsightsCorr
+    from transmogrifai_tpu.types import Prediction
+    rng = np.random.default_rng(5)
+    n, d = 200, 4
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    prob = 1 / (1 + np.exp(-X[:, 1]))
+    pred_col = Column(Prediction, {
+        "prediction": (prob > 0.5).astype(np.float32),
+        "probability": np.stack([1 - prob, prob], axis=1).astype(np.float32)})
+    meta = VectorMeta("v", [
+        VectorColumnMeta(f"c{i}", "Real", index=i) for i in range(d)])
+    pred_f = Feature("pred", Prediction, True, None, parents=())
+    vec_f = Feature("v", OPVector, False, None, parents=())
+    batch = ColumnBatch({"pred": pred_col,
+                         "v": Column(OPVector, X, meta=meta)}, n)
+    est = RecordInsightsCorr(top_k=2, correlation_type="spearman")
+    est.set_input(pred_f, vec_f)
+    model = est.fit(batch)
+    out = model.transform(batch)
+    # c1 drives the probability; it must appear in most rows' insights
+    key = batch["v"].meta.column_names()[1]
+    hits = sum(1 for r in out.values if key in r)
+    assert hits > 0.9 * n

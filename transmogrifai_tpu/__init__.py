@@ -54,7 +54,8 @@ __all__ = [
     "Workflow", "WorkflowModel", "BinaryClassificationModelSelector",
     "MultiClassificationModelSelector", "RegressionModelSelector",
     "Evaluators", "OpParams", "OpWorkflowRunner", "OpApp", "RunType",
-    "ModelInsights", "RecordInsightsLOCO", "RawFeatureFilter",
+    "ModelInsights", "RecordInsightsLOCO", "RecordInsightsCorr",
+    "RawFeatureFilter",
     "score_function", "transmogrify",
 ]
 
@@ -71,6 +72,7 @@ _LAZY = {
     "RunType": ("runner", "RunType"),
     "ModelInsights": ("insights", "ModelInsights"),
     "RecordInsightsLOCO": ("record_insights", "RecordInsightsLOCO"),
+    "RecordInsightsCorr": ("record_insights", "RecordInsightsCorr"),
     "RawFeatureFilter": ("filters", "RawFeatureFilter"),
     "score_function": ("local", "score_function"),
     "transmogrify": ("ops.transmogrify", "transmogrify"),

@@ -25,7 +25,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .columns import Column, ColumnBatch
-from .stages.base import Transformer
+from .stages.base import Estimator, Transformer, TransformerModel
 from .types import OPVector, Prediction, TextMap
 
 _PERIODS = ("HourOfDay", "DayOfWeek", "DayOfMonth", "DayOfYear",
@@ -257,3 +257,163 @@ def _entry_json(name: str, diff: float) -> str:
     if not _json_plain(name) or not np.isfinite(diff):
         return json.dumps([[name, diff]])   # NaN/Infinity parse under json
     return f'[["{name}", {diff}]]'
+
+
+# ---------------------------------------------------------------------------
+# RecordInsightsCorr (reference: core/src/main/scala/com/salesforce/op/
+# stages/impl/insights/RecordInsightsCorr.scala:95-160 fitFn/transformFn,
+# NormType:165-205, Normalizer:210-225)
+# ---------------------------------------------------------------------------
+
+def _corr_fit_program_factory(spearman: bool):
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=())
+    def fit(X, P):
+        """One fused pass over the feature matrix and the score columns:
+        per-feature min/max/mean/var (the Normalizer moments) plus the
+        [P, D] correlation of every feature with every score column —
+        ≙ Statistics.corr over the joined (scores ++ features) RDD
+        (RecordInsightsCorr.scala:104-118), as a single XLA program."""
+        Xf = X.astype(jnp.float32)
+        Pf = P.astype(jnp.float32)
+        mn = jnp.min(Xf, axis=0)
+        mx = jnp.max(Xf, axis=0)
+        mean = jnp.mean(Xf, axis=0)
+        var = jnp.var(Xf, axis=0, ddof=1)
+        if spearman:
+            from .preparators.sanity_checker import _rank_transform
+            Xc_src, Pc_src = _rank_transform(Xf), _rank_transform(Pf)
+        else:
+            Xc_src, Pc_src = Xf, Pf
+        Xc = Xc_src - jnp.mean(Xc_src, axis=0)
+        Pc = Pc_src - jnp.mean(Pc_src, axis=0)
+        xsd = jnp.sqrt(jnp.sum(Xc * Xc, axis=0))
+        psd = jnp.sqrt(jnp.sum(Pc * Pc, axis=0))
+        corr = (Pc.T @ Xc) / jnp.maximum(psd[:, None] * xsd[None, :], 1e-12)
+        return jnp.stack([mn, mx, mean, var]), corr
+
+    return fit
+
+
+def _scores_matrix(col: Column) -> np.ndarray:
+    """[N, P] score columns from an OPVector or Prediction column (the
+    reference requires regression outputs pre-packed as a 1-column vector;
+    Prediction columns unpack here instead)."""
+    vals = col.values
+    if isinstance(vals, dict):
+        v = vals.get("probability", vals.get("prediction"))
+        v = np.asarray(v)
+        return v if v.ndim == 2 else v[:, None]
+    v = np.asarray(vals) if not hasattr(vals, "ndim") else vals
+    return v if v.ndim == 2 else v[:, None]
+
+
+class RecordInsightsCorr(Estimator):
+    """Correlation-based record insights (≙ RecordInsightsCorr.scala:56).
+
+    Inputs: (prediction OPVector/Prediction, features OPVector).  Fit
+    computes the [P, D] score↔feature correlations plus the Normalizer
+    moments in ONE device program; the model's transform emits, per record,
+    the top-K features by |corr × normalized value| for each score column
+    as a TextMap (RecordInsightsParser payload shape: name →
+    [[scoreIndex, importance], ...]).
+
+    Superseded by RecordInsightsLOCO in the reference itself (LOCO explains
+    the actual fitted model, not a linear correlate) but included for
+    parity; norm_type ∈ {minmax, znorm, minmax_centered},
+    correlation_type ∈ {pearson, spearman}.
+    """
+
+    out_kind = TextMap
+    is_device_op = False
+
+    def __init__(self, top_k: int = 20, norm_type: str = "minmax",
+                 correlation_type: str = "pearson", **params):
+        super().__init__(top_k=top_k, norm_type=norm_type,
+                         correlation_type=correlation_type, **params)
+
+    def fit(self, batch: ColumnBatch):
+        pred_f, vec_f = self.input_features
+        X = batch[vec_f.name].values
+        P = _scores_matrix(batch[pred_f.name])
+        import jax.numpy as jnp
+        spearman = self.get("correlation_type", "pearson") == "spearman"
+        stats, corr = _corr_fit_program_factory(spearman)(
+            jnp.asarray(X) if not hasattr(X, "dtype") else X,
+            jnp.asarray(P))
+        mn, mx, mean, var = np.asarray(stats, np.float64)
+        norm_type = self.get("norm_type", "minmax")
+        if norm_type == "minmax":
+            s1, s2, offset = mn, mx - mn, 0.0
+        elif norm_type == "znorm":
+            s1, s2, offset = mean, np.sqrt(var), 0.0
+        elif norm_type == "minmax_centered":
+            s1, s2, offset = mn, (mx - mn) / 2.0, 1.0
+        else:
+            raise ValueError(f"unknown norm_type {norm_type!r}")
+        model = RecordInsightsCorrModel(fitted={
+            "corr": np.asarray(corr, np.float64), "s1": s1, "s2": s2,
+            "offset": float(offset)}, top_k=int(self.get("top_k", 20)))
+        return self._finalize_model(model)
+
+
+class RecordInsightsCorrModel(TransformerModel):
+    out_kind = TextMap
+    is_device_op = False
+
+    def transform(self, batch: ColumnBatch) -> Column:
+        _, vec_f = self.input_features
+        col = batch[vec_f.name]
+        xv = col.values
+        n, d = int(xv.shape[0]), int(xv.shape[1])
+        meta = col.meta
+        names = (meta.column_names() if meta is not None and meta.size == d
+                 else [f"f_{i}" for i in range(d)])
+        corr = self.fitted["corr"]
+        k = max(1, min(int(self.get("top_k", 20)), d))
+
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def topk(X, corr, s1, s2, offset, *, k):
+            Xn = jnp.where(s2 == 0.0, 0.0,
+                           (X.astype(jnp.float32) - s1) / jnp.where(
+                               s2 == 0.0, 1.0, s2) - offset)
+
+            def per_pred(c):
+                imp = Xn * c[None, :]                     # [N, D]
+                _, idx = jax.lax.top_k(jnp.abs(imp), k)   # [N, K]
+                return idx, jnp.take_along_axis(imp, idx, axis=1)
+
+            # P is small (1-2 score columns); sequential map keeps the
+            # working set at one [N, D] importance block
+            return jax.lax.map(per_pred, corr)
+
+        idx, val = topk(
+            xv if hasattr(xv, "dtype") else jnp.asarray(xv),
+            jnp.asarray(corr, jnp.float32),
+            jnp.asarray(self.fitted["s1"], jnp.float32),
+            jnp.asarray(self.fitted["s2"], jnp.float32),
+            jnp.float32(self.fitted["offset"]), k=k)
+        idx = np.asarray(idx)                              # [P, N, K]
+        val = np.asarray(val, np.float64)
+        P = idx.shape[0]
+        out = np.empty(n, dtype=object)
+        names_arr = np.asarray(names)
+        for i in range(n):
+            row: Dict[str, str] = {}
+            ins: Dict[str, List] = {}
+            for p in range(P):
+                for name, v in zip(names_arr[idx[p, i]], val[p, i]):
+                    ins.setdefault(str(name), []).append([p, float(v)])
+            for name, pairs in ins.items():
+                row[name] = json.dumps(pairs)
+            out[i] = row
+        return Column(TextMap, out)

@@ -81,9 +81,23 @@ def main():
     loco = RecordInsightsLOCO(model=model.selected_model, top_k=3)
     loco.set_input(model.selected_model.input_features[1])
     out = loco.transform(scored)
-    print("\nrow 0 top-3 feature attributions:")
+    print("\nrow 0 top-3 feature attributions (LOCO):")
     for name, payload in out.values[0].items():
         print(f"  {name}: {json.loads(payload)[0][1]:+.4f}")
+
+    # the legacy correlation-based explainer (≙ RecordInsightsCorr.scala):
+    # fit on (prediction, features), same TextMap payload shape
+    from transmogrifai_tpu.record_insights import RecordInsightsCorr
+    vec_f = model.selected_model.input_features[1]
+    pred_f = next(f for f in model.result_features)
+    corr_est = RecordInsightsCorr(top_k=3, norm_type="znorm")
+    corr_est.set_input(pred_f, vec_f)
+    corr_model = corr_est.fit(scored)
+    corr_out = corr_model.transform(scored)
+    print("\nrow 0 top-3 correlation insights (Corr):")
+    for name, payload in list(corr_out.values[0].items())[:3]:
+        print(f"  {name}: {json.loads(payload)[0][1]:+.4f}")
+    print("\nInsights OK")
 
 
 if __name__ == "__main__":

@@ -149,6 +149,7 @@ def test_gen_nonstandard_binary_label_remapped(tmp_path):
     ("op_boston_simple", "RMSE"),
     ("op_conditional_aggregation", "ConditionalAggregation OK"),
     ("op_joins_and_aggregates", "JoinsAndAggregates OK"),
+    ("op_custom_model_and_insights", "Insights OK"),
 ])
 def test_examples_run(example, marker):
     """Every shipped example runs and prints its signature output

@@ -11,6 +11,7 @@ The fits are single fused XLA programs (see models/solvers.py).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Optional
 
 import jax
@@ -111,6 +112,43 @@ def _binary_outputs(margin: np.ndarray) -> Dict[str, np.ndarray]:
             "probability": prob, "rawPrediction": raw}
 
 
+@functools.partial(jax.jit, static_argnames=("kind", "full", "family"))
+def _linear_device_scores(Xd, coef, intercept, *, kind: str, full: bool,
+                          family: str = "gaussian"):
+    """One fused program for the whole device-score chain — the eager
+    version dispatched 4-7 separate tiny executables (matmul, sigmoid,
+    greater, stack, ...) per call, each paying dispatch latency (and a
+    first-time executable load) on the tunneled TPU."""
+    if kind == "multinomial":
+        logits = Xd @ coef + intercept
+        out = {"prediction": jnp.argmax(logits, axis=1).astype(jnp.float32),
+               "probability": jax.nn.softmax(logits, axis=-1)}
+        if full:
+            out["rawPrediction"] = logits
+        return out
+    margin = Xd @ coef + (intercept[0] if intercept.ndim else intercept)
+    if kind == "binary":
+        p1 = jax.nn.sigmoid(margin)
+        out = {"prediction": (margin > 0).astype(jnp.float32), "scores": p1}
+        if full:
+            out["probability"] = jnp.stack([1.0 - p1, p1], axis=1)
+            out["rawPrediction"] = jnp.stack([-margin, margin], axis=1)
+        return out
+    if kind == "svc":
+        out = {"prediction": (margin > 0).astype(jnp.float32),
+               "scores": margin}
+        if full:
+            out["rawPrediction"] = jnp.stack([-margin, margin], axis=1)
+        return out
+    if kind == "glm":
+        eta = jnp.clip(margin, -30.0, 30.0)
+        pred = {"poisson": jnp.exp, "gamma": jnp.exp,
+                "binomial": jax.nn.sigmoid,
+                "gaussian": lambda e: e}[family](eta)
+        return {"prediction": pred}
+    return {"prediction": margin}
+
+
 class LinearPredictionModel(PredictionModel):
     """Fitted linear model.  ``fitted``: coef [D] or [D,C], intercept,
     kind ∈ {binary, multinomial, regression, svc}."""
@@ -121,38 +159,11 @@ class LinearPredictionModel(PredictionModel):
         loop uses the minimal set ({'prediction', 'scores'|'probability'});
         ``full=True`` mirrors ``predict_arrays``' key set exactly (probability
         + rawPrediction) so the Prediction schema is residency-independent."""
-        coef = jnp.asarray(self.fitted["coef"])
-        intercept = jnp.asarray(self.fitted["intercept"])
         kind = self.fitted["kind"]
-        if kind == "multinomial":
-            logits = Xd @ coef + intercept
-            out = {"prediction": jnp.argmax(logits, axis=1).astype(jnp.float32),
-                   "probability": jax.nn.softmax(logits, axis=-1)}
-            if full:
-                out["rawPrediction"] = logits
-            return out
-        margin = Xd @ coef + (intercept[0] if intercept.ndim else intercept)
-        if kind == "binary":
-            p1 = jax.nn.sigmoid(margin)
-            out = {"prediction": (margin > 0).astype(jnp.float32), "scores": p1}
-            if full:
-                out["probability"] = jnp.stack([1.0 - p1, p1], axis=1)
-                out["rawPrediction"] = jnp.stack([-margin, margin], axis=1)
-            return out
-        if kind == "svc":
-            out = {"prediction": (margin > 0).astype(jnp.float32),
-                   "scores": margin}
-            if full:
-                out["rawPrediction"] = jnp.stack([-margin, margin], axis=1)
-            return out
-        if kind == "glm":
-            family = self.fitted.get("family", "gaussian")
-            eta = jnp.clip(margin, -30.0, 30.0)
-            pred = {"poisson": jnp.exp, "gamma": jnp.exp,
-                    "binomial": jax.nn.sigmoid,
-                    "gaussian": lambda e: e}[family](eta)
-            return {"prediction": pred}
-        return {"prediction": margin}
+        return _linear_device_scores(
+            Xd, jnp.asarray(self.fitted["coef"]),
+            jnp.asarray(self.fitted["intercept"]), kind=kind,
+            full=bool(full), family=self.fitted.get("family", "gaussian"))
 
     def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
         coef = np.asarray(self.fitted["coef"], dtype=np.float32)

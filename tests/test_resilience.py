@@ -1,0 +1,504 @@
+"""Tests for the resilience layer: retry policies, watchdog deadlines,
+fault injection, the failure log, and graceful degradation wired through the
+selector sweep, streaming scoring, and multi-host init."""
+
+import os
+import time
+
+import jax
+import pytest
+
+from test_aux_subsystems import make_records
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.features import features_from_schema
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.models.trees import OpRandomForestClassifier
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.params import OpParams
+from transmogrifai_tpu.readers.streaming import StreamingReader, StreamingReaders
+from transmogrifai_tpu.resilience import (AllCandidatesFailed, FailureLog,
+                                          FaultInjector, InjectedFault,
+                                          RetryPolicy, WatchdogTimeout,
+                                          active_failure_log, inject_faults,
+                                          maybe_inject, record_failure,
+                                          run_with_deadline, use_failure_log)
+from transmogrifai_tpu.runner import OpWorkflowRunner, RunType
+from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                        ModelCandidate, grid)
+from transmogrifai_tpu.workflow import Workflow
+
+
+# --------------------------------------------------------------------------
+# RetryPolicy
+# --------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_success_first_attempt_records_nothing(self):
+        log = FailureLog()
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0)
+        assert policy.call(lambda: 42, stage="s", log=log) == 42
+        assert len(log) == 0
+
+    def test_retries_then_succeeds(self):
+        log, delays = FailureLog(), []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ValueError(f"boom {calls['n']}")
+            return "ok"
+
+        policy = RetryPolicy(max_attempts=4, base_delay_s=0.01, jitter=0.0)
+        out = policy.call(flaky, stage="s", point="p", key="k", log=log,
+                          sleep=delays.append)
+        assert out == "ok" and calls["n"] == 3
+        acts = [e.action for e in log]
+        assert acts == ["retried", "retried"]
+        assert [e.attempt for e in log] == [1, 2]
+        assert delays == [0.01, 0.02]  # exponential, no jitter
+
+    def test_exhaustion_raises_final_error(self):
+        log = FailureLog()
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0)
+        with pytest.raises(ValueError, match="always"):
+            policy.call(lambda: (_ for _ in ()).throw(ValueError("always")),
+                        stage="s", log=log, sleep=lambda _: None)
+        # the final attempt propagates instead of being recorded as a retry
+        assert [e.action for e in log] == ["retried", "retried"]
+
+    def test_retry_on_filters_exception_types(self):
+        log = FailureLog()
+        policy = RetryPolicy(max_attempts=3, retry_on=(KeyError,),
+                             base_delay_s=0.0, jitter=0.0)
+        with pytest.raises(ValueError):
+            policy.call(lambda: (_ for _ in ()).throw(ValueError("no")),
+                        log=log, sleep=lambda _: None)
+        assert len(log) == 0  # not retried at all
+
+    def test_per_attempt_deadline_counts_as_failure(self):
+        log = FailureLog()
+        policy = RetryPolicy(max_attempts=2, timeout_s=0.05,
+                             base_delay_s=0.0, jitter=0.0)
+        with pytest.raises(WatchdogTimeout):
+            policy.call(lambda: time.sleep(5.0), stage="hang", log=log,
+                        sleep=lambda _: None)
+        assert [e.action for e in log] == ["retried"]
+        assert "deadline" in log.events[0].cause
+
+    def test_jitter_is_deterministic_and_bounded(self):
+        policy = RetryPolicy(base_delay_s=0.1, multiplier=2.0, jitter=0.25,
+                             max_delay_s=1.0, seed=7)
+        d1 = policy.delay_for(2, key="batch-3")
+        assert d1 == policy.delay_for(2, key="batch-3")
+        nominal = 0.2
+        assert nominal * 0.75 <= d1 <= nominal * 1.25
+        assert policy.delay_for(2, key="batch-4") != d1
+        # cap applies to the nominal delay
+        assert policy.delay_for(50, key="x") <= 1.0 * 1.25
+
+    def test_uses_ambient_log_when_none_given(self):
+        log = FailureLog()
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("once")
+            return 1
+
+        policy = RetryPolicy(max_attempts=2, base_delay_s=0.0, jitter=0.0)
+        with use_failure_log(log):
+            policy.call(flaky, stage="s", sleep=lambda _: None)
+        assert [e.action for e in log] == ["retried"]
+
+
+# --------------------------------------------------------------------------
+# run_with_deadline
+# --------------------------------------------------------------------------
+
+class TestRunWithDeadline:
+    def test_returns_value(self):
+        assert run_with_deadline(lambda a, b: a + b, 1.0, 2, b=3) == 5
+
+    def test_none_timeout_runs_inline(self):
+        assert run_with_deadline(lambda: 7, None) == 7
+
+    def test_propagates_worker_exception(self):
+        def boom():
+            raise KeyError("inner")
+        with pytest.raises(KeyError, match="inner"):
+            run_with_deadline(boom, 1.0)
+
+    def test_timeout_raises_watchdog(self):
+        t0 = time.monotonic()
+        with pytest.raises(WatchdogTimeout, match="deadline"):
+            run_with_deadline(time.sleep, 0.05, 5.0, description="hang")
+        assert time.monotonic() - t0 < 2.0  # abandoned, not joined
+
+
+# --------------------------------------------------------------------------
+# FailureLog
+# --------------------------------------------------------------------------
+
+class TestFailureLog:
+    def test_record_summary_and_queries(self):
+        log = FailureLog()
+        log.record("stageA", "retried", ValueError("x"), point="p", attempt=1)
+        log.record("stageA", "skipped", "gave up", point="p")
+        log.record("stageB", "demoted", None, fallback="host")
+        assert len(log) == 3
+        assert log.summary() == {"retried": 1, "skipped": 1, "demoted": 1}
+        assert [e.stage for e in log.by_stage("stageA")] == ["stageA", "stageA"]
+        assert log.by_action("demoted")[0].detail == {"fallback": "host"}
+        assert log.events[0].cause == "ValueError: x"
+        js = log.to_json()
+        assert js[0]["seq"] == 0 and js[2]["action"] == "demoted"
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError, match="unknown failure action"):
+            FailureLog().record("s", "exploded")
+
+    def test_signature_excludes_time_and_order(self):
+        a, b = FailureLog(), FailureLog()
+        a.record("s1", "skipped", "c1", point="p")
+        time.sleep(0.01)
+        a.record("s2", "retried", "c2", attempt=1)
+        b.record("s2", "retried", "c2", attempt=1)
+        b.record("s1", "skipped", "c1", point="p")
+        assert a.signature() == b.signature()
+
+    def test_extend_copies_events(self):
+        a, b = FailureLog(), FailureLog()
+        a.record("s", "swallowed", "c", point="p", extra=1)
+        b.extend(a)
+        assert b.signature() == a.signature()
+        assert b.events[0].detail == {"extra": 1}
+
+    def test_ambient_stack_nests(self):
+        outer, inner = FailureLog(), FailureLog()
+        with use_failure_log(outer):
+            record_failure("o", "swallowed", "1")
+            with use_failure_log(inner):
+                assert active_failure_log() is inner
+                record_failure("i", "swallowed", "2")
+            assert active_failure_log() is outer
+        assert [e.stage for e in outer] == ["o"]
+        assert [e.stage for e in inner] == ["i"]
+
+    def test_empty_log_is_falsy_but_usable(self):
+        log = FailureLog()
+        assert not log and len(log) == 0
+        log.record("s", "skipped")
+        assert log
+
+
+# --------------------------------------------------------------------------
+# FaultInjector
+# --------------------------------------------------------------------------
+
+class TestFaultInjector:
+    def test_fail_keys_are_exact_and_sticky(self):
+        inj = FaultInjector(fail_keys={"pt": ["bad"]})
+        for _ in range(3):  # sticky: same key fails on every retry
+            assert inj.should_fail("pt", "bad")
+        assert not inj.should_fail("pt", "good")
+        assert not inj.should_fail("other", "bad")
+
+    def test_rate_decisions_are_pure_in_seed(self):
+        keys = list(range(200))
+        fails = lambda seed: {k for k in keys
+                              if FaultInjector(rates={"p": 0.2}, seed=seed)
+                              .should_fail("p", k)}
+        s0 = fails(0)
+        assert fails(0) == s0            # reproducible
+        assert fails(1) != s0            # seed actually matters
+        assert 10 < len(s0) < 80         # ~20% of 200
+
+    def test_check_raises_and_records_fired(self):
+        inj = FaultInjector(fail_keys={"pt": [7]})
+        with pytest.raises(InjectedFault, match="pt"):
+            inj.check("pt", 7)
+        inj.check("pt", 8)  # disarmed key: no raise
+        assert inj.fired == [("pt", "7")]
+
+    def test_maybe_inject_is_noop_without_injector(self):
+        maybe_inject("anything", key="x")  # must not raise
+
+    def test_context_manager_installs_and_restores(self):
+        inj = FaultInjector(fail_keys={"pt": ["k"]})
+        with inject_faults(inj):
+            with pytest.raises(InjectedFault):
+                maybe_inject("pt", "k")
+        maybe_inject("pt", "k")  # uninstalled again
+
+
+# --------------------------------------------------------------------------
+# StreamingReader construction (satellite: clear error, not a TypeError)
+# --------------------------------------------------------------------------
+
+class TestStreamingReaderConstruction:
+    def test_no_source_raises_value_error(self):
+        with pytest.raises(ValueError, match="batch source"):
+            StreamingReader()
+        with pytest.raises(ValueError, match="batch source"):
+            StreamingReaders.custom()
+
+    def test_either_source_accepted(self):
+        assert StreamingReader(batches=[[{"a": 1}]]) is not None
+        assert StreamingReader(batch_fn=lambda: [[{"a": 1}]]) is not None
+
+
+# --------------------------------------------------------------------------
+# multihost.init_distributed failure paths
+# --------------------------------------------------------------------------
+
+class TestInitDistributedFailures:
+    @pytest.fixture(autouse=True)
+    def _no_cluster_env(self, monkeypatch):
+        from transmogrifai_tpu.parallel import multihost
+        for v in multihost._CLUSTER_ENV_VARS:
+            monkeypatch.delenv(v, raising=False)
+        monkeypatch.setattr(jax.distributed, "is_initialized",
+                            lambda: False, raising=False)
+
+    def test_no_coordinator_no_env_is_clean_noop(self, monkeypatch):
+        from transmogrifai_tpu.parallel import multihost
+        called = []
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: called.append(kw))
+        assert multihost.init_distributed() is False
+        assert called == []  # auto-detect must not probe without cluster env
+
+    def test_explicit_coordinator_failure_raises(self, monkeypatch):
+        from transmogrifai_tpu.parallel import multihost
+
+        def boom(**kw):
+            raise RuntimeError("coordinator unreachable")
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        with pytest.raises(RuntimeError, match="coordinator unreachable"):
+            multihost.init_distributed("10.0.0.1:1234",
+                                       num_processes=2, process_id=0)
+
+    def test_explicit_coordinator_hang_raises_watchdog(self, monkeypatch):
+        from transmogrifai_tpu.parallel import multihost
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: time.sleep(5.0))
+        with pytest.raises(WatchdogTimeout):
+            multihost.init_distributed("10.0.0.1:1234", num_processes=2,
+                                       process_id=0, timeout_s=0.05)
+
+    def test_cluster_env_failure_degrades_to_single_host(self, monkeypatch):
+        from transmogrifai_tpu.parallel import multihost
+        monkeypatch.setenv("SLURM_JOB_ID", "1234")
+
+        def boom(**kw):
+            raise RuntimeError("no coordinator found")
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        log = FailureLog()
+        with use_failure_log(log):
+            assert multihost.init_distributed() is False
+        evs = log.by_action("degraded")
+        assert len(evs) == 1
+        assert evs[0].point == "multihost.init"
+        assert evs[0].detail.get("fallback") == "single-host"
+        assert "no coordinator found" in evs[0].cause
+
+    def test_injected_init_fault_degrades(self, monkeypatch):
+        from transmogrifai_tpu.parallel import multihost
+        monkeypatch.setenv("SLURM_JOB_ID", "1234")
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: pytest.fail("must inject first"))
+        log = FailureLog()
+        with use_failure_log(log):
+            with inject_faults(FaultInjector(fail_keys={"multihost.init":
+                                                        ["auto"]})):
+                assert multihost.init_distributed() is False
+        assert log.summary() == {"degraded": 1}
+
+
+# --------------------------------------------------------------------------
+# selector sweep degradation (integration)
+# --------------------------------------------------------------------------
+
+def _two_candidate_workflow(records):
+    schema = {"y": T.RealNN, "x1": T.Real, "x2": T.Real, "cat": T.PickList,
+              "sparse": T.Real}
+    y, predictors = features_from_schema(schema, response="y")
+    fv = transmogrify(predictors)
+    checked = y.sanity_check(fv, remove_bad_features=True)
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]),
+                       "OpLogisticRegression"),
+        ModelCandidate(OpRandomForestClassifier(num_trees=5, max_depth=3),
+                       grid(min_info_gain=[0.001]),
+                       "OpRandomForestClassifier"),
+    ])
+    sel.set_input(y, checked)
+    recs = [{k: (1.0 if k == "y" and v else 0.0) if k == "y" else v
+             for k, v in r.items()} for r in records]
+    return (Workflow().set_input_records(recs)
+            .set_result_features(sel.get_output()))
+
+
+class TestSelectorDegradation:
+    def test_failing_candidate_is_skipped_sweep_continues(self):
+        records = make_records(120)
+        injector = FaultInjector(
+            fail_keys={"selector.candidate_fit": ["OpLogisticRegression"]})
+        with inject_faults(injector):
+            model = _two_candidate_workflow(records).train()
+        summary = model.selected_model.summary
+        assert summary.best_model_name == "OpRandomForestClassifier"
+        log = model.failure_log
+        assert log is not None and len(log) > 0
+        # the batched fit degraded, then every per-point refit was skipped
+        assert log.by_action("degraded")
+        skipped = log.by_action("skipped")
+        assert skipped and all(e.stage == "OpLogisticRegression"
+                               for e in skipped)
+
+    def test_same_seed_reproduces_same_failure_log(self):
+        records = make_records(120)
+        sigs = []
+        for _ in range(2):
+            injector = FaultInjector(
+                fail_keys={"selector.candidate_fit": ["OpLogisticRegression"]})
+            with inject_faults(injector):
+                model = _two_candidate_workflow(records).train()
+            sigs.append(model.failure_log.signature())
+        assert sigs[0] == sigs[1] and sigs[0]
+
+    def test_all_candidates_failing_raises_aggregate_error(self):
+        records = make_records(120)
+        injector = FaultInjector(fail_keys={"selector.candidate_fit": [
+            "OpLogisticRegression", "OpRandomForestClassifier"]})
+        with inject_faults(injector):
+            with pytest.raises(AllCandidatesFailed) as ei:
+                _two_candidate_workflow(records).train()
+        assert set(ei.value.causes) == {"OpLogisticRegression",
+                                        "OpRandomForestClassifier"}
+        assert "InjectedFault" in ei.value.causes["OpLogisticRegression"]
+
+
+# --------------------------------------------------------------------------
+# streaming scoring: retries + dead-letter queue (integration)
+# --------------------------------------------------------------------------
+
+class TestStreamingDeadLetter:
+    def test_exhausted_batch_is_dead_lettered(self, tmp_path):
+        from test_aux_subsystems import train_small_model
+        records = make_records(120)
+        wf, _ = train_small_model(records)
+        model = wf.train()
+        model.save(str(tmp_path / "model"))
+        recs = [{k: v for k, v in r.items() if k != "y"} for r in records]
+        batches = [recs[:40], recs[40:80], recs[80:]]
+        runner = OpWorkflowRunner(
+            wf, score_reader=StreamingReaders.custom(batches=batches),
+            retry_policy=RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                                     jitter=0.0))
+        params = OpParams(model_location=str(tmp_path / "model"),
+                          write_location=str(tmp_path / "scores"))
+        with inject_faults(FaultInjector(fail_keys={"streaming.batch": [1]})):
+            result = runner.run(RunType.STREAMING_SCORE, params)
+        assert result.metrics["batches"] == 2
+        assert result.metrics["deadLetterBatches"] == [1]
+        assert len(result.dead_letters) == 1
+        assert result.dead_letters[0]["index"] == 1
+        assert "InjectedFault" in result.dead_letters[0]["error"]
+        # surviving batches were scored and flushed; the poisoned one was not
+        assert (tmp_path / "scores" / "scores_0.jsonl").exists()
+        assert not (tmp_path / "scores" / "scores_1.jsonl").exists()
+        assert (tmp_path / "scores" / "scores_2.jsonl").exists()
+        acts = [e.action for e in result.failure_log]
+        assert acts.count("retried") == 1       # max_attempts=2 → one retry
+        assert acts.count("dead_letter") == 1
+        assert result.metrics["failures"] == {"retried": 1, "dead_letter": 1}
+
+    def test_transient_failure_recovers_without_dead_letter(self, tmp_path):
+        from test_aux_subsystems import train_small_model
+        records = make_records(120)
+        wf, _ = train_small_model(records)
+        model = wf.train()
+        model.save(str(tmp_path / "model"))
+        recs = [{k: v for k, v in r.items() if k != "y"} for r in records]
+        runner = OpWorkflowRunner(
+            wf, score_reader=StreamingReaders.custom(
+                batches=[recs[:60], recs[60:]]),
+            retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                                     jitter=0.0))
+        # FaultInjector decisions are sticky by design, so a *transient*
+        # failure (fails once, then succeeds on retry) needs a one-shot
+        # patch of the runner's injection hook instead.
+        one_shot = {"armed": True}
+        import transmogrifai_tpu.runner as runner_mod
+
+        orig = runner_mod.maybe_inject
+
+        def flaky_inject(point, key=None):
+            if point == "streaming.batch" and key == 0 and one_shot["armed"]:
+                one_shot["armed"] = False
+                raise InjectedFault("transient blip")
+            orig(point, key)
+
+        runner_mod.maybe_inject = flaky_inject
+        try:
+            params = OpParams(model_location=str(tmp_path / "model"),
+                              write_location=str(tmp_path / "scores"))
+            result = runner.run(RunType.STREAMING_SCORE, params)
+        finally:
+            runner_mod.maybe_inject = orig
+        assert result.metrics["batches"] == 2
+        assert result.metrics["deadLetterBatches"] == []
+        assert [e.action for e in result.failure_log] == ["retried"]
+        assert (tmp_path / "scores" / "scores_0.jsonl").exists()
+        assert (tmp_path / "scores" / "scores_1.jsonl").exists()
+
+
+# --------------------------------------------------------------------------
+# chaos: random fault rates across an end-to-end run (slow)
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_train_and_stream_survive_fault_rates(tmp_path):
+    """Kill ~30% of candidate fits and ~10% of streaming micro-batches and
+    require the run to complete with a valid best model and a non-empty,
+    seed-reproducible failure log.  seed=1 is chosen so that exactly one of
+    the two candidates and one of the six batches is hit (decisions are a
+    pure function of (seed, point, key), so this is stable by construction).
+    """
+    records = make_records(240, seed=3)
+    injector = FaultInjector(rates={"selector.candidate_fit": 0.30,
+                                    "streaming.batch": 0.10}, seed=1)
+    with inject_faults(injector):
+        model = _two_candidate_workflow(records).train()
+    assert model.selected_model.summary.best_model_name == \
+        "OpRandomForestClassifier"  # seed=1 kills the LR fit
+    assert model.failure_log is not None and len(model.failure_log) > 0
+    sig_train = model.failure_log.signature()
+
+    model.save(str(tmp_path / "model"))
+    recs = [{k: v for k, v in r.items() if k != "y"} for r in records]
+    batches = [recs[i * 40:(i + 1) * 40] for i in range(6)]
+    wf = _two_candidate_workflow(records)
+    runner = OpWorkflowRunner(
+        wf, score_reader=StreamingReaders.custom(batches=batches),
+        retry_policy=RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                                 jitter=0.0))
+    params = OpParams(model_location=str(tmp_path / "model"),
+                      write_location=str(tmp_path / "scores"))
+    injector2 = FaultInjector(rates={"streaming.batch": 0.10}, seed=1)
+    with inject_faults(injector2):
+        result = runner.run(RunType.STREAMING_SCORE, params)
+    assert result.metrics["deadLetterBatches"] == [5]  # seed=1 hits batch 5
+    assert result.metrics["batches"] == 5
+    scored = sorted(os.listdir(tmp_path / "scores"))
+    assert len(scored) == 5
+
+    # same seeds ⇒ same failure set ⇒ same log signature, end to end
+    injector3 = FaultInjector(rates={"selector.candidate_fit": 0.30,
+                                     "streaming.batch": 0.10}, seed=1)
+    with inject_faults(injector3):
+        model2 = _two_candidate_workflow(records).train()
+    assert model2.failure_log.signature() == sig_train

@@ -57,6 +57,9 @@ __all__ = [
     "ModelInsights", "RecordInsightsLOCO", "RecordInsightsCorr",
     "RawFeatureFilter",
     "score_function", "transmogrify",
+    "RetryPolicy", "FailureLog", "FaultInjector", "InjectedFault",
+    "WatchdogTimeout", "AllCandidatesFailed", "run_with_deadline",
+    "use_failure_log", "inject_faults",
 ]
 
 _LAZY = {
@@ -76,6 +79,15 @@ _LAZY = {
     "RawFeatureFilter": ("filters", "RawFeatureFilter"),
     "score_function": ("local", "score_function"),
     "transmogrify": ("ops.transmogrify", "transmogrify"),
+    "RetryPolicy": ("resilience", "RetryPolicy"),
+    "FailureLog": ("resilience", "FailureLog"),
+    "FaultInjector": ("resilience", "FaultInjector"),
+    "InjectedFault": ("resilience", "InjectedFault"),
+    "WatchdogTimeout": ("resilience", "WatchdogTimeout"),
+    "AllCandidatesFailed": ("resilience", "AllCandidatesFailed"),
+    "run_with_deadline": ("resilience", "run_with_deadline"),
+    "use_failure_log": ("resilience", "use_failure_log"),
+    "inject_faults": ("resilience", "inject_faults"),
 }
 
 

@@ -31,6 +31,7 @@ import jax
 import numpy as np
 
 from .columns import Column, ColumnBatch
+from .resilience import maybe_inject, record_failure
 from .stages.base import Transformer
 
 _WIRE_SEP = "\x00"      # wire-entry names: "<uid>\x00<key>" — never a column
@@ -126,6 +127,9 @@ class ScoreProgram:
                 # demote the offending stage to the host segments and
                 # re-partition; transforms are pure so re-running the
                 # prologue on the original batch is safe
+                record_failure(e.uid, "demoted", e.cause,
+                               point="compiled.trace",
+                               fallback="host segment")
                 self._demoted.add(e.uid)
                 continue
             return b
@@ -263,25 +267,34 @@ class ScoreProgram:
                     return x
                 arrays = {k: (_shard(v), _shard(m))
                           for k, (v, m) in arrays.items()}
-            except Exception:  # noqa: BLE001 — sharding is an optimization;
-                # a failed reshard (e.g. RESOURCE_EXHAUSTED near capacity)
-                # must fall back to the unsharded program, never break
-                # scoring
-                pass
+            except Exception as e:  # noqa: BLE001 — sharding is an
+                # optimization; a failed reshard (e.g. RESOURCE_EXHAUSTED
+                # near capacity) must fall back to the unsharded program,
+                # never break scoring
+                record_failure("compiled", "degraded", e,
+                               point="compiled.shard",
+                               fallback="unsharded program")
         jitted, canon_out_map = self._jitted[key]
         from .profiling import cost_analysis_enabled, record_program_cost
         if cost_analysis_enabled():
             record_program_cost("fused_transform", jitted, (arrays,))
         try:
+            # chaos hook: an injected fault here exercises the eager-segment
+            # demotion below, the same path a device dispatch failure takes
+            maybe_inject("compiled.segment", key=run[0].uid)
             out_c = jitted(arrays)
             out = {n: out_c[c] for n, c in canon_out_map.items()}
         except _StageTraceError:
             self._jitted.pop(key, None)
             self._metas.pop(key, None)
             raise
-        except Exception:
+        except Exception as e:  # noqa: BLE001
             # unexpected jit-boundary failure: never break scoring — run the
             # segment eagerly (≙ apply_dag) and stop attempting to compile
+            record_failure("compiled", "demoted", e,
+                           point="compiled.segment",
+                           stages=[st.uid for st in run],
+                           fallback="eager per-stage execution")
             self._jitted.pop(key, None)
             self._metas.pop(key, None)
             self._demoted.update(st.uid for st in run)

@@ -23,6 +23,8 @@ from typing import Optional
 
 import jax
 
+from ..resilience import maybe_inject, record_failure, run_with_deadline
+
 
 _CLUSTER_ENV_VARS = (
     "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
@@ -38,7 +40,8 @@ def _cluster_env_present() -> bool:
 
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
-                     process_id: Optional[int] = None) -> bool:
+                     process_id: Optional[int] = None,
+                     timeout_s: Optional[float] = None) -> bool:
     """Initialize jax's distributed runtime (idempotent, single-process safe).
 
     Returns True when a multi-process runtime is active after the call.
@@ -46,6 +49,12 @@ def init_distributed(coordinator_address: Optional[str] = None,
     pod / GKE / SLURM / MPI env vars) — probing jax's auto-detect on plain
     single-host machines can hard-abort the process, so without a coordinator
     and without cluster env vars this is a clean no-op.
+
+    ``timeout_s`` runs the init under a watchdog: the round-5 outage showed
+    it can HANG in native code with no error raised (OUTAGE_r5.json), and a
+    hang must surface as ``WatchdogTimeout`` — raised for an explicit
+    coordinator request, recorded in the failure log and degraded to
+    single-host for auto-detection.
     """
     already = getattr(jax.distributed, "is_initialized", None)
     if already is not None and already():
@@ -53,14 +62,21 @@ def init_distributed(coordinator_address: Optional[str] = None,
     if coordinator_address is None and not _cluster_env_present():
         return False
     try:
-        jax.distributed.initialize(coordinator_address=coordinator_address,
-                                   num_processes=num_processes,
-                                   process_id=process_id)
-    except Exception:  # noqa: BLE001
+        maybe_inject("multihost.init", key=coordinator_address or "auto")
+        run_with_deadline(
+            jax.distributed.initialize, timeout_s,
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id,
+            description="jax.distributed.initialize")
+    except Exception as e:  # noqa: BLE001
         if coordinator_address is not None:
             # an EXPLICIT multi-host request that fails must not silently
             # degrade to single-host (every host would train divergently)
             raise
+        # auto-detected cluster env but init failed: degrade to single-host,
+        # observably — exactly the demotion the round-5 probes did by hand
+        record_failure("multihost.init_distributed", "degraded", e,
+                       point="multihost.init", fallback="single-host")
         return False
     return jax.process_count() > 1
 

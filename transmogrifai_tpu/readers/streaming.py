@@ -22,6 +22,12 @@ class StreamingReader(DataReader):
     def __init__(self, batches: Optional[Iterable[List[Dict[str, Any]]]] = None,
                  batch_fn: Optional[Callable[[], Iterable[List[Dict[str, Any]]]]] = None,
                  key_fn=None, raw_features: Sequence[Feature] = ()):
+        if batches is None and batch_fn is None:
+            # fail at construction, not with a TypeError mid-stream
+            raise ValueError(
+                "StreamingReader needs a batch source: pass `batches` (an "
+                "iterable of record micro-batches) or `batch_fn` (a callable "
+                "returning one)")
         super().__init__(records=None, read_fn=lambda: [], key_fn=key_fn)
         self._batches = batches
         self._batch_fn = batch_fn

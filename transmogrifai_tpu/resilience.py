@@ -1,0 +1,430 @@
+"""Resilience — policy-driven failure handling for the execution layer.
+
+The reference system survives messy *data* (SanityChecker, RawFeatureFilter);
+this module makes the *execution* layer survive messy infrastructure.  The
+round-4/5 TPU-tunnel outage (OUTAGE_r5.json) showed device init hanging in
+native code with no error raised, and before this module a single failing
+grid candidate, poisoned micro-batch, or flaky device dispatch aborted an
+entire ``train()`` or streaming-score run while ~20 ad-hoc silent ``except
+Exception`` blocks hid the rest.  Four pieces replace that:
+
+* ``RetryPolicy`` — exponential backoff with deterministic jitter and an
+  optional per-attempt deadline; ``policy.call(fn)`` retries transient
+  failures and records every retry in the active ``FailureLog``.
+* ``run_with_deadline`` — a watchdog that runs a risky (device-touching)
+  call in a worker thread and raises ``WatchdogTimeout`` when it does not
+  return in time, so a native hang cannot stall the host loop (the probe
+  discipline OUTAGE_r5.json's mitigations used, as a library primitive).
+* ``FailureLog`` — every swallowed / retried / degraded / dead-lettered
+  event is recorded with the stage uid, injection-point name and cause.
+  ``Workflow.train`` exposes the log on the returned model; the streaming
+  runner exposes it on the run result.  The ambient log (``use_failure_log``)
+  lets deep code (compiled-program demotions, device-dispatch fallbacks,
+  multihost init) report without threading a handle through every call.
+* ``FaultInjector`` — a chaos-test harness with named injection points
+  (``selector.candidate_fit``, ``streaming.batch``, ...).  Decisions are a
+  pure function of (seed, point, key), so a given seed reproduces the exact
+  same failure set — and therefore the exact same failure log — on every run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import (Any, Callable, Dict, Iterable, List, Optional, Sequence,
+                    Tuple)
+
+
+# --------------------------------------------------------------------------
+# errors
+# --------------------------------------------------------------------------
+
+class InjectedFault(RuntimeError):
+    """Raised by FaultInjector at an armed injection point."""
+
+
+class WatchdogTimeout(TimeoutError):
+    """A watchdogged call did not return before its deadline.
+
+    The worker thread is abandoned (daemonized): native hangs — the failure
+    mode of the round-5 tunnel outage — cannot be interrupted from Python,
+    so the only safe recovery is to stop waiting and degrade."""
+
+
+class AllCandidatesFailed(RuntimeError):
+    """Every (model × grid-point) candidate of a selector sweep failed.
+
+    Carries the per-candidate causes so the aggregate error is actionable
+    instead of a bare "nothing survived"."""
+
+    def __init__(self, message: str, causes: Optional[Dict[str, str]] = None):
+        self.causes = dict(causes or {})
+        if self.causes:
+            detail = "; ".join(f"{k}: {v}" for k, v in
+                               sorted(self.causes.items()))
+            message = f"{message} — per-candidate causes: {detail}"
+        super().__init__(message)
+
+
+# --------------------------------------------------------------------------
+# failure log
+# --------------------------------------------------------------------------
+
+def _format_cause(cause: Any) -> str:
+    if cause is None:
+        return ""
+    if isinstance(cause, BaseException):
+        return f"{type(cause).__name__}: {cause}"
+    return str(cause)
+
+
+@dataclass
+class FailureEvent:
+    """One swallowed / retried / degraded execution event."""
+
+    seq: int
+    stage: str              # stage uid / model name / subsystem
+    action: str             # see FailureLog.ACTIONS
+    cause: str              # "ExcType: message" (or free text)
+    point: str = ""         # injection-point / site name, e.g. "streaming.batch"
+    attempt: int = 0        # retry attempt number (0 = not a retry)
+    detail: Dict[str, Any] = field(default_factory=dict)
+    time_s: float = 0.0     # wall clock; excluded from signature()
+
+    def to_json(self) -> Dict[str, Any]:
+        d = {"seq": self.seq, "stage": self.stage, "action": self.action,
+             "cause": self.cause, "point": self.point,
+             "attempt": self.attempt, "time": self.time_s}
+        if self.detail:
+            d["detail"] = dict(self.detail)
+        return d
+
+
+class FailureLog:
+    """Append-only, thread-safe record of degradation events.
+
+    Worker threads (the validator's candidate pool, watchdog workers) record
+    into the same log the orchestrating call installed, so a train run's log
+    is complete even though fits fan out."""
+
+    ACTIONS = ("retried",      # transient failure, will try again
+               "skipped",      # unit of work abandoned, sweep continues
+               "dead_letter",  # exhausted retries, routed to the DLQ
+               "demoted",      # stage moved off the compiled/device path
+               "degraded",     # optimization abandoned, slower path taken
+               "fallback",     # alternate implementation used
+               "swallowed")    # best-effort side work failed silently before
+
+    def __init__(self):
+        self._events: List[FailureEvent] = []
+        self._lock = threading.Lock()
+
+    def record(self, stage: str, action: str, cause: Any = None, *,
+               point: str = "", attempt: int = 0, **detail) -> FailureEvent:
+        if action not in self.ACTIONS:
+            raise ValueError(f"unknown failure action {action!r}; "
+                             f"expected one of {self.ACTIONS}")
+        with self._lock:
+            ev = FailureEvent(seq=len(self._events), stage=str(stage),
+                              action=action, cause=_format_cause(cause),
+                              point=point, attempt=int(attempt),
+                              detail=dict(detail), time_s=time.time())
+            self._events.append(ev)
+            return ev
+
+    @property
+    def events(self) -> List[FailureEvent]:
+        with self._lock:
+            return list(self._events)
+
+    def by_action(self, action: str) -> List[FailureEvent]:
+        return [e for e in self.events if e.action == action]
+
+    def by_stage(self, stage: str) -> List[FailureEvent]:
+        return [e for e in self.events if e.stage == stage]
+
+    def summary(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in self.events:
+            out[e.action] = out.get(e.action, 0) + 1
+        return out
+
+    def signature(self) -> List[Tuple[str, str, str, str, int]]:
+        """The deterministic projection of the log: everything except wall
+        time and seq.  Two runs with the same seed/injector must produce
+        equal signatures (the acceptance contract for chaos tests).  Sorted
+        so thread-pool completion order cannot reorder it."""
+        return sorted((e.stage, e.point, e.action, e.cause, e.attempt)
+                      for e in self.events)
+
+    def to_json(self) -> List[Dict[str, Any]]:
+        return [e.to_json() for e in self.events]
+
+    def extend(self, other: "FailureLog") -> None:
+        for e in other.events:
+            self.record(e.stage, e.action, e.cause, point=e.point,
+                        attempt=e.attempt, **e.detail)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def __iter__(self):
+        return iter(self.events)
+
+    def __repr__(self) -> str:
+        return f"FailureLog({self.summary() or 'empty'})"
+
+
+# Ambient log: a process-global stack (NOT thread-local — the validator's
+# candidate fits run on a thread pool and must report into the log their
+# orchestrating train() installed).  Concurrent *independent* runs in one
+# process should pass explicit logs instead.
+_LOG_STACK: List[FailureLog] = []
+_LOG_LOCK = threading.Lock()
+DEFAULT_LOG = FailureLog()
+
+
+def active_failure_log() -> FailureLog:
+    """The innermost installed log, or the process-default catch-all."""
+    with _LOG_LOCK:
+        return _LOG_STACK[-1] if _LOG_STACK else DEFAULT_LOG
+
+
+@contextmanager
+def use_failure_log(log: FailureLog):
+    """Install ``log`` as the ambient failure log for the dynamic extent."""
+    with _LOG_LOCK:
+        _LOG_STACK.append(log)
+    try:
+        yield log
+    finally:
+        with _LOG_LOCK:
+            # remove the last occurrence (robust to interleaved exits)
+            for i in range(len(_LOG_STACK) - 1, -1, -1):
+                if _LOG_STACK[i] is log:
+                    del _LOG_STACK[i]
+                    break
+
+
+def record_failure(stage: str, action: str, cause: Any = None, *,
+                   point: str = "", attempt: int = 0, **detail) -> FailureEvent:
+    """Record into the ambient log — the one-liner deep code uses."""
+    return active_failure_log().record(stage, action, cause, point=point,
+                                       attempt=attempt, **detail)
+
+
+# --------------------------------------------------------------------------
+# deterministic hashing (shared by jitter and fault decisions)
+# --------------------------------------------------------------------------
+
+def _stable_uniform(*parts: Any) -> float:
+    """Uniform [0, 1) as a pure function of the parts — independent of
+    PYTHONHASHSEED, process, platform and call order."""
+    h = hashlib.sha256("|".join(str(p) for p in parts).encode()).digest()
+    return int.from_bytes(h[:8], "big") / float(1 << 64)
+
+
+# --------------------------------------------------------------------------
+# watchdog
+# --------------------------------------------------------------------------
+
+def run_with_deadline(fn: Callable[..., Any], timeout_s: Optional[float],
+                      *args, description: str = "", **kwargs) -> Any:
+    """Run ``fn`` with a deadline; raise ``WatchdogTimeout`` if it blows it.
+
+    The call runs in a daemon worker thread and the caller waits at most
+    ``timeout_s``.  A call that never returns (a native hang in device init
+    or dispatch — OUTAGE_r5.json's failure mode) is *abandoned*, not
+    interrupted: Python cannot cancel native code, so the worker leaks by
+    design and the host loop stays alive.  ``timeout_s=None`` runs inline."""
+    if timeout_s is None:
+        return fn(*args, **kwargs)
+    box: Dict[str, Any] = {}
+    done = threading.Event()
+
+    def target():
+        try:
+            box["value"] = fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 — re-raised in the caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=target, daemon=True,
+                              name=f"watchdog:{description or fn.__name__}")
+    worker.start()
+    if not done.wait(timeout_s):
+        raise WatchdogTimeout(
+            f"{description or getattr(fn, '__name__', 'call')} exceeded its "
+            f"{timeout_s:g}s deadline; worker thread abandoned (native hangs "
+            "cannot be interrupted from Python — see OUTAGE_r5.json)")
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+# --------------------------------------------------------------------------
+# retry policy
+# --------------------------------------------------------------------------
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff with deterministic jitter and optional deadline.
+
+    ``call(fn)`` runs ``fn`` up to ``max_attempts`` times.  Each attempt may
+    additionally be watchdogged (``timeout_s``), so a hanging attempt counts
+    as a failed attempt instead of stalling the loop forever.  Every retry is
+    recorded in the supplied (or ambient) ``FailureLog``; the final failure
+    propagates to the caller, which decides skip / dead-letter / raise."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.25            # ± fraction of the nominal delay
+    timeout_s: Optional[float] = None    # per-attempt watchdog deadline
+    retry_on: Tuple[type, ...] = (Exception,)
+    seed: int = 0                   # jitter determinism
+
+    def delay_for(self, attempt: int, key: Any = "") -> float:
+        """Backoff before retry #``attempt`` (1-based), deterministic in
+        (seed, key, attempt)."""
+        nominal = min(self.base_delay_s * self.multiplier ** (attempt - 1),
+                      self.max_delay_s)
+        if self.jitter <= 0:
+            return nominal
+        u = _stable_uniform(self.seed, "retry-jitter", key, attempt)
+        return nominal * (1.0 + self.jitter * (2.0 * u - 1.0))
+
+    def call(self, fn: Callable[[], Any], *, stage: str = "",
+             point: str = "", key: Any = "", log: Optional[FailureLog] = None,
+             sleep: Callable[[float], None] = time.sleep,
+             description: str = "") -> Any:
+        # `is None`, not truthiness — an empty FailureLog is falsy via __len__
+        log = active_failure_log() if log is None else log
+        last: Optional[BaseException] = None
+        for attempt in range(1, max(1, self.max_attempts) + 1):
+            try:
+                return run_with_deadline(fn, self.timeout_s,
+                                         description=description or point)
+            except self.retry_on as e:  # noqa: PERF203
+                last = e
+                if attempt >= self.max_attempts:
+                    raise
+                log.record(stage or point or "retry", "retried", e,
+                           point=point, attempt=attempt, key=str(key))
+                sleep(self.delay_for(attempt, key=key))
+        raise last  # pragma: no cover — loop always returns or raises
+
+
+# --------------------------------------------------------------------------
+# fault injection
+# --------------------------------------------------------------------------
+
+class FaultInjector:
+    """Deterministic chaos harness over named injection points.
+
+    Production code calls ``maybe_inject(point, key=...)`` at its risky
+    sites; with no injector installed that is a no-op attribute check.  A
+    test installs an injector (``with inject_faults(FaultInjector(...))``)
+    and selected (point, key) pairs raise ``InjectedFault``.
+
+    Decisions are *sticky and pure*: whether (point, key) fails is a hash of
+    (seed, point, key) against the point's rate — the same key fails on
+    every retry (so retry exhaustion and dead-lettering are exercised) and
+    the same seed reproduces the identical failure set on every run.
+
+    ``rates``     — point → probability in [0, 1] that a key fails;
+    ``fail_keys`` — point → explicit keys that always fail (deterministic
+                    acceptance tests: "kill candidate 'LR' and batch 1")."""
+
+    def __init__(self, rates: Optional[Dict[str, float]] = None,
+                 fail_keys: Optional[Dict[str, Iterable[Any]]] = None,
+                 seed: int = 0):
+        self.rates = {k: float(v) for k, v in (rates or {}).items()}
+        self.fail_keys = {p: {str(k) for k in ks}
+                          for p, ks in (fail_keys or {}).items()}
+        self.seed = int(seed)
+        self.fired: List[Tuple[str, str]] = []   # every raise, in order
+        self._auto_counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def should_fail(self, point: str, key: Any = None) -> bool:
+        if key is None:
+            with self._lock:
+                key = self._auto_counts.get(point, 0)
+                self._auto_counts[point] = key + 1
+        key = str(key)
+        if key in self.fail_keys.get(point, ()):
+            return True
+        rate = self.rates.get(point, 0.0)
+        if rate <= 0.0:
+            return False
+        return _stable_uniform(self.seed, point, key) < rate
+
+    def check(self, point: str, key: Any = None) -> None:
+        """Raise ``InjectedFault`` when (point, key) is armed."""
+        if self.should_fail(point, key):
+            with self._lock:
+                self.fired.append((point, str(key)))
+            raise InjectedFault(
+                f"injected fault at {point!r} (key={key!r})")
+
+    # -- installation ------------------------------------------------------
+    def install(self) -> "FaultInjector":
+        global _INJECTOR
+        _INJECTOR = self
+        return self
+
+    def uninstall(self) -> None:
+        global _INJECTOR
+        if _INJECTOR is self:
+            _INJECTOR = None
+
+    def __enter__(self) -> "FaultInjector":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+_INJECTOR: Optional[FaultInjector] = None
+
+
+def maybe_inject(point: str, key: Any = None) -> None:
+    """Injection-point hook: no-op unless a FaultInjector is installed."""
+    inj = _INJECTOR
+    if inj is not None:
+        inj.check(point, key)
+
+
+@contextmanager
+def inject_faults(injector: FaultInjector):
+    """Install ``injector`` for the dynamic extent (restores the previous)."""
+    global _INJECTOR
+    prev = _INJECTOR
+    _INJECTOR = injector
+    try:
+        yield injector
+    finally:
+        _INJECTOR = prev
+
+
+# Injection points wired through the execution layer.  Keys are stable
+# identifiers (candidate model name, micro-batch index, stage uid) so chaos
+# decisions survive retries and reorderings.
+INJECTION_POINTS = {
+    "selector.candidate_fit": "one (model × grid) candidate family fit",
+    "selector.candidate_metric": "scoring one fitted candidate",
+    "streaming.batch": "scoring one streaming micro-batch",
+    "compiled.segment": "executing one fused device segment",
+    "multihost.init": "jax distributed runtime initialization",
+}

@@ -21,6 +21,8 @@ import numpy as np
 
 from .params import OpParams
 from .profiling import AppMetrics, PhaseTimer
+from .resilience import (FailureLog, RetryPolicy, maybe_inject,
+                         use_failure_log)
 from .workflow import Workflow, WorkflowModel
 
 
@@ -42,6 +44,10 @@ class OpWorkflowRunnerResult:
     metrics: Optional[Dict[str, Any]] = None
     scores_location: Optional[str] = None
     app_metrics: Optional[AppMetrics] = None
+    failure_log: Optional[FailureLog] = None
+    # streaming micro-batches that exhausted their retries:
+    # [{"index", "error", "batch"}] — the batch rides along for reprocessing
+    dead_letters: List[Dict[str, Any]] = field(default_factory=list)
 
 
 class OpWorkflowRunner:
@@ -50,7 +56,9 @@ class OpWorkflowRunner:
     def __init__(self, workflow: Optional[Workflow] = None,
                  train_reader=None, score_reader=None,
                  evaluator=None, evaluation_feature=None,
-                 features_to_compute=None):
+                 features_to_compute=None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 failure_log: Optional[FailureLog] = None):
         # score / streaming-score / evaluate / features run types load a
         # saved model and need no workflow; only train requires one
         self.workflow = workflow
@@ -59,6 +67,10 @@ class OpWorkflowRunner:
         self.evaluator = evaluator
         self.evaluation_feature = evaluation_feature
         self.features_to_compute = features_to_compute
+        # resilience: transient streaming-batch failures retry per policy;
+        # exhausted batches dead-letter instead of killing the stream
+        self.retry_policy = retry_policy
+        self.failure_log = failure_log
         self._completion_callbacks: List[Callable[[AppMetrics], None]] = []
 
     def add_application_completion_handler(self, fn: Callable[[AppMetrics], None]):
@@ -111,7 +123,9 @@ class OpWorkflowRunner:
                 with open(os.path.join(params.model_location,
                                        "model-summary.json"), "w") as fh:
                     json.dump(summary, fh, indent=2, default=str)
-        return OpWorkflowRunnerResult(RunType.TRAIN, model_summary=summary)
+        return OpWorkflowRunnerResult(
+            RunType.TRAIN, model_summary=summary,
+            failure_log=getattr(model, "failure_log", None))
 
     def _load_model(self, params: OpParams) -> WorkflowModel:
         if not params.model_location:
@@ -151,7 +165,13 @@ class OpWorkflowRunner:
 
     def _streaming_score(self, params: OpParams, timer: PhaseTimer) -> OpWorkflowRunnerResult:
         """≙ :225-263: micro-batch scoring loop over a streaming reader
-        (host loop feeding the compiled score fn, SURVEY §2.6 P6)."""
+        (host loop feeding the compiled score fn, SURVEY §2.6 P6).
+
+        Resilient: each batch retries per ``self.retry_policy`` (exponential
+        backoff; optional per-attempt watchdog deadline so a native hang
+        cannot stall the stream), and a batch that exhausts its retries is
+        routed to the result's dead-letter list — the stream continues.
+        Every retry and dead-letter lands in the result's ``failure_log``."""
         model = self._load_model(params)
         if self.score_reader is None or not hasattr(self.score_reader, "stream"):
             raise ValueError("streaming score requires a StreamingReader")
@@ -159,6 +179,10 @@ class OpWorkflowRunner:
             self.score_reader.set_raw_features(
                 [f for f in model.raw_features if not f.is_response])
         score_fn = model.score_fn()
+        policy = self.retry_policy or RetryPolicy(
+            max_attempts=3, base_delay_s=0.02, max_delay_s=0.5)
+        flog = self.failure_log if self.failure_log is not None else FailureLog()
+        dead_letters: List[Dict[str, Any]] = []
         loc = params.write_location
         if loc:
             os.makedirs(loc, exist_ok=True)
@@ -178,18 +202,39 @@ class OpWorkflowRunner:
             pending = None
 
         try:
-            for i, batch in enumerate(self.score_reader.stream()):
-                with timer.phase(f"batch_{i}"):
-                    scored = score_fn(batch)
-                flush()
-                pending = (i, scored)
-                n_batches += 1
+            with use_failure_log(flog):
+                for i, batch in enumerate(self.score_reader.stream()):
+                    def attempt(b=batch, j=i):
+                        maybe_inject("streaming.batch", key=j)
+                        return score_fn(b)
+
+                    try:
+                        with timer.phase(f"batch_{i}"):
+                            scored = policy.call(
+                                attempt, stage="streaming",
+                                point="streaming.batch", key=i, log=flog,
+                                description=f"streaming batch {i}")
+                    except Exception as e:  # noqa: BLE001 — dead-letter
+                        flog.record("streaming", "dead_letter", e,
+                                    point="streaming.batch", batch_index=i,
+                                    attempt=policy.max_attempts)
+                        dead_letters.append(
+                            {"index": i,
+                             "error": f"{type(e).__name__}: {e}",
+                             "batch": batch})
+                        continue
+                    flush()
+                    pending = (i, scored)
+                    n_batches += 1
         finally:
             # a mid-stream failure must not lose the last scored batch
             flush()
-        return OpWorkflowRunnerResult(RunType.STREAMING_SCORE,
-                                      scores_location=loc,
-                                      metrics={"batches": n_batches})
+        return OpWorkflowRunnerResult(
+            RunType.STREAMING_SCORE, scores_location=loc,
+            metrics={"batches": n_batches,
+                     "deadLetterBatches": [d["index"] for d in dead_letters],
+                     "failures": flog.summary()},
+            failure_log=flog, dead_letters=dead_letters)
 
     def _features(self, params: OpParams, timer: PhaseTimer) -> OpWorkflowRunnerResult:
         """≙ :265: computeDataUpTo a feature and write it."""

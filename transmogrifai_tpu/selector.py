@@ -24,6 +24,7 @@ from .evaluators import (Evaluators, OpBinaryClassificationEvaluator,
                          OpEvaluatorBase, OpMultiClassificationEvaluator,
                          OpRegressionEvaluator)
 from .models.base import PredictionModel, PredictorEstimator, extract_xy
+from .resilience import record_failure
 from .stages.base import Estimator
 from .tuning import (DataBalancer, DataCutter, DataSplitter, ModelCandidate,
                      OpCrossValidation, OpTrainValidationSplit, OpValidator,
@@ -282,7 +283,10 @@ class ModelSelector(Estimator):
                                    data_sharding(mesh, 2, row_axis=1))
             grids = [dict(result.best_params)] * len(cand.grid)
             return cand.estimator.fit_arrays_grid(X, y, W, grids)[0][0]
-        except Exception:  # noqa: BLE001 — reuse is an optimization only
+        except Exception as e:  # noqa: BLE001 — reuse is an optimization only
+            record_failure(self.uid, "degraded", e,
+                           point="selector.refit_reuse",
+                           fallback="fresh single-fit program")
             return None
 
     def _evaluate_all(self, model, X, y) -> Dict[str, Any]:
@@ -297,7 +301,10 @@ class ModelSelector(Estimator):
                 dev_out = model.device_scores(X, full=True)
                 y_dev = jnp.asarray(y, jnp.float32)
                 w_dev = jnp.ones_like(y_dev)
-            except Exception:  # noqa: BLE001 — fall back to host
+            except Exception as e:  # noqa: BLE001 — fall back to host
+                record_failure(self.uid, "fallback", e,
+                               point="selector.evaluate_device",
+                               fallback="host predict path")
                 dev_out = None
         pred = None
         for ev in self.evaluators:
@@ -305,7 +312,10 @@ class ModelSelector(Estimator):
             if dev_out is not None:
                 try:
                     em = ev.evaluate_all_device(y_dev, dev_out, w_dev)
-                except Exception:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001
+                    record_failure(self.uid, "fallback", e,
+                                   point="selector.evaluate_device",
+                                   evaluator=ev.name)
                     em = None
             if em is None:
                 if pred is None:

@@ -22,6 +22,8 @@ import numpy as np
 
 from .columns import ColumnBatch
 from .evaluators import OpEvaluatorBase
+from .resilience import (AllCandidatesFailed, active_failure_log,
+                         maybe_inject, record_failure)
 
 logger = logging.getLogger(__name__)
 
@@ -32,6 +34,7 @@ _logged_fallback_families = set()
 
 
 def _log_metric_fallback(family: str, exc: BaseException) -> None:
+    record_failure(family, "fallback", exc, point="selector.batched_metrics")
     if family not in _logged_fallback_families:
         _logged_fallback_families.add(family)
         # warning, not debug: the default root logger must surface it
@@ -600,10 +603,14 @@ class OpValidator:
 
         def host_metric(cand, params, fitted, X_va, y_va):
             try:
+                maybe_inject("selector.candidate_metric", key=cand.model_name)
                 model = make_model(cand, params, fitted)
                 pred = model.predict_arrays(X_va)
                 return self.evaluator.evaluate(y_va, pred)
-            except Exception:  # noqa: BLE001 — candidate robustness
+            except Exception as e:  # noqa: BLE001 — candidate robustness
+                record_failure(cand.model_name, "skipped", e,
+                               point="selector.candidate_metric",
+                               params=dict(params))
                 return float("nan")
 
         # (X, fold splits) groups: shared X across folds normally; per-fold X
@@ -717,23 +724,33 @@ class OpValidator:
                     W = to_device_f32(W, exact=True)
             def fit_candidate(cand):
                 try:
+                    maybe_inject("selector.candidate_fit", key=cand.model_name)
                     return cand.estimator.fit_arrays_grid(
                         X, y_dev if y_dev is not None else y32, W, cand.grid)
-                except Exception:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001
                     # batched fit failed as a block — retry per point so one
                     # bad candidate can't take down the family (≙ Try-wrapped
                     # fits in OpValidator.getSummary)
+                    record_failure(cand.model_name, "degraded", e,
+                                   point="selector.candidate_fit",
+                                   fallback="per-point refits")
                     fitted_grid = []
                     for f in range(len(fsplits)):
                         row = []
-                        for params in cand.grid:
+                        for gi, params in enumerate(cand.grid):
                             try:
+                                maybe_inject("selector.candidate_fit",
+                                             key=cand.model_name)
                                 est = copy.deepcopy(cand.estimator)
                                 for k, v in params.items():
                                     est.set(k, v)
                                 row.append(est.fit_arrays(
                                     X, y32, sample_weight=W[f]))
-                            except Exception:  # noqa: BLE001
+                            except Exception as e2:  # noqa: BLE001
+                                record_failure(
+                                    cand.model_name, "skipped", e2,
+                                    point="selector.candidate_fit",
+                                    fold=f, grid_index=gi)
                                 row.append(None)
                         fitted_grid.append(row)
                     return fitted_grid
@@ -811,14 +828,19 @@ class OpValidator:
             # ONE host pull for every device-scalar metric of the whole grid
             try:
                 vals = np.asarray(jnp.stack([m for m, _ in deferred]))
-            except Exception:  # noqa: BLE001 — candidate robustness: one bad
-                # candidate's runtime failure must not kill the whole grid;
-                # fall back to per-metric pulls (failed ones stay NaN)
+            except Exception as e:  # noqa: BLE001 — candidate robustness: one
+                # bad candidate's runtime failure must not kill the whole
+                # grid; fall back to per-metric pulls (failed ones stay NaN)
+                record_failure("validator", "degraded", e,
+                               point="selector.metric_pull",
+                               fallback="per-metric pulls")
                 vals = []
                 for m, _ in deferred:
                     try:
                         vals.append(float(m))
-                    except Exception:  # noqa: BLE001
+                    except Exception as e2:  # noqa: BLE001
+                        record_failure("validator", "skipped", e2,
+                                       point="selector.metric_pull")
                         vals.append(float("nan"))
             for v, (lst, i) in zip(vals, (slot for _, slot in deferred)):
                 lst[i] = float(v)
@@ -828,7 +850,17 @@ class OpValidator:
         scored = [(sign * r.mean_metric, r) for r in all_results
                   if np.isfinite(r.mean_metric)]
         if not scored:
-            raise RuntimeError("all model candidates failed validation")
+            # aggregate error with per-candidate causes from the failure log
+            # — "nothing survived" alone is undebuggable at 3am
+            causes: Dict[str, str] = {}
+            for ev in active_failure_log().events:
+                if ev.point.startswith("selector.") and ev.cause:
+                    causes.setdefault(ev.stage, ev.cause)
+            for cand in candidates:
+                causes.setdefault(cand.model_name,
+                                  "no finite validation metric")
+            raise AllCandidatesFailed(
+                "all model candidates failed validation", causes)
         best_score, best_res = max(scored, key=lambda t: t[0])
         best_cand = candidates[best_res.candidate_index]
         import copy as _c

@@ -199,36 +199,44 @@ class Workflow(_WorkflowCore):
 
     # -- training ----------------------------------------------------------
     def train(self) -> "WorkflowModel":
-        """≙ OpWorkflow.train:344."""
+        """≙ OpWorkflow.train:344.
+
+        The whole fit runs under a train-scoped ``FailureLog`` (ambient, so
+        compiled-segment demotions, validator candidate skips and device
+        fallbacks report into it from any depth/thread); the log is exposed
+        on the returned model as ``model.failure_log``."""
         from .profiling import PhaseTimer
+        from .resilience import FailureLog, use_failure_log
         from .sanitizer import (audit_dag_purity, audit_stage_serialization,
                                 nan_guard)
 
         timer = PhaseTimer()
-        with timer.phase("read"):
-            batch = self.generate_raw_data()
-        with timer.phase("prefetch"):
-            self._prefetch_text_profiles(batch)
-        rff_results = None
-        if self._raw_feature_filter is not None:
-            with timer.phase("rff"):
-                batch, dropped, rff_results = \
-                    self._raw_feature_filter.filter_batch(
-                        batch, self.raw_features)
-                self.blacklisted = dropped
-                self._apply_blacklist()
-        dag = compute_dag(self.result_features)
-        if self._sanitizers.get("serialization"):
-            audit_stage_serialization(dag_stages(dag))
-        raw_batch = batch if self._sanitizers.get("purity") else None
-        with nan_guard(self._sanitizers.get("nan", False)):
-            if self._workflow_cv:
-                batch, fitted_dag = self._fit_with_workflow_cv(batch, dag,
-                                                               timer)
-            else:
-                batch, fitted_dag = self._fit_plain(batch, dag, timer)
-        if raw_batch is not None:
-            audit_dag_purity(fitted_dag, raw_batch)
+        flog = FailureLog()
+        with use_failure_log(flog):
+            with timer.phase("read"):
+                batch = self.generate_raw_data()
+            with timer.phase("prefetch"):
+                self._prefetch_text_profiles(batch)
+            rff_results = None
+            if self._raw_feature_filter is not None:
+                with timer.phase("rff"):
+                    batch, dropped, rff_results = \
+                        self._raw_feature_filter.filter_batch(
+                            batch, self.raw_features)
+                    self.blacklisted = dropped
+                    self._apply_blacklist()
+            dag = compute_dag(self.result_features)
+            if self._sanitizers.get("serialization"):
+                audit_stage_serialization(dag_stages(dag))
+            raw_batch = batch if self._sanitizers.get("purity") else None
+            with nan_guard(self._sanitizers.get("nan", False)):
+                if self._workflow_cv:
+                    batch, fitted_dag = self._fit_with_workflow_cv(batch, dag,
+                                                                   timer)
+                else:
+                    batch, fitted_dag = self._fit_plain(batch, dag, timer)
+            if raw_batch is not None:
+                audit_dag_purity(fitted_dag, raw_batch)
         model = WorkflowModel(
             result_features=self.result_features,
             fitted_dag=fitted_dag,
@@ -240,6 +248,7 @@ class Workflow(_WorkflowCore):
         model._input_batch = self._input_batch
         model.train_batch = batch
         model.app_metrics = timer.app_metrics("train")
+        model.failure_log = flog
         return model
 
     def _prefetch_text_profiles(self, batch) -> None:
@@ -287,8 +296,12 @@ class Workflow(_WorkflowCore):
                 if (isinstance(v, np.ndarray)
                         and v.dtype in (np.float32, np.float64)):
                     to_device_f32(v, exact=f.is_response)
-        except Exception:  # noqa: BLE001 — prefetch must never break train
-            pass
+        except Exception as e:  # noqa: BLE001 — prefetch must never break
+            # train, but a dead prefetch means the host link no longer hides
+            # behind RFF/fit work — observable, not invisible
+            from .resilience import record_failure
+            record_failure("workflow.prefetch", "swallowed", e,
+                           point="workflow.prefetch")
 
     def _fit_plain(self, batch, dag, timer=None):
         """Fit the DAG with DEFERRED transform application: estimators fit
@@ -442,6 +455,7 @@ class WorkflowModel(_WorkflowCore):
         self.rff_results = rff_results
         self.train_batch: Optional[ColumnBatch] = None
         self.app_metrics = None     # AppMetrics from train() (profiling.py)
+        self.failure_log = None     # FailureLog from train() (resilience.py)
 
     # -- access ------------------------------------------------------------
     @property

@@ -1,0 +1,514 @@
+"""Tests for the durability subsystem: atomic versioned saves with digest
+verification, checkpoint-root fallback, resumable selector sweeps,
+preemption-aware shutdown, streaming offsets, and observable serialization
+drops."""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from test_aux_subsystems import make_records, train_small_model
+from test_resilience import _two_candidate_workflow
+from transmogrifai_tpu.checkpoint import (BUNDLE_FORMAT_VERSION,
+                                          MANIFEST_NAME, CorruptModelError,
+                                          ModelVersionError, SweepCheckpoint,
+                                          TrainingPreempted,
+                                          atomic_bundle_write,
+                                          find_latest_valid, next_version_dir,
+                                          preemption_guard, prune_versions,
+                                          shutdown_requested, use_sweep_checkpoint,
+                                          verify_bundle, write_json_atomic)
+from transmogrifai_tpu.params import OpParams
+from transmogrifai_tpu.readers.streaming import StreamingReaders
+from transmogrifai_tpu.resilience import (FailureLog, FaultInjector,
+                                          InjectedFault, RetryPolicy,
+                                          inject_faults, use_failure_log)
+from transmogrifai_tpu.runner import OpWorkflowRunner, RunType
+from transmogrifai_tpu.workflow import WorkflowModel
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """One small trained model + a saved, verified bundle shared by the
+    persistence tests (training is the expensive part)."""
+    records = make_records(120)
+    wf, _ = train_small_model(records)
+    model = wf.train()
+    path = tmp_path_factory.mktemp("bundles") / "model"
+    model.save(str(path))
+    return model, str(path), records
+
+
+def _score_vector(model, records):
+    recs = [{k: v for k, v in r.items() if k != "y"} for r in records]
+    batch = model.set_input_records(recs).score()
+    for _, col in sorted(batch.items()):
+        vals = col.values
+        if isinstance(vals, dict) and "prediction" in vals:
+            return np.asarray(vals["prediction"])
+    _, col = sorted(batch.items())[0]
+    return np.asarray(col.values)
+
+
+# --------------------------------------------------------------------------
+# atomic saves + manifest
+# --------------------------------------------------------------------------
+
+class TestAtomicSave:
+    def test_manifest_digests_and_verify(self, trained):
+        _, path, _ = trained
+        with open(os.path.join(path, MANIFEST_NAME)) as fh:
+            manifest = json.load(fh)
+        assert manifest["formatVersion"] == BUNDLE_FORMAT_VERSION
+        assert set(manifest["files"]) >= {"op-model.json", "params.npz"}
+        for info in manifest["files"].values():
+            assert len(info["sha256"]) == 64 and info["bytes"] > 0
+        assert verify_bundle(path)["formatVersion"] == BUNDLE_FORMAT_VERSION
+
+    def test_overwrite_false_raises_on_nonempty(self, trained, tmp_path):
+        model, _, _ = trained
+        target = tmp_path / "m"
+        model.save(str(target))
+        with pytest.raises(FileExistsError, match="overwrite"):
+            model.save(str(target), overwrite=False)
+        # explicit overwrite replaces cleanly and still verifies
+        model.save(str(target), overwrite=True)
+        assert verify_bundle(str(target)) is not None
+
+    def test_overwrite_false_ok_on_fresh_path(self, trained, tmp_path):
+        model, _, _ = trained
+        model.save(str(tmp_path / "fresh"), overwrite=False)
+        assert verify_bundle(str(tmp_path / "fresh")) is not None
+
+    def test_no_temp_dirs_left_behind(self, trained, tmp_path):
+        model, _, _ = trained
+        model.save(str(tmp_path / "m"))
+        model.save(str(tmp_path / "m"))   # replace path too
+        leftovers = [n for n in os.listdir(tmp_path) if n != "m"]
+        assert leftovers == []
+
+    def test_extra_files_in_bundle_are_tolerated(self, trained, tmp_path):
+        # the runner writes model-summary.json into the bundle after save;
+        # verification only covers manifest-listed files
+        model, _, _ = trained
+        p = tmp_path / "m"
+        model.save(str(p))
+        (p / "model-summary.json").write_text("{}")
+        assert verify_bundle(str(p)) is not None
+        assert WorkflowModel.load(str(p)) is not None
+
+    def test_atomic_write_aborts_cleanly_on_error(self, tmp_path):
+        target = tmp_path / "bundle"
+        with pytest.raises(RuntimeError, match="mid-write"):
+            with atomic_bundle_write(str(target)) as tmp:
+                with open(os.path.join(tmp, "half"), "w") as fh:
+                    fh.write("partial")
+                raise RuntimeError("mid-write")
+        assert not target.exists()
+        assert os.listdir(tmp_path) == []   # staging dir discarded
+
+
+class TestWriteJsonAtomic:
+    def test_roundtrip_and_replace(self, tmp_path):
+        p = str(tmp_path / "state.json")
+        write_json_atomic(p, {"nextBatch": 3})
+        write_json_atomic(p, {"nextBatch": 7})
+        with open(p) as fh:
+            assert json.load(fh) == {"nextBatch": 7}
+        assert [n for n in os.listdir(tmp_path)] == ["state.json"]
+
+
+# --------------------------------------------------------------------------
+# load-time verification
+# --------------------------------------------------------------------------
+
+class TestLoadVerification:
+    def test_missing_directory_names_path(self, tmp_path):
+        missing = str(tmp_path / "nope")
+        with pytest.raises(FileNotFoundError, match="nope"):
+            WorkflowModel.load(missing)
+
+    def test_missing_model_json_is_named(self, trained, tmp_path):
+        model, _, _ = trained
+        p = tmp_path / "m"
+        model.save(str(p))
+        os.remove(p / "op-model.json")
+        with pytest.raises(CorruptModelError, match="op-model.json"):
+            WorkflowModel.load(str(p))
+
+    def test_missing_params_npz_is_named(self, trained, tmp_path):
+        model, _, _ = trained
+        p = tmp_path / "m"
+        model.save(str(p))
+        os.remove(p / "params.npz")
+        with pytest.raises(CorruptModelError, match="params.npz"):
+            WorkflowModel.load(str(p))
+
+    def test_digest_mismatch_names_file(self, trained, tmp_path):
+        model, _, _ = trained
+        p = tmp_path / "m"
+        model.save(str(p))
+        with open(p / "params.npz", "r+b") as fh:
+            fh.seek(0)
+            fh.write(b"\x00corrupted\x00")
+        with pytest.raises(CorruptModelError) as ei:
+            WorkflowModel.load(str(p))
+        assert ei.value.file == "params.npz"
+        assert "mismatch" in ei.value.reason
+
+    def test_version_skew_raises_typed_error(self, trained, tmp_path):
+        model, _, _ = trained
+        p = tmp_path / "m"
+        model.save(str(p))
+        mpath = p / MANIFEST_NAME
+        m = json.loads(mpath.read_text())
+        m["formatVersion"] = BUNDLE_FORMAT_VERSION + 99
+        mpath.write_text(json.dumps(m))
+        with pytest.raises(ModelVersionError, match="format version"):
+            WorkflowModel.load(str(p))
+
+    def test_legacy_bundle_loads_with_warning(self, trained, tmp_path):
+        model, _, records = trained
+        p = tmp_path / "m"
+        model.save(str(p))
+        os.remove(p / MANIFEST_NAME)
+        log = FailureLog()
+        with use_failure_log(log), pytest.warns(UserWarning,
+                                                match="MANIFEST"):
+            loaded = WorkflowModel.load(str(p))
+        assert loaded is not None
+        assert any(e.action == "degraded" and e.point == "checkpoint.load"
+                   for e in log)
+
+    def test_checkpoint_root_falls_back_to_newest_valid(self, trained,
+                                                        tmp_path):
+        model, _, records = trained
+        root = tmp_path / "ckpts"
+        v1 = next_version_dir(str(root))
+        model.save(v1)
+        time.sleep(0.05)   # distinct createdAt ordering
+        v2 = next_version_dir(str(root))
+        assert v2.endswith("ckpt-000002")
+        model.save(v2)
+        # corrupt the newest: the loader must skip it and fall back to v1
+        with open(os.path.join(v2, "params.npz"), "r+b") as fh:
+            fh.write(b"\xff\xff\xff\xff")
+        log = FailureLog()
+        with use_failure_log(log):
+            loaded = WorkflowModel.load(str(root))
+        assert loaded is not None
+        skips = [e for e in log if e.action == "skipped"
+                 and e.point == "checkpoint.load"]
+        assert skips and "ckpt-000002" in skips[0].detail["bundle"]
+        np.testing.assert_allclose(_score_vector(loaded, records),
+                                   _score_vector(model, records), rtol=1e-5)
+
+    def test_root_with_no_valid_checkpoint_raises(self, trained, tmp_path):
+        model, _, _ = trained
+        root = tmp_path / "ckpts"
+        v1 = next_version_dir(str(root))
+        model.save(v1)
+        os.remove(os.path.join(v1, "op-model.json"))
+        with pytest.raises(CorruptModelError, match="no valid checkpoint"):
+            WorkflowModel.load(str(root))
+
+    def test_prune_keeps_newest(self, trained, tmp_path):
+        model, _, _ = trained
+        root = str(tmp_path / "ckpts")
+        paths = []
+        for _ in range(3):
+            p = next_version_dir(root)
+            model.save(p)
+            paths.append(p)
+            time.sleep(0.05)
+        removed = prune_versions(root, keep=2)
+        assert removed == [paths[0]]
+        assert find_latest_valid(root) == paths[2]
+
+
+# --------------------------------------------------------------------------
+# sweep checkpoint bundle
+# --------------------------------------------------------------------------
+
+class TestSweepCheckpointBundle:
+    def test_roundtrip_scores_and_fitted_arrays(self, tmp_path):
+        cp = SweepCheckpoint(str(tmp_path / "sweep"))
+        sig = SweepCheckpoint.candidate_signature("LR", 0, [{"reg": 0.1}])
+        fitted = [[{"coef": np.arange(4.0), "kind": "linear"}]]
+        cp.record_candidate(sig, "LR", 0,
+                            [{"params": {"reg": 0.1},
+                              "metricValues": [0.8, 0.9]}],
+                            fitted_grid=fitted)
+        cp.flush()
+        assert verify_bundle(str(tmp_path / "sweep")) is not None
+        re = SweepCheckpoint(str(tmp_path / "sweep"))
+        assert sig in re and len(re) == 1
+        assert re.results_for(sig)[0]["metricValues"] == [0.8, 0.9]
+        fg = re.fitted_grid(sig)
+        assert fg[0][0]["kind"] == "linear"
+        np.testing.assert_array_equal(fg[0][0]["coef"], np.arange(4.0))
+
+    def test_signature_changes_with_grid(self):
+        s1 = SweepCheckpoint.candidate_signature("LR", 0, [{"reg": 0.1}])
+        s2 = SweepCheckpoint.candidate_signature("LR", 0, [{"reg": 0.2}])
+        s3 = SweepCheckpoint.candidate_signature("LR", 1, [{"reg": 0.1}])
+        assert len({s1, s2, s3}) == 3
+        assert s1 == SweepCheckpoint.candidate_signature("LR", 0,
+                                                         [{"reg": 0.1}])
+
+    def test_winner_persists(self, tmp_path):
+        cp = SweepCheckpoint(str(tmp_path / "sweep"))
+        cp.set_winner("RF", {"depth": 3}, 0.91)
+        assert SweepCheckpoint(str(tmp_path / "sweep")).winner == {
+            "modelName": "RF", "params": {"depth": 3}, "metric": 0.91}
+
+
+# --------------------------------------------------------------------------
+# preemption guard
+# --------------------------------------------------------------------------
+
+class TestPreemptionGuard:
+    def test_sigterm_requests_graceful_stop(self):
+        with preemption_guard("test") as g:
+            assert not shutdown_requested()
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.time() + 2.0
+            while not g.stop_requested and time.time() < deadline:
+                time.sleep(0.01)
+            assert g.stop_requested
+            assert shutdown_requested()
+            # second signal escalates to a real interrupt
+            with pytest.raises(KeyboardInterrupt):
+                os.kill(os.getpid(), signal.SIGTERM)
+                time.sleep(2.0)
+        # handlers restored after the guard exits
+        assert signal.getsignal(signal.SIGTERM) is signal.SIG_DFL \
+            or signal.getsignal(signal.SIGTERM) is not None
+
+    def test_injected_preemption_sets_flag(self):
+        with preemption_guard("test") as g:
+            with inject_faults(FaultInjector(
+                    fail_keys={"preemption": ["candidate-x"]})):
+                assert not shutdown_requested(key="candidate-y")
+                assert shutdown_requested(key="candidate-x")
+            assert g.stop_requested
+        # flag does not leak into the next guard
+        with preemption_guard("test") as g2:
+            assert not g2.stop_requested
+
+    def test_guard_records_preempted_event(self):
+        log = FailureLog()
+        with use_failure_log(log), preemption_guard("train") as g:
+            g.request_stop("unit test")
+        evs = [e for e in log if e.action == "preempted"]
+        assert evs and evs[0].stage == "train"
+
+
+# --------------------------------------------------------------------------
+# resumable selector sweep (integration)
+# --------------------------------------------------------------------------
+
+class TestSweepResume:
+    def test_preempt_then_resume_skips_completed_candidate(self, tmp_path):
+        records = make_records(120)
+        sweep_dir = str(tmp_path / "sweep")
+
+        # run 1: injected preemption lands at the RF candidate boundary —
+        # LR completes and checkpoints, RF never starts
+        with inject_faults(FaultInjector(
+                fail_keys={"preemption": ["OpRandomForestClassifier"]})):
+            with pytest.raises(TrainingPreempted) as ei:
+                _two_candidate_workflow(records).train(resume_from=sweep_dir)
+        assert ei.value.resume_from == sweep_dir
+        assert ei.value.failure_log is not None
+        assert any(e.action == "preempted" for e in ei.value.failure_log)
+        cp = SweepCheckpoint(sweep_dir)
+        assert len(cp) == 1   # exactly the completed LR family
+
+        # run 2: resume.  A fit fault is armed for LR — if the sweep tried
+        # to re-fit it the candidate would be skipped with NaN metrics, so a
+        # finite LR metric proves the result was replayed, not re-fit.
+        with inject_faults(FaultInjector(
+                fail_keys={"selector.candidate_fit": ["OpLogisticRegression"]})):
+            model = _two_candidate_workflow(records).train(
+                resume_from=sweep_dir)
+        log = model.failure_log
+        resumed = [e for e in log if e.action == "resumed"]
+        assert resumed, "resume must be reported through the failure log"
+        summary = model.selected_model.summary
+        lr = [r for r in summary.validation_results
+              if r.model_name == "OpLogisticRegression"]
+        assert lr and all(np.isfinite(list(r.metric_values.values())[0])
+                          for r in lr)
+        # the finished sweep recorded its winner
+        assert SweepCheckpoint(sweep_dir).winner is not None
+
+        # the resumed model is a complete, verifiable artifact
+        out = str(tmp_path / "model")
+        model.save(out)
+        assert verify_bundle(out) is not None
+        assert WorkflowModel.load(out) is not None
+
+    def test_fully_replayed_sweep_retrains_nothing(self, tmp_path):
+        records = make_records(120)
+        sweep_dir = str(tmp_path / "sweep")
+        m1 = _two_candidate_workflow(records).train(resume_from=sweep_dir)
+        assert len(SweepCheckpoint(sweep_dir)) == 2
+
+        # every candidate replays; only the winner's full-data refit runs
+        m2 = _two_candidate_workflow(records).train(resume_from=sweep_dir)
+        resumed = [e for e in m2.failure_log if e.action == "resumed"]
+        assert len(resumed) >= 2
+        assert (m2.selected_model.summary.best_model_name
+                == m1.selected_model.summary.best_model_name)
+
+    def test_train_without_resume_from_is_unchanged(self):
+        records = make_records(120)
+        model = _two_candidate_workflow(records).train()
+        assert not [e for e in model.failure_log if e.action == "resumed"]
+
+
+# --------------------------------------------------------------------------
+# streaming offsets + preemption (integration)
+# --------------------------------------------------------------------------
+
+def _streaming_runner(tmp_path, records, wf):
+    recs = [{k: v for k, v in r.items() if k != "y"} for r in records]
+    batches = [recs[:40], recs[40:80], recs[80:]]
+    return OpWorkflowRunner(
+        wf, score_reader=StreamingReaders.custom(batches=batches),
+        retry_policy=RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                                 jitter=0.0))
+
+
+class TestStreamingOffsets:
+    def test_offsets_persist_and_resume_skips_scored(self, tmp_path):
+        records = make_records(120)
+        wf, _ = train_small_model(records)
+        model = wf.train()
+        model.save(str(tmp_path / "model"))
+        params = OpParams(model_location=str(tmp_path / "model"),
+                          write_location=str(tmp_path / "scores"),
+                          checkpoint_location=str(tmp_path / "ckpt"))
+        r1 = _streaming_runner(tmp_path, records, wf).run(
+            RunType.STREAMING_SCORE, params)
+        assert r1.metrics["batches"] == 3
+        offsets = json.loads(
+            (tmp_path / "ckpt" / "stream-offsets.json").read_text())
+        assert offsets == {"nextBatch": 3}
+
+        # identical rerun: everything already scored
+        r2 = _streaming_runner(tmp_path, records, wf).run(
+            RunType.STREAMING_SCORE, params)
+        assert r2.metrics["batches"] == 0
+        assert r2.metrics["skippedBatches"] == 3
+        assert [e.action for e in r2.failure_log].count("resumed") == 1
+
+    def test_preempted_stream_resumes_where_it_stopped(self, tmp_path):
+        records = make_records(120)
+        wf, _ = train_small_model(records)
+        model = wf.train()
+        model.save(str(tmp_path / "model"))
+        params = OpParams(model_location=str(tmp_path / "model"),
+                          write_location=str(tmp_path / "scores"),
+                          checkpoint_location=str(tmp_path / "ckpt"))
+        with inject_faults(FaultInjector(
+                fail_keys={"preemption": ["batch-1"]})):
+            r1 = _streaming_runner(tmp_path, records, wf).run(
+                RunType.STREAMING_SCORE, params)
+        assert r1.metrics["preempted"] is True
+        assert r1.metrics["batches"] == 1
+        assert (tmp_path / "scores" / "scores_0.jsonl").exists()
+        assert not (tmp_path / "scores" / "scores_1.jsonl").exists()
+
+        r2 = _streaming_runner(tmp_path, records, wf).run(
+            RunType.STREAMING_SCORE, params)
+        assert r2.metrics["preempted"] is False
+        assert r2.metrics["skippedBatches"] == 1
+        assert r2.metrics["batches"] == 2
+        for j in range(3):
+            assert (tmp_path / "scores" / f"scores_{j}.jsonl").exists()
+
+
+# --------------------------------------------------------------------------
+# observable serialization drops
+# --------------------------------------------------------------------------
+
+class TestSerializationDropReporting:
+    def test_json_safe_reports_dropped_value(self):
+        from transmogrifai_tpu.stages.serialization import _json_safe
+        log = FailureLog()
+        with use_failure_log(log):
+            out = _json_safe({"ok": 1, "bad": object()}, key="Stage.param")
+        assert out == {"ok": 1, "bad": None}
+        evs = [e for e in log if e.action == "swallowed"]
+        assert evs and evs[0].stage == "serialization"
+        assert evs[0].detail["key"] == "Stage.param.bad"
+
+    def test_stage_to_json_reports_callable_ctor_param(self, trained):
+        from transmogrifai_tpu.stages.serialization import stage_to_json
+        model, _, _ = trained
+        stage = model.fitted_dag[0][0]
+        stage._params["custom_hook"] = lambda x: x
+        log = FailureLog()
+        try:
+            with use_failure_log(log):
+                stage_to_json(stage)
+        finally:
+            del stage._params["custom_hook"]
+        evs = [e for e in log if e.action == "swallowed"]
+        assert evs and evs[0].detail["key"] == "custom_hook"
+        assert evs[0].detail["stage_uid"] == stage.uid
+
+
+# --------------------------------------------------------------------------
+# chaos: crash mid-save (slow)
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestSaveCrashRecovery:
+    def test_save_killed_mid_write_old_bundle_survives(self, tmp_path):
+        records = make_records(120)
+        wf, _ = train_small_model(records)
+        model = wf.train()
+        path = str(tmp_path / "model")
+        model.save(path)
+        baseline = _score_vector(model, records)
+        manifest_before = (tmp_path / "model" / MANIFEST_NAME).read_text()
+
+        # the fault fires after the new bundle's data files are staged but
+        # before the atomic commit — the moment a naive save is torn
+        with inject_faults(FaultInjector(fail_keys={"checkpoint.save":
+                                                    ["model"]})):
+            with pytest.raises(InjectedFault):
+                model.save(path)
+
+        # the torn attempt is never observable at the final path: the old
+        # bundle is byte-identical, verifies, loads, and scores the same
+        assert (tmp_path / "model" / MANIFEST_NAME).read_text() \
+            == manifest_before
+        assert [n for n in os.listdir(tmp_path) if n != "model"] == []
+        assert verify_bundle(path) is not None
+        reloaded = WorkflowModel.load(path)
+        np.testing.assert_allclose(_score_vector(reloaded, records),
+                                   baseline, rtol=1e-5)
+
+    def test_sweep_flush_crash_degrades_not_fatal(self, tmp_path):
+        records = make_records(120)
+        sweep_dir = str(tmp_path / "sweep")
+        # every sweep flush dies mid-commit; training must still complete,
+        # reporting the lost durability instead of crashing
+        with inject_faults(FaultInjector(fail_keys={"checkpoint.save":
+                                                    ["sweep"]})):
+            model = _two_candidate_workflow(records).train(
+                resume_from=sweep_dir)
+        assert model.selected_model.summary.best_model_name
+        degraded = [e for e in model.failure_log
+                    if e.action == "degraded"
+                    and e.point == "checkpoint.save"]
+        assert degraded
+        assert not os.path.exists(sweep_dir)

@@ -60,6 +60,9 @@ __all__ = [
     "RetryPolicy", "FailureLog", "FaultInjector", "InjectedFault",
     "WatchdogTimeout", "AllCandidatesFailed", "run_with_deadline",
     "use_failure_log", "inject_faults",
+    "CheckpointError", "CorruptModelError", "ModelVersionError",
+    "TrainingPreempted", "SweepCheckpoint", "verify_bundle",
+    "atomic_bundle_write", "preemption_guard", "shutdown_requested",
 ]
 
 _LAZY = {
@@ -88,6 +91,15 @@ _LAZY = {
     "run_with_deadline": ("resilience", "run_with_deadline"),
     "use_failure_log": ("resilience", "use_failure_log"),
     "inject_faults": ("resilience", "inject_faults"),
+    "CheckpointError": ("checkpoint", "CheckpointError"),
+    "CorruptModelError": ("checkpoint", "CorruptModelError"),
+    "ModelVersionError": ("checkpoint", "ModelVersionError"),
+    "TrainingPreempted": ("checkpoint", "TrainingPreempted"),
+    "SweepCheckpoint": ("checkpoint", "SweepCheckpoint"),
+    "verify_bundle": ("checkpoint", "verify_bundle"),
+    "atomic_bundle_write": ("checkpoint", "atomic_bundle_write"),
+    "preemption_guard": ("checkpoint", "preemption_guard"),
+    "shutdown_requested": ("checkpoint", "shutdown_requested"),
 }
 
 

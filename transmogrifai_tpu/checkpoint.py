@@ -1,0 +1,601 @@
+"""Crash-safe checkpointing — durability for the persistence and training
+layers.
+
+``resilience.py`` makes the execution layer survive failures *inside* a
+process (retries, watchdogs, degradations); this module makes the system
+survive the death of the process itself — the single most common failure on
+preemptible TPU fleets.  Three pieces:
+
+* **Atomic, versioned, checksummed bundles.**  ``atomic_bundle_write`` stages
+  every file of a model bundle in a temp sibling directory, writes a
+  ``MANIFEST.json`` with a format version and per-file SHA-256 digests,
+  fsyncs, and atomically renames into place — a crash mid-save can never
+  leave a torn bundle at the final path.  ``verify_bundle`` re-checks the
+  digests and version on load, raising ``CorruptModelError`` /
+  ``ModelVersionError`` naming the offending file; ``find_latest_valid``
+  lets a loader pointed at a checkpoint *root* fall back to the newest
+  bundle that still verifies.
+* **Resumable selector sweeps.**  ``SweepCheckpoint`` persists completed
+  (model × grid) candidate results (scores + fitted arrays, split the same
+  way the stage ``save_extra`` machinery splits JSON from npz) after each
+  candidate family finishes; a restarted ``train(resume_from=...)`` replays
+  them and skips the already-evaluated candidates, reporting every
+  resumption through the ambient ``FailureLog``.
+* **Preemption-aware shutdown.**  ``preemption_guard`` installs SIGTERM /
+  SIGINT handlers for the dynamic extent of ``train()`` and streaming
+  scoring; the first signal requests a graceful stop which the sweep and
+  micro-batch loops honor at the next candidate/batch boundary (flushing a
+  final checkpoint + streaming offsets), the second raises.  The
+  ``preemption`` injection point lets chaos tests trigger the same path
+  without real signals.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import signal
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .resilience import InjectedFault, maybe_inject, record_failure
+
+MANIFEST_NAME = "MANIFEST.json"
+BUNDLE_FORMAT_VERSION = 1
+_VERSION_DIR_PREFIX = "ckpt-"
+
+
+# --------------------------------------------------------------------------
+# errors
+# --------------------------------------------------------------------------
+
+class CheckpointError(RuntimeError):
+    """Base of all checkpoint/bundle integrity errors."""
+
+
+class CorruptModelError(CheckpointError):
+    """A model bundle failed integrity verification.
+
+    ``path`` is the bundle directory, ``file`` the offending file (or ""
+    for whole-bundle problems), ``reason`` the specific failure."""
+
+    def __init__(self, path: str, file: str = "", reason: str = ""):
+        self.path = str(path)
+        self.file = str(file)
+        self.reason = str(reason)
+        at = f"{self.path}/{self.file}" if self.file else self.path
+        super().__init__(f"corrupt model bundle: {at}: "
+                         f"{self.reason or 'integrity check failed'}")
+
+
+class ModelVersionError(CheckpointError):
+    """A bundle's format version is outside what this build can read."""
+
+    def __init__(self, path: str, found: Any,
+                 supported: int = BUNDLE_FORMAT_VERSION):
+        self.path = str(path)
+        self.found = found
+        self.supported = supported
+        super().__init__(
+            f"model bundle {self.path}: format version {found!r} is not "
+            f"readable by this build (supports 1..{supported}); "
+            f"re-save the model with a matching version")
+
+
+class TrainingPreempted(RuntimeError):
+    """``train()`` stopped gracefully at a candidate boundary after a
+    preemption signal (or injected preemption).  ``resume_from`` names the
+    sweep checkpoint to pass back to ``train(resume_from=...)``."""
+
+    def __init__(self, message: str, resume_from: Optional[str] = None):
+        self.resume_from = resume_from
+        self.failure_log = None   # attached by Workflow.train on the way out
+        if resume_from:
+            message = f"{message} (resume with resume_from={resume_from!r})"
+        super().__init__(message)
+
+
+# --------------------------------------------------------------------------
+# digests + fsync
+# --------------------------------------------------------------------------
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        while True:
+            b = fh.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _fsync_path(path: str) -> None:
+    """fsync a file or directory; best-effort on platforms that refuse
+    directory fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def write_json_atomic(path: str, payload: Dict[str, Any]) -> None:
+    """Durable small-file write: temp sibling + fsync + rename.  Used for
+    streaming offsets and other single-file progress markers."""
+    tmp = f"{path}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, indent=2, default=str)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    _fsync_path(os.path.dirname(os.path.abspath(path)))
+
+
+# --------------------------------------------------------------------------
+# atomic bundle write + manifest
+# --------------------------------------------------------------------------
+
+def write_manifest(dirpath: str, extra: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Any]:
+    """Digest every file in ``dirpath`` into a ``MANIFEST.json``."""
+    files: Dict[str, Dict[str, Any]] = {}
+    for name in sorted(os.listdir(dirpath)):
+        p = os.path.join(dirpath, name)
+        if name == MANIFEST_NAME or not os.path.isfile(p):
+            continue
+        files[name] = {"sha256": _sha256_file(p),
+                       "bytes": os.path.getsize(p)}
+    manifest: Dict[str, Any] = {"formatVersion": BUNDLE_FORMAT_VERSION,
+                                "createdAt": time.time(), "files": files}
+    if extra:
+        manifest.update(extra)
+    mpath = os.path.join(dirpath, MANIFEST_NAME)
+    with open(mpath, "w") as fh:
+        json.dump(manifest, fh, indent=2)
+        fh.flush()
+        os.fsync(fh.fileno())
+    return manifest
+
+
+@contextmanager
+def atomic_bundle_write(path: str, overwrite: bool = True,
+                        manifest_extra: Optional[Dict[str, Any]] = None):
+    """Write a bundle directory atomically.
+
+    Yields a temp sibling directory the caller populates; on clean exit the
+    manifest is written, everything is fsynced, and the temp directory is
+    renamed over ``path`` (the previous bundle, if any, is swapped out and
+    removed only after the new one is in place).  On ANY failure — including
+    an injected ``checkpoint.save`` fault — the temp directory is discarded
+    and the previous bundle at ``path`` is untouched."""
+    path = os.path.abspath(path)
+    parent = os.path.dirname(path)
+    os.makedirs(parent, exist_ok=True)
+    if (not overwrite and os.path.isdir(path) and os.listdir(path)):
+        raise FileExistsError(
+            f"model directory {path!r} is not empty; pass overwrite=True "
+            "to replace it")
+    tmp = os.path.join(
+        parent,
+        f".{os.path.basename(path)}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+    os.makedirs(tmp)
+    try:
+        yield tmp
+        # chaos hook: a fault here simulates the process dying after the
+        # data files are written but before the bundle commits
+        maybe_inject("checkpoint.save", key=os.path.basename(path))
+        write_manifest(tmp, extra=manifest_extra)
+        for name in os.listdir(tmp):
+            _fsync_path(os.path.join(tmp, name))
+        _fsync_path(tmp)
+        if os.path.lexists(path):
+            old = f"{tmp}.old"
+            os.rename(path, old)
+            os.rename(tmp, path)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(tmp, path)
+        _fsync_path(parent)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+# --------------------------------------------------------------------------
+# verification + checkpoint-root fallback
+# --------------------------------------------------------------------------
+
+def read_manifest(path: str) -> Optional[Dict[str, Any]]:
+    """The bundle's manifest dict, or None for a legacy unversioned bundle."""
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return None
+    try:
+        with open(mpath) as fh:
+            return json.load(fh)
+    except (OSError, ValueError) as e:
+        raise CorruptModelError(path, MANIFEST_NAME,
+                                f"unreadable manifest ({e})") from e
+
+
+def verify_bundle(path: str) -> Optional[Dict[str, Any]]:
+    """Verify a bundle directory's format version and per-file digests.
+
+    Returns the manifest (None for a legacy bundle without one); raises
+    ``ModelVersionError`` on version skew and ``CorruptModelError`` naming
+    the first missing/mismatched file.  Files present in the directory but
+    not listed in the manifest (e.g. a side-written summary) are ignored."""
+    maybe_inject("checkpoint.load", key=os.path.basename(path))
+    if not os.path.isdir(path):
+        raise FileNotFoundError(
+            f"model bundle directory {path!r} does not exist")
+    manifest = read_manifest(path)
+    if manifest is None:
+        return None
+    version = manifest.get("formatVersion")
+    if not isinstance(version, int) or not 1 <= version <= BUNDLE_FORMAT_VERSION:
+        raise ModelVersionError(path, version)
+    for name, info in (manifest.get("files") or {}).items():
+        fpath = os.path.join(path, name)
+        if not os.path.exists(fpath):
+            raise CorruptModelError(path, name,
+                                    "listed in MANIFEST but missing on disk")
+        digest = _sha256_file(fpath)
+        if digest != info.get("sha256"):
+            raise CorruptModelError(
+                path, name, f"SHA-256 mismatch (manifest "
+                f"{str(info.get('sha256'))[:12]}…, disk {digest[:12]}…)")
+    return manifest
+
+
+def is_bundle_dir(path: str) -> bool:
+    """Does ``path`` look like a single model bundle (vs a checkpoint root)?"""
+    return os.path.isdir(path) and (
+        os.path.exists(os.path.join(path, MANIFEST_NAME))
+        or os.path.exists(os.path.join(path, "op-model.json")))
+
+
+def _bundle_sort_key(path: str) -> float:
+    try:
+        m = read_manifest(path)
+        if m and isinstance(m.get("createdAt"), (int, float)):
+            return float(m["createdAt"])
+    except CheckpointError:
+        pass
+    try:
+        return os.path.getmtime(path)
+    except OSError:
+        return 0.0
+
+
+def find_latest_valid(root: str) -> str:
+    """Newest sub-bundle under ``root`` that passes verification.
+
+    Invalid/corrupt candidates are reported to the ambient ``FailureLog``
+    (action ``skipped``, point ``checkpoint.load``) and the scan continues;
+    raises ``CorruptModelError`` when nothing under the root verifies."""
+    if not os.path.isdir(root):
+        raise FileNotFoundError(
+            f"checkpoint root {root!r} does not exist")
+    candidates = [os.path.join(root, n) for n in os.listdir(root)
+                  if is_bundle_dir(os.path.join(root, n))]
+    if not candidates:
+        raise FileNotFoundError(
+            f"model directory {root!r} contains neither a model bundle "
+            f"(no op-model.json / {MANIFEST_NAME}) nor any checkpoint "
+            "sub-directories")
+    for cand in sorted(candidates, key=_bundle_sort_key, reverse=True):
+        try:
+            verify_bundle(cand)
+            return cand
+        except (CheckpointError, FileNotFoundError) as e:
+            record_failure("checkpoint", "skipped", e,
+                           point="checkpoint.load", bundle=cand)
+    raise CorruptModelError(
+        root, "", f"no valid checkpoint under root (tried "
+        f"{len(candidates)} candidate(s); see failure log for causes)")
+
+
+def next_version_dir(root: str) -> str:
+    """The next ``ckpt-NNNNNN`` directory name under a checkpoint root."""
+    os.makedirs(root, exist_ok=True)
+    ids = []
+    for n in os.listdir(root):
+        if n.startswith(_VERSION_DIR_PREFIX):
+            try:
+                ids.append(int(n[len(_VERSION_DIR_PREFIX):]))
+            except ValueError:
+                pass
+    return os.path.join(root, f"{_VERSION_DIR_PREFIX}{max(ids, default=0) + 1:06d}")
+
+
+def prune_versions(root: str, keep: int) -> List[str]:
+    """Remove the oldest version directories beyond ``keep``; returns the
+    removed paths.  Never removes a bundle it cannot order."""
+    if keep < 1:
+        raise ValueError("keep must be >= 1")
+    versions = sorted(
+        (os.path.join(root, n) for n in os.listdir(root)
+         if n.startswith(_VERSION_DIR_PREFIX)
+         and os.path.isdir(os.path.join(root, n))),
+        key=_bundle_sort_key, reverse=True)
+    removed = []
+    for path in versions[keep:]:
+        shutil.rmtree(path, ignore_errors=True)
+        removed.append(path)
+    return removed
+
+
+# --------------------------------------------------------------------------
+# resumable selector sweeps
+# --------------------------------------------------------------------------
+
+_SWEEP_JSON = "sweep.json"
+_SWEEP_NPZ = "sweep.npz"
+
+
+class SweepCheckpoint:
+    """Durable record of completed selector-sweep candidates.
+
+    One bundle directory (atomic + checksummed like any model bundle)
+    holding ``sweep.json`` — per-candidate grid scores keyed by a content
+    signature of (model name, candidate index, grid) — and ``sweep.npz``
+    with the candidates' fitted arrays, split JSON-vs-npz the same way the
+    stage ``save_extra`` machinery splits stage state.  A candidate whose
+    signature is present is *complete*: a resumed sweep replays its scores
+    instead of re-fitting it."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        self._candidates: Dict[str, Dict[str, Any]] = {}
+        self._arrays: Dict[str, np.ndarray] = {}
+        self.winner: Optional[Dict[str, Any]] = None
+        if os.path.isdir(self.path) and \
+                os.path.exists(os.path.join(self.path, _SWEEP_JSON)):
+            self._load()
+
+    # -- identity ----------------------------------------------------------
+    @staticmethod
+    def candidate_signature(model_name: str, candidate_index: int,
+                            grid: Sequence[Dict[str, Any]]) -> str:
+        """Content hash of a candidate: a resumed run only replays a result
+        if the model, its position, and its full grid are unchanged."""
+        payload = json.dumps(
+            {"model": model_name, "index": int(candidate_index),
+             "grid": [dict(sorted(g.items())) for g in grid]},
+            sort_keys=True, default=str)
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+    # -- persistence -------------------------------------------------------
+    def _load(self) -> None:
+        verify_bundle(self.path)
+        with open(os.path.join(self.path, _SWEEP_JSON)) as fh:
+            data = json.load(fh)
+        self._candidates = dict(data.get("candidates") or {})
+        self.winner = data.get("winner")
+        npz = os.path.join(self.path, _SWEEP_NPZ)
+        if os.path.exists(npz):
+            self._arrays = dict(np.load(npz, allow_pickle=False))
+
+    def flush(self) -> None:
+        """Atomically rewrite the whole sweep bundle."""
+        with atomic_bundle_write(self.path, overwrite=True,
+                                 manifest_extra={"kind": "selector-sweep"}
+                                 ) as tmp:
+            with open(os.path.join(tmp, _SWEEP_JSON), "w") as fh:
+                json.dump({"formatVersion": BUNDLE_FORMAT_VERSION,
+                           "candidates": self._candidates,
+                           "winner": self.winner}, fh, indent=2, default=str)
+            np.savez_compressed(os.path.join(tmp, _SWEEP_NPZ), **self._arrays)
+
+    # -- candidate results -------------------------------------------------
+    def __contains__(self, sig: str) -> bool:
+        return sig in self._candidates
+
+    def __len__(self) -> int:
+        return len(self._candidates)
+
+    def results_for(self, sig: str) -> Optional[List[Dict[str, Any]]]:
+        entry = self._candidates.get(sig)
+        return None if entry is None else list(entry.get("results") or [])
+
+    def record_candidate(self, sig: str, model_name: str,
+                         candidate_index: int,
+                         results: Sequence[Dict[str, Any]],
+                         fitted_grid: Optional[Sequence[Sequence[Any]]] = None
+                         ) -> None:
+        """Add a completed candidate: ``results`` is the per-grid-point
+        score list ``[{"params": ..., "metricValues": [...]}]``; the fitted
+        (fold × grid) state, when given, splits into JSON scalars +
+        npz arrays exactly like stage ``save_extra`` state."""
+        from .stages.serialization import _is_array, _json_safe
+
+        entry: Dict[str, Any] = {
+            "modelName": model_name, "candidateIndex": int(candidate_index),
+            "results": [dict(r) for r in results]}
+        if fitted_grid is not None:
+            fitted_json: List[List[Optional[Dict[str, Any]]]] = []
+            for f, row in enumerate(fitted_grid):
+                jrow: List[Optional[Dict[str, Any]]] = []
+                for g, fitted in enumerate(row):
+                    if not isinstance(fitted, dict):
+                        jrow.append(None)
+                        continue
+                    cell: Dict[str, Any] = {}
+                    for k, v in fitted.items():
+                        if _is_array(v):
+                            self._arrays[f"{sig}/f{f}/g{g}/{k}"] = \
+                                np.asarray(v)
+                        else:
+                            cell[k] = _json_safe(v)
+                    jrow.append(cell)
+                fitted_json.append(jrow)
+            entry["fittedJson"] = fitted_json
+        self._candidates[sig] = entry
+
+    def fitted_grid(self, sig: str) -> Optional[List[List[Any]]]:
+        """Reconstruct a completed candidate's (fold × grid) fitted state."""
+        entry = self._candidates.get(sig)
+        if entry is None or "fittedJson" not in entry:
+            return None
+        out: List[List[Any]] = []
+        for f, jrow in enumerate(entry["fittedJson"]):
+            row: List[Any] = []
+            for g, cell in enumerate(jrow):
+                if cell is None:
+                    row.append(None)
+                    continue
+                fitted = dict(cell)
+                prefix = f"{sig}/f{f}/g{g}/"
+                for k, v in self._arrays.items():
+                    if k.startswith(prefix):
+                        fitted[k[len(prefix):]] = v
+                row.append(fitted)
+            out.append(row)
+        return out
+
+    def set_winner(self, model_name: str, params: Dict[str, Any],
+                   metric: float) -> None:
+        self.winner = {"modelName": model_name, "params": dict(params),
+                       "metric": float(metric)}
+        self.flush()
+
+
+# Ambient sweep checkpoint, mirroring resilience.use_failure_log: installed
+# by Workflow.train for its dynamic extent so the validator — reached through
+# the stage-fit plumbing — can pick it up without signature changes.
+_SWEEP_STACK: List[SweepCheckpoint] = []
+_SWEEP_LOCK = threading.Lock()
+
+
+def active_sweep_checkpoint() -> Optional[SweepCheckpoint]:
+    with _SWEEP_LOCK:
+        return _SWEEP_STACK[-1] if _SWEEP_STACK else None
+
+
+@contextmanager
+def use_sweep_checkpoint(cp: Optional[SweepCheckpoint]):
+    if cp is None:
+        yield None
+        return
+    with _SWEEP_LOCK:
+        _SWEEP_STACK.append(cp)
+    try:
+        yield cp
+    finally:
+        with _SWEEP_LOCK:
+            for i in range(len(_SWEEP_STACK) - 1, -1, -1):
+                if _SWEEP_STACK[i] is cp:
+                    del _SWEEP_STACK[i]
+                    break
+
+
+# --------------------------------------------------------------------------
+# preemption-aware shutdown
+# --------------------------------------------------------------------------
+
+class PreemptionGuard:
+    """Cooperative stop flag set by SIGTERM/SIGINT (or injected preemption).
+
+    Loops poll ``shutdown_requested()`` at their candidate/batch boundaries
+    and wind down gracefully — flushing checkpoints and offsets — instead of
+    dying mid-write."""
+
+    def __init__(self, stage: str = "train"):
+        self.stage = stage
+        self.stop_requested = False
+        self.reason = ""
+
+    def request_stop(self, reason: Any) -> None:
+        if not self.stop_requested:
+            self.stop_requested = True
+            self.reason = str(reason)
+            record_failure(self.stage, "preempted", reason,
+                           point="preemption")
+
+
+_GUARD: Optional[PreemptionGuard] = None
+_GUARD_DEPTH = 0
+_GUARD_LOCK = threading.Lock()
+_PREV_HANDLERS: Dict[int, Any] = {}
+
+
+def _signal_handler(signum, frame):  # pragma: no cover — exercised via kill
+    guard = _GUARD
+    if guard is None:
+        return
+    if guard.stop_requested:
+        # second signal: the operator really means it
+        raise KeyboardInterrupt(
+            f"second signal {signum} during graceful shutdown")
+    guard.request_stop(f"signal {signum}")
+
+
+@contextmanager
+def preemption_guard(stage: str = "train",
+                     signals: Sequence[int] = (signal.SIGTERM, signal.SIGINT)):
+    """Install the SIGTERM/SIGINT → graceful-stop handler for the dynamic
+    extent.  Re-entrant: nested guards (runner → train) share one flag and
+    only the outermost install/restore touches the handlers.  Off the main
+    thread — where Python forbids signal() — the guard still works for
+    injected preemptions and records the degradation."""
+    global _GUARD, _GUARD_DEPTH
+    with _GUARD_LOCK:
+        _GUARD_DEPTH += 1
+        if _GUARD is None:
+            _GUARD = PreemptionGuard(stage)
+            try:
+                for s in signals:
+                    _PREV_HANDLERS[s] = signal.signal(s, _signal_handler)
+            except ValueError as e:   # not the main thread
+                record_failure(stage, "degraded", e,
+                               point="preemption.install",
+                               fallback="injection-only preemption checks")
+        guard = _GUARD
+    try:
+        yield guard
+    finally:
+        with _GUARD_LOCK:
+            _GUARD_DEPTH -= 1
+            if _GUARD_DEPTH == 0:
+                for s, h in _PREV_HANDLERS.items():
+                    try:
+                        signal.signal(s, h)
+                    except (ValueError, OSError):
+                        pass
+                _PREV_HANDLERS.clear()
+                _GUARD = None
+
+
+def shutdown_requested(key: Any = None) -> bool:
+    """Has a graceful stop been requested (signal or injected fault)?
+
+    The one-liner loops call at their boundaries: ``key`` identifies the
+    unit of work about to start (candidate name, batch index) so chaos
+    tests can preempt at an exact boundary via the ``preemption``
+    injection point."""
+    guard = _GUARD
+    if guard is not None and guard.stop_requested:
+        return True
+    try:
+        maybe_inject("preemption", key=key)
+    except InjectedFault as e:
+        if guard is not None:
+            guard.request_stop(e)
+        else:
+            record_failure("preemption", "preempted", e, point="preemption")
+        return True
+    return False

@@ -27,6 +27,7 @@ class OpParams:
     model_location: Optional[str] = None
     write_location: Optional[str] = None
     metrics_location: Optional[str] = None
+    checkpoint_location: Optional[str] = None   # sweep + streaming progress
     batch_size: Optional[int] = None
     custom_tag_name: Optional[str] = None
     custom_params: Dict[str, Any] = field(default_factory=dict)
@@ -44,6 +45,7 @@ class OpParams:
             model_location=d.get("modelLocation"),
             write_location=d.get("writeLocation"),
             metrics_location=d.get("metricsLocation"),
+            checkpoint_location=d.get("checkpointLocation"),
             batch_size=d.get("batchSize"),
             custom_tag_name=d.get("customTagName"),
             custom_params=d.get("customParams") or {},
@@ -63,6 +65,7 @@ class OpParams:
             "modelLocation": self.model_location,
             "writeLocation": self.write_location,
             "metricsLocation": self.metrics_location,
+            "checkpointLocation": self.checkpoint_location,
             "batchSize": self.batch_size,
             "customTagName": self.custom_tag_name,
             "customParams": self.custom_params,

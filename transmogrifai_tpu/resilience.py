@@ -116,7 +116,9 @@ class FailureLog:
                "demoted",      # stage moved off the compiled/device path
                "degraded",     # optimization abandoned, slower path taken
                "fallback",     # alternate implementation used
-               "swallowed")    # best-effort side work failed silently before
+               "swallowed",    # best-effort side work failed silently before
+               "resumed",      # unit of work replayed from a checkpoint
+               "preempted")    # graceful stop requested mid-run
 
     def __init__(self):
         self._events: List[FailureEvent] = []
@@ -427,4 +429,8 @@ INJECTION_POINTS = {
     "streaming.batch": "scoring one streaming micro-batch",
     "compiled.segment": "executing one fused device segment",
     "multihost.init": "jax distributed runtime initialization",
+    "checkpoint.save": "committing a model/sweep bundle (after data write, "
+                       "before atomic rename)",
+    "checkpoint.load": "verifying a bundle's manifest + digests on load",
+    "preemption": "a candidate/batch boundary's graceful-stop check",
 }

@@ -19,6 +19,8 @@ from typing import Any, Callable, Dict, Iterable, List, Optional
 
 import numpy as np
 
+from .checkpoint import (TrainingPreempted, preemption_guard,
+                         shutdown_requested, write_json_atomic)
 from .params import OpParams
 from .profiling import AppMetrics, PhaseTimer
 from .resilience import (FailureLog, RetryPolicy, maybe_inject,
@@ -111,8 +113,24 @@ class OpWorkflowRunner:
             self.workflow.set_reader(self.train_reader)
         if params.stage_params:
             self.workflow.apply_stage_params(params)
-        with timer.phase("train"):
-            model = self.workflow.train()
+        # with a checkpoint location, the selector sweep persists completed
+        # candidates under <location>/selector-sweep — a rerun of the same
+        # command resumes instead of restarting
+        resume_from = None
+        if params.checkpoint_location:
+            resume_from = os.path.join(params.checkpoint_location,
+                                       "selector-sweep")
+        try:
+            with timer.phase("train"):
+                model = self.workflow.train(resume_from=resume_from)
+        except TrainingPreempted as e:
+            # graceful preemption is an outcome, not a crash: report the
+            # resume point so the orchestrator can relaunch the same command
+            return OpWorkflowRunnerResult(
+                RunType.TRAIN,
+                metrics={"preempted": True, "reason": str(e),
+                         "resumeFrom": e.resume_from},
+                failure_log=e.failure_log)
         summary = None
         if params.model_location:
             with timer.phase("save"):
@@ -186,7 +204,30 @@ class OpWorkflowRunner:
         loc = params.write_location
         if loc:
             os.makedirs(loc, exist_ok=True)
+        # durable stream position: scores_<j>.jsonl is written BEFORE the
+        # offsets file advances to j+1, so a crash between the two re-scores
+        # batch j into the same file (idempotent) instead of losing it
+        offsets_path = None
+        next_batch = 0
+        if params.checkpoint_location:
+            os.makedirs(params.checkpoint_location, exist_ok=True)
+            offsets_path = os.path.join(params.checkpoint_location,
+                                        "stream-offsets.json")
+            if os.path.exists(offsets_path):
+                try:
+                    with open(offsets_path) as fh:
+                        next_batch = int(json.load(fh).get("nextBatch", 0))
+                except (OSError, ValueError) as e:
+                    flog.record("streaming", "degraded", e,
+                                point="checkpoint.load",
+                                fallback="restart from batch 0")
+            if next_batch:
+                flog.record("streaming", "resumed",
+                            f"offsets file: {next_batch} batch(es) already "
+                            "scored", point="checkpoint.load",
+                            next_batch=next_batch)
         n_batches = 0
+        was_preempted = False
         # double-buffered pipeline (SURVEY §2.6 P6): scoring dispatches
         # asynchronously on the device, so batch i computes while the host
         # serializes batch i-1's results — the d2h pull in _write_scores is
@@ -195,15 +236,27 @@ class OpWorkflowRunner:
 
         def flush():
             nonlocal pending
-            if pending is not None and loc:
+            if pending is not None:
                 j, prev = pending
-                with timer.phase(f"write_{j}"):
-                    _write_scores(prev, os.path.join(loc, f"scores_{j}.jsonl"))
+                if loc:
+                    with timer.phase(f"write_{j}"):
+                        _write_scores(prev,
+                                      os.path.join(loc, f"scores_{j}.jsonl"))
+                if offsets_path:
+                    write_json_atomic(offsets_path, {"nextBatch": j + 1})
             pending = None
 
         try:
-            with use_failure_log(flog):
+            with use_failure_log(flog), preemption_guard("streaming"):
                 for i, batch in enumerate(self.score_reader.stream()):
+                    if i < next_batch:
+                        continue   # already scored by a previous run
+                    if shutdown_requested(key=f"batch-{i}"):
+                        # graceful stop at the batch boundary: the finally
+                        # below flushes the last scored batch + its offset
+                        was_preempted = True
+                        break
+
                     def attempt(b=batch, j=i):
                         maybe_inject("streaming.batch", key=j)
                         return score_fn(b)
@@ -222,6 +275,9 @@ class OpWorkflowRunner:
                             {"index": i,
                              "error": f"{type(e).__name__}: {e}",
                              "batch": batch})
+                        # persist the predecessor before moving on so a
+                        # later crash cannot lose it
+                        flush()
                         continue
                     flush()
                     pending = (i, scored)
@@ -232,6 +288,8 @@ class OpWorkflowRunner:
         return OpWorkflowRunnerResult(
             RunType.STREAMING_SCORE, scores_location=loc,
             metrics={"batches": n_batches,
+                     "skippedBatches": next_batch,
+                     "preempted": was_preempted,
                      "deadLetterBatches": [d["index"] for d in dead_letters],
                      "failures": flog.summary()},
             failure_log=flog, dead_letters=dead_letters)
@@ -296,6 +354,9 @@ class OpApp:
         p.add_argument("--read-location")
         p.add_argument("--write-location")
         p.add_argument("--metrics-location")
+        p.add_argument("--checkpoint-location",
+                       help="directory for sweep checkpoints + streaming "
+                            "offsets; rerunning the same command resumes")
         p.add_argument("--param-location",
                        help="json file of OpParams")
         return p.parse_args(argv)
@@ -310,6 +371,8 @@ class OpApp:
             params.write_location = args.write_location
         if args.metrics_location:
             params.metrics_location = args.metrics_location
+        if args.checkpoint_location:
+            params.checkpoint_location = args.checkpoint_location
         if args.read_location:
             from .params import ReaderParams
             params.reader_params.setdefault("default", ReaderParams()).path = \

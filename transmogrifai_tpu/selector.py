@@ -390,6 +390,21 @@ class ModelSelector(Estimator):
         model.metadata["summary"] = summary.to_json()
         model.fitted = {"best_model_class": type(best_model).__name__,
                         "best_metric": float(result.best_metric)}
+
+        # seal the sweep checkpoint with the winner: a later resume of an
+        # already-finished sweep sees every candidate replayed AND which one
+        # won, so restart cost is one full-data refit, not a re-sweep
+        from .checkpoint import active_sweep_checkpoint
+        cp = active_sweep_checkpoint()
+        if cp is not None:
+            try:
+                cp.set_winner(result.best.model_name, result.best_params,
+                              float(result.best_metric))
+            except Exception as e:  # noqa: BLE001 — durability is best-effort
+                from .resilience import record_failure
+                record_failure("selector", "degraded", e,
+                               point="checkpoint.save",
+                               fallback="winner not persisted")
         return self._finalize_model(model)
 
 

@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..features import Feature
+from ..resilience import record_failure
 from ..types import FEATURE_TYPES, FeatureType
 from ..vector_meta import VectorMeta
 from .base import PipelineStage, TransformerModel
@@ -63,7 +64,7 @@ def resolve_stage_class(class_name: str):
     raise ValueError(f"unknown stage class {class_name!r}")
 
 
-def _json_safe(v: Any) -> Any:
+def _json_safe(v: Any, key: str = "") -> Any:
     if isinstance(v, (str, int, float, bool)) or v is None:
         return v
     if isinstance(v, (np.integer,)):
@@ -74,21 +75,35 @@ def _json_safe(v: Any) -> Any:
         # arrays nested inside dict/list fitted state (e.g. per-key splits)
         # round-trip as lists (0-d → scalar); top-level arrays go to
         # params.npz instead
-        return _json_safe(v.tolist())
+        return _json_safe(v.tolist(), key)
     if isinstance(v, (list, tuple)):
-        return [_json_safe(x) for x in v]
+        return [_json_safe(x, f"{key}[{i}]") for i, x in enumerate(v)]
     if isinstance(v, dict):
-        return {str(k): _json_safe(x) for k, x in v.items()}
-    return None  # unserializable param (e.g. callable) — dropped, like the
-    # reference drops non-ctor state
+        return {str(k): _json_safe(x, f"{key}.{k}" if key else str(k))
+                for k, x in v.items()}
+    # unserializable (e.g. callable, arbitrary object) — dropped like the
+    # reference drops non-ctor state, but observably: a silently-lossy save
+    # is a corrupt reload waiting to happen
+    record_failure("serialization", "swallowed",
+                   f"dropped unserializable value of type {type(v).__name__}",
+                   point="serialization.json_safe", key=key or "<anonymous>")
+    return None
 
 
 def stage_to_json(stage: PipelineStage) -> Dict[str, Any]:
+    params: Dict[str, Any] = {}
+    for k, v in stage.ctor_args().items():
+        if callable(v):
+            record_failure("serialization", "swallowed",
+                           f"ctor param {k!r} is callable and cannot be "
+                           "persisted", point="serialization.json_safe",
+                           stage_uid=stage.uid, key=k)
+            continue
+        params[k] = _json_safe(v, key=f"{stage.uid}.{k}")
     d: Dict[str, Any] = {
         "uid": stage.uid,
         "className": type(stage).__name__,
-        "params": {k: _json_safe(v) for k, v in stage.ctor_args().items()
-                   if not callable(v)},
+        "params": params,
         "inputFeatures": [f.uid for f in stage.input_features],
     }
     if isinstance(stage, TransformerModel):
@@ -99,9 +114,9 @@ def stage_to_json(stage: PipelineStage) -> Dict[str, Any]:
             if isinstance(v, VectorMeta):
                 fitted_json[k] = {"__vector_meta__": v.to_json()}
             else:
-                fitted_json[k] = _json_safe(v)
+                fitted_json[k] = _json_safe(v, key=f"{stage.uid}.{k}")
         d["fittedJson"] = fitted_json
-        d["metadata"] = _json_safe(stage.metadata)
+        d["metadata"] = _json_safe(stage.metadata, key=f"{stage.uid}.metadata")
     extra_json, _ = stage.save_extra()
     if extra_json:
         d["extra"] = extra_json

@@ -572,6 +572,40 @@ class OpValidator:
         # full host-link round trip each (~0.1 s on a tunneled TPU)
         deferred: List[Tuple[Any, list]] = []
 
+        # resumable sweep: candidates already completed in the ambient sweep
+        # checkpoint replay their scores instead of re-fitting.  Fast path
+        # only — the in-fold-DAG path accumulates each candidate's metrics
+        # across several fold groups, so a per-family snapshot would persist
+        # half-filled metric lists.
+        from .checkpoint import (SweepCheckpoint, TrainingPreempted,
+                                 active_sweep_checkpoint, shutdown_requested)
+        sweep_cp = None if in_fold_dag else active_sweep_checkpoint()
+        sweep_sigs: List[str] = []
+        replayed: set = set()
+        preempted: List[str] = []
+        if sweep_cp is not None:
+            for ci, cand in enumerate(candidates):
+                sig = SweepCheckpoint.candidate_signature(
+                    cand.model_name, ci, cand.grid)
+                sweep_sigs.append(sig)
+                stored = sweep_cp.results_for(sig)
+                if stored is None:
+                    continue
+                replayed.add(ci)
+                for gi, r in enumerate(stored):
+                    key = (cand.model_name, ci * 10000 + gi)
+                    results[key] = ValidatedCandidate(
+                        cand.model_name, dict(r.get("params") or {}),
+                        [float(v) for v in (r.get("metricValues") or [])],
+                        candidate_index=ci)
+                record_failure(cand.model_name, "resumed",
+                               f"replayed {len(stored)} grid point(s) from "
+                               "sweep checkpoint", point="checkpoint.load",
+                               candidate_index=ci)
+        live = [ci for ci in range(len(candidates)) if ci not in replayed]
+        _REPLAYED = object()     # sentinel fitted_grid: scores came from cp
+        _PREEMPTED = object()    # sentinel fitted_grid: stop won the boundary
+
         def record(cand, ci, gi, params, metric):
             key = (cand.model_name, ci * 10000 + gi)
             if key not in results:
@@ -626,6 +660,10 @@ class OpValidator:
             return np.asarray(v, dtype=np.float32)
 
         def fold_groups():
+            if not live:
+                # every candidate replayed from the sweep checkpoint — no
+                # data matrix, fold masks, or device transfers needed
+                return
             if in_fold_dag:
                 for tr_idx, va_idx in splits:
                     dag_copy = [[copy.deepcopy(s) for s in layer]
@@ -639,12 +677,66 @@ class OpValidator:
         import jax
         import jax.numpy as jnp
 
+        def drain_deferred():
+            """Pull every pending device-scalar metric in one stacked
+            transfer (falling back to per-metric pulls on failure).  Called
+            at the end of the grid, and before each sweep-checkpoint flush —
+            a flushed family's metric values must be real numbers, not the
+            NaN placeholders the batched pull would patch later."""
+            if not deferred:
+                return
+            try:
+                vals = np.asarray(jnp.stack([m for m, _ in deferred]))
+            except Exception as e:  # noqa: BLE001 — candidate robustness: one
+                # bad candidate's runtime failure must not kill the whole
+                # grid; fall back to per-metric pulls (failed ones stay NaN)
+                record_failure("validator", "degraded", e,
+                               point="selector.metric_pull",
+                               fallback="per-metric pulls")
+                vals = []
+                for m, _ in deferred:
+                    try:
+                        vals.append(float(m))
+                    except Exception as e2:  # noqa: BLE001
+                        record_failure("validator", "skipped", e2,
+                                       point="selector.metric_pull")
+                        vals.append(float("nan"))
+            for v, (lst, i) in zip(vals, (slot for _, slot in deferred)):
+                lst[i] = float(v)
+            deferred.clear()
+
+        def checkpoint_family(ci, cand, fitted_grid):
+            """Persist one completed candidate family into the ambient sweep
+            checkpoint (atomic flush).  A checkpoint-write failure degrades —
+            the sweep's correctness never depends on its durability."""
+            entry = []
+            for gi in range(len(cand.grid)):
+                r = results.get((cand.model_name, ci * 10000 + gi))
+                if r is not None:
+                    entry.append({"params": r.params,
+                                  "metricValues": r.metric_values})
+            try:
+                sweep_cp.record_candidate(
+                    sweep_sigs[ci], cand.model_name, ci, entry,
+                    fitted_grid=fitted_grid
+                    if isinstance(fitted_grid, list) else None)
+                sweep_cp.flush()
+            except Exception as e:  # noqa: BLE001
+                record_failure(cand.model_name, "degraded", e,
+                               point="checkpoint.save",
+                               fallback="sweep continues unpersisted")
+
         # reuse the label column's own buffer so the weakref-keyed transfer
         # cache shares ONE host→device shipment with SanityChecker/evaluate
         y32 = np.asarray(batch[label].values, dtype=np.float32)
         # shape of the fold-weight mask used for the batched fits — the final
         # refit reuses it to hit the SAME compiled executable (shape-keyed)
         self.last_fit_shape = None if in_fold_dag else (len(splits), len(y32))
+        if not live:
+            # fully-replayed sweep: no grid executable was compiled this
+            # process, so the winner refit must take the plain fit path
+            self.last_fit_shape = None
+            self.last_mesh = None
         from .columns import to_device_f32
         for X, fsplits in fold_groups():
             if not isinstance(X, jax.Array):
@@ -765,6 +857,19 @@ class OpValidator:
             # space) — fit sequentially so peak = max, not sum.  Grids with
             # no HBM-heavy family keep the compile-overlap pool at any N.
             import os as _os
+
+            def fit_or_skip(icand):
+                """Candidate boundary: replay beats fit, and a requested
+                graceful stop (signal or injected preemption) wins over
+                starting new work."""
+                ci, cand = icand
+                if ci in replayed:
+                    return _REPLAYED
+                if shutdown_requested(key=cand.model_name):
+                    preempted.append(cand.model_name)
+                    return _PREEMPTED
+                return fit_candidate(cand)
+
             serial_rows = int(_os.environ.get(
                 "TRANSMOGRIFAI_SERIAL_FIT_ROWS", 4_000_000))
             n_workers = min(self.parallelism, len(candidates))
@@ -772,12 +877,13 @@ class OpValidator:
                     getattr(c.estimator, "hbm_heavy", False)
                     for c in candidates):
                 n_workers = 1
+            indexed = list(enumerate(candidates))
             if n_workers > 1:
                 from concurrent.futures import ThreadPoolExecutor
                 with ThreadPoolExecutor(max_workers=n_workers) as pool:
-                    fitted_grids = list(pool.map(fit_candidate, candidates))
+                    fitted_grids = list(pool.map(fit_or_skip, indexed))
             else:
-                fitted_grids = [fit_candidate(c) for c in candidates]
+                fitted_grids = [fit_or_skip(ic) for ic in indexed]
 
             va_cache: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
 
@@ -804,46 +910,42 @@ class OpValidator:
 
             for ci, cand in enumerate(candidates):
                 fitted_grid = fitted_grids[ci]
-                if (is_dev and mesh is None
+                if fitted_grid is _REPLAYED or fitted_grid is _PREEMPTED:
+                    continue
+                if not (is_dev and mesh is None
                         and self._record_grid_metrics_batched(
                             cand, ci, fitted_grid, X, y_dev,
                             va_masks_dev, record)):
-                    continue
-                for f, va_idx in enumerate(va_slices):
-                    for gi, params in enumerate(cand.grid):
-                        fitted = fitted_grid[f][gi]
-                        if fitted is None:
-                            record(cand, ci, gi, params, float("nan"))
-                            continue
-                        metric = None
-                        if is_dev:
-                            metric = device_metric(cand, params, fitted, X,
-                                                   y_dev, va_masks_dev[f])
-                        if metric is None:
-                            metric = host_metric(cand, params, fitted,
-                                                 *va_slice(f, va_idx))
-                        record(cand, ci, gi, params, metric)
+                    for f, va_idx in enumerate(va_slices):
+                        for gi, params in enumerate(cand.grid):
+                            fitted = fitted_grid[f][gi]
+                            if fitted is None:
+                                record(cand, ci, gi, params, float("nan"))
+                                continue
+                            metric = None
+                            if is_dev:
+                                metric = device_metric(cand, params, fitted,
+                                                       X, y_dev,
+                                                       va_masks_dev[f])
+                            if metric is None:
+                                metric = host_metric(cand, params, fitted,
+                                                     *va_slice(f, va_idx))
+                            record(cand, ci, gi, params, metric)
+                if sweep_cp is not None:
+                    drain_deferred()
+                    checkpoint_family(ci, cand, fitted_grid)
 
-        if deferred:
-            # ONE host pull for every device-scalar metric of the whole grid
-            try:
-                vals = np.asarray(jnp.stack([m for m, _ in deferred]))
-            except Exception as e:  # noqa: BLE001 — candidate robustness: one
-                # bad candidate's runtime failure must not kill the whole
-                # grid; fall back to per-metric pulls (failed ones stay NaN)
-                record_failure("validator", "degraded", e,
-                               point="selector.metric_pull",
-                               fallback="per-metric pulls")
-                vals = []
-                for m, _ in deferred:
-                    try:
-                        vals.append(float(m))
-                    except Exception as e2:  # noqa: BLE001
-                        record_failure("validator", "skipped", e2,
-                                       point="selector.metric_pull")
-                        vals.append(float("nan"))
-            for v, (lst, i) in zip(vals, (slot for _, slot in deferred)):
-                lst[i] = float(v)
+        if preempted:
+            # graceful stop honored at a candidate boundary: everything
+            # completed so far is drained + flushed (per family, above);
+            # hand the caller the resume point instead of dying mid-write
+            drain_deferred()
+            raise TrainingPreempted(
+                "selector sweep stopped before candidate(s) "
+                + ", ".join(sorted(set(preempted))),
+                resume_from=sweep_cp.path if sweep_cp is not None else None)
+
+        drain_deferred()   # ONE pull for every device-scalar metric left
 
         all_results = list(results.values())
         sign = 1.0 if self.evaluator.is_larger_better else -1.0

@@ -198,45 +198,78 @@ class Workflow(_WorkflowCore):
                 raise TypeError(f"stage {st} is neither Transformer nor Estimator")
 
     # -- training ----------------------------------------------------------
-    def train(self) -> "WorkflowModel":
+    def train(self, resume_from: Optional[str] = None) -> "WorkflowModel":
         """≙ OpWorkflow.train:344.
 
         The whole fit runs under a train-scoped ``FailureLog`` (ambient, so
         compiled-segment demotions, validator candidate skips and device
         fallbacks report into it from any depth/thread); the log is exposed
-        on the returned model as ``model.failure_log``."""
+        on the returned model as ``model.failure_log``.
+
+        ``resume_from`` names a sweep-checkpoint directory: completed
+        selector candidates are flushed there after each candidate family,
+        and a restarted train pointed at the same directory replays them
+        instead of re-fitting (resumptions appear in the failure log with
+        action ``resumed``).  For the dynamic extent of the call SIGTERM/
+        SIGINT request a graceful stop at the next candidate boundary; the
+        sweep flushes a final checkpoint and the call raises
+        ``TrainingPreempted`` (carrying ``resume_from`` and the failure
+        log) instead of dying mid-write."""
+        from .checkpoint import (SweepCheckpoint, TrainingPreempted,
+                                 preemption_guard, use_sweep_checkpoint)
         from .profiling import PhaseTimer
-        from .resilience import FailureLog, use_failure_log
+        from .resilience import FailureLog, record_failure, use_failure_log
         from .sanitizer import (audit_dag_purity, audit_stage_serialization,
                                 nan_guard)
 
         timer = PhaseTimer()
         flog = FailureLog()
-        with use_failure_log(flog):
-            with timer.phase("read"):
-                batch = self.generate_raw_data()
-            with timer.phase("prefetch"):
-                self._prefetch_text_profiles(batch)
-            rff_results = None
-            if self._raw_feature_filter is not None:
-                with timer.phase("rff"):
-                    batch, dropped, rff_results = \
-                        self._raw_feature_filter.filter_batch(
-                            batch, self.raw_features)
-                    self.blacklisted = dropped
-                    self._apply_blacklist()
-            dag = compute_dag(self.result_features)
-            if self._sanitizers.get("serialization"):
-                audit_stage_serialization(dag_stages(dag))
-            raw_batch = batch if self._sanitizers.get("purity") else None
-            with nan_guard(self._sanitizers.get("nan", False)):
-                if self._workflow_cv:
-                    batch, fitted_dag = self._fit_with_workflow_cv(batch, dag,
-                                                                   timer)
-                else:
-                    batch, fitted_dag = self._fit_plain(batch, dag, timer)
-            if raw_batch is not None:
-                audit_dag_purity(fitted_dag, raw_batch)
+        sweep_cp = None
+        if resume_from is not None:
+            sweep_cp = SweepCheckpoint(resume_from)
+        try:
+            with use_failure_log(flog), preemption_guard("train"), \
+                    use_sweep_checkpoint(sweep_cp):
+                if sweep_cp is not None and len(sweep_cp):
+                    record_failure(
+                        "train", "resumed",
+                        f"sweep checkpoint with {len(sweep_cp)} completed "
+                        "candidate(s)", point="checkpoint.load",
+                        resume_from=sweep_cp.path)
+                return self._train_guarded(timer, flog)
+        except TrainingPreempted as e:
+            e.failure_log = flog
+            raise
+
+    def _train_guarded(self, timer, flog) -> "WorkflowModel":
+        """Body of ``train`` — runs with the failure log, preemption guard
+        and sweep checkpoint already ambient."""
+        from .sanitizer import (audit_dag_purity, audit_stage_serialization,
+                                nan_guard)
+        with timer.phase("read"):
+            batch = self.generate_raw_data()
+        with timer.phase("prefetch"):
+            self._prefetch_text_profiles(batch)
+        rff_results = None
+        if self._raw_feature_filter is not None:
+            with timer.phase("rff"):
+                batch, dropped, rff_results = \
+                    self._raw_feature_filter.filter_batch(
+                        batch, self.raw_features)
+                self.blacklisted = dropped
+                self._apply_blacklist()
+        dag = compute_dag(self.result_features)
+        if self._sanitizers.get("serialization"):
+            audit_stage_serialization(dag_stages(dag))
+        raw_batch = batch if self._sanitizers.get("purity") else None
+        with nan_guard(self._sanitizers.get("nan", False)):
+            if self._workflow_cv:
+                batch, fitted_dag = self._fit_with_workflow_cv(batch, dag,
+                                                               timer)
+            else:
+                batch, fitted_dag = self._fit_plain(batch, dag, timer)
+        if raw_batch is not None:
+            audit_dag_purity(fitted_dag, raw_batch)
         model = WorkflowModel(
             result_features=self.result_features,
             fitted_dag=fitted_dag,
@@ -607,7 +640,20 @@ class WorkflowModel(_WorkflowCore):
 
     # -- persistence (≙ OpWorkflowModelWriter.toJson) -----------------------
     def save(self, path: str, overwrite: bool = True):
-        os.makedirs(path, exist_ok=True)
+        """Atomically write the model bundle to ``path``.
+
+        The bundle is staged in a temp sibling directory, checksummed into
+        a ``MANIFEST.json``, fsynced and renamed into place — a crash mid-
+        save can never leave a torn bundle at ``path``.  With
+        ``overwrite=False`` a non-empty ``path`` raises ``FileExistsError``
+        instead of being replaced."""
+        from .checkpoint import atomic_bundle_write
+        with atomic_bundle_write(path, overwrite=overwrite,
+                                 manifest_extra={"kind": "workflow-model"}
+                                 ) as tmp:
+            self._write_bundle_files(tmp)
+
+    def _write_bundle_files(self, path: str) -> None:
         all_feats: Dict[str, Feature] = {}
         for rf in self.result_features:
             for f in rf.all_features():
@@ -663,12 +709,49 @@ class WorkflowModel(_WorkflowCore):
 
     @staticmethod
     def load(path: str) -> "WorkflowModel":
-        """≙ OpWorkflowModelReader: stages → features → model."""
-        with open(os.path.join(path, MODEL_JSON)) as fh:
+        """≙ OpWorkflowModelReader: stages → features → model.
+
+        ``path`` may be a single bundle directory or a checkpoint root
+        containing versioned ``ckpt-NNNNNN`` bundles — in the latter case
+        the newest bundle that passes verification is loaded (corrupt ones
+        are skipped with a recorded failure).  Bundles with a
+        ``MANIFEST.json`` are digest- and version-verified
+        (``CorruptModelError`` / ``ModelVersionError`` name the offending
+        file); legacy bundles without one still load, with a warning."""
+        from .checkpoint import (CorruptModelError, find_latest_valid,
+                                 is_bundle_dir, verify_bundle)
+        from .resilience import record_failure
+        if not os.path.isdir(path):
+            raise FileNotFoundError(
+                f"model directory {path!r} does not exist")
+        if not is_bundle_dir(path):
+            path = find_latest_valid(path)
+        manifest_meta = verify_bundle(path)
+        if manifest_meta is None:
+            import warnings
+            warnings.warn(
+                f"model bundle {path!r} has no MANIFEST.json (saved by a "
+                "pre-checkpointing build); loading without integrity "
+                "verification", stacklevel=2)
+            record_failure("checkpoint", "degraded",
+                           "legacy bundle without MANIFEST",
+                           point="checkpoint.load", bundle=path)
+        json_path = os.path.join(path, MODEL_JSON)
+        if not os.path.exists(json_path):
+            raise CorruptModelError(path, MODEL_JSON,
+                                    "model description file is missing")
+        with open(json_path) as fh:
             manifest = json.load(fh)
         npz_path = os.path.join(path, PARAMS_NPZ)
-        arrays = dict(np.load(npz_path, allow_pickle=False)) \
-            if os.path.exists(npz_path) else {}
+        if os.path.exists(npz_path):
+            arrays = dict(np.load(npz_path, allow_pickle=False))
+        elif manifest_meta is not None and \
+                PARAMS_NPZ in (manifest_meta.get("files") or {}):
+            raise CorruptModelError(path, PARAMS_NPZ,
+                                    "fitted-parameter file is missing")
+        else:
+            # legacy bundles may legitimately have no arrays
+            arrays = {}
 
         # 1. rebuild stages
         stages_by_uid: Dict[str, PipelineStage] = {}

@@ -86,18 +86,31 @@ def _per_family(n, flush):
     merged = {"rows": n, "phase": "per_family_isolated",
               "rc": 0 if ok else 1, "families": fams}
     if ok:
-        cv = {}
-        for r in fams.values():
-            cv.update(r["result"]["aux"].get("family_cv_metrics", {}))
-        winner = max(cv, key=cv.get)
-        win_rec = {"lr": "OpLogisticRegression", "rf":
-                   "OpRandomForestClassifier", "gbt": "OpGBTClassifier"}
-        win_fam = next(k for k, v in win_rec.items() if v == winner)
+        # model name → (metric, source family key), sourced from whichever
+        # process reported it — no hardcoded class-name table, so a renamed
+        # or additional candidate cannot raise StopIteration here
+        cv, src = {}, {}
+        larger_better = True
+        for fam_key, r in fams.items():
+            aux = r["result"]["aux"]
+            larger_better = bool(aux.get("metric_larger_better", True))
+            for name, v in (aux.get("family_cv_metrics") or {}).items():
+                cv[name], src[name] = v, fam_key
         merged["family_cv_metrics"] = cv
+        if not cv:
+            merged["rc"] = 1
+            merged["note"] = ("family processes reported no CV metrics; "
+                              "winner merge skipped")
+            return merged
+        # best per the validation evaluator's own direction (AuPR is
+        # larger-better, but e.g. a regression RMSE selector is not)
+        winner = (max if larger_better else min)(cv, key=cv.get)
         merged["winner"] = winner
+        merged["metric_larger_better"] = larger_better
         # the winning family's process already refit its winner on the full
         # matrix and evaluated train AuROC — that IS the full grid's outcome
-        merged["train_auroc"] = fams[win_fam]["result"]["aux"]["train_auroc"]
+        merged["train_auroc"] = fams[src[winner]]["result"]["aux"][
+            "train_auroc"]
         merged["combined_wall_s"] = round(sum(
             r["result"]["value"] for r in fams.values()), 2)
         merged["note"] = ("full grid as three isolated family processes "

@@ -1,0 +1,533 @@
+"""Online scoring service: micro-batching engine, HTTP server, hot reload.
+
+Covers the serving acceptance criteria: batcher coalescing, padding-ladder
+reuse (zero online XLA recompiles after warmup, probed via
+``compiled.trace_count``), concurrent-client correctness against
+``local.score_function``, hot reload mid-traffic (responses always match the
+version that served them), 429 shedding, /metrics shape, and — marked
+``slow`` for the weekly chaos workflow — SIGTERM drain of the real CLI
+server under load."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.checkpoint import next_version_dir
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.local import score_function
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                        ModelCandidate, grid)
+from transmogrifai_tpu.serving import (EngineClosed, OverloadedError,
+                                       ScoringEngine)
+from transmogrifai_tpu.serving.server import render_metrics, start_server
+from transmogrifai_tpu.workflow import Workflow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _train(seed=0, flip=False):
+    """A tiny y~x logistic model; ``flip`` inverts the relationship so two
+    trained versions score visibly differently (hot-reload telltale)."""
+    rng = np.random.default_rng(seed)
+    sgn = -1.0 if flip else 1.0
+    records = [{"y": float(i % 2), "x": sgn * (float(rng.normal()) + (i % 2))}
+               for i in range(120)]
+    label = FeatureBuilder.RealNN("y").as_response()
+    x = FeatureBuilder.Real("x").as_predictor()
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]), "LR")])
+    sel.set_input(label, transmogrify([x]))
+    pred = sel.get_output()
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred).train())
+    return model, pred.name
+
+
+@pytest.fixture(scope="module")
+def bundle(tmp_path_factory):
+    """(bundle_path, pred_name, local_fn) for one saved model."""
+    model, pred_name = _train()
+    path = str(tmp_path_factory.mktemp("serving") / "model")
+    model.save(path)
+    return path, pred_name, score_function(model)
+
+
+@pytest.fixture(scope="module")
+def engine(bundle):
+    path, _, _ = bundle
+    eng = ScoringEngine(path, max_batch=4, linger_ms=2.0, queue_bound=256)
+    yield eng
+    eng.close()
+
+
+def _post(port, payload, timeout=60):
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/score", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def _get(port, path, timeout=30):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+class TestEngine:
+    def test_warmup_then_no_online_recompile(self, engine, bundle):
+        """The padded ladder is compiled at init; traffic at any size ≤
+        max_batch reuses those programs — the tentpole's no-recompile
+        invariant, probed with the real trace counter."""
+        from transmogrifai_tpu.compiled import trace_count
+        assert engine.compiled_path_active
+        assert engine.stats()["counters"]["warmup_traces_total"] > 0
+        t0 = trace_count()
+        engine.score_record({"x": 0.5}, timeout_s=30)             # size 1→1
+        engine.score_records([{"x": float(i)} for i in range(3)],
+                             timeout_s=30)                        # 3→pad 4
+        engine.score_records([{"x": float(i)} for i in range(4)],
+                             timeout_s=30)                        # 4→4
+        assert trace_count() == t0, "online traffic must not trace"
+        s = engine.stats()
+        assert s["counters"].get("online_traces_total", 0) == 0
+        assert s["compiled_path_active"]
+
+    def test_single_record_matches_local(self, engine, bundle):
+        _, pred_name, local_fn = bundle
+        rec = {"x": 1.25}
+        res, version = engine.score_record(rec, timeout_s=30)
+        want = local_fn(rec)
+        assert version == engine.model_version
+        assert res[pred_name]["prediction"] == want[pred_name]["prediction"]
+        np.testing.assert_allclose(res[pred_name]["probability_1"],
+                                   want[pred_name]["probability_1"],
+                                   atol=1e-6)
+
+    def test_batcher_coalesces_concurrent_requests(self, engine):
+        """8 records enqueued at once against a blocked scorer come out as
+        exactly two max_batch=4 micro-batches, not eight singles."""
+        c0 = dict(engine.stats()["counters"])
+        got = []
+        with engine._score_lock:      # hold the device; queue must build up
+            t = threading.Thread(
+                target=lambda: got.extend(engine.score_records(
+                    [{"x": float(i)} for i in range(8)], timeout_s=60)))
+            t.start()
+            deadline = time.monotonic() + 10
+            while engine.queue_depth != 4 and time.monotonic() < deadline:
+                time.sleep(0.002)     # batcher holds 4, the rest wait
+            assert engine.queue_depth == 4
+        t.join(timeout=60)
+        c1 = engine.stats()["counters"]
+        assert len(got) == 8
+        assert c1["batch_rows_total"] - c0["batch_rows_total"] == 8
+        assert c1["batches_total"] - c0["batches_total"] == 2
+
+    def test_concurrent_clients_match_local(self, engine, bundle):
+        """64 concurrent single-record clients: every response equals the
+        row-at-a-time local scorer, and none trigger an online recompile."""
+        from transmogrifai_tpu.compiled import trace_count
+        _, pred_name, local_fn = bundle
+        t0 = trace_count()
+        results = [None] * 64
+        errors = []
+
+        def client(i):
+            try:
+                res, _ = engine.score_record({"x": (i - 32) / 8.0},
+                                             timeout_s=60)
+                results[i] = res
+            except Exception as e:  # noqa: BLE001 — collected for the assert
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(64)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors
+        for i, res in enumerate(results):
+            want = local_fn({"x": (i - 32) / 8.0})
+            assert res is not None
+            np.testing.assert_allclose(
+                res[pred_name]["probability_1"],
+                want[pred_name]["probability_1"], atol=1e-6)
+        assert trace_count() == t0
+        assert engine.stats()["counters"].get("online_traces_total", 0) == 0
+
+    def test_admission_control_sheds_past_queue_bound(self, bundle):
+        path, _, _ = bundle
+        eng = ScoringEngine(path, max_batch=1, linger_ms=0.5, queue_bound=2)
+        try:
+            reqs = []
+            with eng._score_lock:    # first request blocks in-flight
+                t = threading.Thread(
+                    target=lambda: reqs.append(
+                        eng.score_record({"x": 0.0}, timeout_s=60)))
+                t.start()
+                deadline = time.monotonic() + 10
+                while (eng.stats()["counters"].get("requests_total", 0) < 1
+                       or eng.queue_depth > 0) \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.002)
+                t2 = threading.Thread(
+                    target=lambda: reqs.extend(eng.score_records(
+                        [{"x": 1.0}, {"x": 2.0}], timeout_s=60)))
+                t2.start()
+                deadline = time.monotonic() + 10
+                while eng.queue_depth != 2 and time.monotonic() < deadline:
+                    time.sleep(0.002)
+                assert eng.queue_depth == 2
+                with pytest.raises(OverloadedError):
+                    eng.score_record({"x": 3.0}, timeout_s=5)
+                assert eng.stats()["counters"]["shed_total"] == 1
+            t.join(timeout=60)
+            t2.join(timeout=60)
+            assert len(reqs) == 3    # shed request lost nothing queued
+        finally:
+            eng.close()
+
+    def test_closed_engine_rejects(self, bundle):
+        path, _, _ = bundle
+        eng = ScoringEngine(path, max_batch=1, warm=False)
+        eng.close()
+        with pytest.raises(EngineClosed):
+            eng.score_record({"x": 0.0}, timeout_s=5)
+
+
+class TestHotReload:
+    def test_reload_swaps_to_newer_valid_version(self, tmp_path):
+        model1, pred1 = _train()
+        model2, pred2 = _train(seed=7, flip=True)
+        root = str(tmp_path / "ckpts")
+        model1.save(next_version_dir(root))
+        eng = ScoringEngine(root, max_batch=2, linger_ms=1.0)
+        try:
+            v1 = eng.model_version
+            assert "ckpt-000001" in v1
+            assert not eng.reload_now()          # nothing newer yet
+            time.sleep(0.05)                     # distinct createdAt
+            model2.save(next_version_dir(root))
+            assert eng.reload_now()
+            v2 = eng.model_version
+            assert "ckpt-000002" in v2 and v2 != v1
+            assert eng.stats()["counters"]["reloads_total"] == 1
+            # the swapped-in model answers, and matches ITS local scorer
+            rec = {"x": 1.0}
+            res, version = eng.score_record(rec, timeout_s=30)
+            assert version == v2
+            want = score_function(model2)(rec)
+            np.testing.assert_allclose(
+                res[pred2]["probability_1"],
+                want[pred2]["probability_1"], atol=1e-6)
+            # the two versions genuinely disagree (flip=True) — the parity
+            # assertions above are not vacuous
+            p1 = score_function(model1)(rec)[pred1]["probability_1"]
+            assert abs(p1 - want[pred2]["probability_1"]) > 0.05
+        finally:
+            eng.close()
+
+    def test_corrupt_candidate_is_skipped(self, tmp_path):
+        model1, _ = _train()
+        root = str(tmp_path / "ckpts")
+        model1.save(next_version_dir(root))
+        eng = ScoringEngine(root, max_batch=1, linger_ms=1.0, warm=False)
+        try:
+            v1 = eng.model_version
+            time.sleep(0.05)
+            bad = next_version_dir(root)
+            model1.save(bad)
+            with open(os.path.join(bad, "params.npz"), "r+b") as fh:
+                fh.write(b"\xff\xff\xff\xff")   # digest mismatch
+            assert not eng.reload_now()          # newest is corrupt → keep v1
+            assert eng.model_version == v1
+            assert eng.stats()["counters"].get("reloads_total", 0) == 0
+        finally:
+            eng.close()
+
+
+class TestHTTPServer:
+    @pytest.fixture(scope="class")
+    def server(self, bundle):
+        path, _, _ = bundle
+        srv, thread = start_server(path, port=0, max_batch=4, linger_ms=2.0,
+                                   queue_bound=64)
+        yield srv
+        srv.drain_and_close()
+        thread.join(timeout=10)
+
+    def test_http_smoke_single_list_and_p99(self, server, bundle):
+        """The CI serving smoke: ephemeral-port server scores single + list
+        bodies and /metrics reports a recorded p99."""
+        _, pred_name, local_fn = bundle
+        port = server.port
+        status, out, _ = _post(port, {"x": -0.25})
+        assert status == 200
+        assert out["modelVersion"] == server.engine.model_version
+        np.testing.assert_allclose(
+            out["result"][pred_name]["probability_1"],
+            local_fn({"x": -0.25})[pred_name]["probability_1"], atol=1e-6)
+
+        status, out, _ = _post(port, [{"x": 0.1}, {"x": 2.0}, {"x": -3.0}])
+        assert status == 200
+        assert len(out["results"]) == 3
+        for i, x in enumerate((0.1, 2.0, -3.0)):
+            np.testing.assert_allclose(
+                out["results"][i][pred_name]["probability_1"],
+                local_fn({"x": x})[pred_name]["probability_1"], atol=1e-6)
+
+        status, body = _get(port, "/healthz")
+        assert status == 200 and json.loads(body)["status"] == "ok"
+
+        status, text = _get(port, "/metrics")
+        assert status == 200
+        assert "transmogrifai_serving_requests_total" in text
+        assert "transmogrifai_serving_queue_depth" in text
+        assert "transmogrifai_serving_online_traces_total 0" in text
+        p99 = [ln for ln in text.splitlines()
+               if ln.startswith("transmogrifai_serving_request_latency_"
+                                "seconds") and 'quantile="0.99"' in ln]
+        assert p99, "p99 must be recorded after traffic"
+        assert float(p99[0].split()[-1]) > 0.0
+
+    def test_http_sheds_with_429_and_retry_after(self, server):
+        eng = server.engine
+        old_bound = eng.queue_bound
+        eng.queue_bound = 2
+        codes = []
+        try:
+            with eng._score_lock:
+                t = threading.Thread(target=lambda: codes.append(
+                    _post(server.port, {"x": 0.0})[0]))
+                t.start()
+                time.sleep(0.2)      # past linger: the batch is in flight
+                t2 = threading.Thread(target=lambda: codes.append(
+                    _post(server.port, [{"x": 1.0}, {"x": 2.0}])[0]))
+                t2.start()
+                deadline = time.monotonic() + 10
+                while eng.queue_depth != 2 and time.monotonic() < deadline:
+                    time.sleep(0.002)
+                assert eng.queue_depth == 2
+                status, out, headers = _post(server.port, {"x": 3.0})
+                assert status == 429
+                assert headers.get("Retry-After") == "1"
+                assert "error" in out
+            t.join(timeout=60)
+            t2.join(timeout=60)
+            assert codes == [200, 200]   # blocked requests still completed
+        finally:
+            eng.queue_bound = old_bound
+
+    def test_http_errors(self, server):
+        port = server.port
+        status, out, _ = _post(port, "not-an-object")
+        assert status == 400
+        status, out, _ = _post(port, [{"x": 1.0}, 5])
+        assert status == 400
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/score", data=b"{nope",
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("malformed JSON must 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        try:
+            _get(port, "/nope")
+            raise AssertionError("unknown path must 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+    def test_healthz_reports_draining(self, server):
+        server.draining = True
+        try:
+            try:
+                _get(server.port, "/healthz")
+                raise AssertionError("draining must 503")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                assert json.loads(e.read())["status"] == "draining"
+        finally:
+            server.draining = False
+
+    def test_render_metrics_is_prometheus_text(self, server):
+        text = render_metrics(server.engine)
+        for line in text.splitlines():
+            assert (line.startswith("# HELP") or line.startswith("# TYPE")
+                    or line.startswith("transmogrifai_serving_"))
+
+
+class TestHotReloadMidTraffic:
+    def test_64_clients_with_hot_swap(self, tmp_path):
+        """The acceptance smoke: 64 concurrent HTTP clients, one hot model
+        swap mid-run, zero dropped or incorrect responses — every response
+        matches ``local.score_function`` of the version that served it —
+        and no online XLA recompile."""
+        model1, pred1 = _train()
+        model2, pred2 = _train(seed=7, flip=True)
+        root = str(tmp_path / "ckpts")
+        model1.save(next_version_dir(root))
+        srv, thread = start_server(root, port=0, max_batch=8, linger_ms=2.0,
+                                   queue_bound=256)
+        eng = srv.engine
+        local_fns = {eng.model_version: (score_function(model1), pred1)}
+        swapped = threading.Event()
+        collected = []               # (record, response_json)
+        errors = []
+        start = threading.Barrier(64, timeout=60)
+
+        def client(i):
+            try:
+                start.wait()
+                for j in range(3):   # pre-swap traffic
+                    rec = {"x": (i * 3 + j - 96) / 16.0}
+                    status, out, _ = _post(srv.port, rec)
+                    assert status == 200, out
+                    collected.append((rec, out))
+                assert swapped.wait(timeout=120)
+                for j in range(2):   # post-swap traffic
+                    rec = {"x": (i * 2 + j) / 16.0}
+                    status, out, _ = _post(srv.port, rec)
+                    assert status == 200, out
+                    collected.append((rec, out))
+            except Exception as e:  # noqa: BLE001 — surfaced by the assert
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(64)]
+        for t in threads:
+            t.start()
+        try:
+            deadline = time.monotonic() + 120
+            while (eng.stats()["counters"].get("responses_total", 0) < 64
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)     # let pre-swap traffic flow first
+            time.sleep(0.05)         # distinct createdAt ordering
+            model2.save(next_version_dir(root))
+            assert eng.reload_now()  # exactly what the watcher thread calls
+            local_fns[eng.model_version] = (score_function(model2), pred2)
+            swapped.set()
+            for t in threads:
+                t.join(timeout=180)
+            assert not errors, errors[:3]
+            assert len(collected) == 64 * 5, "zero dropped responses"
+            versions_seen = {out["modelVersion"] for _, out in collected}
+            assert versions_seen == set(local_fns), \
+                "both versions must have served traffic"
+            for rec, out in collected:
+                fn, pname = local_fns[out["modelVersion"]]
+                want = fn(rec)
+                np.testing.assert_allclose(
+                    out["result"][pname]["probability_1"],
+                    want[pname]["probability_1"], atol=1e-6)
+            s = eng.stats()
+            assert s["counters"].get("online_traces_total", 0) == 0
+            assert s["compiled_path_active"]
+            assert s["counters"]["reloads_total"] == 1
+        finally:
+            swapped.set()
+            srv.drain_and_close()
+            thread.join(timeout=10)
+
+
+def test_params_serving_roundtrip():
+    from transmogrifai_tpu.params import OpParams
+    p = OpParams.from_json({"servingParams": {"port": 9999, "maxBatch": 16}})
+    assert p.serving == {"port": 9999, "maxBatch": 16}
+    assert OpParams.from_json(p.to_json()).serving == p.serving
+    assert OpParams.from_json({}).serving == {}
+
+
+def test_cli_serve_requires_model_location():
+    from transmogrifai_tpu.cli import main
+    with pytest.raises(SystemExit):
+        main(["serve"])              # --model-location is required
+
+
+@pytest.mark.slow
+def test_sigterm_drains_cli_server_under_load(tmp_path):
+    """Chaos: the real `serve` subcommand, killed with SIGTERM while 16
+    clients are scoring, drains in-flight work and exits 0."""
+    model, pred_name = _train()
+    root = str(tmp_path / "ckpts")
+    model.save(next_version_dir(root))
+    from transmogrifai_tpu.serving.server import free_port
+    port = free_port()
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    # pin the CPU backend past the image's sitecustomize (same trick as
+    # test_cli_gen.run_script)
+    boot = ("import sys, jax; jax.config.update('jax_platforms', 'cpu'); "
+            "from transmogrifai_tpu.cli import main; "
+            f"sys.exit(main(['serve', '--model-location', {root!r}, "
+            f"'--port', '{port}', '--max-batch', '4', '--linger-ms', '2', "
+            "'--reload-poll-s', '0']))")
+    proc = subprocess.Popen([sys.executable, "-c", boot], cwd=REPO, env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+    try:
+        deadline = time.monotonic() + 300
+        up = False
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                break
+            try:
+                status, _ = _get(port, "/healthz", timeout=2)
+                up = status == 200
+                break
+            except OSError:
+                time.sleep(0.5)
+        assert up, (proc.poll(), proc.stderr.read()[-2000:]
+                    if proc.poll() is not None else "healthz never came up")
+
+        oks = []
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                try:
+                    status, out, _ = _post(port, {"x": 0.5}, timeout=30)
+                    if status == 200:
+                        assert "modelVersion" in out
+                        assert pred_name in out["result"]
+                        oks.append(1)
+                except OSError:
+                    return           # server went down mid-request: fine
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=client) for _ in range(16)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 60
+        while len(oks) < 32 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert len(oks) >= 32, "server must score under load before TERM"
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        out, err = proc.stdout.read(), proc.stderr.read()
+        assert rc == 0, (rc, err[-2000:])
+        assert "draining" in out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)

@@ -309,6 +309,21 @@ def find_latest_valid(root: str) -> str:
         f"{len(candidates)} candidate(s); see failure log for causes)")
 
 
+def bundle_version(path: str) -> str:
+    """Stable identity of a bundle for serving: its directory basename plus
+    the manifest's createdAt when present (``ckpt-000002@1722800000``).  Two
+    loads of the same bundle compare equal; a rewritten bundle does not."""
+    base = os.path.basename(os.path.normpath(path))
+    try:
+        m = read_manifest(path)
+    except CheckpointError:
+        m = None
+    created = (m or {}).get("createdAt")
+    if isinstance(created, (int, float)):
+        return f"{base}@{int(created)}"
+    return base
+
+
 def next_version_dir(root: str) -> str:
     """The next ``ckpt-NNNNNN`` directory name under a checkpoint root."""
     os.makedirs(root, exist_ok=True)

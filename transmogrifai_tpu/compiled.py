@@ -36,6 +36,17 @@ from .stages.base import Transformer
 
 _WIRE_SEP = "\x00"      # wire-entry names: "<uid>\x00<key>" — never a column
 
+# process-wide count of fused-program TRACES (each one implies an XLA
+# compile).  The serving layer's "no online recompile after warmup" guarantee
+# is asserted against this: snapshot after warmup, require no growth under
+# traffic.  Incremented inside traced() — that body only executes while jax
+# is actually tracing, never on a jit cache hit.
+_TRACE_COUNT = [0]
+
+
+def trace_count() -> int:
+    return _TRACE_COUNT[0]
+
 
 class _StageTraceError(Exception):
     """Tracing failed inside a specific stage; carries the stage uid."""
@@ -202,6 +213,7 @@ class ScoreProgram:
             canon_out = {n: f"o{i}" for i, n in enumerate(out_names)}
 
             def traced(arrays_c: Dict[str, Tuple[Any, Any]]):
+                _TRACE_COUNT[0] += 1
                 arrays = {inv_in[c]: vm for c, vm in arrays_c.items()}
                 cols = {n: Column(kinds[n], v, m, meta=metas_in[n])
                         for n, (v, m) in arrays.items()

@@ -19,6 +19,24 @@ from .stages.generator import FeatureGeneratorStage
 from .types import FeatureType, Prediction
 
 
+def extract_raw_value(feature, record: Dict[str, Any]) -> FeatureType:
+    """Stage-0 raw extraction of one feature from one record
+    (≙ FeatureGeneratorStage extract): apply the feature's extract_fn, then
+    the monoid-zero rule for non-nullable kinds so unlabeled records score
+    (the batch path's ``extract_column`` applies the same rule).  Shared by
+    the row closure below and the serving engine's batch builder — parity
+    between the two paths starts here."""
+    gen = feature.origin_stage
+    val = (gen.extract_fn(record)
+           if isinstance(gen, FeatureGeneratorStage)
+           else record.get(feature.name))
+    if isinstance(val, FeatureType):
+        return val
+    if val is None and feature.kind.non_nullable:
+        return feature.kind(0.0)  # monoid zero (unlabeled scoring)
+    return feature.kind(val)
+
+
 def score_function(workflow_model) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
     """≙ OpWorkflowModelLocal.scoreFunction."""
     stages = workflow_model.stages
@@ -27,18 +45,8 @@ def score_function(workflow_model) -> Callable[[Dict[str, Any]], Dict[str, Any]]
 
     def score(record: Dict[str, Any]) -> Dict[str, Any]:
         # stage 0: raw extraction (≙ FeatureGeneratorStage extract)
-        row: Dict[str, FeatureType] = {}
-        for f in raw_features:
-            gen = f.origin_stage
-            val = (gen.extract_fn(record)
-                   if isinstance(gen, FeatureGeneratorStage)
-                   else record.get(f.name))
-            if isinstance(val, FeatureType):
-                row[f.name] = val
-            elif val is None and f.kind.non_nullable:
-                row[f.name] = f.kind(0.0)  # monoid zero (unlabeled scoring)
-            else:
-                row[f.name] = f.kind(val)
+        row: Dict[str, FeatureType] = {
+            f.name: extract_raw_value(f, record) for f in raw_features}
         # fold the fitted transformer DAG row-wise (≙ transformKeyValue fold)
         for st in stages:
             out = st.transform_row(row)

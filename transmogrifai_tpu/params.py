@@ -32,6 +32,9 @@ class OpParams:
     custom_tag_name: Optional[str] = None
     custom_params: Dict[str, Any] = field(default_factory=dict)
     collect_metrics: bool = False
+    # online-serving knobs (run-type "serve"): host, port, maxBatch,
+    # lingerMs, queueBound, requestDeadlineS, reloadPollS
+    serving: Dict[str, Any] = field(default_factory=dict)
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "OpParams":
@@ -49,7 +52,8 @@ class OpParams:
             batch_size=d.get("batchSize"),
             custom_tag_name=d.get("customTagName"),
             custom_params=d.get("customParams") or {},
-            collect_metrics=bool(d.get("collectMetrics", False)))
+            collect_metrics=bool(d.get("collectMetrics", False)),
+            serving=d.get("servingParams") or {})
 
     @staticmethod
     def load(path: str) -> "OpParams":
@@ -70,6 +74,7 @@ class OpParams:
             "customTagName": self.custom_tag_name,
             "customParams": self.custom_params,
             "collectMetrics": self.collect_metrics,
+            "servingParams": self.serving,
         }
 
     def apply_stage_params(self, stages) -> None:

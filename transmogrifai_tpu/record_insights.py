@@ -19,6 +19,7 @@ with the same output, so the two paths are parity-testable.
 
 from __future__ import annotations
 
+import functools
 import json
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -265,13 +266,15 @@ def _entry_json(name: str, diff: float) -> str:
 # NormType:165-205, Normalizer:210-225)
 # ---------------------------------------------------------------------------
 
+@functools.lru_cache(maxsize=None)
 def _corr_fit_program_factory(spearman: bool):
-    import functools
-
+    # lru_cache'd so every fit with the same correlation type reuses ONE
+    # jax.jit wrapper — a fresh wrapper per call would re-trace each fit
+    # even though jit's own cache keys on the wrapper identity
     import jax
     import jax.numpy as jnp
 
-    @functools.partial(jax.jit, static_argnames=())
+    @jax.jit
     def fit(X, P):
         """One fused pass over the feature matrix and the score columns:
         per-feature min/max/mean/var (the Normalizer moments) plus the
@@ -361,6 +364,31 @@ class RecordInsightsCorr(Estimator):
         return self._finalize_model(model)
 
 
+@functools.lru_cache(maxsize=None)
+def _corr_topk_program():
+    # module-level (one jit wrapper for the process) so repeat transforms hit
+    # jit's compile cache instead of re-tracing under a fresh wrapper per call
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def topk(X, corr, s1, s2, offset, *, k):
+        Xn = jnp.where(s2 == 0.0, 0.0,
+                       (X.astype(jnp.float32) - s1) / jnp.where(
+                           s2 == 0.0, 1.0, s2) - offset)
+
+        def per_pred(c):
+            imp = Xn * c[None, :]                     # [N, D]
+            _, idx = jax.lax.top_k(jnp.abs(imp), k)   # [N, K]
+            return idx, jnp.take_along_axis(imp, idx, axis=1)
+
+        # P is small (1-2 score columns); sequential map keeps the
+        # working set at one [N, D] importance block
+        return jax.lax.map(per_pred, corr)
+
+    return topk
+
+
 class RecordInsightsCorrModel(TransformerModel):
     out_kind = TextMap
     is_device_op = False
@@ -376,27 +404,9 @@ class RecordInsightsCorrModel(TransformerModel):
         corr = self.fitted["corr"]
         k = max(1, min(int(self.get("top_k", 20)), d))
 
-        import functools
-
-        import jax
         import jax.numpy as jnp
 
-        @functools.partial(jax.jit, static_argnames=("k",))
-        def topk(X, corr, s1, s2, offset, *, k):
-            Xn = jnp.where(s2 == 0.0, 0.0,
-                           (X.astype(jnp.float32) - s1) / jnp.where(
-                               s2 == 0.0, 1.0, s2) - offset)
-
-            def per_pred(c):
-                imp = Xn * c[None, :]                     # [N, D]
-                _, idx = jax.lax.top_k(jnp.abs(imp), k)   # [N, K]
-                return idx, jnp.take_along_axis(imp, idx, axis=1)
-
-            # P is small (1-2 score columns); sequential map keeps the
-            # working set at one [N, D] importance block
-            return jax.lax.map(per_pred, corr)
-
-        idx, val = topk(
+        idx, val = _corr_topk_program()(
             xv if hasattr(xv, "dtype") else jnp.asarray(xv),
             jnp.asarray(corr, jnp.float32),
             jnp.asarray(self.fitted["s1"], jnp.float32),

@@ -118,7 +118,8 @@ class FailureLog:
                "fallback",     # alternate implementation used
                "swallowed",    # best-effort side work failed silently before
                "resumed",      # unit of work replayed from a checkpoint
-               "preempted")    # graceful stop requested mid-run
+               "preempted",    # graceful stop requested mid-run
+               "reloaded")     # serving swapped in a newer model version
 
     def __init__(self):
         self._events: List[FailureEvent] = []
@@ -433,4 +434,6 @@ INJECTION_POINTS = {
                        "before atomic rename)",
     "checkpoint.load": "verifying a bundle's manifest + digests on load",
     "preemption": "a candidate/batch boundary's graceful-stop check",
+    "serving.batch": "scoring one coalesced serving micro-batch",
+    "serving.reload": "hot-swapping a newer model version into the engine",
 }

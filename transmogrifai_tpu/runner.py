@@ -34,8 +34,9 @@ class RunType:
     STREAMING_SCORE = "streamingScore"
     FEATURES = "features"
     EVALUATE = "evaluate"
+    SERVE = "serve"
 
-    ALL = (TRAIN, SCORE, STREAMING_SCORE, FEATURES, EVALUATE)
+    ALL = (TRAIN, SCORE, STREAMING_SCORE, FEATURES, EVALUATE, SERVE)
 
 
 @dataclass
@@ -93,6 +94,8 @@ class OpWorkflowRunner:
                 result = self._features(params, timer)
             elif run_type == RunType.EVALUATE:
                 result = self._evaluate(params, timer)
+            elif run_type == RunType.SERVE:
+                result = self._serve(params, timer)
             else:
                 raise ValueError(f"unknown run type {run_type!r}; "
                                  f"expected one of {RunType.ALL}")
@@ -317,6 +320,26 @@ class OpWorkflowRunner:
                       "w") as fh:
                 json.dump(metrics, fh, indent=2, default=str)
         return OpWorkflowRunnerResult(RunType.EVALUATE, metrics=metrics)
+
+    def _serve(self, params: OpParams, timer: PhaseTimer
+               ) -> OpWorkflowRunnerResult:
+        """Online scoring: block inside the HTTP serve loop until
+        SIGTERM/SIGINT, then drain and return.  Serving knobs ride in
+        ``params.serving`` (see ``OpParams``)."""
+        if not params.model_location:
+            raise ValueError("run-type 'serve' needs --model-location")
+        from .serving.server import serve_main
+        sv = params.serving or {}
+        with timer.phase("serve"):
+            serve_main(params.model_location,
+                       host=sv.get("host", "127.0.0.1"),
+                       port=int(sv.get("port", 8180)),
+                       max_batch=int(sv.get("maxBatch", 64)),
+                       linger_ms=float(sv.get("lingerMs", 2.0)),
+                       queue_bound=int(sv.get("queueBound", 256)),
+                       request_deadline_s=sv.get("requestDeadlineS", 30.0),
+                       reload_poll_s=float(sv.get("reloadPollS", 10.0)))
+        return OpWorkflowRunnerResult(RunType.SERVE)
 
 
 def _write_scores(batch, path: str):

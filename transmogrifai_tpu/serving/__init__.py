@@ -1,0 +1,11 @@
+"""Online scoring — the deployable inference stack over the fitted DAG.
+
+``engine.ScoringEngine`` coalesces concurrent single-record requests into
+padded device batches (no online XLA recompile after warmup);
+``server`` exposes it over stdlib HTTP with health, Prometheus metrics,
+admission control, hot model reload, and SIGTERM draining.
+"""
+
+from .engine import (DeadlineExceeded, EngineClosed,  # noqa: F401
+                     OverloadedError, ScoringEngine)
+from .server import ScoringHTTPServer, serve_main  # noqa: F401

@@ -1,0 +1,262 @@
+"""Stdlib-only HTTP front end for the ScoringEngine.
+
+Endpoints:
+
+* ``POST /v1/score`` — body ``{...record...}`` or ``[{...}, ...]``;
+  response ``{"modelVersion": v, "result": {...}}`` or
+  ``{"modelVersion": v, "results": [...]}`` (a list response carries the
+  version that served the FIRST record; per-item versions are in
+  ``results[i]["_modelVersion"]`` only if they differ — a hot swap can land
+  mid-list).  429 + ``Retry-After`` under shed load, 504 on deadline,
+  503 while draining.
+* ``GET /healthz`` — 200 ``{"status": "ok", ...}`` / 503 when draining.
+* ``GET /metrics`` — Prometheus text exposition: request/batch counters,
+  queue depth, latency summaries with p50/p95/p99.
+
+``serve_main`` wires the whole thing behind ``preemption_guard``: SIGTERM
+stops the accept loop, drains in-flight batches, then exits.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..checkpoint import preemption_guard, shutdown_requested
+from ..resilience import WatchdogTimeout
+from .engine import (DeadlineExceeded, EngineClosed, OverloadedError,
+                     ScoringEngine)
+
+_METRIC_PREFIX = "transmogrifai_serving"
+
+
+def render_metrics(engine: ScoringEngine) -> str:
+    """The engine's stats in Prometheus text exposition format."""
+    s = engine.stats()
+    lines: List[str] = []
+
+    def counter(name: str, value, help_: str) -> None:
+        full = f"{_METRIC_PREFIX}_{name}"
+        lines.append(f"# HELP {full} {help_}")
+        lines.append(f"# TYPE {full} counter")
+        lines.append(f"{full} {value}")
+
+    def gauge(name: str, value, help_: str) -> None:
+        full = f"{_METRIC_PREFIX}_{name}"
+        lines.append(f"# HELP {full} {help_}")
+        lines.append(f"# TYPE {full} gauge")
+        lines.append(f"{full} {value}")
+
+    c = s["counters"]
+    counter("requests_total", c.get("requests_total", 0),
+            "Records accepted into the scoring queue")
+    counter("responses_total", c.get("responses_total", 0),
+            "Records scored and returned")
+    counter("errors_total", c.get("errors_total", 0),
+            "Records that failed to score")
+    counter("shed_total", c.get("shed_total", 0),
+            "Requests shed by admission control (HTTP 429)")
+    counter("batches_total", c.get("batches_total", 0),
+            "Coalesced micro-batches dispatched")
+    counter("batch_rows_total", c.get("batch_rows_total", 0),
+            "Records across all dispatched micro-batches")
+    counter("fallback_batches_total", c.get("fallback_batches_total", 0),
+            "Micro-batches served by the local row path")
+    counter("reloads_total", c.get("reloads_total", 0),
+            "Hot model reloads performed")
+    counter("online_traces_total", c.get("online_traces_total", 0),
+            "XLA traces triggered by traffic after warmup (should be 0)")
+    gauge("queue_depth", s["queue_depth"],
+          "Requests currently waiting for a micro-batch")
+    gauge("compiled_path_active", int(s["compiled_path_active"]),
+          "1 when batches ride the fused device program")
+    lines.append(f"# HELP {_METRIC_PREFIX}_model_info Serving model version")
+    lines.append(f"# TYPE {_METRIC_PREFIX}_model_info gauge")
+    lines.append(f'{_METRIC_PREFIX}_model_info'
+                 f'{{version="{s["model_version"]}"}} 1')
+    for hist_name, snap in (("request_latency_seconds",
+                             s["request_latency"]),
+                            ("batch_latency_seconds", s["batch_latency"])):
+        full = f"{_METRIC_PREFIX}_{hist_name}"
+        lines.append(f"# HELP {full} End-to-end latency summary")
+        lines.append(f"# TYPE {full} summary")
+        for q in ("0.5", "0.95", "0.99"):
+            key = "p" + q.replace("0.", "").ljust(2, "0")
+            v = snap.get(key)
+            if v is not None:
+                lines.append(f'{full}{{quantile="{q}"}} {v:.6g}')
+        lines.append(f"{full}_sum {snap['sum']:.6g}")
+        lines.append(f"{full}_count {snap['count']}")
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: "ScoringHTTPServer"
+
+    # quiet by default; the engine's FailureLog is the observability channel
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    def _reply(self, code: int, payload: Any,
+               content_type: str = "application/json",
+               extra_headers: Optional[Dict[str, str]] = None) -> None:
+        body = (payload if isinstance(payload, bytes)
+                else json.dumps(payload).encode()
+                if content_type == "application/json"
+                else str(payload).encode())
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away; nothing to salvage
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        engine = self.server.engine
+        if self.path == "/healthz":
+            if self.server.draining:
+                self._reply(503, {"status": "draining"})
+            else:
+                self._reply(200, {"status": "ok",
+                                  "modelVersion": engine.model_version,
+                                  "queueDepth": engine.queue_depth})
+        elif self.path == "/metrics":
+            self._reply(200, render_metrics(engine).encode(),
+                        content_type="text/plain; version=0.0.4")
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        if self.path != "/v1/score":
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        engine = self.server.engine
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            payload = json.loads(self.rfile.read(length) or b"null")
+        except (ValueError, TypeError) as e:
+            self._reply(400, {"error": f"invalid JSON body: {e}"})
+            return
+        timeout_s = self.server.request_deadline_s
+        try:
+            if isinstance(payload, dict):
+                result, version = engine.score_record(payload, timeout_s)
+                self._reply(200, {"modelVersion": version, "result": result})
+            elif isinstance(payload, list):
+                if not all(isinstance(r, dict) for r in payload):
+                    self._reply(400, {"error": "list items must be objects"})
+                    return
+                pairs = engine.score_records(payload, timeout_s)
+                versions = {v for _, v in pairs}
+                out: Dict[str, Any] = {
+                    "modelVersion": pairs[0][1] if pairs else
+                    engine.model_version,
+                    "results": [r for r, _ in pairs]}
+                if len(versions) > 1:   # a hot swap landed mid-list
+                    for (r, v), slot in zip(pairs, out["results"]):
+                        slot["_modelVersion"] = v
+                self._reply(200, out)
+            else:
+                self._reply(400, {"error": "body must be an object or a "
+                                           "list of objects"})
+        except OverloadedError as e:
+            self._reply(429, {"error": str(e)},
+                        extra_headers={"Retry-After": "1"})
+        except (DeadlineExceeded, WatchdogTimeout) as e:
+            self._reply(504, {"error": str(e)})
+        except EngineClosed as e:
+            self._reply(503, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — a bad record must not 500
+            #                     the whole connection with a stack trace
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+
+class ScoringHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer bound to a ScoringEngine."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+    # socketserver's default listen backlog of 5 resets connections under a
+    # concurrent-client burst; serving is exactly that workload
+    request_queue_size = 128
+
+    def __init__(self, engine: ScoringEngine, host: str = "127.0.0.1",
+                 port: int = 8180,
+                 request_deadline_s: Optional[float] = 30.0):
+        super().__init__((host, port), _Handler)
+        self.engine = engine
+        self.request_deadline_s = request_deadline_s
+        self.draining = False
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def drain_and_close(self, timeout_s: Optional[float] = 30.0) -> None:
+        """Stop accepting, finish queued work, release the socket."""
+        self.draining = True
+        self.engine.close(drain=True, timeout_s=timeout_s)
+        self.shutdown()
+        self.server_close()
+
+
+def start_server(model_location: str, *, host: str = "127.0.0.1",
+                 port: int = 0, max_batch: int = 64, linger_ms: float = 2.0,
+                 queue_bound: int = 256,
+                 request_deadline_s: Optional[float] = 30.0,
+                 reload_poll_s: float = 0.0,
+                 warm: bool = True) -> Tuple[ScoringHTTPServer,
+                                             threading.Thread]:
+    """Build engine + server and start the accept loop in a daemon thread.
+    ``port=0`` binds an ephemeral port (see ``server.port``)."""
+    engine = ScoringEngine(model_location, max_batch=max_batch,
+                           linger_ms=linger_ms, queue_bound=queue_bound,
+                           reload_poll_s=reload_poll_s, warm=warm)
+    server = ScoringHTTPServer(engine, host=host, port=port,
+                               request_deadline_s=request_deadline_s)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="scoring-http", daemon=True)
+    thread.start()
+    return server, thread
+
+
+def serve_main(model_location: str, *, host: str = "127.0.0.1",
+               port: int = 8180, max_batch: int = 64, linger_ms: float = 2.0,
+               queue_bound: int = 256,
+               request_deadline_s: Optional[float] = 30.0,
+               reload_poll_s: float = 10.0) -> int:
+    """Blocking entry point for the ``serve`` CLI subcommand: serve until
+    SIGTERM/SIGINT, then drain in-flight batches and exit 0."""
+    with preemption_guard("serve"):
+        server, thread = start_server(
+            model_location, host=host, port=port, max_batch=max_batch,
+            linger_ms=linger_ms, queue_bound=queue_bound,
+            request_deadline_s=request_deadline_s,
+            reload_poll_s=reload_poll_s)
+        print(f"serving {server.engine.model_version} on "
+              f"http://{host}:{server.port} (max_batch={max_batch}, "
+              f"linger_ms={linger_ms})", flush=True)
+        try:
+            while not shutdown_requested("serve"):
+                time.sleep(0.2)
+        finally:
+            print("draining...", flush=True)
+            server.drain_and_close()
+            thread.join(timeout=5.0)
+    return 0
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """An ephemeral port for tests/smoke runs."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]

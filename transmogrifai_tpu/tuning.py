@@ -445,13 +445,22 @@ class OpValidator:
             # evaluator supports it — masks stay [F, N] (no per-grid-point
             # mask HBM duplication in the near-capacity regime), and the F
             # per-fold dispatches + eager S slices collapse into one
-            W = (jnp.stack(list(va_masks_dev))
-                 if not hasattr(va_masks_dev, "ndim") else va_masks_dev)
-            panel = self.evaluator.evaluate_masked_fold_grid(
-                y_dev, S.reshape(S.shape[0], F, G), W)
-            if panel is not None and getattr(panel, "shape", ()) == (F, G):
-                per_fold = list(panel)
-            else:
+            per_fold = None
+            try:
+                W = (jnp.stack(list(va_masks_dev))
+                     if not hasattr(va_masks_dev, "ndim") else va_masks_dev)
+                panel = self.evaluator.evaluate_masked_fold_grid(
+                    y_dev, S.reshape(S.shape[0], F, G), W)
+                if (panel is not None
+                        and getattr(panel, "shape", ()) == (F, G)):
+                    per_fold = list(panel)
+            except Exception as panel_exc:  # noqa: BLE001 — e.g. HBM OOM on
+                # the fused [N, F, G] panel; the per-fold loop below needs
+                # only 1/F of that score memory at a time, so degrade to it
+                # instead of abandoning the batched path entirely
+                record_failure(cand.model_name, "degraded", panel_exc,
+                               point="selector.fused_panel")
+            if per_fold is None:
                 # per-fold fallback: one grid-metric program per fold,
                 # sharing the fold's single [N] validation mask
                 per_fold = []

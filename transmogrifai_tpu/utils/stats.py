@@ -59,7 +59,14 @@ def _igamc(a: float, x: float) -> float:
 
 
 def chi2_sf(chi2: float, dof: int) -> float:
-    """Chi-squared survival function P[X >= chi2] = Q(dof/2, chi2/2)."""
+    """Chi-squared survival function P[X >= chi2] = Q(dof/2, chi2/2).
+
+    ``dof <= 0`` matches scipy's convention: the distribution is a point
+    mass at 0, so the survival probability is 0.0 for any chi2 > 0 and 1.0
+    at (or below) 0 — _igamc's blanket ``a <= 0 → 1.0`` would report the
+    least-significant possible p-value for a degenerate table."""
+    if dof <= 0:
+        return 1.0 if chi2 <= 0.0 else 0.0
     return _igamc(dof / 2.0, chi2 / 2.0)
 
 

@@ -12,9 +12,16 @@ import os as _os
 # Persistent XLA compilation cache: fitted-grid / tree programs are large and
 # their compiles dominate cold-start wall time; caching them on disk makes
 # every run after the first pay execution cost only (the TPU analog of the
-# JVM/Spark warm-start the reference relies on).  Opt out with
-# TRANSMOGRIFAI_COMPILATION_CACHE=0.
-if _os.environ.get("TRANSMOGRIFAI_COMPILATION_CACHE", "1") != "0":
+# JVM/Spark warm-start the reference relies on).
+#
+# TRANSMOGRIFAI_COMPILE_CACHE=<dir> pins the cache root explicitly (scoped
+# per backend platform underneath) and caches EVERY program, so a warm
+# process reports ~0 new compiles; =0 disables the cache outright.  Unset,
+# the legacy default applies: /tmp/transmogrifai_tpu_jax_cache_<plat> with a
+# 0.1s floor, opt out with TRANSMOGRIFAI_COMPILATION_CACHE=0.
+_cc = _os.environ.get("TRANSMOGRIFAI_COMPILE_CACHE")
+if _cc != "0" and (_cc or _os.environ.get(
+        "TRANSMOGRIFAI_COMPILATION_CACHE", "1") != "0"):
     try:
         import jax as _jax
 
@@ -24,16 +31,31 @@ if _os.environ.get("TRANSMOGRIFAI_COMPILATION_CACHE", "1") != "0":
         # CPU process (xla cpu_aot_loader rejects them with SIGILL warnings).
         _plat = ((_os.environ.get("JAX_PLATFORMS") or "default")
                  .split(",")[0].strip() or "default")
-        _jax.config.update(
-            "jax_compilation_cache_dir",
-            _os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                            f"/tmp/transmogrifai_tpu_jax_cache_{_plat}"))
-        # cache even small programs: a warm train run launches ~90 distinct
-        # executables and re-compiling the sub-second ones still costs
-        # multiple seconds of wall per run
-        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        if _cc:
+            _jax.config.update("jax_compilation_cache_dir",
+                               _os.path.join(_cc, _plat))
+            _jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+        else:
+            _jax.config.update(
+                "jax_compilation_cache_dir",
+                _os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                f"/tmp/transmogrifai_tpu_jax_cache_{_plat}"))
+            # cache even small programs: a warm train run launches ~90
+            # distinct executables and re-compiling the sub-second ones
+            # still costs multiple seconds of wall per run
+            _jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.1)
     except Exception:  # pragma: no cover — cache is best-effort
         pass
+
+# compile-vs-execute counters (profiling.compile_stats) ride jax.monitoring's
+# process-global listeners; registering costs nothing until a compile fires
+try:
+    from .profiling import install_compile_listeners as _icl
+    _icl()
+except Exception:  # pragma: no cover — diagnostics only
+    pass
 
 from . import types
 from .aggregators import CustomMonoidAggregator, MonoidAggregator

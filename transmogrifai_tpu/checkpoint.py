@@ -385,12 +385,17 @@ class SweepCheckpoint:
     # -- identity ----------------------------------------------------------
     @staticmethod
     def candidate_signature(model_name: str, candidate_index: int,
-                            grid: Sequence[Dict[str, Any]]) -> str:
+                            grid: Sequence[Dict[str, Any]],
+                            racing: Optional[Dict[str, Any]] = None) -> str:
         """Content hash of a candidate: a resumed run only replays a result
-        if the model, its position, and its full grid are unchanged."""
+        if the model, its position, its full grid, AND the sweep's racing
+        configuration are unchanged — a raced family's pruned points carry
+        fold-0-only score lists, which must never replay into (or out of)
+        an unraced sweep."""
         payload = json.dumps(
             {"model": model_name, "index": int(candidate_index),
-             "grid": [dict(sorted(g.items())) for g in grid]},
+             "grid": [dict(sorted(g.items())) for g in grid],
+             "racing": dict(sorted((racing or {}).items()))},
             sort_keys=True, default=str)
         return hashlib.sha256(payload.encode()).hexdigest()[:16]
 

@@ -48,6 +48,18 @@ def trace_count() -> int:
     return _TRACE_COUNT[0]
 
 
+def compile_attribution() -> Dict[str, Any]:
+    """Traces vs actual backend compiles vs persistent-cache hits, in one
+    snapshot.  A trace that ends in a cache hit costs milliseconds; one that
+    reaches the backend compiler costs seconds — warmup asserts should
+    compare against ``new_compiles`` (cache-aware), not ``traces``."""
+    from .profiling import compile_seconds, compile_stats, new_compile_count
+    return {"traces": trace_count(),
+            "new_compiles": new_compile_count(),
+            "compile_seconds": round(compile_seconds(), 4),
+            **compile_stats()}
+
+
 class _StageTraceError(Exception):
     """Tracing failed inside a specific stage; carries the stage uid."""
 

@@ -221,9 +221,15 @@ class ModelInsights:
             for r in sm.get("validationResults", [])[:20]:
                 mv = r.get("metricValues", {})
                 metric = next(iter(mv.values())) if mv else float("nan")
+                shown = (f"{metric:.4f}"
+                         if isinstance(metric, float) else metric)
+                if r.get("racedOut"):
+                    # fold-0 screen metric only — the point was pruned by
+                    # sweep racing and never competed on full CV means
+                    shown = f"{shown} (raced out @fold0)"
                 rows.append([r.get("modelName"),
                              json.dumps(r.get("modelParameters", {}))[:48],
-                             f"{metric:.4f}" if isinstance(metric, float) else metric])
+                             shown])
             out.append(render_table(
                 ["Model", "Parameters", sm.get("evaluationMetric", "metric")],
                 rows, title="Model Evaluation Metrics"))

@@ -188,6 +188,10 @@ class OpLogisticRegression(PredictorEstimator):
     """≙ OpLogisticRegression (elastic-net logistic; binary or multinomial)."""
 
     model_cls = LinearPredictionModel
+    # every reduction in the solvers is sample-weighted (sum(w·)/sum(w)), so
+    # zero-weight padding rows leave the fit exact — lets the sweep pad N up
+    # a ladder to reuse compiled executables across nearby dataset sizes
+    weighted_pad_exact = True
 
     def __init__(self, reg_param: float = 0.0, elastic_net_param: float = 0.0,
                  max_iter: int = 100, tol: float = 1e-6,
@@ -238,6 +242,7 @@ class OpLinearSVC(PredictorEstimator):
     """≙ OpLinearSVC (squared-hinge linear SVM; binary, no probabilities)."""
 
     model_cls = LinearPredictionModel
+    weighted_pad_exact = True   # see OpLogisticRegression
 
     def __init__(self, reg_param: float = 0.0, max_iter: int = 100,
                  tol: float = 1e-6, fit_intercept: bool = True,
@@ -275,6 +280,7 @@ class OpLinearRegression(PredictorEstimator):
     l1 = 0)."""
 
     model_cls = LinearPredictionModel
+    weighted_pad_exact = True   # see OpLogisticRegression
 
     def __init__(self, reg_param: float = 0.0, elastic_net_param: float = 0.0,
                  max_iter: int = 100, tol: float = 1e-6,
@@ -322,6 +328,8 @@ class OpGeneralizedLinearRegression(PredictorEstimator):
     """≙ OpGeneralizedLinearRegression: families gaussian/binomial/poisson/gamma
     (log/identity/logit links as in the reference grid
     BinaryClassificationModelSelector.scala / DefaultSelectorParams.scala:56-65)."""
+
+    weighted_pad_exact = True   # see OpLogisticRegression
 
     def __init__(self, family: str = "gaussian", link: Optional[str] = None,
                  reg_param: float = 0.0, max_iter: int = 50, tol: float = 1e-6,

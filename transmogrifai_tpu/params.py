@@ -35,6 +35,9 @@ class OpParams:
     # online-serving knobs (run-type "serve"): host, port, maxBatch,
     # lingerMs, queueBound, requestDeadlineS, reloadPollS
     serving: Dict[str, Any] = field(default_factory=dict)
+    # sweep-racing knobs applied to every ModelSelector validator: enabled,
+    # eta, minSurvivors (see DefaultSelectorParams.RACING*)
+    racing: Dict[str, Any] = field(default_factory=dict)
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "OpParams":
@@ -53,7 +56,8 @@ class OpParams:
             custom_tag_name=d.get("customTagName"),
             custom_params=d.get("customParams") or {},
             collect_metrics=bool(d.get("collectMetrics", False)),
-            serving=d.get("servingParams") or {})
+            serving=d.get("servingParams") or {},
+            racing=d.get("racingParams") or {})
 
     @staticmethod
     def load(path: str) -> "OpParams":
@@ -75,6 +79,7 @@ class OpParams:
             "customParams": self.custom_params,
             "collectMetrics": self.collect_metrics,
             "servingParams": self.serving,
+            "racingParams": self.racing,
         }
 
     def apply_stage_params(self, stages) -> None:

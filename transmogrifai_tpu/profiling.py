@@ -33,6 +33,132 @@ def host_link_bytes() -> int:
     return _HOST_LINK_BYTES[0]
 
 
+# -- compile-vs-execute attribution (ISSUE 4) -------------------------------
+# jax.monitoring streams every backend compile (and, with a persistent
+# compilation cache configured, every cache hit/miss) through process-global
+# listeners.  The counters below let PhaseTimer split a phase's wall into
+# "seconds spent inside XLA compilation" vs everything else, and let the
+# bench count NEW programs built this process (persistent-cache misses when
+# the cache is on, raw backend compiles otherwise).
+_COMPILE_DURATION_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_COMPILE_LOCK = None  # created lazily with the listeners
+_COMPILE_STATS = {"compile_s": 0.0, "backend_compiles": 0,
+                  "cache_hits": 0, "cache_misses": 0}
+_COMPILE_LISTENERS_INSTALLED = [False]
+
+
+def install_compile_listeners() -> bool:
+    """Register the jax.monitoring listeners feeding ``compile_stats``.
+    Idempotent and safe without jax (returns False).  Called from package
+    import; also from the accessors so a bare ``import profiling`` works."""
+    global _COMPILE_LOCK
+    if _COMPILE_LISTENERS_INSTALLED[0]:
+        return True
+    try:
+        import threading
+
+        from jax import monitoring
+    except Exception:  # pragma: no cover — jax-less host
+        return False
+    _COMPILE_LOCK = threading.Lock()
+
+    def _on_duration(event: str, duration: float, **kw) -> None:
+        if event == _COMPILE_DURATION_EVENT:
+            with _COMPILE_LOCK:
+                _COMPILE_STATS["compile_s"] += float(duration)
+                _COMPILE_STATS["backend_compiles"] += 1
+
+    def _on_event(event: str, **kw) -> None:
+        if event == _CACHE_HIT_EVENT:
+            with _COMPILE_LOCK:
+                _COMPILE_STATS["cache_hits"] += 1
+        elif event == _CACHE_MISS_EVENT:
+            with _COMPILE_LOCK:
+                _COMPILE_STATS["cache_misses"] += 1
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    monitoring.register_event_listener(_on_event)
+    _COMPILE_LISTENERS_INSTALLED[0] = True
+    return True
+
+
+def compile_stats() -> Dict[str, float]:
+    install_compile_listeners()
+    return dict(_COMPILE_STATS)
+
+
+def reset_compile_stats() -> None:
+    install_compile_listeners()
+    for k in _COMPILE_STATS:
+        _COMPILE_STATS[k] = 0.0 if k == "compile_s" else 0
+
+
+def compile_seconds() -> float:
+    install_compile_listeners()
+    return float(_COMPILE_STATS["compile_s"])
+
+
+def new_compile_count() -> int:
+    """Programs newly BUILT this process.  With a persistent compilation
+    cache configured this is the miss count (a hit retrieves a prior build —
+    its small backend_compile_duration is retrieval, not compilation);
+    without one every backend compile is a fresh build."""
+    install_compile_listeners()
+    try:
+        import jax
+        if jax.config.jax_compilation_cache_dir:
+            return int(_COMPILE_STATS["cache_misses"])
+    except Exception:  # pragma: no cover
+        pass
+    return int(_COMPILE_STATS["backend_compiles"])
+
+
+def set_compile_cache_dir(path: str, min_compile_time_secs: float = 0.0
+                          ) -> bool:
+    """Point jax's persistent compilation cache at ``path`` (created on
+    first write by jax).  ``min_compile_time_secs=0`` caches every program —
+    a warm process then reports ~0 ``new_compile_count()``.  The path is
+    scoped per backend platform (same hazard as the import-time default: CPU
+    AOT entries carry host machine-feature assumptions)."""
+    try:
+        import os
+
+        import jax
+        plat = ((os.environ.get("JAX_PLATFORMS") or "default")
+                .split(",")[0].strip() or "default")
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(path, plat))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_secs))
+        return True
+    except Exception:  # pragma: no cover — cache is best-effort
+        return False
+
+
+# -- selector racing accounting (ISSUE 4) -----------------------------------
+# Fold-fits the successive-halving sweep did NOT run (pruned grid points ×
+# remaining folds).  Reset at bench-workload boundaries.
+RACING_STATS = {"cv_fits_saved": 0, "families_raced": 0, "points_pruned": 0}
+
+
+def record_racing(fits_saved: int, points_pruned: int) -> None:
+    RACING_STATS["cv_fits_saved"] += int(fits_saved)
+    RACING_STATS["families_raced"] += 1
+    RACING_STATS["points_pruned"] += int(points_pruned)
+
+
+def racing_stats() -> Dict[str, int]:
+    return dict(RACING_STATS)
+
+
+def reset_racing_stats() -> None:
+    for k in RACING_STATS:
+        RACING_STATS[k] = 0
+
+
 # -- XLA program cost registry (VERDICT r4 next #5) -------------------------
 # When TRANSMOGRIFAI_COST_ANALYSIS=1, the dominant compiled programs record
 # their XLA cost analysis (flops / bytes accessed) here, once per program
@@ -156,12 +282,15 @@ class PhaseMetrics:
     device_bytes_in_use: Optional[int] = None
     peak_bytes_in_use: Optional[int] = None
     host_link_bytes: Optional[int] = None
+    compile_s: Optional[float] = None   # XLA compile seconds inside the phase
 
     def to_json(self) -> Dict[str, Any]:
         return {"name": self.name, "wallSeconds": round(self.wall_s, 4),
                 "deviceBytesInUse": self.device_bytes_in_use,
                 "peakBytesInUse": self.peak_bytes_in_use,
-                "hostLinkBytes": self.host_link_bytes}
+                "hostLinkBytes": self.host_link_bytes,
+                "compileSeconds": (None if self.compile_s is None
+                                   else round(self.compile_s, 4))}
 
 
 @dataclass
@@ -207,6 +336,7 @@ class PhaseTimer:
     def phase(self, name: str):
         t0 = time.time()
         link0 = host_link_bytes()
+        compile0 = compile_seconds()
         try:
             yield
         finally:
@@ -215,7 +345,8 @@ class PhaseTimer:
                 name, time.time() - t0,
                 device_bytes_in_use=mem["bytes_in_use"],
                 peak_bytes_in_use=mem["peak_bytes_in_use"],
-                host_link_bytes=host_link_bytes() - link0))
+                host_link_bytes=host_link_bytes() - link0,
+                compile_s=compile_seconds() - compile0))
 
     def app_metrics(self, tag: Optional[str] = None) -> AppMetrics:
         return AppMetrics(tag, time.time() - self._t0, list(self.phases))

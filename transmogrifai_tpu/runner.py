@@ -116,6 +116,8 @@ class OpWorkflowRunner:
             self.workflow.set_reader(self.train_reader)
         if params.stage_params:
             self.workflow.apply_stage_params(params)
+        if params.racing:
+            self.workflow.apply_racing_params(params.racing)
         # with a checkpoint location, the selector sweep persists completed
         # candidates under <location>/selector-sweep — a rerun of the same
         # command resumes instead of restarting
@@ -123,6 +125,13 @@ class OpWorkflowRunner:
         if params.checkpoint_location:
             resume_from = os.path.join(params.checkpoint_location,
                                        "selector-sweep")
+            if not os.environ.get("TRANSMOGRIFAI_COMPILE_CACHE"):
+                # the checkpoint dir outlives /tmp, so parking the XLA
+                # compile cache beside the sweep state makes every re-train
+                # of this app pay execution cost only
+                from .profiling import set_compile_cache_dir
+                set_compile_cache_dir(os.path.join(
+                    params.checkpoint_location, "compile-cache"))
         try:
             with timer.phase("train"):
                 model = self.workflow.train(resume_from=resume_from)
@@ -382,6 +391,15 @@ class OpApp:
                             "offsets; rerunning the same command resumes")
         p.add_argument("--param-location",
                        help="json file of OpParams")
+        p.add_argument("--no-racing", action="store_true",
+                       help="run the full fold x grid sweep instead of "
+                            "successive-halving racing")
+        p.add_argument("--racing-eta", type=float,
+                       help="racing reduction factor (keep top 1/eta per "
+                            "family after the fold-0 screen)")
+        p.add_argument("--racing-min-survivors", type=int,
+                       help="never race a family below this many surviving "
+                            "grid points")
         return p.parse_args(argv)
 
     def main(self, argv: Optional[List[str]] = None) -> OpWorkflowRunnerResult:
@@ -400,5 +418,11 @@ class OpApp:
             from .params import ReaderParams
             params.reader_params.setdefault("default", ReaderParams()).path = \
                 args.read_location
+        if args.no_racing:
+            params.racing["enabled"] = False
+        if args.racing_eta is not None:
+            params.racing["eta"] = args.racing_eta
+        if args.racing_min_survivors is not None:
+            params.racing["minSurvivors"] = args.racing_min_survivors
         runner = self.make_runner()
         return runner.run(args.run_type, params)

@@ -54,6 +54,15 @@ class DefaultSelectorParams:
     XGB_ETA = [0.1, 0.3]
     XGB_MIN_CHILD_WEIGHT = [1.0, 5.0, 10.0]
 
+    # sweep racing (successive halving, Jamieson & Talwalkar 2016): screen
+    # the full grid on fold 0 only, keep the top ceil(G/η) (≥ MIN_SURVIVORS)
+    # per family, run the remaining folds for survivors only.  Families whose
+    # grid can't shrink past the floor run full CV — bit-identical to the
+    # unraced sweep.
+    RACING = True
+    RACING_ETA = 3.0
+    RACING_MIN_SURVIVORS = 2
+
 
 def grid(**param_lists) -> List[Dict[str, Any]]:
     """Cartesian product of param lists (≙ ParamGridBuilder)."""
@@ -101,6 +110,9 @@ class ModelEvaluation:
     model_name: str
     params: Dict[str, Any]
     metric_values: Dict[str, float]
+    # pruned by sweep racing after the fold-0 screen: metric_values hold the
+    # screen metric (not a full-CV mean) and the point never competed for best
+    raced_out: bool = False
 
 
 @dataclass
@@ -133,7 +145,9 @@ class ModelSelectorSummary:
             "bestModelType": self.best_model_type,
             "validationResults": [
                 {"modelName": r.model_name, "modelParameters": r.params,
-                 "metricValues": r.metric_values} for r in self.validation_results],
+                 "metricValues": r.metric_values,
+                 **({"racedOut": True} if r.raced_out else {})}
+                for r in self.validation_results],
             "trainEvaluation": self.train_evaluation,
             "holdoutEvaluation": self.holdout_evaluation,
         }
@@ -255,21 +269,48 @@ class ModelSelector(Estimator):
         cheap fits instead of compiling + loading a fresh single-fit program —
         on the tunneled TPU the compile/load dwarfs the compute.  Returns None
         (→ caller falls back to ``fit_arrays``) when the shapes differ (e.g. a
-        Balancer resampled the train set) or anything goes wrong."""
-        shape = getattr(self.validator, "last_fit_shape", None)
-        if shape is None or shape[1] != X.shape[0]:
-            return None
+        Balancer resampled the train set) or anything goes wrong.
+
+        With racing/padding live, the winning family's last batched fit may
+        have run on fewer folds (survivor round: F-1), a survivor-sized grid,
+        or ladder-padded rows — ``validator.family_fit_meta`` records the
+        exact (folds, rows, lanes) of the family's most recent batched
+        program, and the refit mirrors it (padding X/y with zero-weight rows
+        when needed) so the executable-cache key matches."""
         cand = next((c for c in self.models
                      if c.model_name == result.best.model_name), None)
         if cand is None or not cand.grid:
+            return None
+        meta = getattr(self.validator, "family_fit_meta", {}).get(
+            result.best.model_name)
+        if meta is not None:
+            if meta["real_rows"] != X.shape[0]:
+                meta = None   # Balancer/Cutter changed the final train rows
+            elif meta["padded"] and not getattr(
+                    cand.estimator, "weighted_pad_exact", False):
+                meta = None   # never zero-pad an estimator that can't take it
+        shape = getattr(self.validator, "last_fit_shape", None)
+        if meta is None and (shape is None or shape[1] != X.shape[0]):
             return None
         try:
             import jax
             import jax.numpy as jnp
 
-            F = shape[0]
-            # all-ones fold weights materialize ON DEVICE — zero wire bytes
-            W = jnp.ones((F, X.shape[0]), jnp.float32)
+            if meta is not None:
+                F, rows, lanes = meta["folds"], meta["rows"], meta["lanes"]
+            else:
+                F, rows, lanes = shape[0], shape[1], len(cand.grid)
+            pad = rows - X.shape[0]
+            if pad:
+                Xj = X if isinstance(X, jax.Array) else jnp.asarray(
+                    X, jnp.float32)
+                X = jnp.pad(Xj, ((0, pad), (0, 0)))
+                y = jnp.pad(jnp.asarray(y, jnp.float32), (0, pad))
+            # all-ones fold weights materialize ON DEVICE — zero wire bytes;
+            # padded rows get weight 0 so they can't perturb the fit
+            W = jnp.ones((F, rows), jnp.float32)
+            if pad:
+                W = W.at[:, -pad:].set(0.0)
             mesh = getattr(self.validator, "last_mesh", None)
             if mesh is not None:
                 # match the CV call's shardings exactly — the jit cache keys
@@ -281,7 +322,7 @@ class ModelSelector(Estimator):
                     else jnp.asarray(X, jnp.float32), data_sharding(mesh, 2))
                 W = jax.device_put(jnp.asarray(W),
                                    data_sharding(mesh, 2, row_axis=1))
-            grids = [dict(result.best_params)] * len(cand.grid)
+            grids = [dict(result.best_params)] * lanes
             return cand.estimator.fit_arrays_grid(X, y, W, grids)[0][0]
         except Exception as e:  # noqa: BLE001 — reuse is an optimization only
             record_failure(self.uid, "degraded", e,
@@ -364,7 +405,9 @@ class ModelSelector(Estimator):
                 **({"numFolds": self.validator.num_folds}
                    if isinstance(self.validator, OpCrossValidation) else
                    {"trainRatio": self.validator.train_ratio}
-                   if isinstance(self.validator, OpTrainValidationSplit) else {})},
+                   if isinstance(self.validator, OpTrainValidationSplit) else {}),
+                "racing": dict(zip(("enabled", "eta", "minSurvivors"),
+                                   self.validator._racing_config()))},
             data_prep_parameters=(
                 {} if self.splitter is None else {
                     k: v for k, v in vars(self.splitter).items()
@@ -379,7 +422,8 @@ class ModelSelector(Estimator):
             best_model_type=type(best_est).__name__,
             validation_results=[
                 ModelEvaluation(r.model_name, r.params,
-                                {result.metric_name: r.mean_metric})
+                                {result.metric_name: r.mean_metric},
+                                raced_out=r.raced_out)
                 for r in result.all_results],
             train_evaluation=train_eval,
             holdout_evaluation=holdout_eval,

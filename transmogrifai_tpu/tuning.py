@@ -292,6 +292,37 @@ def _grid_margins(X, C, b):
     return _GRID_MARGINS_JIT(X, C, b)
 
 
+# fit-program row-count canonicalization (ISSUE 4 compile reuse): pad N up a
+# geometric ladder with zero-weight rows so re-trains at nearby sizes hit the
+# SAME compiled fit executable.  Zero-weight padding is exact for the linear
+# solvers (every reduction is weight-normalized — see
+# models/solvers.linear_grid_fit); tree fitters bin features with unweighted
+# quantiles, so only estimators declaring ``weighted_pad_exact`` opt in.
+_FIT_PAD_FLOOR = 4096
+_FIT_PAD_STEP = 1.25
+_FIT_PAD_QUANTUM = 256
+
+
+def _fit_pad_rows(n: int) -> int:
+    """Smallest ladder rung >= n.  n <= the floor returns n unchanged, so
+    small fixtures (and every tier-1 test) keep bit-identical shapes."""
+    if n <= _FIT_PAD_FLOOR:
+        return int(n)
+    rung = _FIT_PAD_FLOOR
+    while rung < n:
+        rung = int(-(-int(rung * _FIT_PAD_STEP) // _FIT_PAD_QUANTUM)
+                   * _FIT_PAD_QUANTUM)
+    return rung
+
+
+def _fit_padding_enabled() -> bool:
+    """Shape canonicalization only pays off with a persistent compile cache
+    to hit, so it rides the TRANSMOGRIFAI_COMPILE_CACHE opt-in."""
+    import os
+    cc = os.environ.get("TRANSMOGRIFAI_COMPILE_CACHE")
+    return bool(cc) and cc != "0"
+
+
 _FOLD_MASK_FNS: Dict[int, Any] = {}
 
 
@@ -332,6 +363,10 @@ class ValidatedCandidate:
     params: Dict[str, Any]
     metric_values: List[float]
     candidate_index: int = 0   # identity: two candidates may share a name
+    # successive halving pruned this grid point after the fold-0 screen:
+    # metric_values holds the fold-0 metric only and the point is excluded
+    # from final winner selection (full-k-fold means only)
+    raced_out: bool = False
 
     @property
     def mean_metric(self) -> float:
@@ -363,11 +398,36 @@ class OpValidator:
     validation_type = "validator"
 
     def __init__(self, evaluator: OpEvaluatorBase, seed: int = 42,
-                 stratify: bool = False, parallelism: int = 8):
+                 stratify: bool = False, parallelism: int = 8,
+                 racing: Optional[bool] = None,
+                 racing_eta: Optional[float] = None,
+                 racing_min_survivors: Optional[int] = None):
         self.evaluator = evaluator
         self.seed = int(seed)
         self.stratify = bool(stratify)
         self.parallelism = int(parallelism)
+        # successive-halving sweep racing (ISSUE 4): None defers to
+        # DefaultSelectorParams so OpParams/selector factories can retune
+        # the fleet-wide defaults without touching every validator ctor
+        self.racing = racing
+        self.racing_eta = racing_eta
+        self.racing_min_survivors = racing_min_survivors
+        # per-family (folds, rows, lanes) of the last batched fit block —
+        # the selector's winner refit reuses the SAME compiled executable
+        self.family_fit_meta: Dict[str, Dict[str, Any]] = {}
+
+    def _racing_config(self) -> Tuple[bool, float, int]:
+        """(enabled, eta, min_survivors) with DefaultSelectorParams filling
+        unset knobs.  Lazy import: selector.py imports this module."""
+        from .selector import DefaultSelectorParams as P
+        enabled = (self.racing if self.racing is not None
+                   else bool(getattr(P, "RACING", True)))
+        eta = float(self.racing_eta if self.racing_eta is not None
+                    else getattr(P, "RACING_ETA", 3.0))
+        mins = int(self.racing_min_survivors
+                   if self.racing_min_survivors is not None
+                   else getattr(P, "RACING_MIN_SURVIVORS", 2))
+        return bool(enabled), max(eta, 1.0 + 1e-9), max(mins, 1)
 
     # -- split generation -------------------------------------------------
     def splits(self, y: np.ndarray) -> List[Tuple[np.ndarray, np.ndarray]]:
@@ -575,6 +635,42 @@ class OpValidator:
 
         y_all = np.asarray(batch[label].values, dtype=np.float64)
         splits = self.splits(y_all)
+
+        # -- successive-halving racing plan (ISSUE 4) ----------------------
+        # Screen the full grid on fold 0 only, prune to the top 1/eta per
+        # family (floored at min_survivors), run the remaining folds for
+        # survivors only.  The parity guard keeps any family whose survivor
+        # floor covers its whole grid on the exact full-CV path — tiny grids
+        # are bit-identical to an unraced sweep.
+        racing_on, racing_eta, racing_min_surv = self._racing_config()
+        race_path_ok = (not in_fold_dag and len(splits) >= 2
+                        and self._maybe_mesh(len(y_all)) is None)
+        if racing_on and not race_path_ok:
+            # the flag is on by default — say WHY this sweep runs unraced
+            # instead of silently ignoring it (ISSUE 4 satellite)
+            reason = ("in-fold DAG refits feature stages per fold"
+                      if in_fold_dag else
+                      "single train/validation split (racing needs >= 2 "
+                      "folds)" if len(splits) < 2 else
+                      "mesh-sharded fit path")
+            record_failure("validator", "degraded",
+                           f"racing disabled: {reason}",
+                           point="selector.racing",
+                           validation_type=self.validation_type)
+
+        def _survivor_count(G: int) -> int:
+            return max(racing_min_surv, int(np.ceil(G / racing_eta)))
+
+        raced_flags = [racing_on and race_path_ok
+                       and _survivor_count(len(c.grid)) < len(c.grid)
+                       for c in candidates]
+
+        def _racing_sig(ci: int) -> Dict[str, Any]:
+            if not raced_flags[ci]:
+                return {"enabled": False}
+            return {"enabled": True, "eta": racing_eta,
+                    "minSurvivors": racing_min_surv}
+
         results: Dict[Tuple[str, int], ValidatedCandidate] = {}
         # device-scalar metrics are recorded lazily and pulled host-side in
         # ONE stacked transfer at the end — a per-candidate float() costs a
@@ -595,7 +691,7 @@ class OpValidator:
         if sweep_cp is not None:
             for ci, cand in enumerate(candidates):
                 sig = SweepCheckpoint.candidate_signature(
-                    cand.model_name, ci, cand.grid)
+                    cand.model_name, ci, cand.grid, racing=_racing_sig(ci))
                 sweep_sigs.append(sig)
                 stored = sweep_cp.results_for(sig)
                 if stored is None:
@@ -606,7 +702,8 @@ class OpValidator:
                     results[key] = ValidatedCandidate(
                         cand.model_name, dict(r.get("params") or {}),
                         [float(v) for v in (r.get("metricValues") or [])],
-                        candidate_index=ci)
+                        candidate_index=ci,
+                        raced_out=bool(r.get("racedOut", False)))
                 record_failure(cand.model_name, "resumed",
                                f"replayed {len(stored)} grid point(s) from "
                                "sweep checkpoint", point="checkpoint.load",
@@ -723,7 +820,8 @@ class OpValidator:
                 r = results.get((cand.model_name, ci * 10000 + gi))
                 if r is not None:
                     entry.append({"params": r.params,
-                                  "metricValues": r.metric_values})
+                                  "metricValues": r.metric_values,
+                                  "racedOut": r.raced_out})
             try:
                 sweep_cp.record_candidate(
                     sweep_sigs[ci], cand.model_name, ci, entry,
@@ -741,6 +839,7 @@ class OpValidator:
         # shape of the fold-weight mask used for the batched fits — the final
         # refit reuses it to hit the SAME compiled executable (shape-keyed)
         self.last_fit_shape = None if in_fold_dag else (len(splits), len(y32))
+        self.family_fit_meta = {}
         if not live:
             # fully-replayed sweep: no grid executable was compiled this
             # process, so the winner refit must take the plain fit path
@@ -823,22 +922,58 @@ class OpValidator:
                     # fold masks; balancer keep/drop weights) — custom
                     # splitters may emit arbitrary weights, which go exact f32
                     W = to_device_f32(W, exact=True)
-            def fit_candidate(cand):
+            # fit-shape canonicalization (ISSUE 4 compile reuse): one shared
+            # zero-weight-row-padded copy of (X, y) serves every pad-exact
+            # family, so nearby row counts land on the same ladder rung and
+            # hit the persistent compile cache
+            pad_rows = 0
+            X_pad = y_pad = None
+            if (_fit_padding_enabled() and mesh is None
+                    and any(getattr(c.estimator, "weighted_pad_exact", False)
+                            for c in candidates)):
+                pad_rows = _fit_pad_rows(N) - N
+            if pad_rows:
+                if is_dev:
+                    X_pad = jnp.pad(X, ((0, pad_rows), (0, 0)))
+                    y_pad = jnp.pad(y_dev, (0, pad_rows))
+                else:
+                    X_pad = np.pad(X, ((0, pad_rows), (0, 0)))
+                    y_pad = np.pad(y32, (0, pad_rows))
+
+            def _pad_weight_cols(Wblk):
+                if isinstance(Wblk, np.ndarray):
+                    return np.pad(Wblk, ((0, 0), (0, pad_rows)))
+                return jnp.pad(Wblk, ((0, 0), (0, pad_rows)))
+
+            def fit_candidate(cand, Wblk, grid):
+                use_pad = bool(pad_rows) and getattr(
+                    cand.estimator, "weighted_pad_exact", False)
+                Xf = X_pad if use_pad else X
+                yf = (y_pad if use_pad
+                      else y_dev if y_dev is not None else y32)
+                Wf = _pad_weight_cols(Wblk) if use_pad else Wblk
                 try:
                     maybe_inject("selector.candidate_fit", key=cand.model_name)
-                    return cand.estimator.fit_arrays_grid(
-                        X, y_dev if y_dev is not None else y32, W, cand.grid)
+                    out = cand.estimator.fit_arrays_grid(Xf, yf, Wf, grid)
+                    self.family_fit_meta[cand.model_name] = {
+                        "folds": len(out), "rows": int(Xf.shape[0]),
+                        "real_rows": int(N), "lanes": len(grid),
+                        "padded": use_pad}
+                    return out
                 except Exception as e:  # noqa: BLE001
                     # batched fit failed as a block — retry per point so one
                     # bad candidate can't take down the family (≙ Try-wrapped
-                    # fits in OpValidator.getSummary)
+                    # fits in OpValidator.getSummary).  Per-point refits run
+                    # unpadded: exactness beats executable reuse on a path
+                    # that is already degraded.
                     record_failure(cand.model_name, "degraded", e,
                                    point="selector.candidate_fit",
                                    fallback="per-point refits")
+                    self.family_fit_meta.pop(cand.model_name, None)
                     fitted_grid = []
-                    for f in range(len(fsplits)):
+                    for f in range(len(Wblk)):
                         row = []
-                        for gi, params in enumerate(cand.grid):
+                        for gi, params in enumerate(grid):
                             try:
                                 maybe_inject("selector.candidate_fit",
                                              key=cand.model_name)
@@ -846,7 +981,7 @@ class OpValidator:
                                 for k, v in params.items():
                                     est.set(k, v)
                                 row.append(est.fit_arrays(
-                                    X, y32, sample_weight=W[f]))
+                                    X, y32, sample_weight=Wblk[f]))
                             except Exception as e2:  # noqa: BLE001
                                 record_failure(
                                     cand.model_name, "skipped", e2,
@@ -877,7 +1012,10 @@ class OpValidator:
                 if shutdown_requested(key=cand.model_name):
                     preempted.append(cand.model_name)
                     return _PREEMPTED
-                return fit_candidate(cand)
+                if raced_flags[ci]:
+                    # successive-halving round A: full grid, fold 0 only
+                    return fit_candidate(cand, W[:1], cand.grid)
+                return fit_candidate(cand, W, cand.grid)
 
             serial_rows = int(_os.environ.get(
                 "TRANSMOGRIFAI_SERIAL_FIT_ROWS", 4_000_000))
@@ -917,32 +1055,126 @@ class OpValidator:
                     va_cache[f] = (xv, y32[va_idx])
                 return va_cache[f]
 
+            def score_block(cand, ci, fitted_grid, fold_offset, n_folds,
+                            rec):
+                """Score a fitted (n_folds × grid) block against validation
+                folds [fold_offset, fold_offset + n_folds) — batched fast
+                path first, device/host per-candidate fallback otherwise.
+                ``rec`` lets racing remap a survivor sub-grid's local
+                indices back to the family's full grid."""
+                masks = va_masks_dev[fold_offset:fold_offset + n_folds]
+                if (is_dev and mesh is None
+                        and self._record_grid_metrics_batched(
+                            cand, ci, fitted_grid, X, y_dev, masks, rec)):
+                    return
+                for f_local in range(n_folds):
+                    f = fold_offset + f_local
+                    va_idx = va_slices[f]
+                    for gi, params in enumerate(cand.grid):
+                        fitted = fitted_grid[f_local][gi]
+                        if fitted is None:
+                            rec(cand, ci, gi, params, float("nan"))
+                            continue
+                        metric = None
+                        if is_dev:
+                            metric = device_metric(cand, params, fitted,
+                                                   X, y_dev,
+                                                   va_masks_dev[f])
+                        if metric is None:
+                            metric = host_metric(cand, params, fitted,
+                                                 *va_slice(f, va_idx))
+                        rec(cand, ci, gi, params, metric)
+
+            # round A: raced families score their fold-0 screen; unraced
+            # families score (and checkpoint) their full CV block exactly
+            # as an unraced sweep would
             for ci, cand in enumerate(candidates):
                 fitted_grid = fitted_grids[ci]
                 if fitted_grid is _REPLAYED or fitted_grid is _PREEMPTED:
                     continue
-                if not (is_dev and mesh is None
-                        and self._record_grid_metrics_batched(
-                            cand, ci, fitted_grid, X, y_dev,
-                            va_masks_dev, record)):
-                    for f, va_idx in enumerate(va_slices):
-                        for gi, params in enumerate(cand.grid):
-                            fitted = fitted_grid[f][gi]
-                            if fitted is None:
-                                record(cand, ci, gi, params, float("nan"))
-                                continue
-                            metric = None
-                            if is_dev:
-                                metric = device_metric(cand, params, fitted,
-                                                       X, y_dev,
-                                                       va_masks_dev[f])
-                            if metric is None:
-                                metric = host_metric(cand, params, fitted,
-                                                     *va_slice(f, va_idx))
-                            record(cand, ci, gi, params, metric)
+                if raced_flags[ci]:
+                    score_block(cand, ci, fitted_grid, 0, 1, record)
+                    continue
+                score_block(cand, ci, fitted_grid, 0, len(fsplits), record)
                 if sweep_cp is not None:
                     drain_deferred()
                     checkpoint_family(ci, cand, fitted_grid)
+
+            # round B: rank each raced family's fold-0 screen in the
+            # evaluator's direction, prune past the survivor floor, then fit
+            # + score ONLY the survivors on the remaining folds — the
+            # (folds-1) × (grid - survivors) fits never run
+            race_live = [ci for ci in range(len(candidates))
+                         if raced_flags[ci]
+                         and fitted_grids[ci] is not _REPLAYED
+                         and fitted_grids[ci] is not _PREEMPTED]
+            if race_live:
+                drain_deferred()   # ranking needs numbers, not deferred slots
+                sign = 1.0 if self.evaluator.is_larger_better else -1.0
+
+                def prune(ci, cand):
+                    G = len(cand.grid)
+                    S = _survivor_count(G)
+
+                    def keyf(gi):
+                        r = results.get((cand.model_name, ci * 10000 + gi))
+                        v = (r.metric_values[0]
+                             if r and r.metric_values else float("nan"))
+                        return sign * v if np.isfinite(v) else -np.inf
+
+                    # deterministic: ties and NaNs break by grid position
+                    order = sorted(range(G), key=lambda gi: (-keyf(gi), gi))
+                    for gi in order[S:]:
+                        r = results.get((cand.model_name, ci * 10000 + gi))
+                        if r is not None:
+                            r.raced_out = True
+                    return sorted(order[:S])
+
+                survivors_by_ci = {ci: prune(ci, candidates[ci])
+                                   for ci in race_live}
+
+                def sub_candidate(ci):
+                    cand = candidates[ci]
+                    return ModelCandidate(
+                        cand.estimator,
+                        [dict(cand.grid[g]) for g in survivors_by_ci[ci]],
+                        cand.model_name)
+
+                def fit_survivors(ci):
+                    cand = candidates[ci]
+                    if shutdown_requested(key=cand.model_name):
+                        preempted.append(cand.model_name)
+                        return _PREEMPTED
+                    sub = sub_candidate(ci)
+                    return fit_candidate(sub, W[1:], sub.grid)
+
+                if n_workers > 1 and len(race_live) > 1:
+                    from concurrent.futures import ThreadPoolExecutor
+                    with ThreadPoolExecutor(
+                            max_workers=min(n_workers,
+                                            len(race_live))) as pool:
+                        fitted_b = list(pool.map(fit_survivors, race_live))
+                else:
+                    fitted_b = [fit_survivors(ci) for ci in race_live]
+
+                from .profiling import record_racing
+                rest = len(fsplits) - 1
+                for ci, fb in zip(race_live, fitted_b):
+                    cand = candidates[ci]
+                    if fb is _PREEMPTED:
+                        continue
+                    survivors = survivors_by_ci[ci]
+
+                    def rec(_c, _ci, gi_local, params, metric,
+                            _map=survivors, _cand=cand, _i=ci):
+                        record(_cand, _i, _map[gi_local], params, metric)
+
+                    score_block(sub_candidate(ci), ci, fb, 1, rest, rec)
+                    record_racing(rest * (len(cand.grid) - len(survivors)),
+                                  len(cand.grid) - len(survivors))
+                    if sweep_cp is not None:
+                        drain_deferred()
+                        checkpoint_family(ci, cand, None)
 
         if preempted:
             # graceful stop honored at a candidate boundary: everything
@@ -958,8 +1190,16 @@ class OpValidator:
 
         all_results = list(results.values())
         sign = 1.0 if self.evaluator.is_larger_better else -1.0
+        # raced-out points carry a fold-0 screen mean only; comparing that
+        # against survivors' full-k-fold means would be apples-to-oranges,
+        # so they are excluded from winner selection (kept in all_results
+        # for the summary). If racing somehow pruned everything that
+        # finished, fall back to the full list rather than fail the sweep.
         scored = [(sign * r.mean_metric, r) for r in all_results
-                  if np.isfinite(r.mean_metric)]
+                  if np.isfinite(r.mean_metric) and not r.raced_out]
+        if not scored:
+            scored = [(sign * r.mean_metric, r) for r in all_results
+                      if np.isfinite(r.mean_metric)]
         if not scored:
             # aggregate error with per-candidate causes from the failure log
             # — "nothing survived" alone is undebuggable at 3am
@@ -994,8 +1234,9 @@ class OpCrossValidation(OpValidator):
     validation_type = "CrossValidation"
 
     def __init__(self, num_folds: int = 3, evaluator: Optional[OpEvaluatorBase] = None,
-                 seed: int = 42, stratify: bool = False, parallelism: int = 8):
-        super().__init__(evaluator, seed, stratify, parallelism)
+                 seed: int = 42, stratify: bool = False, parallelism: int = 8,
+                 **kw):
+        super().__init__(evaluator, seed, stratify, parallelism, **kw)
         self.num_folds = int(num_folds)
 
     def splits(self, y: np.ndarray):
@@ -1018,8 +1259,8 @@ class OpTrainValidationSplit(OpValidator):
 
     def __init__(self, train_ratio: float = 0.75,
                  evaluator: Optional[OpEvaluatorBase] = None, seed: int = 42,
-                 stratify: bool = False, parallelism: int = 8):
-        super().__init__(evaluator, seed, stratify, parallelism)
+                 stratify: bool = False, parallelism: int = 8, **kw):
+        super().__init__(evaluator, seed, stratify, parallelism, **kw)
         self.train_ratio = float(train_ratio)
 
     def splits(self, y: np.ndarray):

@@ -143,6 +143,25 @@ class Workflow(_WorkflowCore):
                     stacklevel=2)
         return self
 
+    def apply_racing_params(self, racing) -> "Workflow":
+        """Push OpParams.racing ({enabled, eta, minSurvivors}) onto every
+        ModelSelector's validator — racing is a validator behavior, not a
+        stage hyper-parameter, so it rides its own channel instead of
+        stageParams."""
+        if not racing:
+            return self
+        for st in dag_stages(compute_dag(self.result_features)):
+            v = getattr(st, "validator", None)
+            if v is None or not hasattr(v, "racing"):
+                continue
+            if "enabled" in racing:
+                v.racing = bool(racing["enabled"])
+            if "eta" in racing:
+                v.racing_eta = float(racing["eta"])
+            if "minSurvivors" in racing:
+                v.racing_min_survivors = int(racing["minSurvivors"])
+        return self
+
     def with_raw_feature_filter(self, **kw) -> "Workflow":
         """≙ withRawFeatureFilter (OpWorkflow.scala:538)."""
         from .filters import RawFeatureFilter

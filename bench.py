@@ -157,6 +157,26 @@ def _phase_split(model):
                                       for k, v in link.items()}}
 
 
+def _telemetry_aux(tracer, top_n: int = 8):
+    """Compact telemetry block for the bench aux (ISSUE 5 satellite): top
+    slowest trace spans + the unified compile/racing counters, so every
+    BENCH_*.json is a self-describing perf record."""
+    from transmogrifai_tpu.telemetry import REGISTRY
+    snap = REGISTRY.snapshot()["gauges"]
+    out = {"compile": {k.split(".", 1)[1]: snap[k] for k in snap
+                       if k.startswith("compile.")},
+           "racing": {k.split(".", 1)[1]: snap[k] for k in snap
+                      if k.startswith("racing.")},
+           "host_link_bytes": snap.get("host_link.bytes", 0)}
+    if tracer is not None:
+        out["span_count"] = len(tracer)
+        out["slowest_spans"] = [
+            {"name": s.name, "seconds": round(s.duration_s, 4),
+             "status": s.status}
+            for s in tracer.slowest(top_n)]
+    return out
+
+
 # nominal dense peak of one TPU v5e chip (bf16 MXU); override with
 # TRANSMOGRIFAI_PEAK_FLOPS for other parts.  Used only to place the bench
 # programs on a roofline — achieved numbers are the measurement.
@@ -266,8 +286,11 @@ def run_dense(N: int, on_accel: bool, platform: str):
                                              reset_racing_stats)
     reset_racing_stats()
     nc0 = new_compile_count()
+    from transmogrifai_tpu.telemetry import Tracer, use_tracer
+    tracer = Tracer(run_name=f"bench:dense:{N}")
     t0 = time.time()
-    model = wf.train()
+    with use_tracer(tracer):
+        model = wf.train()
     wall = time.time() - t0
     # compiles that actually reached the backend during train — with the
     # persistent cache warm, a second consecutive run reports ~0 here
@@ -317,6 +340,7 @@ def run_dense(N: int, on_accel: bool, platform: str):
                                       if (lpt8 and at_ref) else None),
             **phases,
             "roofline": _roofline_aux(phases.get("selector_s"), on_accel),
+            "telemetry": _telemetry_aux(tracer),
         },
     }
 
@@ -350,8 +374,11 @@ def run_transmog(N: int, on_accel: bool, platform: str):
     wf = (Workflow().set_input_batch(batch).set_result_features(pred)
           .with_raw_feature_filter(min_fill_rate=0.01))
 
+    from transmogrifai_tpu.telemetry import Tracer, use_tracer
+    tracer = Tracer(run_name=f"bench:transmog:{N}")
     t0 = time.time()
-    model = wf.train()
+    with use_tracer(tracer):
+        model = wf.train()
     wall = time.time() - t0
     _TRANSMOG_MODEL[N] = model
 
@@ -386,6 +413,7 @@ def run_transmog(N: int, on_accel: bool, platform: str):
                                       if (lpt8 and at_ref) else None),
             **phases,
             "roofline": _roofline_aux(phases.get("selector_s"), on_accel),
+            "telemetry": _telemetry_aux(tracer),
         },
     }
 

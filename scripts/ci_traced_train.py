@@ -1,0 +1,116 @@
+"""CI smoke for the telemetry layer (ISSUE 5): run a tiny traced train,
+export the Chrome-trace JSON, and validate the span tree.
+
+Usage:
+    python scripts/ci_traced_train.py run OUT_DIR       # train + export
+    python scripts/ci_traced_train.py validate TRACE    # parse + assert
+
+``validate`` asserts the trace parses as Chrome trace-event JSON and that
+it contains a ``selector.sweep`` span nested (via the parentId chain in
+``args``) under a ``workflow.train`` span — the acceptance shape for the
+traced-train timeline.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+# runnable as `python scripts/ci_traced_train.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def make_records(n, seed=7):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        x1 = float(rng.normal())
+        x2 = float(rng.normal())
+        recs.append({
+            "y": 1.0 if (x1 + 0.5 * x2 + rng.normal() * 0.3) > 0 else 0.0,
+            "x1": x1, "x2": x2,
+            "cat": ["a", "b", "c"][i % 3],
+            "sparse": x2 if i % 4 == 0 else None,
+        })
+    return recs
+
+
+def run(out_dir):
+    from transmogrifai_tpu import types as T
+    from transmogrifai_tpu.features import features_from_schema
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, ModelCandidate, grid)
+    from transmogrifai_tpu.telemetry import (Tracer, use_tracer,
+                                             write_telemetry_summary)
+    from transmogrifai_tpu.workflow import Workflow
+
+    schema = {"y": T.RealNN, "x1": T.Real, "x2": T.Real,
+              "cat": T.PickList, "sparse": T.Real}
+    y, predictors = features_from_schema(schema, response="y")
+    fv = transmogrify(predictors)
+    checked = y.sanity_check(fv, remove_bad_features=True)
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(),
+                       grid(reg_param=[0.01, 0.1]),
+                       "OpLogisticRegression")])
+    sel.set_input(y, checked)
+    wf = (Workflow().set_input_records(make_records(150))
+          .set_result_features(sel.get_output()))
+
+    os.makedirs(out_dir, exist_ok=True)
+    tracer = Tracer(run_name="ci-traced-train")
+    with use_tracer(tracer):
+        model = wf.train()
+        model.score()
+    trace_path = tracer.export_chrome_trace(
+        os.path.join(out_dir, "trace-train.json"))
+    write_telemetry_summary(os.path.join(out_dir, "telemetry.json"), tracer)
+    print(f"wrote {trace_path} ({len(tracer)} spans)")
+    return 0
+
+
+def validate(trace_path):
+    from transmogrifai_tpu.telemetry import (load_trace,
+                                             render_trace_summary)
+    with open(trace_path) as fh:
+        doc = json.load(fh)
+    assert "traceEvents" in doc, "not a Chrome trace-event file"
+    assert all(e.get("ph") == "X" for e in doc["traceEvents"])
+
+    spans = load_trace(trace_path)
+    assert spans, "trace contains no spans"
+    by_id = {s["spanId"]: s for s in spans if s.get("spanId")}
+    names = {s["name"] for s in spans}
+    assert "workflow.train" in names, f"no workflow.train span in {names}"
+    assert "selector.sweep" in names, f"no selector.sweep span in {names}"
+
+    def chain(s):
+        out, seen = [], set()
+        while s is not None and s.get("spanId") not in seen:
+            seen.add(s.get("spanId"))
+            out.append(s["name"])
+            s = by_id.get(s.get("parentId"))
+        return out
+
+    sweeps = [s for s in spans if s["name"] == "selector.sweep"]
+    nested = [s for s in sweeps if "workflow.train" in chain(s)[1:]]
+    assert nested, ("selector.sweep span is not nested under "
+                    "workflow.train: " + repr([chain(s) for s in sweeps]))
+    errors = [s["name"] for s in spans if s.get("status") == "error"]
+    assert not errors, f"error spans in a clean train: {errors}"
+    print(f"OK: {len(spans)} spans; selector.sweep chain: "
+          + " -> ".join(chain(nested[0])))
+    print(render_trace_summary(trace_path, top_n=8))
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "run":
+        sys.exit(run(sys.argv[2]))
+    if len(sys.argv) == 3 and sys.argv[1] == "validate":
+        sys.exit(validate(sys.argv[2]))
+    sys.exit(f"usage: {sys.argv[0]} run OUT_DIR | validate TRACE_FILE")

@@ -307,6 +307,52 @@ class TestHTTPServer:
         assert p99, "p99 must be recorded after traffic"
         assert float(p99[0].split()[-1]) > 0.0
 
+    def test_metrics_unified_registry_families(self, server):
+        """/metrics surfaces the central-registry families (ISSUE 5) —
+        dead-letter, compile, racing, host-link — alongside the stable
+        serving names, and every sample line parses as Prometheus text."""
+        status, text = _get(server.port, "/metrics")
+        assert status == 200
+        samples = {}
+        for ln in text.splitlines():
+            if not ln or ln.startswith("#"):
+                continue
+            name, _, value = ln.partition(" ")
+            samples[name.partition("{")[0]] = float(value)
+        for family in ("dead_letter_total", "compile_seconds_total",
+                       "backend_compiles_total", "compile_cache_hits_total",
+                       "compile_cache_misses_total",
+                       "racing_cv_fits_saved_total",
+                       "racing_points_pruned_total",
+                       "host_link_bytes_total"):
+            full = f"transmogrifai_serving_{family}"
+            assert full in samples, f"missing family {full}"
+            assert samples[full] >= 0.0
+        # pre-existing names stay exactly stable next to the new ones
+        for family in ("requests_total", "responses_total", "errors_total",
+                       "shed_total", "batches_total", "batch_rows_total",
+                       "fallback_batches_total", "reloads_total",
+                       "online_traces_total", "queue_depth",
+                       "compiled_path_active", "model_info"):
+            assert f"transmogrifai_serving_{family}" in samples
+        # HELP/TYPE lines accompany each new family
+        assert "# TYPE transmogrifai_serving_dead_letter_total counter" \
+            in text
+        assert "# TYPE transmogrifai_serving_compile_seconds_total gauge" \
+            in text
+
+    def test_engine_metrics_registry_backs_stats(self, server):
+        """The engine's counters now live in its MetricsRegistry; stats()
+        keeps its shape and the registry exposes the same values."""
+        eng = server.engine
+        counters = eng.stats()["counters"]
+        assert counters == eng.metrics.counters()
+        assert eng.metrics.counter("requests_total").value \
+            == counters["requests_total"]
+        snap = eng.metrics.snapshot()
+        assert snap["gauges"]["queue_depth"] == eng.queue_depth
+        assert "request_latency" in snap["histograms"]
+
     def test_http_sheds_with_429_and_retry_after(self, server):
         eng = server.engine
         old_bound = eng.queue_bound

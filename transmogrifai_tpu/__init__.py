@@ -85,6 +85,8 @@ __all__ = [
     "CheckpointError", "CorruptModelError", "ModelVersionError",
     "TrainingPreempted", "SweepCheckpoint", "verify_bundle",
     "atomic_bundle_write", "preemption_guard", "shutdown_requested",
+    "Tracer", "use_tracer", "active_tracer", "span", "current_span_id",
+    "MetricsRegistry", "telemetry_summary",
 ]
 
 _LAZY = {
@@ -122,6 +124,13 @@ _LAZY = {
     "atomic_bundle_write": ("checkpoint", "atomic_bundle_write"),
     "preemption_guard": ("checkpoint", "preemption_guard"),
     "shutdown_requested": ("checkpoint", "shutdown_requested"),
+    "Tracer": ("telemetry", "Tracer"),
+    "use_tracer": ("telemetry", "use_tracer"),
+    "active_tracer": ("telemetry", "active_tracer"),
+    "span": ("telemetry", "span"),
+    "current_span_id": ("telemetry", "current_span_id"),
+    "MetricsRegistry": ("telemetry", "MetricsRegistry"),
+    "telemetry_summary": ("telemetry", "telemetry_summary"),
 }
 
 

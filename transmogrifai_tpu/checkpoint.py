@@ -181,6 +181,7 @@ def atomic_bundle_write(path: str, overwrite: bool = True,
     removed only after the new one is in place).  On ANY failure — including
     an injected ``checkpoint.save`` fault — the temp directory is discarded
     and the previous bundle at ``path`` is untouched."""
+    from .telemetry import span
     path = os.path.abspath(path)
     parent = os.path.dirname(path)
     os.makedirs(parent, exist_ok=True)
@@ -193,22 +194,23 @@ def atomic_bundle_write(path: str, overwrite: bool = True,
         f".{os.path.basename(path)}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}")
     os.makedirs(tmp)
     try:
-        yield tmp
-        # chaos hook: a fault here simulates the process dying after the
-        # data files are written but before the bundle commits
-        maybe_inject("checkpoint.save", key=os.path.basename(path))
-        write_manifest(tmp, extra=manifest_extra)
-        for name in os.listdir(tmp):
-            _fsync_path(os.path.join(tmp, name))
-        _fsync_path(tmp)
-        if os.path.lexists(path):
-            old = f"{tmp}.old"
-            os.rename(path, old)
-            os.rename(tmp, path)
-            shutil.rmtree(old, ignore_errors=True)
-        else:
-            os.rename(tmp, path)
-        _fsync_path(parent)
+        with span("checkpoint.save", bundle=os.path.basename(path)):
+            yield tmp
+            # chaos hook: a fault here simulates the process dying after the
+            # data files are written but before the bundle commits
+            maybe_inject("checkpoint.save", key=os.path.basename(path))
+            write_manifest(tmp, extra=manifest_extra)
+            for name in os.listdir(tmp):
+                _fsync_path(os.path.join(tmp, name))
+            _fsync_path(tmp)
+            if os.path.lexists(path):
+                old = f"{tmp}.old"
+                os.rename(path, old)
+                os.rename(tmp, path)
+                shutil.rmtree(old, ignore_errors=True)
+            else:
+                os.rename(tmp, path)
+            _fsync_path(parent)
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
@@ -238,27 +240,30 @@ def verify_bundle(path: str) -> Optional[Dict[str, Any]]:
     ``ModelVersionError`` on version skew and ``CorruptModelError`` naming
     the first missing/mismatched file.  Files present in the directory but
     not listed in the manifest (e.g. a side-written summary) are ignored."""
-    maybe_inject("checkpoint.load", key=os.path.basename(path))
-    if not os.path.isdir(path):
-        raise FileNotFoundError(
-            f"model bundle directory {path!r} does not exist")
-    manifest = read_manifest(path)
-    if manifest is None:
-        return None
-    version = manifest.get("formatVersion")
-    if not isinstance(version, int) or not 1 <= version <= BUNDLE_FORMAT_VERSION:
-        raise ModelVersionError(path, version)
-    for name, info in (manifest.get("files") or {}).items():
-        fpath = os.path.join(path, name)
-        if not os.path.exists(fpath):
-            raise CorruptModelError(path, name,
-                                    "listed in MANIFEST but missing on disk")
-        digest = _sha256_file(fpath)
-        if digest != info.get("sha256"):
-            raise CorruptModelError(
-                path, name, f"SHA-256 mismatch (manifest "
-                f"{str(info.get('sha256'))[:12]}…, disk {digest[:12]}…)")
-    return manifest
+    from .telemetry import span
+    with span("checkpoint.load", bundle=os.path.basename(path)):
+        maybe_inject("checkpoint.load", key=os.path.basename(path))
+        if not os.path.isdir(path):
+            raise FileNotFoundError(
+                f"model bundle directory {path!r} does not exist")
+        manifest = read_manifest(path)
+        if manifest is None:
+            return None
+        version = manifest.get("formatVersion")
+        if not isinstance(version, int) \
+                or not 1 <= version <= BUNDLE_FORMAT_VERSION:
+            raise ModelVersionError(path, version)
+        for name, info in (manifest.get("files") or {}).items():
+            fpath = os.path.join(path, name)
+            if not os.path.exists(fpath):
+                raise CorruptModelError(
+                    path, name, "listed in MANIFEST but missing on disk")
+            digest = _sha256_file(fpath)
+            if digest != info.get("sha256"):
+                raise CorruptModelError(
+                    path, name, f"SHA-256 mismatch (manifest "
+                    f"{str(info.get('sha256'))[:12]}…, disk {digest[:12]}…)")
+        return manifest
 
 
 def is_bundle_dir(path: str) -> bool:

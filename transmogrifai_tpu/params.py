@@ -38,6 +38,9 @@ class OpParams:
     # sweep-racing knobs applied to every ModelSelector validator: enabled,
     # eta, minSurvivors (see DefaultSelectorParams.RACING*)
     racing: Dict[str, Any] = field(default_factory=dict)
+    # telemetry knobs: traceDir (where chrome-trace + telemetry.json land),
+    # enabled (default: true when traceDir is set), summaryTopN
+    telemetry: Dict[str, Any] = field(default_factory=dict)
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "OpParams":
@@ -57,7 +60,8 @@ class OpParams:
             custom_params=d.get("customParams") or {},
             collect_metrics=bool(d.get("collectMetrics", False)),
             serving=d.get("servingParams") or {},
-            racing=d.get("racingParams") or {})
+            racing=d.get("racingParams") or {},
+            telemetry=d.get("telemetryParams") or {})
 
     @staticmethod
     def load(path: str) -> "OpParams":
@@ -80,6 +84,7 @@ class OpParams:
             "collectMetrics": self.collect_metrics,
             "servingParams": self.serving,
             "racingParams": self.racing,
+            "telemetryParams": self.telemetry,
         }
 
     def apply_stage_params(self, stages) -> None:

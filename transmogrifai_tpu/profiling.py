@@ -10,9 +10,11 @@ capture, all emitted as structured JSON.
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import json
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -44,7 +46,8 @@ _COMPILE_DURATION_EVENT = "/jax/core/compile/backend_compile_duration"
 _CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
 _CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
 
-_COMPILE_LOCK = None  # created lazily with the listeners
+_COMPILE_LOCK = threading.Lock()
+_COMPILE_INSTALL_LOCK = threading.Lock()
 _COMPILE_STATS = {"compile_s": 0.0, "backend_compiles": 0,
                   "cache_hits": 0, "cache_misses": 0}
 _COMPILE_LISTENERS_INSTALLED = [False]
@@ -53,17 +56,16 @@ _COMPILE_LISTENERS_INSTALLED = [False]
 def install_compile_listeners() -> bool:
     """Register the jax.monitoring listeners feeding ``compile_stats``.
     Idempotent and safe without jax (returns False).  Called from package
-    import; also from the accessors so a bare ``import profiling`` works."""
-    global _COMPILE_LOCK
+    import; also from the accessors so a bare ``import profiling`` works.
+    Registration is double-checked under an install lock: jax.monitoring has
+    no dedup, so two racing callers registering the same listeners would
+    double-count every compile second from then on."""
     if _COMPILE_LISTENERS_INSTALLED[0]:
         return True
     try:
-        import threading
-
         from jax import monitoring
     except Exception:  # pragma: no cover — jax-less host
         return False
-    _COMPILE_LOCK = threading.Lock()
 
     def _on_duration(event: str, duration: float, **kw) -> None:
         if event == _COMPILE_DURATION_EVENT:
@@ -79,9 +81,12 @@ def install_compile_listeners() -> bool:
             with _COMPILE_LOCK:
                 _COMPILE_STATS["cache_misses"] += 1
 
-    monitoring.register_event_duration_secs_listener(_on_duration)
-    monitoring.register_event_listener(_on_event)
-    _COMPILE_LISTENERS_INSTALLED[0] = True
+    with _COMPILE_INSTALL_LOCK:
+        if _COMPILE_LISTENERS_INSTALLED[0]:
+            return True
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
+        _COMPILE_LISTENERS_INSTALLED[0] = True
     return True
 
 
@@ -226,37 +231,55 @@ class LatencyHistogram:
     _BOUNDS = tuple(1e-4 * (1.3 ** i) for i in range(54))
 
     def __init__(self):
-        import threading
         self._lock = threading.Lock()
         self._counts = [0] * (len(self._BOUNDS) + 1)
         self._count = 0
         self._sum = 0.0
+        self._min: Optional[float] = None
+        self._max: Optional[float] = None
 
     def observe(self, seconds: float) -> None:
-        import bisect
+        """Record one observation.  Every mutation — bucket increment,
+        count/sum, min/max — happens under the instance lock, so concurrent
+        server threads never lose an update."""
         s = float(seconds)
         i = bisect.bisect_left(self._BOUNDS, s)
         with self._lock:
             self._counts[i] += 1
             self._count += 1
             self._sum += s
+            if self._min is None or s < self._min:
+                self._min = s
+            if self._max is None or s > self._max:
+                self._max = s
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def sum(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
 
     def quantile(self, q: float) -> Optional[float]:
+        """q-quantile estimate.  Empty → None; q<=0 → exact min; q>=1 →
+        exact max; bucket-interpolated results are clamped into [min, max],
+        so a single observation returns that exact value for any q."""
         with self._lock:
             total = self._count
             counts = list(self._counts)
+            mn, mx = self._min, self._max
         if total == 0:
             return None
+        if q <= 0.0:
+            return mn
+        if q >= 1.0:
+            return mx
         target = q * total
         seen = 0.0
+        est = self._BOUNDS[-1]
         for i, c in enumerate(counts):
             if c == 0:
                 continue
@@ -264,12 +287,13 @@ class LatencyHistogram:
             hi = self._BOUNDS[i] if i < len(self._BOUNDS) else lo * 1.3
             if seen + c >= target:
                 frac = (target - seen) / c
-                return lo + (hi - lo) * frac
+                est = lo + (hi - lo) * frac
+                break
             seen += c
-        return self._BOUNDS[-1]
+        return min(max(est, mn), mx)
 
     def snapshot(self) -> Dict[str, Optional[float]]:
-        return {"count": self._count, "sum": round(self._sum, 6),
+        return {"count": self.count, "sum": round(self.sum, 6),
                 "p50": self.quantile(0.50), "p95": self.quantile(0.95),
                 "p99": self.quantile(0.99)}
 
@@ -334,11 +358,15 @@ class PhaseTimer:
 
     @contextlib.contextmanager
     def phase(self, name: str):
+        # late import: telemetry imports profiling, so the reverse edge must
+        # stay out of module load.  span() is a no-op without a tracer.
+        from .telemetry import span as _span
         t0 = time.time()
         link0 = host_link_bytes()
         compile0 = compile_seconds()
         try:
-            yield
+            with _span(f"phase.{name}"):
+                yield
         finally:
             mem = _device_memory()
             self.phases.append(PhaseMetrics(

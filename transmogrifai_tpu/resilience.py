@@ -130,6 +130,14 @@ class FailureLog:
         if action not in self.ACTIONS:
             raise ValueError(f"unknown failure action {action!r}; "
                              f"expected one of {self.ACTIONS}")
+        if "span_id" not in detail:
+            # correlate with the ambient trace: the span this failure was
+            # recorded inside.  Safe for chaos determinism — signature()
+            # excludes detail.  Late import: telemetry imports profiling only.
+            from .telemetry import current_span_id
+            sid = current_span_id()
+            if sid is not None:
+                detail["span_id"] = sid
         with self._lock:
             ev = FailureEvent(seq=len(self._events), stage=str(stage),
                               action=action, cause=_format_cause(cause),
@@ -357,6 +365,10 @@ class FaultInjector:
                           for p, ks in (fail_keys or {}).items()}
         self.seed = int(seed)
         self.fired: List[Tuple[str, str]] = []   # every raise, in order
+        # parallel to ``fired``: the ambient span id each fault fired
+        # inside (None when tracing was off) — chaos failures point at the
+        # exact span in the trace timeline
+        self.fired_spans: List[Optional[str]] = []
         self._auto_counts: Dict[str, int] = {}
         self._lock = threading.Lock()
 
@@ -376,10 +388,15 @@ class FaultInjector:
     def check(self, point: str, key: Any = None) -> None:
         """Raise ``InjectedFault`` when (point, key) is armed."""
         if self.should_fail(point, key):
+            from .telemetry import current_span_id
+            sid = current_span_id()
             with self._lock:
                 self.fired.append((point, str(key)))
-            raise InjectedFault(
+                self.fired_spans.append(sid)
+            err = InjectedFault(
                 f"injected fault at {point!r} (key={key!r})")
+            err.span_id = sid
+            raise err
 
     # -- installation ------------------------------------------------------
     def install(self) -> "FaultInjector":

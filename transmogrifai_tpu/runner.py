@@ -51,6 +51,8 @@ class OpWorkflowRunnerResult:
     # streaming micro-batches that exhausted their retries:
     # [{"index", "error", "batch"}] — the batch rides along for reprocessing
     dead_letters: List[Dict[str, Any]] = field(default_factory=list)
+    # the run's Tracer when telemetryParams enabled tracing (telemetry.py)
+    tracer: Optional[Any] = None
 
 
 class OpWorkflowRunner:
@@ -82,6 +84,28 @@ class OpWorkflowRunner:
 
     # -- dispatch (≙ run:296-316) -----------------------------------------
     def run(self, run_type: str, params: OpParams) -> OpWorkflowRunnerResult:
+        # telemetryParams: traceDir turns the whole run into a traced run —
+        # every phase/selector/checkpoint span lands in one tracer, exported
+        # as Chrome-trace JSON + telemetry.json when the run finishes
+        import contextlib
+
+        from .telemetry import Tracer, use_tracer
+        tele = params.telemetry or {}
+        trace_dir = tele.get("traceDir")
+        enabled = bool(tele.get("enabled", trace_dir is not None))
+        tracer = Tracer(run_name=f"run:{run_type}") if enabled else None
+        ctx = use_tracer(tracer) if tracer is not None \
+            else contextlib.nullcontext()
+        with ctx:
+            result = self._run_dispatch(run_type, params)
+        if tracer is not None:
+            result.tracer = tracer
+            if trace_dir:
+                self._export_telemetry(tracer, trace_dir, run_type, result)
+        return result
+
+    def _run_dispatch(self, run_type: str,
+                      params: OpParams) -> OpWorkflowRunnerResult:
         timer = PhaseTimer()
         with timer.phase(f"run:{run_type}"):
             if run_type == RunType.TRAIN:
@@ -104,6 +128,26 @@ class OpWorkflowRunner:
         for cb in self._completion_callbacks:
             cb(metrics)
         return result
+
+    @staticmethod
+    def _export_telemetry(tracer, trace_dir: str, run_type: str,
+                          result: OpWorkflowRunnerResult) -> None:
+        """Write <trace_dir>/trace-<run_type>.json (Chrome trace events,
+        Perfetto-loadable) and telemetry.json (summary).  Best-effort: a
+        full disk must not fail a finished run."""
+        from .telemetry import write_telemetry_summary
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+            trace_path = os.path.join(trace_dir, f"trace-{run_type}.json")
+            tracer.export_chrome_trace(trace_path)
+            write_telemetry_summary(
+                os.path.join(trace_dir, "telemetry.json"), tracer)
+            if isinstance(result.metrics, dict):
+                result.metrics["traceFile"] = trace_path
+        except Exception as e:  # noqa: BLE001 — diagnostics only
+            from .resilience import record_failure
+            record_failure("runner.telemetry", "swallowed", e,
+                           point="runner.telemetry", trace_dir=trace_dir)
 
     # -- run types --------------------------------------------------------
     def _train(self, params: OpParams, timer: PhaseTimer) -> OpWorkflowRunnerResult:
@@ -400,6 +444,9 @@ class OpApp:
         p.add_argument("--racing-min-survivors", type=int,
                        help="never race a family below this many surviving "
                             "grid points")
+        p.add_argument("--trace-dir",
+                       help="trace this run and write Chrome-trace JSON + "
+                            "telemetry.json into this directory")
         return p.parse_args(argv)
 
     def main(self, argv: Optional[List[str]] = None) -> OpWorkflowRunnerResult:
@@ -424,5 +471,7 @@ class OpApp:
             params.racing["eta"] = args.racing_eta
         if args.racing_min_survivors is not None:
             params.racing["minSurvivors"] = args.racing_min_survivors
+        if args.trace_dir:
+            params.telemetry["traceDir"] = args.trace_dir
         runner = self.make_runner()
         return runner.run(args.run_type, params)

@@ -381,20 +381,24 @@ class ModelSelector(Estimator):
             train_batch = self.splitter.validation_prepare(batch, label)
         best_est: PredictorEstimator = result.best.estimator
         X, y = extract_xy(train_batch, label_f, feats_f)
-        fitted = self._refit_reusing_grid_executable(result, X, y)
-        if fitted is None:
-            fitted = best_est.fit_arrays(X, y)
+        from .telemetry import span
+        with span("selector.winner_refit", model=result.best.model_name):
+            fitted = self._refit_reusing_grid_executable(result, X, y)
+            if fitted is None:
+                fitted = best_est.fit_arrays(X, y)
         best_model = best_est.model_cls(fitted=fitted, **best_est._params)
 
         # evaluate all evaluators on the training data (≙ trainEvaluation) —
         # on device when possible: pulling 1M-row prediction vectors over the
         # host link costs more than the whole grid's compute
-        train_eval = self._evaluate_all(best_model, X, y)
+        with span("selector.evaluate", split="train"):
+            train_eval = self._evaluate_all(best_model, X, y)
 
         holdout_eval = None
         if holdout is not None and len(holdout):
             Xh, yh = extract_xy(holdout, label_f, feats_f)
-            holdout_eval = self._evaluate_all(best_model, Xh, yh)
+            with span("selector.evaluate", split="holdout"):
+                holdout_eval = self._evaluate_all(best_model, Xh, yh)
             self.holdout_eval = holdout_eval
 
         summary = ModelSelectorSummary(

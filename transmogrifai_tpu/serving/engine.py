@@ -34,10 +34,10 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from ..checkpoint import bundle_version, find_latest_valid, is_bundle_dir
 from ..columns import ColumnBatch, column_from_values
 from ..local import extract_raw_value, score_function
-from ..profiling import LatencyHistogram
 from ..resilience import (WatchdogTimeout, maybe_inject, record_failure,
                           run_with_deadline)
 from ..stages.generator import FeatureGeneratorStage
+from ..telemetry import MetricsRegistry, span
 from ..types import FeatureType, Prediction
 
 
@@ -149,9 +149,15 @@ class ScoringEngine:
         #                                      device work (batches, warmups)
         self._compiled_ok = True
 
-        self.request_latency = LatencyHistogram()
-        self.batch_latency = LatencyHistogram()
-        self._counters: Dict[str, int] = collections.defaultdict(int)
+        # per-engine metrics namespace: counters/gauges/histograms reset with
+        # the engine; /metrics and stats() read everything from here.  The
+        # old attribute names stay as aliases into the registry.
+        self.metrics = MetricsRegistry()
+        self.request_latency = self.metrics.histogram("request_latency")
+        self.batch_latency = self.metrics.histogram("batch_latency")
+        self.metrics.gauge("queue_depth", lambda: self.queue_depth)
+        self.metrics.gauge("compiled_path_active",
+                           lambda: int(self._compiled_ok))
 
         self._entry = self._load_entry()
         if warm:
@@ -187,8 +193,8 @@ class ScoringEngine:
                     from ..compiled import trace_count
                     t0 = trace_count()
                     self._score_compiled(entry, records)
-                    self._counters["warmup_traces_total"] += \
-                        trace_count() - t0
+                    self.metrics.counter("warmup_traces_total").inc(
+                        trace_count() - t0)
                 except Exception as e:  # noqa: BLE001 — degrade, don't die
                     self._compiled_ok = False
                     record_failure("serving", "degraded", e,
@@ -236,7 +242,7 @@ class ScoringEngine:
         with self._swap_lock:
             old = self._entry.version
             self._entry = entry
-        self._counters["reloads_total"] += 1
+        self.metrics.counter("reloads_total").inc()
         record_failure("serving", "reloaded", None, point="serving.reload",
                        previous=old, current=entry.version)
         return True
@@ -267,7 +273,7 @@ class ScoringEngine:
         if req.error is not None:
             raise req.error
         self.request_latency.observe(time.perf_counter() - req.t_enqueue)
-        self._counters["responses_total"] += 1
+        self.metrics.counter("responses_total").inc()
         assert req.result is not None
         return req.result
 
@@ -280,7 +286,7 @@ class ScoringEngine:
             self._check_admission(extra=len(records))
             reqs = [_Request(r) for r in records]
             self._queue.extend(reqs)
-            self._counters["requests_total"] += len(reqs)
+            self.metrics.counter("requests_total").inc(len(reqs))
             self._cv.notify()
         deadline = (time.monotonic() + timeout_s
                     if timeout_s is not None else None)
@@ -295,7 +301,7 @@ class ScoringEngine:
                 raise req.error
             self.request_latency.observe(
                 time.perf_counter() - req.t_enqueue)
-            self._counters["responses_total"] += 1
+            self.metrics.counter("responses_total").inc()
             assert req.result is not None
             out.append(req.result)
         return out
@@ -308,7 +314,7 @@ class ScoringEngine:
         if self._closed or self._draining:
             raise EngineClosed("engine is shutting down")
         if len(self._queue) + extra > self.queue_bound:
-            self._counters["shed_total"] += 1
+            self.metrics.counter("shed_total").inc()
             raise OverloadedError(
                 f"queue depth {len(self._queue)} + {extra} exceeds bound "
                 f"{self.queue_bound}")
@@ -318,7 +324,7 @@ class ScoringEngine:
             self._check_admission()
             req = _Request(record)
             self._queue.append(req)
-            self._counters["requests_total"] += 1
+            self.metrics.counter("requests_total").inc()
             self._cv.notify()
         return req
 
@@ -335,21 +341,28 @@ class ScoringEngine:
                 batch = [self._queue.popleft()]
             # linger: coalesce whatever arrives before the deadline, up to
             # one full padded batch
-            deadline = time.monotonic() + self.linger_s
-            while len(batch) < self.max_batch:
-                with self._cv:
-                    if self._queue:
-                        batch.append(self._queue.popleft())
-                        continue
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0 or self._closed:
-                        break
-                    self._cv.wait(remaining)
-                    if not self._queue:
-                        break
+            with span("serving.assemble") as sp:
+                deadline = time.monotonic() + self.linger_s
+                while len(batch) < self.max_batch:
+                    with self._cv:
+                        if self._queue:
+                            batch.append(self._queue.popleft())
+                            continue
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0 or self._closed:
+                            break
+                        self._cv.wait(remaining)
+                        if not self._queue:
+                            break
+                if sp is not None:
+                    sp.attrs["rows"] = len(batch)
             self._process(batch)
 
     def _process(self, batch: List[_Request]) -> None:
+        with span("serving.batch", rows=len(batch)):
+            self._process_inner(batch)
+
+    def _process_inner(self, batch: List[_Request]) -> None:
         with self._swap_lock:
             entry = self._entry
         records = [r.record for r in batch]
@@ -361,17 +374,19 @@ class ScoringEngine:
                 with self._score_lock:
                     before = trace_count()
                     maybe_inject("serving.batch",
-                                 key=self._counters["batches_total"])
-                    results = run_with_deadline(
-                        self._score_compiled, self.batch_deadline_s,
-                        entry, records,
-                        description=f"serving micro-batch of {len(records)}")
+                                 key=int(self.metrics.counter("batches_total").value))
+                    with span("serving.execute", rows=len(records)):
+                        results = run_with_deadline(
+                            self._score_compiled, self.batch_deadline_s,
+                            entry, records,
+                            description=f"serving micro-batch of "
+                                        f"{len(records)}")
                     traced = trace_count() - before
                 if traced > 0:
                     # an online trace means this model's frontier shapes are
                     # content-dependent (e.g. text wire arrays): every batch
                     # would recompile, so demote the engine to the local path
-                    self._counters["online_traces_total"] += traced
+                    self.metrics.counter("online_traces_total").inc(traced)
                     self._compiled_ok = False
                     record_failure(
                         "serving", "degraded", None, point="serving.batch",
@@ -381,7 +396,7 @@ class ScoringEngine:
                 record_failure("serving", "fallback", e,
                                point="serving.batch",
                                fallback="local row scoring")
-                self._counters["batch_deadline_total"] += 1
+                self.metrics.counter("batch_deadline_total").inc()
                 results = None
             except Exception as e:  # noqa: BLE001 — per-record fallback
                 record_failure("serving", "fallback", e,
@@ -389,20 +404,23 @@ class ScoringEngine:
                                fallback="local row scoring")
                 results = None
         if results is None:
-            self._counters["fallback_batches_total"] += 1
+            self.metrics.counter("fallback_batches_total").inc()
             results = []
             for rec in records:
                 try:
                     results.append(entry.local_fn(rec))
                 except Exception as e:  # noqa: BLE001 — isolate bad records
+                    # even the row-at-a-time fallback failed: this record is
+                    # unservable by either path — a serving dead letter
+                    self.metrics.counter("dead_letter_total").inc()
                     results.append(e)
-        self._counters["batches_total"] += 1
-        self._counters["batch_rows_total"] += len(batch)
+        self.metrics.counter("batches_total").inc()
+        self.metrics.counter("batch_rows_total").inc(len(batch))
         self.batch_latency.observe(time.perf_counter() - t0)
         for req, res in zip(batch, results):
             if isinstance(res, BaseException):
                 req.error = res
-                self._counters["errors_total"] += 1
+                self.metrics.counter("errors_total").inc()
             else:
                 req.result = (res, entry.version)
             req.event.set()
@@ -424,7 +442,7 @@ class ScoringEngine:
     def stats(self) -> Dict[str, Any]:
         with self._swap_lock:
             version = self._entry.version
-        return {"counters": dict(self._counters),
+        return {"counters": self.metrics.counters(),
                 "queue_depth": self.queue_depth,
                 "model_version": version,
                 "compiled_path_active": self._compiled_ok,

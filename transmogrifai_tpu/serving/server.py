@@ -70,10 +70,31 @@ def render_metrics(engine: ScoringEngine) -> str:
             "Hot model reloads performed")
     counter("online_traces_total", c.get("online_traces_total", 0),
             "XLA traces triggered by traffic after warmup (should be 0)")
+    counter("dead_letter_total", c.get("dead_letter_total", 0),
+            "Records unservable by both the compiled and local paths")
     gauge("queue_depth", s["queue_depth"],
           "Requests currently waiting for a micro-batch")
     gauge("compiled_path_active", int(s["compiled_path_active"]),
           "1 when batches ride the fused device program")
+    # process-wide telemetry from the central registry: compile, racing and
+    # host-link counters surface alongside the serving families so one
+    # scrape answers "what has this process compiled/pruned/transferred"
+    from ..telemetry import REGISTRY
+    reg = REGISTRY.snapshot()["gauges"]
+    gauge("compile_seconds_total", reg.get("compile.compile_s", 0),
+          "Seconds this process has spent inside XLA compilation")
+    gauge("backend_compiles_total", reg.get("compile.backend_compiles", 0),
+          "Backend compiles performed by this process")
+    gauge("compile_cache_hits_total", reg.get("compile.cache_hits", 0),
+          "Persistent compile-cache hits")
+    gauge("compile_cache_misses_total", reg.get("compile.cache_misses", 0),
+          "Persistent compile-cache misses")
+    gauge("racing_cv_fits_saved_total", reg.get("racing.cv_fits_saved", 0),
+          "CV fold-fits skipped by selector grid racing")
+    gauge("racing_points_pruned_total", reg.get("racing.points_pruned", 0),
+          "Grid points pruned by selector racing")
+    gauge("host_link_bytes_total", reg.get("host_link.bytes", 0),
+          "Tracked host-to-device transfer bytes")
     lines.append(f"# HELP {_METRIC_PREFIX}_model_info Serving model version")
     lines.append(f"# TYPE {_METRIC_PREFIX}_model_info gauge")
     lines.append(f'{_METRIC_PREFIX}_model_info'

@@ -1,0 +1,506 @@
+"""Unified telemetry — structured trace spans + a central metrics registry.
+
+The runtime story used to be scattered: compile stats, racing counters,
+host-link bytes, serving latency histograms and the FailureLog each lived in
+their own ad-hoc global with no shared run context.  This module gives every
+run one measurement substrate, in the style of Dapper/OpenTelemetry span
+trees and Chrome ``chrome://tracing`` timelines:
+
+* ``Tracer`` — thread-safe producer of nested spans.  ``tracer.span(name,
+  **attrs)`` is a context manager recording monotonic wall times, a span id,
+  the parent span id, a status (``ok``/``error``) and attributes.  Parenting
+  is per-thread (each thread nests its own spans); a worker thread with no
+  open span of its own parents to the innermost open span of the thread that
+  installed the tracer — so the validator's thread-pool candidate fits nest
+  under the orchestrating ``selector.sweep`` span.
+* ``use_tracer(tracer)`` — the ambient run context, mirroring
+  ``resilience.use_failure_log``: deep code calls the module-level
+  ``span(...)`` / ``event(...)`` helpers, which no-op (near-zero cost) when
+  no tracer is installed.
+* ``MetricsRegistry`` — named ``Counter``s, ``Gauge``s and
+  ``LatencyHistogram``s behind one namespace.  The process-default
+  ``REGISTRY`` absorbs and re-exports today's scattered sources
+  (``profiling.compile_stats``, ``profiling.racing_stats``,
+  ``profiling.host_link_bytes``) as read-through gauges, so one
+  ``snapshot()`` answers "what did this process compile/prune/transfer".
+* Exports — ``tracer.export_chrome_trace(path)`` writes Perfetto-loadable
+  Chrome trace-event JSON; ``telemetry_summary()`` builds the
+  ``telemetry.json`` bundled next to saved models and into bench aux;
+  ``render_trace_summary()`` prints the top-N slowest-spans table behind the
+  ``transmogrifai_tpu trace-summary`` subcommand.
+
+Span ids correlate with the failure layer: ``resilience.FailureLog.record``
+stamps the recording thread's active span id into each event's detail, and
+``FaultInjector`` remembers the span each injected fault fired inside — a
+chaos-test failure points at the exact span.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from .profiling import (LatencyHistogram, compile_stats, host_link_bytes,
+                        racing_stats)
+
+__all__ = [
+    "Span", "Tracer", "use_tracer", "active_tracer", "span", "event",
+    "current_span_id", "Counter", "Gauge", "MetricsRegistry", "REGISTRY",
+    "LatencyHistogram", "telemetry_summary", "write_telemetry_summary",
+    "render_trace_summary", "load_trace",
+]
+
+
+# --------------------------------------------------------------------------
+# spans
+# --------------------------------------------------------------------------
+
+@dataclass
+class Span:
+    """One timed unit of work in the trace tree."""
+
+    name: str
+    span_id: str
+    parent_id: Optional[str]
+    start_s: float              # monotonic, relative to the tracer's epoch
+    end_s: Optional[float] = None
+    status: str = "ok"          # "ok" | "error"
+    attrs: Dict[str, Any] = field(default_factory=dict)
+    thread: int = 0
+    start_wall_s: float = 0.0   # absolute wall clock at span start
+
+    @property
+    def duration_s(self) -> float:
+        return (self.end_s if self.end_s is not None else self.start_s) \
+            - self.start_s
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"name": self.name, "spanId": self.span_id,
+                "parentId": self.parent_id,
+                "startS": round(self.start_s, 6),
+                "durationS": round(self.duration_s, 6),
+                "status": self.status, "attrs": dict(self.attrs),
+                "thread": self.thread,
+                "startWallS": round(self.start_wall_s, 3)}
+
+
+class Tracer:
+    """Thread-safe span collector.  See module docstring for the parenting
+    rule; all mutation happens under one lock, so concurrent serving/
+    validator threads can record freely."""
+
+    def __init__(self, run_name: str = "run"):
+        self.run_name = run_name
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._spans: List[Span] = []          # completed, in finish order
+        self._stacks: Dict[int, List[Span]] = {}   # open spans per thread
+        self._install_thread: Optional[int] = None
+        self.t0_mono = time.monotonic()
+        self.t0_wall = time.time()
+
+    # -- parenting ---------------------------------------------------------
+    def _parent(self, tid: int) -> Optional[Span]:
+        stack = self._stacks.get(tid)
+        if stack:
+            return stack[-1]
+        if self._install_thread is not None:
+            root = self._stacks.get(self._install_thread)
+            if root:
+                return root[-1]
+        return None
+
+    def current_span(self) -> Optional[Span]:
+        """The calling thread's innermost open span (falling back to the
+        install thread's — the span a worker's work is logically inside)."""
+        with self._lock:
+            return self._parent(threading.get_ident())
+
+    def current_span_id(self) -> Optional[str]:
+        s = self.current_span()
+        return s.span_id if s is not None else None
+
+    # -- recording ---------------------------------------------------------
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        tid = threading.get_ident()
+        now = time.monotonic() - self.t0_mono
+        with self._lock:
+            parent = self._parent(tid)
+            sp = Span(name=name, span_id=f"s{next(self._ids)}",
+                      parent_id=parent.span_id if parent else None,
+                      start_s=now, attrs=dict(attrs), thread=tid,
+                      start_wall_s=time.time())
+            self._stacks.setdefault(tid, []).append(sp)
+        try:
+            yield sp
+        except BaseException as e:
+            sp.status = "error"
+            sp.attrs.setdefault("error", f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            sp.end_s = time.monotonic() - self.t0_mono
+            with self._lock:
+                stack = self._stacks.get(tid, [])
+                for i in range(len(stack) - 1, -1, -1):
+                    if stack[i] is sp:      # robust to interleaved exits
+                        del stack[i]
+                        break
+                self._spans.append(sp)
+
+    def event(self, name: str, **attrs) -> Span:
+        """A zero-duration marker span (e.g. a racing prune decision)."""
+        now = time.monotonic() - self.t0_mono
+        tid = threading.get_ident()
+        with self._lock:
+            parent = self._parent(tid)
+            sp = Span(name=name, span_id=f"s{next(self._ids)}",
+                      parent_id=parent.span_id if parent else None,
+                      start_s=now, end_s=now, attrs=dict(attrs), thread=tid,
+                      start_wall_s=time.time())
+            self._spans.append(sp)
+            return sp
+
+    @property
+    def spans(self) -> List[Span]:
+        """Completed spans (finish order); open spans are not included."""
+        with self._lock:
+            return list(self._spans)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    # -- export ------------------------------------------------------------
+    def to_json(self) -> Dict[str, Any]:
+        return {"runName": self.run_name, "t0WallS": round(self.t0_wall, 3),
+                "spans": [s.to_json() for s in self.spans]}
+
+    def export_chrome_trace(self, path: str) -> str:
+        """Write the trace in Chrome trace-event JSON ("X" complete events,
+        microsecond timestamps) — loadable in Perfetto / chrome://tracing.
+        Span ids and parent ids ride in ``args`` so the span tree survives
+        the round trip (``load_trace`` reads them back)."""
+        events = []
+        for s in self.spans:
+            events.append({
+                "name": s.name, "cat": s.name.split(".", 1)[0], "ph": "X",
+                "ts": round(s.start_s * 1e6, 1),
+                "dur": round(max(s.duration_s, 0.0) * 1e6, 1),
+                "pid": 0, "tid": s.thread,
+                "args": {"spanId": s.span_id, "parentId": s.parent_id,
+                         "status": s.status, **s.attrs}})
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"runName": self.run_name,
+                             "t0WallS": round(self.t0_wall, 3)}}
+        with open(path, "w") as fh:
+            json.dump(doc, fh, default=str)
+        return path
+
+    def slowest(self, top_n: int = 10) -> List[Span]:
+        return sorted(self.spans, key=lambda s: -s.duration_s)[:top_n]
+
+
+# --------------------------------------------------------------------------
+# ambient tracer (mirrors resilience.use_failure_log)
+# --------------------------------------------------------------------------
+
+# Process-global stack, NOT thread-local: the validator's candidate fits run
+# on a thread pool and must record into the tracer their orchestrating
+# train() installed.  Concurrent *independent* traced runs in one process
+# should pass explicit tracers instead.
+_TRACER_STACK: List[Tracer] = []
+_TRACER_LOCK = threading.Lock()
+
+
+def active_tracer() -> Optional[Tracer]:
+    """The innermost installed tracer, or None (spans become no-ops)."""
+    with _TRACER_LOCK:
+        return _TRACER_STACK[-1] if _TRACER_STACK else None
+
+
+@contextlib.contextmanager
+def use_tracer(tracer: Tracer):
+    """Install ``tracer`` as the ambient tracer for the dynamic extent."""
+    with _TRACER_LOCK:
+        _TRACER_STACK.append(tracer)
+        if tracer._install_thread is None:
+            tracer._install_thread = threading.get_ident()
+    try:
+        yield tracer
+    finally:
+        with _TRACER_LOCK:
+            for i in range(len(_TRACER_STACK) - 1, -1, -1):
+                if _TRACER_STACK[i] is tracer:
+                    del _TRACER_STACK[i]
+                    break
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Record a span on the ambient tracer; a no-op (one attribute check)
+    when tracing is off — instrumentation sites pay nothing by default."""
+    tracer = active_tracer()
+    if tracer is None:
+        yield None
+        return
+    with tracer.span(name, **attrs) as sp:
+        yield sp
+
+
+def event(name: str, **attrs) -> Optional[Span]:
+    """Record a zero-duration marker on the ambient tracer (None when off)."""
+    tracer = active_tracer()
+    if tracer is None:
+        return None
+    return tracer.event(name, **attrs)
+
+
+def current_span_id() -> Optional[str]:
+    """The calling thread's active span id on the ambient tracer, or None.
+    ``resilience.FailureLog`` uses this to correlate failures with spans."""
+    tracer = active_tracer()
+    if tracer is None:
+        return None
+    return tracer.current_span_id()
+
+
+# --------------------------------------------------------------------------
+# metrics registry
+# --------------------------------------------------------------------------
+
+class Counter:
+    """Monotonic thread-safe counter."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: Union[int, float] = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> Union[int, float]:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Point-in-time value: either set explicitly or read through a
+    callback (for absorbing external sources like ``compile_stats``)."""
+
+    __slots__ = ("name", "_value", "_fn", "_lock")
+
+    def __init__(self, name: str, fn: Optional[Callable[[], Any]] = None):
+        self.name = name
+        self._value: Any = 0
+        self._fn = fn
+        self._lock = threading.Lock()
+
+    def set(self, v: Any) -> None:
+        with self._lock:
+            self._value = v
+
+    @property
+    def value(self) -> Any:
+        if self._fn is not None:
+            try:
+                return self._fn()
+            except Exception:  # noqa: BLE001 — a dead source reads as 0
+                return 0
+        with self._lock:
+            return self._value
+
+
+class MetricsRegistry:
+    """Central named-metric namespace: counters, gauges, latency
+    histograms.  ``counter``/``gauge``/``histogram`` are get-or-create, so
+    call sites never race on registration; ``snapshot()`` renders the whole
+    registry as one JSON-safe dict."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, LatencyHistogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name: str,
+              fn: Optional[Callable[[], Any]] = None) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name, fn)
+            elif fn is not None:
+                g._fn = fn
+            return g
+
+    def histogram(self, name: str) -> LatencyHistogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = LatencyHistogram()
+            return h
+
+    def counters(self) -> Dict[str, Union[int, float]]:
+        with self._lock:
+            items = list(self._counters.items())
+        return {k: c.value for k, c in items}
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counters = list(self._counters.items())
+            gauges = list(self._gauges.items())
+            hists = list(self._histograms.items())
+        return {
+            "counters": {k: c.value for k, c in counters},
+            "gauges": {k: g.value for k, g in gauges},
+            "histograms": {k: h.snapshot() for k, h in hists},
+        }
+
+
+def _default_registry() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    # read-through gauges over the legacy profiling globals: ONE namespace
+    # re-exports every scattered counter without moving its source of truth
+    # (jax.monitoring listeners keep writing into profiling._COMPILE_STATS)
+    reg.gauge("compile.compile_s", lambda: compile_stats()["compile_s"])
+    reg.gauge("compile.backend_compiles",
+              lambda: compile_stats()["backend_compiles"])
+    reg.gauge("compile.cache_hits", lambda: compile_stats()["cache_hits"])
+    reg.gauge("compile.cache_misses",
+              lambda: compile_stats()["cache_misses"])
+    reg.gauge("racing.cv_fits_saved",
+              lambda: racing_stats()["cv_fits_saved"])
+    reg.gauge("racing.families_raced",
+              lambda: racing_stats()["families_raced"])
+    reg.gauge("racing.points_pruned",
+              lambda: racing_stats()["points_pruned"])
+    reg.gauge("host_link.bytes", host_link_bytes)
+    return reg
+
+
+#: Process-default registry.  Serving engines create their own instance per
+#: engine (counters reset with the engine); train/bench report through this.
+REGISTRY = _default_registry()
+
+
+# --------------------------------------------------------------------------
+# summaries + CLI rendering
+# --------------------------------------------------------------------------
+
+def telemetry_summary(tracer: Optional[Tracer] = None,
+                      registry: Optional[MetricsRegistry] = None,
+                      top_n: int = 15) -> Dict[str, Any]:
+    """The ``telemetry.json`` payload: top slowest spans (with tree
+    context), span counts by name, and the full metrics snapshot.  Bundled
+    next to saved models and embedded in bench aux."""
+    tracer = tracer if tracer is not None else active_tracer()
+    registry = registry if registry is not None else REGISTRY
+    out: Dict[str, Any] = {"metrics": registry.snapshot()}
+    if tracer is not None:
+        spans = tracer.spans
+        by_name: Dict[str, Dict[str, Any]] = {}
+        for s in spans:
+            agg = by_name.setdefault(
+                s.name, {"count": 0, "totalS": 0.0, "maxS": 0.0,
+                         "errors": 0})
+            agg["count"] += 1
+            agg["totalS"] = round(agg["totalS"] + s.duration_s, 6)
+            agg["maxS"] = round(max(agg["maxS"], s.duration_s), 6)
+            agg["errors"] += int(s.status == "error")
+        out["trace"] = {
+            "runName": tracer.run_name,
+            "spanCount": len(spans),
+            "slowestSpans": [s.to_json() for s in tracer.slowest(top_n)],
+            "byName": by_name,
+        }
+    return out
+
+
+def write_telemetry_summary(path: str,
+                            tracer: Optional[Tracer] = None,
+                            registry: Optional[MetricsRegistry] = None
+                            ) -> str:
+    with open(path, "w") as fh:
+        json.dump(telemetry_summary(tracer, registry), fh, indent=2,
+                  default=str)
+    return path
+
+
+def load_trace(path: str) -> List[Dict[str, Any]]:
+    """Read spans back from either export format: Chrome trace-event JSON
+    (``traceEvents`` with span ids in ``args``) or ``Tracer.to_json()``
+    (``spans``).  Returns a list of span dicts with name/spanId/parentId/
+    durationS/status keys."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict) and "spans" in doc:
+        return list(doc["spans"])
+    events = (doc or {}).get("traceEvents", []) if isinstance(doc, dict) \
+        else []
+    spans = []
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args") or {}
+        spans.append({"name": ev.get("name", "?"),
+                      "spanId": args.get("spanId"),
+                      "parentId": args.get("parentId"),
+                      "startS": float(ev.get("ts", 0.0)) / 1e6,
+                      "durationS": float(ev.get("dur", 0.0)) / 1e6,
+                      "status": args.get("status", "ok"),
+                      "attrs": {k: v for k, v in args.items()
+                                if k not in ("spanId", "parentId",
+                                             "status")}})
+    return spans
+
+
+def render_trace_summary(path: str, top_n: int = 10) -> str:
+    """The ``trace-summary`` subcommand's table: top-N slowest spans with
+    their depth-in-tree, duration, status and attributes."""
+    spans = load_trace(path)
+    if not spans:
+        return f"{path}: no spans"
+    by_id = {s.get("spanId"): s for s in spans if s.get("spanId")}
+
+    def depth(s: Dict[str, Any]) -> int:
+        d, seen = 0, set()
+        while s.get("parentId") and s["parentId"] in by_id \
+                and s["parentId"] not in seen:
+            seen.add(s["parentId"])
+            s = by_id[s["parentId"]]
+            d += 1
+        return d
+
+    rows = sorted(spans, key=lambda s: -float(s.get("durationS", 0.0)))
+    rows = rows[:top_n]
+    name_w = max(len("span"),
+                 max(len(s.get("name", "?")) + 2 * depth(s) for s in rows))
+    lines = [f"{path}: {len(spans)} span(s); top {len(rows)} by duration",
+             f"{'span'.ljust(name_w)}  {'seconds':>10}  {'status':<6}  attrs"]
+    for s in rows:
+        nm = "  " * depth(s) + s.get("name", "?")
+        attrs = s.get("attrs") or {}
+        attr_s = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        if len(attr_s) > 60:
+            attr_s = attr_s[:57] + "..."
+        lines.append(f"{nm.ljust(name_w)}  "
+                     f"{float(s.get('durationS', 0.0)):>10.4f}  "
+                     f"{s.get('status', 'ok'):<6}  {attr_s}")
+    return "\n".join(lines)

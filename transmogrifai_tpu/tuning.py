@@ -617,6 +617,19 @@ class OpValidator:
                  label: str, features: str,
                  in_fold_dag: Optional[List[List[Any]]] = None,
                  splitter: Optional[Splitter] = None) -> ValidationResult:
+        from .telemetry import span
+        with span("selector.sweep", candidates=len(candidates),
+                  validation_type=self.validation_type,
+                  grid_points=sum(len(c.grid) for c in candidates)):
+            return self._validate_impl(candidates, batch, label, features,
+                                       in_fold_dag=in_fold_dag,
+                                       splitter=splitter)
+
+    def _validate_impl(self, candidates: Sequence[ModelCandidate],
+                       batch: ColumnBatch, label: str, features: str,
+                       in_fold_dag: Optional[List[List[Any]]] = None,
+                       splitter: Optional[Splitter] = None
+                       ) -> ValidationResult:
         """Run the CV/TVS grid.
 
         The fast path (no in-fold DAG) keeps ONE data matrix in HBM and turns
@@ -771,11 +784,14 @@ class OpValidator:
                 # data matrix, fold masks, or device transfers needed
                 return
             if in_fold_dag:
-                for tr_idx, va_idx in splits:
-                    dag_copy = [[copy.deepcopy(s) for s in layer]
-                                for layer in in_fold_dag]
-                    _, fitted_dag = fit_dag(batch.take_rows(tr_idx), dag_copy)
-                    full = apply_dag(batch, fitted_dag)
+                from .telemetry import span as _span
+                for f, (tr_idx, va_idx) in enumerate(splits):
+                    with _span("selector.fold_fit", fold=f, in_fold_dag=True):
+                        dag_copy = [[copy.deepcopy(s) for s in layer]
+                                    for layer in in_fold_dag]
+                        _, fitted_dag = fit_dag(batch.take_rows(tr_idx),
+                                                dag_copy)
+                        full = apply_dag(batch, fitted_dag)
                     yield _col_values(full), [(tr_idx, va_idx)]
             else:
                 yield _col_values(batch), splits
@@ -946,6 +962,16 @@ class OpValidator:
                 return jnp.pad(Wblk, ((0, 0), (0, pad_rows)))
 
             def fit_candidate(cand, Wblk, grid):
+                # per-candidate trace span: worker threads have no span of
+                # their own, so this parents under the orchestrating
+                # selector.sweep span even through the thread pool
+                from .telemetry import span as _span
+                with _span("selector.candidate_fit", model=cand.model_name,
+                           grid=len(grid), folds=int(len(Wblk))):
+                    return _fit_candidate_body(cand, Wblk, grid)
+
+            def _fit_candidate_body(cand, Wblk, grid):
+                from .telemetry import span as _span
                 use_pad = bool(pad_rows) and getattr(
                     cand.estimator, "weighted_pad_exact", False)
                 Xf = X_pad if use_pad else X
@@ -972,22 +998,25 @@ class OpValidator:
                     self.family_fit_meta.pop(cand.model_name, None)
                     fitted_grid = []
                     for f in range(len(Wblk)):
-                        row = []
-                        for gi, params in enumerate(grid):
-                            try:
-                                maybe_inject("selector.candidate_fit",
-                                             key=cand.model_name)
-                                est = copy.deepcopy(cand.estimator)
-                                for k, v in params.items():
-                                    est.set(k, v)
-                                row.append(est.fit_arrays(
-                                    X, y32, sample_weight=Wblk[f]))
-                            except Exception as e2:  # noqa: BLE001
-                                record_failure(
-                                    cand.model_name, "skipped", e2,
-                                    point="selector.candidate_fit",
-                                    fold=f, grid_index=gi)
-                                row.append(None)
+                        with _span("selector.fold_fit",
+                                   model=cand.model_name, fold=f,
+                                   degraded=True):
+                            row = []
+                            for gi, params in enumerate(grid):
+                                try:
+                                    maybe_inject("selector.candidate_fit",
+                                                 key=cand.model_name)
+                                    est = copy.deepcopy(cand.estimator)
+                                    for k, v in params.items():
+                                        est.set(k, v)
+                                    row.append(est.fit_arrays(
+                                        X, y32, sample_weight=Wblk[f]))
+                                except Exception as e2:  # noqa: BLE001
+                                    record_failure(
+                                        cand.model_name, "skipped", e2,
+                                        point="selector.candidate_fit",
+                                        fold=f, grid_index=gi)
+                                    row.append(None)
                         fitted_grid.append(row)
                     return fitted_grid
 
@@ -1128,6 +1157,9 @@ class OpValidator:
                         r = results.get((cand.model_name, ci * 10000 + gi))
                         if r is not None:
                             r.raced_out = True
+                    from .telemetry import event as _event
+                    _event("selector.racing.prune", model=cand.model_name,
+                           grid=G, survivors=S, pruned=G - S)
                     return sorted(order[:S])
 
                 survivors_by_ci = {ci: prune(ci, candidates[ci])

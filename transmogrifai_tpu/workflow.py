@@ -240,6 +240,7 @@ class Workflow(_WorkflowCore):
         from .resilience import FailureLog, record_failure, use_failure_log
         from .sanitizer import (audit_dag_purity, audit_stage_serialization,
                                 nan_guard)
+        from .telemetry import span
 
         timer = PhaseTimer()
         flog = FailureLog()
@@ -247,7 +248,9 @@ class Workflow(_WorkflowCore):
         if resume_from is not None:
             sweep_cp = SweepCheckpoint(resume_from)
         try:
-            with use_failure_log(flog), preemption_guard("train"), \
+            with span("workflow.train",
+                      resumed=bool(sweep_cp is not None and len(sweep_cp))), \
+                    use_failure_log(flog), preemption_guard("train"), \
                     use_sweep_checkpoint(sweep_cp):
                 if sweep_cp is not None and len(sweep_cp):
                     record_failure(
@@ -547,10 +550,12 @@ class WorkflowModel(_WorkflowCore):
               keep_intermediate_features: bool = False) -> ColumnBatch:
         """≙ OpWorkflowModel.score:255 — apply the whole fitted transformer
         DAG and return the result-feature columns."""
+        from .telemetry import span
         if batch is None:
             batch = self.generate_raw_data()
-        scored = self.score_program()(
-            batch, keep_intermediate=keep_intermediate_features)
+        with span("workflow.score", rows=len(batch)):
+            scored = self.score_program()(
+                batch, keep_intermediate=keep_intermediate_features)
         names = [f.name for f in self.result_features if f.name in scored]
         if keep_intermediate_features:
             return scored
@@ -725,6 +730,16 @@ class WorkflowModel(_WorkflowCore):
         with open(os.path.join(path, MODEL_JSON), "w") as fh:
             json.dump(manifest, fh, indent=2, default=str)
         np.savez_compressed(os.path.join(path, PARAMS_NPZ), **arrays)
+        from .telemetry import active_tracer, write_telemetry_summary
+        if active_tracer() is not None:
+            # traced run: bundle the run's timeline summary next to the
+            # model (digested into MANIFEST.json like every bundle file)
+            try:
+                write_telemetry_summary(os.path.join(path, "telemetry.json"))
+            except Exception as e:  # noqa: BLE001 — diagnostics only
+                from .resilience import record_failure
+                record_failure("workflow.save", "swallowed", e,
+                               point="checkpoint.save")
 
     @staticmethod
     def load(path: str) -> "WorkflowModel":

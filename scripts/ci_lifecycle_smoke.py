@@ -1,0 +1,135 @@
+"""CI smoke for the lifecycle loop (ISSUE 6): seed a serving root, stream
+a 500-row drifted feed through the drift monitor, force one retrain, and
+validate that the candidate promoted — exporting the run's trace and a
+``drift.*`` / ``lifecycle_*`` metrics snapshot as CI artifacts.
+
+Usage:
+    python scripts/ci_lifecycle_smoke.py run OUT_DIR       # loop + export
+    python scripts/ci_lifecycle_smoke.py validate OUT_DIR  # parse + assert
+
+``validate`` asserts the summary reports one promotion and a drift breach,
+the metrics snapshot carries per-feature PSI gauges plus the lifecycle
+counter families, and the exported trace contains ``lifecycle.retrain`` and
+``drift.evaluate`` spans.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+# runnable as `python scripts/ci_lifecycle_smoke.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def make_records(n, seed, shift=0.0, flip=False):
+    rng = np.random.default_rng(seed)
+    sgn = -1.0 if flip else 1.0
+    return [{"y": float(i % 2),
+             "x": float(shift + sgn * (rng.normal() + (i % 2)))}
+            for i in range(n)]
+
+
+def build_workflow(records):
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, ModelCandidate, grid)
+    from transmogrifai_tpu.workflow import Workflow
+    y = FeatureBuilder.RealNN("y").extract(
+        lambda r: r.get("y"), source="r.get('y')").as_response()
+    x = FeatureBuilder.Real("x").extract(
+        lambda r: r.get("x"), source="r.get('x')").as_predictor()
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]),
+                       "OpLogisticRegression")])
+    sel.set_input(y, transmogrify([x]))
+    return (Workflow().set_input_records(records)
+            .set_result_features(sel.get_output()))
+
+
+def run(out_dir):
+    from transmogrifai_tpu.lifecycle import lifecycle_main
+    from transmogrifai_tpu.readers import DataReader
+    from transmogrifai_tpu.readers.streaming import StreamingReader
+    from transmogrifai_tpu.telemetry import (REGISTRY, Tracer, use_tracer,
+                                             write_telemetry_summary)
+    from transmogrifai_tpu.checkpoint import next_version_dir
+
+    os.makedirs(out_dir, exist_ok=True)
+    root = os.path.join(out_dir, "ckpts")
+
+    # incumbent: regime A; live feed: 500 drifted regime-B rows
+    incumbent = build_workflow(make_records(200, seed=1)).train()
+    incumbent.save(next_version_dir(root))
+    live = make_records(500, seed=2, shift=4.0, flip=True)
+    batches = [live[i:i + 100] for i in range(0, 500, 100)]
+
+    tracer = Tracer(run_name="ci-lifecycle")
+    with use_tracer(tracer):
+        summary = lifecycle_main(
+            build_workflow(make_records(300, seed=3, shift=4.0, flip=True)),
+            root,
+            live_reader=StreamingReader(batches=batches),
+            holdout_reader=DataReader(
+                records=make_records(150, seed=4, shift=4.0, flip=True)),
+            config={"forceRetrain": True, "minRows": 100})
+
+    trace_path = tracer.export_chrome_trace(
+        os.path.join(out_dir, "trace-lifecycle.json"))
+    write_telemetry_summary(os.path.join(out_dir, "telemetry.json"), tracer)
+    with open(os.path.join(out_dir, "lifecycle-summary.json"), "w") as fh:
+        json.dump(summary, fh, indent=2, default=str)
+    with open(os.path.join(out_dir, "metrics-snapshot.json"), "w") as fh:
+        json.dump(REGISTRY.snapshot(), fh, indent=2, default=str)
+    print(f"wrote {trace_path} ({len(tracer)} spans); "
+          f"promotions={summary['state']['promotions']} "
+          f"ingested={summary['batchesIngested']} batches")
+    return 0
+
+
+def validate(out_dir):
+    from transmogrifai_tpu.telemetry import load_trace
+
+    with open(os.path.join(out_dir, "lifecycle-summary.json")) as fh:
+        summary = json.load(fh)
+    assert summary["driftEnabled"], "baselines must enable drift"
+    assert summary["batchesIngested"] == 5, summary["batchesIngested"]
+    assert summary["state"]["promotions"] >= 1, summary["state"]
+    assert summary["state"]["failedRetrains"] == 0, summary["state"]
+    outcome = summary["outcomes"][0]
+    assert outcome["status"] == "promoted", outcome
+    assert outcome["candidateMetric"] > outcome["incumbentMetric"], outcome
+    report = summary["driftReport"]
+    assert report["breached"], "the 500-row drifted feed must breach"
+    assert any("PSI" in r for r in report["reasons"]), report["reasons"]
+
+    with open(os.path.join(out_dir, "metrics-snapshot.json")) as fh:
+        snap = json.load(fh)
+    assert snap["counters"].get("lifecycle.retrains_total", 0) >= 1
+    assert snap["counters"].get("lifecycle.promotions_total", 0) >= 1
+    assert snap["counters"].get("drift.evaluations_total", 0) >= 1
+    assert "drift.psi.x" in snap["gauges"], sorted(snap["gauges"])
+
+    spans = load_trace(os.path.join(out_dir, "trace-lifecycle.json"))
+    names = {s["name"] for s in spans}
+    for required in ("lifecycle.run", "lifecycle.retrain",
+                     "lifecycle.promote", "drift.evaluate",
+                     "workflow.train"):
+        assert required in names, f"no {required} span in {sorted(names)}"
+    x_psi = [f for f in report["features"] if f["feature"] == "x"]
+    assert x_psi and x_psi[0]["psi"] > 0.25, report["features"]
+    print(f"OK: promotion shipped ({outcome['bundleVersion']}), drift "
+          f"PSI={x_psi[0]['psi']:.2f}, {len(spans)} spans")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "run":
+        sys.exit(run(sys.argv[2]))
+    if len(sys.argv) == 3 and sys.argv[1] == "validate":
+        sys.exit(validate(sys.argv[2]))
+    sys.exit(f"usage: {sys.argv[0]} run OUT_DIR | validate OUT_DIR")

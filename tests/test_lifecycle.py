@@ -1,0 +1,586 @@
+"""Production lifecycle: drift detection → gated retrain → atomic hot-swap.
+
+Covers the lifecycle acceptance criteria: training baselines ride inside the
+bundle (digest-covered by the manifest) and survive load; pre-lifecycle
+bundles still load and serve with drift disabled; a covariate shift breaches
+within one evaluation window while an in-distribution window does not; a
+deliberately-worse candidate is REJECTED with the incumbent left serving; the
+full drift → retrain → promote → hot-swap loop runs under concurrent HTTP
+clients with zero failed requests; chaos injection at the retrain/promote
+boundaries and preemption mid-sweep (with checkpointed resume) leave the
+incumbent serving; and /metrics exposes per-feature PSI plus ``lifecycle_*``
+counter families."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.checkpoint import (SweepCheckpoint, TrainingPreempted,
+                                          find_latest_valid, next_version_dir,
+                                          verify_bundle)
+from transmogrifai_tpu.evaluators import OpBinaryClassificationEvaluator
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.filters import FeatureSketch
+from transmogrifai_tpu.lifecycle import (BASELINES_JSON, DriftMonitor,
+                                         DriftThresholdPolicy,
+                                         LifecycleController, ManualPolicy,
+                                         ModelBaselines,
+                                         ScheduledIntervalPolicy,
+                                         load_baselines)
+from transmogrifai_tpu.lifecycle.controller import (REJECTED_MARKER,
+                                                    REJECTED_SUBDIR,
+                                                    SWEEP_SUBDIR)
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.models.trees import OpRandomForestClassifier
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.resilience import (FailureLog, FaultInjector,
+                                          inject_faults, use_failure_log)
+from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                        ModelCandidate, grid)
+from transmogrifai_tpu.workflow import Workflow, WorkflowModel
+
+
+def make_records(n, seed, shift=0.0, flip=False):
+    """y ~ x with a controllable regime: ``shift`` moves the x distribution
+    (covariate drift), ``flip`` inverts the x↔y relation so a model trained
+    on the old regime genuinely degrades on the new one."""
+    rng = np.random.default_rng(seed)
+    sgn = -1.0 if flip else 1.0
+    return [{"id": str(i), "y": float(i % 2),
+             "x": float(shift + sgn * (rng.normal() + (i % 2)))}
+            for i in range(n)]
+
+
+def build_workflow(records, two_candidates=False):
+    """Fresh y~x workflow over ``records``; ``two_candidates`` adds a second
+    selector family so preemption has a candidate boundary to land on."""
+    label = FeatureBuilder.RealNN("y").extract(
+        lambda r: r.get("y"), source="r.get('y')").as_response()
+    x = FeatureBuilder.Real("x").extract(
+        lambda r: r.get("x"), source="r.get('x')").as_predictor()
+    models = [ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]),
+                             "OpLogisticRegression")]
+    if two_candidates:
+        models.append(ModelCandidate(
+            OpRandomForestClassifier(num_trees=5, max_depth=3),
+            grid(min_info_gain=[0.001]), "OpRandomForestClassifier"))
+    sel = BinaryClassificationModelSelector(models=models)
+    sel.set_input(label, transmogrify([x]))
+    return (Workflow().set_input_records(records)
+            .set_result_features(sel.get_output()))
+
+
+@pytest.fixture(scope="module")
+def incumbent_model():
+    """One regime-A model shared by every test that needs an incumbent
+    (training is the expensive part; each test saves it to a fresh root)."""
+    return build_workflow(make_records(150, seed=0)).train()
+
+
+@pytest.fixture()
+def seeded_root(incumbent_model, tmp_path):
+    root = str(tmp_path / "ckpts")
+    incumbent_model.save(next_version_dir(root))
+    return root
+
+
+# --------------------------------------------------------------------------
+# baselines in the bundle
+# --------------------------------------------------------------------------
+
+class TestBaselines:
+    def test_save_embeds_digest_covered_baselines(self, seeded_root):
+        bundle = find_latest_valid(seeded_root)
+        assert os.path.exists(os.path.join(bundle, BASELINES_JSON))
+        with open(os.path.join(bundle, "MANIFEST.json")) as fh:
+            manifest = json.load(fh)
+        assert BASELINES_JSON in manifest["files"], \
+            "baselines must be covered by the bundle digest"
+        assert verify_bundle(bundle) is not None
+
+    def test_load_restores_streaming_sketches(self, seeded_root):
+        model = WorkflowModel.load(seeded_root)
+        b = model.baselines
+        assert b is not None
+        assert ("x", None) in b.features
+        sk = b.features[("x", None)]
+        assert isinstance(sk, FeatureSketch)
+        assert sk.count == 150 and sk.histogram is not None
+        assert sk.histogram.total == pytest.approx(150)
+        assert b.score_histogram is not None
+        assert b.score_histogram.total == pytest.approx(150)
+        assert b.score_field in ("probability_1", "prediction")
+        # the raw JSON round-trips through the dataclass unchanged
+        b2 = ModelBaselines.from_json(b.to_json())
+        assert set(b2.features) == set(b.features)
+        np.testing.assert_allclose(b2.features[("x", None)].histogram.bins,
+                                   sk.histogram.bins)
+
+    def test_legacy_bundle_without_baselines_loads_and_serves(
+            self, incumbent_model, tmp_path):
+        """MIGRATION: a pre-lifecycle bundle (no baselines.json) must load,
+        score, and serve — with drift monitoring disabled and the
+        degradation recorded, not an error."""
+        root = str(tmp_path / "legacy")
+        path = next_version_dir(root)
+        incumbent_model.save(path)
+        # strip the baselines the way an old build's bundle looks: no file,
+        # no manifest entry (the manifest itself is not digest-protected)
+        os.remove(os.path.join(path, BASELINES_JSON))
+        mpath = os.path.join(path, "MANIFEST.json")
+        with open(mpath) as fh:
+            manifest = json.load(fh)
+        del manifest["files"][BASELINES_JSON]
+        with open(mpath, "w") as fh:
+            json.dump(manifest, fh)
+        assert verify_bundle(path) is not None
+        assert load_baselines(path) is None
+
+        log = FailureLog()
+        with use_failure_log(log):
+            model = WorkflowModel.load(root)
+            assert model.baselines is None
+            assert DriftMonitor.for_model(model) is None
+        assert log.summary().get("degraded", 0) >= 1
+        # and it still serves
+        from transmogrifai_tpu.serving import ScoringEngine
+        eng = ScoringEngine(path, max_batch=2, linger_ms=1.0, warm=False)
+        try:
+            assert eng.attach_drift_monitor() is None
+            res, _ = eng.score_record({"x": 0.5}, timeout_s=60)
+            assert res
+        finally:
+            eng.close()
+
+
+# --------------------------------------------------------------------------
+# drift detection
+# --------------------------------------------------------------------------
+
+class TestDriftMonitor:
+    def test_covariate_shift_breaches_within_one_window(self, seeded_root):
+        model = WorkflowModel.load(seeded_root)
+        mon = DriftMonitor.for_model(model, min_rows=50)
+        mon.observe_records(make_records(200, seed=1, shift=4.0, flip=True))
+        report = mon.evaluate()
+        assert report.ready and report.breached
+        assert any("PSI" in r for r in report.reasons)
+        x = [f for f in report.features if f.name == "x"][0]
+        assert x.psi > 0.25 and x.breached
+
+    def test_in_distribution_window_does_not_breach(self, seeded_root):
+        model = WorkflowModel.load(seeded_root)
+        mon = DriftMonitor.for_model(model, min_rows=50)
+        mon.observe_records(make_records(200, seed=2))
+        report = mon.evaluate()
+        assert report.ready and not report.breached
+
+    def test_below_min_rows_never_breaches(self, seeded_root):
+        mon = DriftMonitor.for_model(WorkflowModel.load(seeded_root),
+                                     min_rows=500)
+        mon.observe_records(make_records(100, seed=3, shift=8.0))
+        report = mon.evaluate()
+        assert not report.ready and not report.breached
+        assert report.features, "stats still reported while warming up"
+
+    def test_score_distribution_psi(self, seeded_root):
+        model = WorkflowModel.load(seeded_root)
+        mon = DriftMonitor.for_model(model, min_rows=20)
+        mon.observe_records(make_records(60, seed=4))
+        # scores wildly unlike the training score distribution
+        mon.observe_scores(np.linspace(-40.0, -30.0, 60))
+        report = mon.evaluate()
+        assert report.score_rows == 60
+        assert report.score_psi > 0.25
+        assert any("score distribution" in r for r in report.reasons)
+
+    def test_exports_gauges_and_counters_to_registry(self, seeded_root):
+        from transmogrifai_tpu.telemetry import MetricsRegistry
+        reg = MetricsRegistry()
+        mon = DriftMonitor.for_model(WorkflowModel.load(seeded_root),
+                                     registry=reg, min_rows=50)
+        mon.observe_records(make_records(100, seed=5, shift=4.0, flip=True))
+        mon.evaluate()
+        snap = reg.snapshot()
+        assert snap["gauges"]["drift.psi.x"] > 0.25
+        assert snap["gauges"]["drift.rows_observed"] == 100
+        assert "drift.fill_delta.x" in snap["gauges"]
+        assert snap["counters"]["drift.evaluations_total"] == 1
+        assert snap["counters"]["drift.breaches_total"] == 1
+
+
+# --------------------------------------------------------------------------
+# the promotion gate
+# --------------------------------------------------------------------------
+
+class TestPromotionGate:
+    def test_worse_candidate_is_rejected_incumbent_keeps_serving(
+            self, seeded_root):
+        """A retrain that produces a worse model must NOT ship: the loser is
+        kept under lifecycle/rejected with a marker, the serving root's
+        newest valid bundle is unchanged, and the failure log says why."""
+        incumbent_bundle = find_latest_valid(seeded_root)
+        holdout = make_records(100, seed=7)
+        manual = ManualPolicy()
+        manual.trigger("unit test: force a bad retrain")
+        log = FailureLog()
+        with use_failure_log(log):
+            # the bad candidate: trained on a FLIPPED x↔y relation, so its
+            # holdout ranking is inverted (AuPR is rank-based — a merely
+            # noisy model could still tie the incumbent's ranking)
+            ctl = LifecycleController(
+                lambda: build_workflow(make_records(150, seed=8, flip=True)),
+                seeded_root, OpBinaryClassificationEvaluator(),
+                holdout_records=holdout, policies=[manual])
+            outcome = ctl.run_once()
+        assert outcome.status == "rejected"
+        assert outcome.candidate_metric < outcome.incumbent_metric
+        assert ctl.state.rejections_total == 1
+        # incumbent untouched and still the newest valid version
+        assert find_latest_valid(seeded_root) == incumbent_bundle
+        # the loser is preserved for audit, outside the serving scan
+        assert outcome.candidate_path.startswith(
+            os.path.join(seeded_root, REJECTED_SUBDIR))
+        marker = os.path.join(outcome.candidate_path, REJECTED_MARKER)
+        with open(marker) as fh:
+            rejected = json.load(fh)
+        assert rejected["candidateMetric"] == outcome.candidate_metric
+        assert verify_bundle(outcome.candidate_path) is not None
+        assert log.summary().get("rejected") == 1
+
+    def test_tolerance_lets_a_tie_ship(self, seeded_root):
+        """With a wide-open tolerance even the flipped candidate promotes —
+        proving the gate is the tolerance comparison, not a hidden rule."""
+        manual = ManualPolicy()
+        manual.trigger("unit test: tolerant gate")
+        ctl = LifecycleController(
+            lambda: build_workflow(make_records(150, seed=8, flip=True)),
+            seeded_root, OpBinaryClassificationEvaluator(),
+            holdout_records=make_records(100, seed=7),
+            policies=[manual], tolerance=1.0)
+        outcome = ctl.run_once()
+        assert outcome.status == "promoted"
+        assert "ckpt-000002" in outcome.bundle_version
+        assert find_latest_valid(seeded_root) == outcome.candidate_path
+
+    def test_drift_policy_triggers_retrain_and_promotes_better_model(
+            self, seeded_root):
+        """The tentpole loop minus HTTP: live drift breach fires the policy,
+        the regime-B candidate beats the regime-A incumbent on the regime-B
+        holdout, and the new bundle becomes the serving root's newest."""
+        model = WorkflowModel.load(seeded_root)
+        mon = DriftMonitor.for_model(model, min_rows=50)
+        mon.observe_records(make_records(300, seed=10, shift=4.0, flip=True))
+        ctl = LifecycleController(
+            lambda: build_workflow(make_records(300, seed=11, shift=4.0,
+                                                flip=True)),
+            seeded_root, OpBinaryClassificationEvaluator(),
+            holdout_records=make_records(120, seed=12, shift=4.0, flip=True),
+            monitor=mon, policies=[DriftThresholdPolicy()])
+        outcome = ctl.run_once()
+        assert outcome.status == "promoted"
+        assert outcome.policy == "drift" and "PSI" in outcome.reason
+        assert outcome.candidate_metric > outcome.incumbent_metric + 0.2
+        assert find_latest_valid(seeded_root) == outcome.candidate_path
+        # the monitor was rebased onto the new baselines: window reset and
+        # regime-B traffic no longer reads as drift
+        assert mon.rows_observed == 0
+        mon.observe_records(make_records(200, seed=13, shift=4.0, flip=True))
+        assert not mon.evaluate().breached
+        # no second retrain while nothing is drifting
+        assert ctl.run_once() is None
+        # sweep checkpoint consumed — the next retrain starts fresh
+        assert not os.path.exists(os.path.join(seeded_root, SWEEP_SUBDIR))
+
+    def test_scheduled_policy_fires_on_interval(self, seeded_root):
+        clock = [1000.0]
+        pol = ScheduledIntervalPolicy(60.0, time_fn=lambda: clock[0])
+        ctl = LifecycleController(
+            lambda: build_workflow(make_records(150, seed=8)),
+            seeded_root, OpBinaryClassificationEvaluator(),
+            holdout_records=make_records(80, seed=7), policies=[pol])
+        assert ctl.run_once() is None          # anchor set, not yet due
+        clock[0] += 61.0
+        outcome = ctl.run_once()
+        assert outcome is not None and outcome.policy == "interval"
+
+
+# --------------------------------------------------------------------------
+# chaos: injected faults at every lifecycle boundary
+# --------------------------------------------------------------------------
+
+class TestLifecycleChaos:
+    def test_injected_retrain_fault_leaves_incumbent(self, seeded_root):
+        incumbent_bundle = find_latest_valid(seeded_root)
+        ctl = LifecycleController(
+            lambda: build_workflow(make_records(150, seed=8)),
+            seeded_root, OpBinaryClassificationEvaluator(),
+            holdout_records=make_records(80, seed=7))
+        with inject_faults(FaultInjector(
+                fail_keys={"lifecycle.retrain": ["1"]})):
+            outcome = ctl.retrain_and_promote("chaos: kill at retrain start")
+        assert outcome.status == "failed"
+        assert "InjectedFault" in outcome.error
+        assert ctl.state.failed_retrains_total == 1
+        assert find_latest_valid(seeded_root) == incumbent_bundle
+
+    def test_injected_promote_fault_dies_before_commit(self, seeded_root):
+        """The candidate trains fully and wins the gate, then the process
+        'dies' right before the bundle write: no new version appears and
+        the incumbent keeps serving."""
+        incumbent_bundle = find_latest_valid(seeded_root)
+        ctl = LifecycleController(
+            lambda: build_workflow(make_records(300, seed=11, shift=4.0,
+                                                flip=True)),
+            seeded_root, OpBinaryClassificationEvaluator(),
+            holdout_records=make_records(120, seed=12, shift=4.0, flip=True))
+        with inject_faults(FaultInjector(
+                fail_keys={"lifecycle.promote": ["1"]})):
+            outcome = ctl.retrain_and_promote("chaos: kill at promote")
+        assert outcome.status == "failed"
+        assert "InjectedFault" in outcome.error
+        assert find_latest_valid(seeded_root) == incumbent_bundle
+        assert ctl.state.promotions_total == 0
+
+    def test_preempted_retrain_resumes_from_sweep_checkpoint(self, tmp_path):
+        """FaultInjector kills the retrain mid-sweep (between candidate
+        families); the controller reports 'preempted' and keeps the sweep
+        checkpoint, and the next retrain resumes — proven by arming a fit
+        fault for the already-completed family, which would poison its
+        metrics if the sweep re-fit instead of replaying."""
+        root = str(tmp_path / "ckpts")           # fresh root: the resumed
+        sweep_dir = os.path.join(root, SWEEP_SUBDIR)  # winner ships unopposed
+        factory = lambda: build_workflow(         # noqa: E731
+            make_records(150, seed=20), two_candidates=True)
+        ctl = LifecycleController(
+            factory, root, OpBinaryClassificationEvaluator(),
+            holdout_records=make_records(80, seed=21))
+
+        with inject_faults(FaultInjector(
+                fail_keys={"preemption": ["OpRandomForestClassifier"]})):
+            outcome = ctl.retrain_and_promote("chaos: preempt mid-sweep")
+        assert outcome.status == "preempted"
+        assert outcome.resume_from == sweep_dir
+        assert ctl.state.preemptions_total == 1
+        assert len(SweepCheckpoint(sweep_dir)) == 1   # LR completed + saved
+        with pytest.raises(Exception):
+            find_latest_valid(root)                   # nothing shipped
+
+        # second attempt OUTSIDE the injector (injected decisions are
+        # sticky); the armed fit fault proves LR is replayed, not re-fit
+        with inject_faults(FaultInjector(fail_keys={
+                "selector.candidate_fit": ["OpLogisticRegression"]})):
+            outcome2 = ctl.retrain_and_promote("retry after preemption")
+        assert outcome2.status == "promoted"
+        assert outcome2.train_failures.get("resumed", 0) >= 1
+        # had the sweep re-fit LR, the armed fault would have skipped it
+        assert outcome2.train_failures.get("skipped", 0) == 0
+        assert find_latest_valid(root) == outcome2.candidate_path
+        assert WorkflowModel.load(root).baselines is not None
+
+
+# --------------------------------------------------------------------------
+# the full loop over HTTP: drift → retrain → promote → hot swap under load
+# --------------------------------------------------------------------------
+
+class TestLifecycleEndToEnd:
+    def test_drift_retrain_hot_swap_under_concurrent_clients(
+            self, incumbent_model, tmp_path):
+        """Acceptance: regime-B traffic through the real HTTP server feeds
+        the drift monitor, the breach triggers a gated retrain, the winning
+        candidate hot-swaps atomically while 16 clients keep scoring — zero
+        failed requests, both bundle versions observed serving."""
+        from transmogrifai_tpu.serving.server import start_server
+        root = str(tmp_path / "ckpts")
+        incumbent_model.save(next_version_dir(root))
+        srv, thread = start_server(root, port=0, max_batch=8, linger_ms=2.0,
+                                   queue_bound=256)
+        eng = srv.engine
+        mon = eng.attach_drift_monitor(min_rows=40)
+        assert mon is not None and eng.drift_monitor is mon
+
+        live = make_records(16 * 8, seed=30, shift=4.0, flip=True)
+        swapped = threading.Event()
+        collected, errors = [], []
+        start = threading.Barrier(16, timeout=60)
+
+        def client(i):
+            import urllib.request
+            try:
+                start.wait()
+                for phase, count in (("pre", 5), ("post", 3)):
+                    if phase == "post":
+                        assert swapped.wait(timeout=300)
+                    for j in range(count):
+                        rec = {"x": live[(i * 8 + j) % len(live)]["x"]}
+                        body = json.dumps(rec).encode()
+                        req = urllib.request.Request(
+                            f"http://127.0.0.1:{srv.port}/v1/score",
+                            data=body,
+                            headers={"Content-Type": "application/json"})
+                        with urllib.request.urlopen(req, timeout=60) as r:
+                            assert r.status == 200
+                            collected.append(json.loads(r.read()))
+            except Exception as e:  # noqa: BLE001 — surfaced by the assert
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        try:
+            # the monitor fills from SERVED traffic, not a side channel
+            deadline = time.monotonic() + 120
+            while mon.rows_observed < 40 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert mon.rows_observed >= 40
+
+            ctl = LifecycleController(
+                lambda: build_workflow(make_records(300, seed=31, shift=4.0,
+                                                    flip=True)),
+                root, OpBinaryClassificationEvaluator(),
+                holdout_records=make_records(120, seed=32, shift=4.0,
+                                             flip=True),
+                monitor=mon, policies=[DriftThresholdPolicy()], engine=eng)
+            outcome = ctl.run_once()
+            assert outcome is not None and outcome.status == "promoted", \
+                outcome and outcome.to_json()
+            assert outcome.swapped, "engine must hot-swap on promotion"
+            assert "PSI" in outcome.reason
+            assert outcome.candidate_metric > outcome.incumbent_metric
+            swapped.set()
+            for t in threads:
+                t.join(timeout=300)
+            assert not errors, errors[:3]
+            assert len(collected) == 16 * 8, "zero dropped responses"
+            versions = {out["modelVersion"] for out in collected}
+            assert len(versions) == 2, \
+                "both incumbent and promoted versions must have served"
+            assert eng.stats()["counters"]["reloads_total"] == 1
+            # /healthz advertises the new bundle identity
+            import urllib.request
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/healthz", timeout=30) as r:
+                health = json.loads(r.read())
+            assert health["bundleVersion"].startswith("ckpt-000002@")
+            assert health["modelStalenessS"] >= 0.0
+            # the swap rebased the drift monitor onto the new baselines
+            assert eng.drift_monitor.baselines.features[("x", None)].count \
+                == 300
+            # /metrics exposes per-feature PSI and lifecycle_* counters
+            from transmogrifai_tpu.serving.server import render_metrics
+            text = render_metrics(eng)
+            assert 'transmogrifai_serving_drift_feature_psi{feature="x"}' \
+                in text
+            assert "transmogrifai_serving_drift_evaluations_total" in text
+            assert "transmogrifai_serving_lifecycle_promotions_total" in text
+            assert "transmogrifai_serving_lifecycle_retrains_total" in text
+            assert "transmogrifai_serving_model_staleness_seconds" in text
+        finally:
+            swapped.set()
+            srv.drain_and_close()
+            thread.join(timeout=10)
+
+    def test_lifecycle_main_streaming_force_retrain(self, incumbent_model,
+                                                    tmp_path):
+        """The runner entry point: StreamingReader live feed + forced
+        retrain over a pre-seeded root promotes a regime-B candidate and
+        reports the whole run as JSON."""
+        from transmogrifai_tpu.lifecycle import lifecycle_main
+        from transmogrifai_tpu.readers import DataReader
+        from transmogrifai_tpu.readers.streaming import StreamingReader
+        root = str(tmp_path / "ckpts")
+        incumbent_model.save(next_version_dir(root))
+        live_b = make_records(200, seed=40, shift=4.0, flip=True)
+        batches = [live_b[i:i + 50] for i in range(0, 200, 50)]
+        result = lifecycle_main(
+            build_workflow(make_records(300, seed=41, shift=4.0, flip=True)),
+            root,
+            live_reader=StreamingReader(batches=batches),
+            holdout_reader=DataReader(
+                records=make_records(120, seed=42, shift=4.0, flip=True)),
+            config={"forceRetrain": True, "minRows": 50})
+        assert result["driftEnabled"]
+        assert result["batchesIngested"] == 4
+        assert result["state"]["promotions"] == 1
+        assert result["outcomes"][0]["status"] == "promoted"
+        assert result["driftReport"] is not None
+        assert "ckpt-000002" in find_latest_valid(root)
+
+    def test_lifecycle_main_seeds_empty_root(self, tmp_path):
+        from transmogrifai_tpu.lifecycle import lifecycle_main
+        root = str(tmp_path / "ckpts")
+        result = lifecycle_main(
+            build_workflow(make_records(150, seed=50)), root,
+            config={"maxIterations": 1})
+        assert "ckpt-000001" in find_latest_valid(root)
+        assert result["driftEnabled"]
+        assert result["state"]["retrains"] == 0    # nothing fired: no drift
+
+
+# --------------------------------------------------------------------------
+# params / CLI wiring
+# --------------------------------------------------------------------------
+
+def test_params_lifecycle_roundtrip():
+    from transmogrifai_tpu.params import OpParams
+    p = OpParams.from_json(
+        {"lifecycleParams": {"policy": "drift", "psiThreshold": 0.3}})
+    assert p.lifecycle == {"policy": "drift", "psiThreshold": 0.3}
+    assert OpParams.from_json(p.to_json()).lifecycle == p.lifecycle
+    assert OpParams.from_json({}).lifecycle == {}
+
+
+def test_runner_exposes_lifecycle_run_type():
+    from transmogrifai_tpu.runner import RunType
+    assert RunType.LIFECYCLE == "lifecycle"
+    assert RunType.LIFECYCLE in RunType.ALL
+
+
+def test_cli_lifecycle_drift_check(incumbent_model, tmp_path, capsys):
+    from transmogrifai_tpu.cli import main
+    root = str(tmp_path / "ckpts")
+    incumbent_model.save(next_version_dir(root))
+    recs = tmp_path / "live.jsonl"
+
+    def write_records(records):
+        with open(recs, "w") as fh:
+            for r in records:
+                fh.write(json.dumps(r) + "\n")
+
+    write_records(make_records(120, seed=60))
+    assert main(["lifecycle", "--model-location", root,
+                 "--records", str(recs)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["ready"] and not report["breached"]
+
+    write_records(make_records(120, seed=61, shift=4.0, flip=True))
+    assert main(["lifecycle", "--model-location", root,
+                 "--records", str(recs), "--shadow-score"]) == 2
+    report = json.loads(capsys.readouterr().out)
+    assert report["breached"] and any("PSI" in r for r in report["reasons"])
+    assert report["scoreRows"] == 120
+
+
+def test_cli_lifecycle_exit_3_without_baselines(incumbent_model, tmp_path,
+                                                capsys):
+    from transmogrifai_tpu.cli import main
+    path = str(tmp_path / "legacy")
+    incumbent_model.save(path)
+    os.remove(os.path.join(path, BASELINES_JSON))
+    mpath = os.path.join(path, "MANIFEST.json")
+    with open(mpath) as fh:
+        manifest = json.load(fh)
+    del manifest["files"][BASELINES_JSON]
+    with open(mpath, "w") as fh:
+        json.dump(manifest, fh)
+    recs = tmp_path / "live.jsonl"
+    with open(recs, "w") as fh:
+        fh.write(json.dumps({"x": 1.0}) + "\n")
+    assert main(["lifecycle", "--model-location", path,
+                 "--records", str(recs)]) == 3
+    assert not json.loads(capsys.readouterr().out)["enabled"]

@@ -401,6 +401,22 @@ class TestHTTPServer:
         except urllib.error.HTTPError as e:
             assert e.code == 404
 
+    def test_healthz_reports_bundle_version_and_staleness(self, server):
+        """/healthz carries the active bundle's checkpoint identity and how
+        stale the served model is — the lifecycle loop's liveness probe."""
+        from transmogrifai_tpu.checkpoint import bundle_version
+        status, body = _get(server.port, "/healthz")
+        assert status == 200
+        h = json.loads(body)
+        eng = server.engine
+        assert h["bundleVersion"] == bundle_version(eng.active_bundle_path)
+        assert "@" in h["bundleVersion"], "identity must pin createdAt"
+        assert h["modelStalenessS"] >= 0.0
+        # staleness is measured from the manifest's createdAt, so a
+        # just-trained bundle reads as seconds old, not zero-since-load
+        assert h["modelStalenessS"] == pytest.approx(
+            eng.model_staleness_s, abs=5.0)
+
     def test_healthz_reports_draining(self, server):
         server.draining = True
         try:

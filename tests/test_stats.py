@@ -160,3 +160,119 @@ def test_sanity_checker_contingency_metadata():
     assert "pointwiseMutualInfo" in panel and "mutualInfo" in panel
     assert panel["mutualInfo"] > 0.05      # real association present
     assert len(panel["maxRuleConfidences"]) == 3
+
+
+# -- JSON serialization (lifecycle baselines ride on these) -----------------
+
+class TestHistogramJSON:
+    def test_round_trip_preserves_points_and_queries(self):
+        rng = np.random.default_rng(3)
+        h = StreamingHistogram(32).update_all(rng.normal(size=2000))
+        h2 = StreamingHistogram.from_json(h.to_json())
+        assert h2.max_bins == h.max_bins
+        assert h2.total == pytest.approx(h.total)
+        np.testing.assert_allclose(h2.bins, h.bins)
+        for q in (-1.0, 0.0, 0.7):
+            assert h2.sum_to(q) == pytest.approx(h.sum_to(q))
+        np.testing.assert_allclose(h2.to_fixed_bins(10, -3, 3),
+                                   h.to_fixed_bins(10, -3, 3))
+
+    def test_merge_after_deserialize_equals_merge_before(self):
+        """The drift monitor merges live sketches against deserialized
+        baselines — the monoid must survive the JSON round trip."""
+        rng = np.random.default_rng(4)
+        a = StreamingHistogram(24).update_all(rng.gamma(2.0, size=800))
+        b = StreamingHistogram(24).update_all(rng.gamma(3.0, size=700))
+        direct = a.merge(b)
+        revived = StreamingHistogram.from_json(a.to_json()).merge(
+            StreamingHistogram.from_json(b.to_json()))
+        assert revived.total == pytest.approx(direct.total)
+        np.testing.assert_allclose(revived.bins, direct.bins)
+
+    def test_empty_and_degenerate(self):
+        e = StreamingHistogram.from_json(StreamingHistogram(8).to_json())
+        assert e.total == 0 and e.bins == []
+        one = StreamingHistogram(8).update_all(np.full(50, 3.25))
+        one2 = StreamingHistogram.from_json(one.to_json())
+        assert one2.total == pytest.approx(50)
+        assert one2.bins == [(3.25, 50.0)]
+        # a JSON round trip is plain-JSON-serializable (no numpy scalars)
+        import json as _json
+        _json.dumps(one.to_json())
+
+    def test_feature_distribution_round_trip(self):
+        from transmogrifai_tpu.filters import FeatureDistribution
+        fd = FeatureDistribution("f", key="k", count=10, nulls=2,
+                                 distribution=np.array([1.0, 4.0, 3.0]),
+                                 summary={"min": -1.0, "max": 2.0})
+        fd2 = FeatureDistribution.from_json(fd.to_json())
+        assert (fd2.name, fd2.key, fd2.count, fd2.nulls) == ("f", "k", 10, 2)
+        assert fd2.fill_rate == pytest.approx(fd.fill_rate)
+        np.testing.assert_allclose(fd2.distribution, fd.distribution)
+        assert fd2.js_divergence(fd) == pytest.approx(0.0)
+        empty = FeatureDistribution.from_json(FeatureDistribution("g").to_json())
+        assert empty.count == 0 and empty.distribution.size == 0
+
+    def test_feature_sketch_round_trip(self):
+        from transmogrifai_tpu.filters import FeatureSketch
+        rng = np.random.default_rng(5)
+        num = FeatureSketch("r", None, 100, 7,
+                            histogram=StreamingHistogram(16).update_all(
+                                rng.normal(size=93)))
+        num2 = FeatureSketch.from_json(num.to_json())
+        assert (num2.count, num2.nulls) == (100, 7)
+        assert num2.fill_rate == pytest.approx(num.fill_rate)
+        np.testing.assert_allclose(num2.histogram.bins, num.histogram.bins)
+        txt = FeatureSketch("t", "k", 50, 5,
+                            text_counts=np.arange(8, dtype=np.float64))
+        txt2 = FeatureSketch.from_json(txt.to_json())
+        assert txt2.key == "k" and txt2.histogram is None
+        np.testing.assert_allclose(txt2.text_counts, txt.text_counts)
+        # merge-after-round-trip stays exact for text bins
+        np.testing.assert_allclose(
+            txt2.merge(txt2).text_counts, txt.merge(txt).text_counts)
+
+
+def test_merge_sketches_pads_absent_map_keys():
+    """Regression: a map key seen in only one shard must keep its histogram
+    (numeric) / zero-padded text bins, with the other shard's rows counted
+    as nulls.  The padding branch previously dropped the histogram."""
+    from transmogrifai_tpu import types as T
+    from transmogrifai_tpu.columns import column_from_values, ColumnBatch
+    from transmogrifai_tpu.features import Feature
+    from transmogrifai_tpu.filters import compute_sketches, merge_sketches
+
+    f = [Feature("m", T.RealMap, False, None, parents=()),
+         Feature("s", T.TextMap, False, None, parents=())]
+
+    def batch_of(maps_num, maps_txt):
+        return ColumnBatch(
+            {"m": column_from_values(T.RealMap, maps_num),
+             "s": column_from_values(T.TextMap, maps_txt)}, len(maps_num))
+
+    # shard A has keys a+b, shard B only a — key b must be padded in B
+    sh_a = compute_sketches(f, batch_of(
+        [{"a": 1.0, "b": 2.0}, {"a": 3.0, "b": 4.0}],
+        [{"k": "x"}, {"k": "y"}]))
+    sh_b = compute_sketches(f, batch_of(
+        [{"a": 5.0}, {"a": 6.0}, {"a": 7.0}],
+        [{}, {}, {}]))
+    merged = merge_sketches(sh_a, sh_b)
+
+    b_key = merged[("m", "b")]
+    assert b_key.count == 5 and b_key.nulls == 3      # 3 padded B rows
+    assert b_key.fill_rate == pytest.approx(2 / 5)
+    assert b_key.histogram is not None, "padding must not drop the histogram"
+    assert b_key.histogram.total == pytest.approx(2)  # the two real values
+    np.testing.assert_allclose(
+        [c for c, _ in b_key.histogram.bins], [2.0, 4.0])
+
+    s_key = merged[("s", "k")]
+    assert s_key.count == 5 and s_key.nulls == 3
+    assert s_key.text_counts is not None
+    assert s_key.text_counts.sum() == pytest.approx(2)
+
+    # merge is symmetric
+    flipped = merge_sketches(sh_b, sh_a)
+    assert flipped[("m", "b")].count == 5
+    assert flipped[("m", "b")].histogram.total == pytest.approx(2)

@@ -72,6 +72,17 @@ class FeatureDistribution:
                 "distribution": self.distribution.tolist(),
                 "summary": self.summary}
 
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "FeatureDistribution":
+        # fillRate is derived from count/nulls and not read back
+        return FeatureDistribution(
+            d["name"], key=d.get("key"), count=int(d.get("count", 0)),
+            nulls=int(d.get("nulls", 0)),
+            distribution=np.asarray(d.get("distribution") or [],
+                                    dtype=np.float64),
+            summary={k: float(v)
+                     for k, v in (d.get("summary") or {}).items()})
+
 
 @dataclass
 class FeatureSketch:
@@ -88,6 +99,11 @@ class FeatureSketch:
     histogram: Optional[Any] = None      # StreamingHistogram (numeric kinds)
     text_counts: Optional[np.ndarray] = None  # [text_bins] (text kinds)
 
+    @property
+    def fill_rate(self) -> float:
+        """≙ FeatureDistribution.fill_rate (count = rows seen, nulls ⊆)."""
+        return 0.0 if self.count == 0 else 1.0 - self.nulls / self.count
+
     def merge(self, other: "FeatureSketch") -> "FeatureSketch":
         assert (self.name, self.key) == (other.name, other.key)
         hist = None
@@ -103,6 +119,25 @@ class FeatureSketch:
             tc = za + zb
         return FeatureSketch(self.name, self.key, self.count + other.count,
                              self.nulls + other.nulls, hist, tc)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"name": self.name, "key": self.key, "count": int(self.count),
+                "nulls": int(self.nulls),
+                "histogram": (self.histogram.to_json()
+                              if self.histogram is not None else None),
+                "textCounts": ([float(x) for x in self.text_counts]
+                               if self.text_counts is not None else None)}
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "FeatureSketch":
+        hist = None
+        if d.get("histogram") is not None:
+            from .utils.stats import StreamingHistogram
+            hist = StreamingHistogram.from_json(d["histogram"])
+        tc = (np.asarray(d["textCounts"], dtype=np.float64)
+              if d.get("textCounts") is not None else None)
+        return FeatureSketch(d["name"], d.get("key"), int(d.get("count", 0)),
+                             int(d.get("nulls", 0)), hist, tc)
 
     def to_distribution(self, bins: int) -> FeatureDistribution:
         if self.text_counts is not None:
@@ -194,14 +229,12 @@ def merge_sketches(a: Dict, b: Dict) -> Dict:
         base = side.get((sk.name, None))
         if base is None or base.count == 0:
             return sk
-        missing = FeatureSketch(
-            sk.name, sk.key, base.count, base.count,
-            histogram=None if sk.histogram is None else None,
-            text_counts=None if sk.text_counts is None else
-            np.zeros_like(sk.text_counts))
+        missing = FeatureSketch(sk.name, sk.key, base.count, base.count)
         if sk.histogram is not None:
             from .utils.stats import StreamingHistogram
             missing.histogram = StreamingHistogram(sk.histogram.max_bins)
+        if sk.text_counts is not None:
+            missing.text_counts = np.zeros_like(sk.text_counts)
         return sk.merge(missing)
 
     out: Dict = {}

@@ -1,0 +1,17 @@
+"""Production lifecycle: drift detection → gated retrain → atomic hot-swap.
+
+Closes the train → monitor → retrain → promote loop over the existing
+subsystems: bundle-embedded training baselines (``baselines``), a
+streaming-sketch drift monitor fed from the serving path (``drift``), a
+policy-driven retrain controller with holdout-gated promotion
+(``controller``), and the runner/CLI glue (``service``).
+"""
+
+from .baselines import (BASELINES_JSON, ModelBaselines,  # noqa: F401
+                        build_baselines, load_baselines)
+from .controller import (DriftThresholdPolicy,  # noqa: F401
+                         LifecycleController, LifecycleOutcome,
+                         LifecycleState, ManualPolicy, RetrainPolicy,
+                         ScheduledIntervalPolicy)
+from .drift import DriftMonitor, DriftReport, psi  # noqa: F401
+from .service import drift_check_main, lifecycle_main  # noqa: F401

@@ -1,0 +1,122 @@
+"""Training-time drift baselines bundled with every saved model.
+
+At ``WorkflowModel.save`` time the post-fit training batch is still on the
+model (``train_batch``), so the per-raw-feature ``FeatureSketch``es (streaming
+histograms for numeric kinds, stable-hash bins for text — filters.py) and the
+score distribution can be serialized into the bundle as ``baselines.json``.
+``atomic_bundle_write`` digests every staged file into ``MANIFEST.json``, so
+the baselines are integrity-covered exactly like the model weights.
+
+At serving time ``DriftMonitor`` (lifecycle/drift.py) deserializes these and
+compares the live feed against them with the same streaming-histogram merge
+semantics the training-side filters use.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..filters import FeatureSketch, compute_sketches
+from ..utils.stats import StreamingHistogram
+
+BASELINES_JSON = "baselines.json"
+FORMAT_VERSION = 1
+
+
+@dataclass
+class ModelBaselines:
+    """What the training data looked like, in mergeable-sketch form."""
+
+    features: Dict[Tuple[str, Optional[str]], FeatureSketch] = \
+        field(default_factory=dict)
+    score_histogram: Optional[StreamingHistogram] = None
+    score_feature: Optional[str] = None   # Prediction column name
+    score_field: Optional[str] = None     # e.g. "probability_1"/"prediction"
+    row_count: int = 0
+    max_bins: int = 64
+    text_bins: int = 100                  # live sketches must match this
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"formatVersion": FORMAT_VERSION,
+                "rowCount": int(self.row_count),
+                "maxBins": int(self.max_bins),
+                "textBins": int(self.text_bins),
+                "scoreFeature": self.score_feature,
+                "scoreField": self.score_field,
+                "scoreHistogram": (self.score_histogram.to_json()
+                                   if self.score_histogram is not None
+                                   else None),
+                "features": [sk.to_json() for sk in self.features.values()]}
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "ModelBaselines":
+        feats: Dict[Tuple[str, Optional[str]], FeatureSketch] = {}
+        for sd in d.get("features") or []:
+            sk = FeatureSketch.from_json(sd)
+            feats[(sk.name, sk.key)] = sk
+        hist = None
+        if d.get("scoreHistogram") is not None:
+            hist = StreamingHistogram.from_json(d["scoreHistogram"])
+        return ModelBaselines(
+            features=feats, score_histogram=hist,
+            score_feature=d.get("scoreFeature"),
+            score_field=d.get("scoreField"),
+            row_count=int(d.get("rowCount", 0)),
+            max_bins=int(d.get("maxBins", 64)),
+            text_bins=int(d.get("textBins", 100)))
+
+    def save(self, dirpath: str) -> str:
+        """Write ``baselines.json`` into a bundle staging directory (called
+        inside ``atomic_bundle_write``, so the digest covers it)."""
+        out = os.path.join(dirpath, BASELINES_JSON)
+        with open(out, "w") as fh:
+            json.dump(self.to_json(), fh)
+        return out
+
+
+def build_baselines(model, max_bins: int = 64,
+                    text_bins: int = 100) -> Optional[ModelBaselines]:
+    """Sketch the model's retained training batch; ``None`` when the model
+    has no training batch (e.g. it was loaded from disk and re-saved)."""
+    batch = getattr(model, "train_batch", None)
+    if batch is None or len(batch) == 0:
+        return None
+    feats = [f for f in model.raw_features
+             if not f.is_response and batch.get(f.name) is not None]
+    if not feats:
+        return None
+    sketches = compute_sketches(feats, batch, max_bins=max_bins,
+                                text_bins=text_bins)
+    score_hist = score_feature = score_field = None
+    from ..types import Prediction
+    pred = next((f for f in model.result_features
+                 if f.kind is Prediction and batch.get(f.name) is not None),
+                None)
+    if pred is not None:
+        vals = batch[pred.name].values
+        if isinstance(vals, dict) and vals:
+            score_field = ("probability_1" if "probability_1" in vals
+                           else "prediction" if "prediction" in vals
+                           else next(iter(vals)))
+            arr = np.asarray(vals[score_field], dtype=np.float64)
+            score_hist = StreamingHistogram(max_bins).update_all(arr)
+            score_feature = pred.name
+    return ModelBaselines(features=sketches, score_histogram=score_hist,
+                          score_feature=score_feature,
+                          score_field=score_field, row_count=len(batch),
+                          max_bins=max_bins, text_bins=text_bins)
+
+
+def load_baselines(bundle_path: str) -> Optional[ModelBaselines]:
+    """Read a bundle's ``baselines.json``; ``None`` when the bundle predates
+    the lifecycle subsystem (drift monitoring is then disabled)."""
+    path = os.path.join(bundle_path, BASELINES_JSON)
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        return ModelBaselines.from_json(json.load(fh))

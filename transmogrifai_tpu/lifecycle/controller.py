@@ -1,0 +1,370 @@
+"""LifecycleController — policy-gated retrain and holdout-gated promotion.
+
+Closes the train → monitor → retrain → promote loop:
+
+* pluggable retrain policies (``DriftThresholdPolicy`` on a
+  ``DriftMonitor`` breach, ``ScheduledIntervalPolicy``, ``ManualPolicy``),
+* retrains under ``preemption_guard`` with the selector sweep
+  checkpointed to ``<root>/lifecycle/sweep`` — a SIGTERM (or injected
+  preemption) mid-sweep leaves a resumable checkpoint and the NEXT
+  retrain replays completed candidates instead of refitting them,
+* warm-starts from the incumbent loaded via ``checkpoint.find_latest_valid``
+  (``Workflow.with_model_stages`` reuses matching fitted stages),
+* promotes the candidate only when it beats — or ties within
+  ``tolerance`` — the incumbent's holdout metric; winners become a new
+  ``ckpt-NNNNNN`` bundle under the serving root and trigger
+  ``ScoringEngine.reload_now()`` (atomic hot swap); losers are kept under
+  ``<root>/lifecycle/rejected/`` with a ``REJECTED.json`` marker and a
+  FailureLog entry so an operator can audit why a retrain didn't ship.
+
+Injection points ``lifecycle.retrain`` / ``lifecycle.promote`` let the
+chaos harness kill the loop at either boundary; in both cases the
+incumbent keeps serving.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..checkpoint import (TrainingPreempted, bundle_version,
+                          find_latest_valid, next_version_dir,
+                          preemption_guard, write_json_atomic)
+from ..resilience import maybe_inject, record_failure
+from ..telemetry import REGISTRY, MetricsRegistry, event, span
+from .drift import DriftMonitor, DriftReport
+
+SWEEP_SUBDIR = os.path.join("lifecycle", "sweep")
+REJECTED_SUBDIR = os.path.join("lifecycle", "rejected")
+REJECTED_MARKER = "REJECTED.json"
+
+
+# -- retrain policies --------------------------------------------------------
+class RetrainPolicy:
+    """Decides whether a retrain should fire; returns a human-readable
+    reason string, or ``None`` to stay put."""
+
+    name = "policy"
+
+    def should_retrain(self, report: Optional[DriftReport],
+                       state: "LifecycleState") -> Optional[str]:
+        raise NotImplementedError
+
+
+class DriftThresholdPolicy(RetrainPolicy):
+    """Fire when the drift monitor reports a breach (optionally rate-limited
+    so a persistently-drifted feed can't retrain in a tight loop)."""
+
+    name = "drift"
+
+    def __init__(self, min_interval_s: float = 0.0):
+        self.min_interval_s = float(min_interval_s)
+
+    def should_retrain(self, report, state):
+        if report is None or not report.breached:
+            return None
+        if self.min_interval_s and state.last_retrain_s is not None and \
+                time.time() - state.last_retrain_s < self.min_interval_s:
+            return None
+        return "drift breach: " + "; ".join(report.reasons[:3])
+
+
+class ScheduledIntervalPolicy(RetrainPolicy):
+    """Fire every ``interval_s`` seconds regardless of drift."""
+
+    name = "interval"
+
+    def __init__(self, interval_s: float, time_fn: Callable[[], float] = time.time):
+        self.interval_s = float(interval_s)
+        self.time_fn = time_fn
+        self._anchor: Optional[float] = None
+
+    def should_retrain(self, report, state):
+        now = self.time_fn()
+        if self._anchor is None:
+            self._anchor = now
+        ref = state.last_retrain_s if state.last_retrain_s is not None \
+            else self._anchor
+        if now - ref >= self.interval_s:
+            return f"scheduled retrain (interval {self.interval_s:g}s)"
+        return None
+
+
+class ManualPolicy(RetrainPolicy):
+    """Fire once per explicit ``trigger()`` call (operator-driven)."""
+
+    name = "manual"
+
+    def __init__(self):
+        self._pending: Optional[str] = None
+
+    def trigger(self, reason: str = "manual trigger") -> None:
+        self._pending = reason
+
+    def should_retrain(self, report, state):
+        reason, self._pending = self._pending, None
+        return reason
+
+
+# -- controller --------------------------------------------------------------
+@dataclass
+class LifecycleOutcome:
+    """What one retrain attempt did."""
+
+    status: str                      # promoted|rejected|preempted|failed
+    reason: str = ""
+    policy: str = ""
+    metric_name: str = ""
+    candidate_metric: Optional[float] = None
+    incumbent_metric: Optional[float] = None
+    candidate_path: Optional[str] = None
+    bundle_version: Optional[str] = None
+    resume_from: Optional[str] = None
+    swapped: bool = False
+    error: str = ""
+    train_failures: Dict[str, int] = field(default_factory=dict)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"status": self.status, "reason": self.reason,
+                "policy": self.policy, "metricName": self.metric_name,
+                "candidateMetric": self.candidate_metric,
+                "incumbentMetric": self.incumbent_metric,
+                "candidatePath": self.candidate_path,
+                "bundleVersion": self.bundle_version,
+                "resumeFrom": self.resume_from, "swapped": self.swapped,
+                "error": self.error, "trainFailures": self.train_failures}
+
+
+@dataclass
+class LifecycleState:
+    retrains_total: int = 0
+    promotions_total: int = 0
+    rejections_total: int = 0
+    preemptions_total: int = 0
+    failed_retrains_total: int = 0
+    last_retrain_s: Optional[float] = None
+    last_outcome: Optional[LifecycleOutcome] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"retrains": self.retrains_total,
+                "promotions": self.promotions_total,
+                "rejections": self.rejections_total,
+                "preemptions": self.preemptions_total,
+                "failedRetrains": self.failed_retrains_total,
+                "lastOutcome": (self.last_outcome.to_json()
+                                if self.last_outcome else None)}
+
+
+class LifecycleController:
+    """See module docstring.
+
+    ``workflow_factory`` builds (or returns) the ``Workflow`` to retrain
+    with — its reader must point at the CURRENT training source, so a
+    retrain fits on post-shift data.  ``holdout_records`` (raw dicts) or
+    ``holdout_reader`` supplies labeled evaluation data for the gate."""
+
+    def __init__(self, workflow_factory: Callable[[], Any],
+                 checkpoint_root: str, evaluator, *,
+                 holdout_records: Optional[List[Dict[str, Any]]] = None,
+                 holdout_reader=None,
+                 monitor: Optional[DriftMonitor] = None,
+                 policies: Sequence[RetrainPolicy] = (),
+                 engine=None, tolerance: float = 0.0,
+                 warm_start: bool = True,
+                 registry: Optional[MetricsRegistry] = None):
+        if holdout_records is None and holdout_reader is None:
+            raise ValueError("LifecycleController needs holdout_records or "
+                             "holdout_reader for the promotion gate")
+        self.workflow_factory = workflow_factory
+        self.root = checkpoint_root
+        self.evaluator = evaluator
+        self.holdout_records = holdout_records
+        self.holdout_reader = holdout_reader
+        self.monitor = monitor
+        self.policies = list(policies)
+        self.engine = engine
+        self.tolerance = float(tolerance)
+        self.warm_start = bool(warm_start)
+        self.registry = registry if registry is not None else REGISTRY
+        self.state = LifecycleState()
+
+    # -- evaluation helpers ------------------------------------------------
+    def _holdout_batch(self, model):
+        if self.holdout_reader is not None:
+            return self.holdout_reader.generate_batch(model.raw_features)
+        from ..readers import DataReader
+        return DataReader(records=self.holdout_records).generate_batch(
+            model.raw_features)
+
+    def _holdout_metric(self, model) -> float:
+        metrics = model.evaluate(self.evaluator,
+                                 batch=self._holdout_batch(model))
+        return float(metrics[self.evaluator.default_metric])
+
+    def _load_incumbent(self):
+        """(model, bundle_path) of the newest valid version, or (None, None)
+        for a fresh root — the first promotion then ships unopposed."""
+        from ..workflow import WorkflowModel
+        try:
+            path = find_latest_valid(self.root)
+            return WorkflowModel.load(path), path
+        except Exception as e:  # noqa: BLE001 — empty/corrupt root is fine
+            record_failure("lifecycle", "skipped", e, point="checkpoint.load",
+                           detail="no incumbent; candidate ships if it "
+                                  "clears the holdout")
+            return None, None
+
+    # -- the loop ----------------------------------------------------------
+    def run_once(self) -> Optional[LifecycleOutcome]:
+        """One control iteration: evaluate drift, poll policies in order,
+        retrain on the first that fires.  ``None`` when nothing fired."""
+        report = self.monitor.evaluate() if self.monitor is not None else None
+        for policy in self.policies:
+            reason = policy.should_retrain(report, self.state)
+            if reason:
+                return self.retrain_and_promote(reason, policy=policy.name)
+        return None
+
+    def retrain_and_promote(self, reason: str,
+                            policy: str = "manual") -> LifecycleOutcome:
+        self.state.retrains_total += 1
+        self.state.last_retrain_s = time.time()
+        self.registry.counter("lifecycle.retrains_total").inc()
+        sweep_dir = os.path.join(self.root, SWEEP_SUBDIR)
+        with span("lifecycle.retrain", reason=reason, policy=policy,
+                  attempt=self.state.retrains_total):
+            event("lifecycle.retrain", reason=reason, policy=policy)
+            outcome = self._retrain_inner(reason, policy, sweep_dir)
+        self.state.last_outcome = outcome
+        return outcome
+
+    def _retrain_inner(self, reason: str, policy: str,
+                       sweep_dir: str) -> LifecycleOutcome:
+        try:
+            maybe_inject("lifecycle.retrain",
+                         key=str(self.state.retrains_total))
+        except Exception as e:  # noqa: BLE001 — injected chaos
+            return self._failed(reason, policy, e, "lifecycle.retrain")
+        incumbent, incumbent_path = self._load_incumbent()
+        wf = self.workflow_factory()
+        if self.warm_start and incumbent is not None:
+            wf.with_model_stages(incumbent)
+        try:
+            with preemption_guard("lifecycle"):
+                candidate = wf.train(resume_from=sweep_dir)
+        except TrainingPreempted as e:
+            self.state.preemptions_total += 1
+            self.registry.counter("lifecycle.preemptions_total").inc()
+            resume = getattr(e, "resume_from", None) or sweep_dir
+            record_failure("lifecycle", "preempted", e,
+                           point="lifecycle.retrain", resume_from=resume)
+            return LifecycleOutcome("preempted", reason=reason, policy=policy,
+                                    resume_from=resume, error=str(e))
+        except Exception as e:  # noqa: BLE001 — a failed retrain must not
+            #                     take the incumbent down with it
+            return self._failed(reason, policy, e, "lifecycle.retrain")
+        outcome = self._promote_if_better(candidate, incumbent, reason,
+                                          policy)
+        flog = getattr(candidate, "failure_log", None)
+        if flog is not None:
+            outcome.train_failures = flog.summary()
+        if outcome.status in ("promoted", "rejected"):
+            # the sweep served its purpose; keeping it would make the NEXT
+            # retrain replay THIS sweep's fits (candidate signatures don't
+            # hash the training data) instead of fitting fresh data
+            shutil.rmtree(sweep_dir, ignore_errors=True)
+        return outcome
+
+    def _failed(self, reason: str, policy: str, e: Exception,
+                point: str) -> LifecycleOutcome:
+        self.state.failed_retrains_total += 1
+        self.registry.counter("lifecycle.failed_retrains_total").inc()
+        record_failure("lifecycle", "skipped", e, point=point)
+        return LifecycleOutcome("failed", reason=reason, policy=policy,
+                                error=f"{type(e).__name__}: {e}")
+
+    def _promote_if_better(self, candidate, incumbent, reason: str,
+                           policy: str) -> LifecycleOutcome:
+        metric_name = self.evaluator.default_metric
+        larger = getattr(self.evaluator, "is_larger_better", True)
+        with span("lifecycle.promote", metric=metric_name):
+            cand_m = self._holdout_metric(candidate)
+            inc_m = (self._holdout_metric(incumbent)
+                     if incumbent is not None else None)
+            if inc_m is None:
+                wins = True
+            elif larger:
+                wins = cand_m >= inc_m - self.tolerance
+            else:
+                wins = cand_m <= inc_m + self.tolerance
+            try:
+                maybe_inject("lifecycle.promote",
+                             key=str(self.state.retrains_total))
+            except Exception as e:  # noqa: BLE001 — injected chaos: die
+                #                     right before the commit; incumbent
+                #                     keeps serving
+                return self._failed(reason, policy, e, "lifecycle.promote")
+            if wins:
+                return self._promote(candidate, reason, policy, metric_name,
+                                     cand_m, inc_m)
+            return self._reject(candidate, reason, policy, metric_name,
+                                cand_m, inc_m)
+
+    def _promote(self, candidate, reason, policy, metric_name,
+                 cand_m, inc_m) -> LifecycleOutcome:
+        path = next_version_dir(self.root)
+        candidate.save(path)
+        version = bundle_version(path)
+        self.state.promotions_total += 1
+        self.registry.counter("lifecycle.promotions_total").inc()
+        record_failure("lifecycle", "promoted", None,
+                       point="lifecycle.promote", bundle=path,
+                       metric=metric_name, candidate_metric=cand_m,
+                       incumbent_metric=inc_m, reason=reason)
+        event("lifecycle.promoted", bundle=version, metric=metric_name,
+              candidate_metric=cand_m, incumbent_metric=inc_m)
+        swapped = False
+        if self.engine is not None:
+            swapped = bool(self.engine.reload_now())
+        elif self.monitor is not None:
+            # no engine to rebase it on swap — rebase directly
+            from .baselines import load_baselines
+            self.monitor.rebase(load_baselines(path),
+                                [f for f in candidate.raw_features
+                                 if not f.is_response])
+        return LifecycleOutcome("promoted", reason=reason, policy=policy,
+                                metric_name=metric_name,
+                                candidate_metric=cand_m,
+                                incumbent_metric=inc_m, candidate_path=path,
+                                bundle_version=version, swapped=swapped)
+
+    def _reject(self, candidate, reason, policy, metric_name,
+                cand_m, inc_m) -> LifecycleOutcome:
+        # the loser is preserved for audit under <root>/lifecycle/rejected/
+        # ("lifecycle" is not a bundle dir, so find_latest_valid never
+        # serves it); the marker is written AFTER the atomic save —
+        # verify_bundle ignores files outside the manifest
+        path = next_version_dir(os.path.join(self.root, REJECTED_SUBDIR))
+        candidate.save(path)
+        write_json_atomic(os.path.join(path, REJECTED_MARKER),
+                          {"reason": reason, "metric": metric_name,
+                           "candidateMetric": cand_m,
+                           "incumbentMetric": inc_m,
+                           "tolerance": self.tolerance,
+                           "rejectedAt": time.time()})
+        self.state.rejections_total += 1
+        self.registry.counter("lifecycle.rejections_total").inc()
+        record_failure("lifecycle", "rejected",
+                       f"candidate {metric_name}={cand_m:.4f} did not beat "
+                       f"incumbent {metric_name}={inc_m:.4f} "
+                       f"(tolerance {self.tolerance})",
+                       point="lifecycle.promote", bundle=path)
+        event("lifecycle.rejected", bundle=path, metric=metric_name,
+              candidate_metric=cand_m, incumbent_metric=inc_m)
+        return LifecycleOutcome("rejected", reason=reason, policy=policy,
+                                metric_name=metric_name,
+                                candidate_metric=cand_m,
+                                incumbent_metric=inc_m, candidate_path=path)

@@ -1,0 +1,333 @@
+"""DriftMonitor — live feature/score distributions vs. bundle baselines.
+
+Fed from the serving path (a ``ScoringEngine`` batch observer) or from a
+``StreamingReader`` pump, the monitor accumulates the SAME mergeable
+``FeatureSketch``es the training-side filters build (``compute_sketches`` +
+``merge_sketches``), then ``evaluate()`` compares them against the bundle's
+training-time baselines:
+
+* per-feature fill-rate delta,
+* per-feature PSI + Jensen-Shannon divergence over a SHARED fixed binning
+  (the union of both sketches' centroid ranges — without a shared range a
+  pure mean shift would bin to near-identical shapes and never fire),
+* score-distribution PSI.
+
+Results export through a ``MetricsRegistry`` (the engine's, when attached —
+they surface on ``/metrics``) and as ``drift.*`` telemetry spans/events.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..filters import (FeatureDistribution, FeatureSketch, compute_sketches,
+                       merge_sketches)
+from ..telemetry import MetricsRegistry, event, span
+from ..utils.stats import StreamingHistogram
+from .baselines import ModelBaselines
+
+
+def psi(expected, actual, eps: float = 1e-4) -> float:
+    """Population Stability Index between two binned counts/frequencies.
+    Zero-probability bins are clipped to ``eps`` (then renormalized) so a
+    bin empty on one side contributes a large-but-finite term."""
+    e = np.asarray(expected, dtype=np.float64)
+    a = np.asarray(actual, dtype=np.float64)
+    if e.size == 0 or a.size == 0 or e.size != a.size:
+        return 0.0
+    if e.sum() <= 0 or a.sum() <= 0:
+        return 0.0
+    e = np.clip(e / e.sum(), eps, None)
+    a = np.clip(a / a.sum(), eps, None)
+    e, a = e / e.sum(), a / a.sum()
+    return float(np.sum((a - e) * np.log(a / e)))
+
+
+def _shared_range(a: StreamingHistogram,
+                  b: StreamingHistogram) -> Tuple[float, float]:
+    pts = [p for p, _ in a.bins] + [p for p, _ in b.bins]
+    if not pts:
+        return 0.0, 1.0
+    lo, hi = min(pts), max(pts)
+    if hi <= lo:
+        hi = lo + 1.0
+    return lo, hi
+
+
+def _label(name: str, key: Optional[str]) -> str:
+    return name if key is None else f"{name}[{key}]"
+
+
+@dataclass
+class FeatureDriftStat:
+    name: str
+    key: Optional[str]
+    psi: float
+    js: float
+    fill_rate: float
+    baseline_fill_rate: float
+    fill_delta: float
+    rows: int
+    reasons: List[str] = field(default_factory=list)
+
+    @property
+    def breached(self) -> bool:
+        return bool(self.reasons)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"feature": _label(self.name, self.key), "psi": self.psi,
+                "jsDivergence": self.js, "fillRate": self.fill_rate,
+                "baselineFillRate": self.baseline_fill_rate,
+                "fillDelta": self.fill_delta, "rows": self.rows,
+                "breached": self.breached, "reasons": self.reasons}
+
+
+@dataclass
+class DriftReport:
+    ready: bool
+    rows: int
+    score_rows: int
+    score_psi: float
+    features: List[FeatureDriftStat] = field(default_factory=list)
+    reasons: List[str] = field(default_factory=list)
+
+    @property
+    def breached(self) -> bool:
+        return bool(self.reasons)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"ready": self.ready, "rows": self.rows,
+                "scoreRows": self.score_rows, "scorePsi": self.score_psi,
+                "breached": self.breached, "reasons": self.reasons,
+                "features": [f.to_json() for f in self.features]}
+
+
+class DriftMonitor:
+    """Accumulates live sketches and scores; ``evaluate()`` produces a
+    ``DriftReport`` and exports ``drift.*`` gauges/counters/events.
+
+    Thread-safe: serving batch observers feed it concurrently with the
+    controller's ``evaluate()`` calls."""
+
+    def __init__(self, baselines: Optional[ModelBaselines],
+                 raw_features: Sequence = (), *,
+                 registry: Optional[MetricsRegistry] = None,
+                 psi_threshold: float = 0.25,
+                 score_psi_threshold: float = 0.25,
+                 fill_delta_threshold: float = 0.2,
+                 min_rows: int = 50, bins: int = 10):
+        # 10 fixed bins: finer binnings inflate PSI on small live windows
+        # (empty tail bins hit the epsilon clip and each contributes a
+        # spurious ~eps*log term)
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.psi_threshold = float(psi_threshold)
+        self.score_psi_threshold = float(score_psi_threshold)
+        self.fill_delta_threshold = float(fill_delta_threshold)
+        self.min_rows = int(min_rows)
+        self.bins = int(bins)
+        self.last_report: Optional[DriftReport] = None
+        self._lock = threading.Lock()
+        self._set_baselines(baselines, raw_features)
+
+    @classmethod
+    def for_model(cls, model, **kw) -> Optional["DriftMonitor"]:
+        """Monitor for a loaded ``WorkflowModel``; ``None`` (drift disabled,
+        recorded as a degradation) when its bundle carries no baselines."""
+        baselines = getattr(model, "baselines", None)
+        if baselines is None:
+            from ..resilience import record_failure
+            record_failure(
+                "drift", "degraded",
+                "model bundle has no baselines.json (pre-lifecycle build); "
+                "drift monitoring disabled", point="checkpoint.load")
+            return None
+        raw = [f for f in model.raw_features if not f.is_response]
+        return cls(baselines, raw, **kw)
+
+    def _set_baselines(self, baselines: Optional[ModelBaselines],
+                       raw_features: Sequence) -> None:
+        self.baselines = baselines
+        self.raw_features = list(raw_features)
+        self.enabled = baselines is not None
+        max_bins = baselines.max_bins if baselines is not None else 64
+        self._live: Dict[Tuple[str, Optional[str]], FeatureSketch] = {}
+        self._score_hist = StreamingHistogram(max_bins)
+        self._rows = 0
+
+    # -- observation -------------------------------------------------------
+    def observe_batch(self, batch) -> None:
+        """Accumulate a raw ``ColumnBatch`` from the live feed (the same
+        sketch/merge path training uses, so live and baseline distributions
+        are directly comparable)."""
+        if not self.enabled or len(batch) == 0:
+            return
+        sketches = compute_sketches(self.raw_features, batch,
+                                    max_bins=self.baselines.max_bins,
+                                    text_bins=self.baselines.text_bins)
+        with self._lock:
+            self._live = merge_sketches(self._live, sketches)
+            self._rows += len(batch)
+
+    def observe_records(self, records: List[Dict[str, Any]]) -> None:
+        """Accumulate raw serving records (the engine observer path)."""
+        if not self.enabled or not records:
+            return
+        from ..serving.engine import records_to_batch
+        self.observe_batch(records_to_batch(self.raw_features, records))
+
+    def observe_scores(self, values) -> None:
+        if not self.enabled:
+            return
+        arr = np.asarray(values, dtype=np.float64)
+        with self._lock:
+            self._score_hist.update_all(arr)
+
+    def observe_results(self, results: List[Dict[str, Any]]) -> None:
+        """Pull score values out of serving result rows (the Prediction
+        column serializes as a dict of named values)."""
+        if not self.enabled or self.baselines.score_feature is None:
+            return
+        vals = []
+        for r in results:
+            d = r.get(self.baselines.score_feature) if isinstance(r, dict) \
+                else None
+            if isinstance(d, dict):
+                v = d.get(self.baselines.score_field, d.get("prediction"))
+                if v is not None:
+                    vals.append(float(np.asarray(v).reshape(-1)[0]))
+        if vals:
+            self.observe_scores(vals)
+
+    def observe_serving(self, records: List[Dict[str, Any]],
+                        results: List[Dict[str, Any]]) -> None:
+        """ScoringEngine batch-observer entry point."""
+        self.observe_records(records)
+        self.observe_results(results)
+
+    @property
+    def rows_observed(self) -> int:
+        return self._rows
+
+    # -- evaluation --------------------------------------------------------
+    def evaluate(self) -> DriftReport:
+        """Compare the accumulated window against the baselines."""
+        with span("drift.evaluate", rows=self._rows):
+            with self._lock:
+                report = self._evaluate_locked()
+        g = self.registry.gauge
+        for f in report.features:
+            lbl = _label(f.name, f.key)
+            g(f"drift.psi.{lbl}").set(f.psi)
+            g(f"drift.fill_delta.{lbl}").set(f.fill_delta)
+        g("drift.score_psi").set(report.score_psi)
+        g("drift.rows_observed").set(report.rows)
+        self.registry.counter("drift.evaluations_total").inc()
+        if report.breached:
+            self.registry.counter("drift.breaches_total").inc()
+            for f in report.features:
+                if f.breached:
+                    event("drift.breach", feature=_label(f.name, f.key),
+                          psi=f.psi, fill_delta=f.fill_delta,
+                          reasons="; ".join(f.reasons))
+            if report.score_psi > self.score_psi_threshold and \
+                    report.score_rows >= self.min_rows:
+                event("drift.breach", feature="__score__",
+                      psi=report.score_psi)
+        self.last_report = report
+        return report
+
+    def _evaluate_locked(self) -> DriftReport:
+        if not self.enabled:
+            return DriftReport(ready=False, rows=0, score_rows=0,
+                               score_psi=0.0)
+        rows = self._rows
+        ready = rows >= self.min_rows
+        feats: List[FeatureDriftStat] = []
+        reasons: List[str] = []
+        for (name, key), base in sorted(self.baselines.features.items(),
+                                        key=lambda kv: (kv[0][0],
+                                                        kv[0][1] or "")):
+            live = self._live.get((name, key))
+            if live is None or live.count == 0:
+                continue
+            fill_delta = abs(base.fill_rate - live.fill_rate)
+            if base.histogram is not None or live.histogram is not None:
+                bh = base.histogram or StreamingHistogram()
+                lh = live.histogram or StreamingHistogram()
+                lo, hi = _shared_range(bh, lh)
+                p = bh.to_fixed_bins(self.bins, lo, hi)
+                q = lh.to_fixed_bins(self.bins, lo, hi)
+            else:
+                p = np.asarray(base.text_counts if base.text_counts is not None
+                               else [], dtype=np.float64)
+                q = np.asarray(live.text_counts if live.text_counts is not None
+                               else [], dtype=np.float64)
+            psi_v = psi(p, q)
+            js = FeatureDistribution(
+                name, key=key, count=base.count, nulls=base.nulls,
+                distribution=np.asarray(p, dtype=np.float64)).js_divergence(
+                FeatureDistribution(
+                    name, key=key, count=live.count, nulls=live.nulls,
+                    distribution=np.asarray(q, dtype=np.float64)))
+            freasons: List[str] = []
+            if ready:
+                if psi_v > self.psi_threshold:
+                    freasons.append(
+                        f"{_label(name, key)}: PSI {psi_v:.3f} > "
+                        f"{self.psi_threshold}")
+                if fill_delta > self.fill_delta_threshold:
+                    freasons.append(
+                        f"{_label(name, key)}: fill-rate delta "
+                        f"{fill_delta:.3f} > {self.fill_delta_threshold}")
+            feats.append(FeatureDriftStat(
+                name=name, key=key, psi=psi_v, js=js,
+                fill_rate=live.fill_rate, baseline_fill_rate=base.fill_rate,
+                fill_delta=fill_delta, rows=live.count, reasons=freasons))
+            reasons.extend(freasons)
+        score_psi = 0.0
+        score_rows = int(self._score_hist.total)
+        if self.baselines.score_histogram is not None and score_rows > 0:
+            bh, lh = self.baselines.score_histogram, self._score_hist
+            lo, hi = _shared_range(bh, lh)
+            score_psi = psi(bh.to_fixed_bins(self.bins, lo, hi),
+                            lh.to_fixed_bins(self.bins, lo, hi))
+            if score_rows >= self.min_rows and \
+                    score_psi > self.score_psi_threshold:
+                reasons.append(f"score distribution: PSI {score_psi:.3f} > "
+                               f"{self.score_psi_threshold}")
+        return DriftReport(ready=ready, rows=rows, score_rows=score_rows,
+                           score_psi=score_psi, features=feats,
+                           reasons=reasons)
+
+    # -- lifecycle ---------------------------------------------------------
+    def reset(self) -> None:
+        """Start a fresh observation window (baselines unchanged)."""
+        with self._lock:
+            self._live = {}
+            self._score_hist = StreamingHistogram(
+                self.baselines.max_bins if self.baselines is not None else 64)
+            self._rows = 0
+
+    def rebase(self, baselines: Optional[ModelBaselines],
+               raw_features: Optional[Sequence] = None) -> None:
+        """Swap in a newly-promoted model's baselines and reset the window.
+        ``None`` disables the monitor (promoted bundle without baselines)."""
+        with self._lock:
+            self._set_baselines(
+                baselines,
+                raw_features if raw_features is not None
+                else self.raw_features)
+        if baselines is None:
+            from ..resilience import record_failure
+            record_failure(
+                "drift", "degraded",
+                "promoted bundle has no baselines.json; drift monitoring "
+                "disabled until the next promotion", point="serving.reload")
+
+    def rebase_to_model(self, model) -> None:
+        self.rebase(getattr(model, "baselines", None),
+                    [f for f in model.raw_features if not f.is_response])

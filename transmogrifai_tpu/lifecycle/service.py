@@ -1,0 +1,190 @@
+"""Lifecycle service glue — StreamingReader live feed → DriftMonitor →
+LifecycleController, wired for the runner (``--run-type lifecycle``) and the
+``lifecycle`` CLI subcommand (one-shot drift check).
+
+``lifecycle_main`` is the runner entry point: it seeds the serving root with
+a first trained bundle when empty, builds the drift monitor from the
+incumbent's baselines, pumps live micro-batches (with shadow scoring for
+score-distribution PSI), and runs bounded controller iterations under
+``preemption_guard``.  Knobs ride in ``OpParams.lifecycle``
+("lifecycleParams"): ``psiThreshold``, ``scorePsiThreshold``,
+``fillDeltaThreshold``, ``minRows``, ``tolerance``, ``policy``
+(``drift``/``interval``), ``intervalS``, ``forceRetrain``,
+``maxIterations``, ``batchesPerCheck``, ``pollS``, ``warmStart``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..checkpoint import (find_latest_valid, next_version_dir,
+                          preemption_guard, shutdown_requested)
+from ..resilience import FailureLog, record_failure, use_failure_log
+from ..telemetry import event, span
+from .controller import (DriftThresholdPolicy, LifecycleController,
+                         ManualPolicy, RetrainPolicy, ScheduledIntervalPolicy)
+from .drift import DriftMonitor
+
+
+def pump_stream(monitor: DriftMonitor, stream, shadow_model=None,
+                max_batches: Optional[int] = None) -> int:
+    """Feed live micro-batches into the monitor; with ``shadow_model`` the
+    batch is also scored so score-distribution PSI sees the live feed even
+    when no serving engine is attached.  Returns batches consumed."""
+    n = 0
+    for batch in stream:
+        if max_batches is not None and n >= max_batches:
+            break
+        monitor.observe_batch(batch)
+        if shadow_model is not None and monitor.enabled and \
+                monitor.baselines.score_feature is not None:
+            try:
+                scored = shadow_model.score(batch=batch)
+                col = scored.get(monitor.baselines.score_feature)
+                if col is not None and isinstance(col.values, dict):
+                    vals = col.values.get(monitor.baselines.score_field)
+                    if vals is None:
+                        vals = col.values.get("prediction")
+                    if vals is not None:
+                        monitor.observe_scores(
+                            np.asarray(vals, dtype=np.float64))
+            except Exception as e:  # noqa: BLE001 — shadow scoring is
+                #                     best-effort observability
+                record_failure("lifecycle", "swallowed", e,
+                               point="drift.observe")
+        n += 1
+    return n
+
+
+def _build_policies(cfg: Dict[str, Any],
+                    monitor: Optional[DriftMonitor]) -> List[RetrainPolicy]:
+    policies: List[RetrainPolicy] = []
+    if cfg.get("forceRetrain"):
+        manual = ManualPolicy()
+        manual.trigger("forced retrain (lifecycleParams.forceRetrain)")
+        policies.append(manual)
+    policy = cfg.get("policy", "drift")
+    if policy == "interval" or cfg.get("intervalS") is not None:
+        policies.append(
+            ScheduledIntervalPolicy(float(cfg.get("intervalS", 3600.0))))
+    if policy == "drift" and monitor is not None:
+        policies.append(DriftThresholdPolicy(
+            min_interval_s=float(cfg.get("minRetrainIntervalS", 0.0))))
+    return policies
+
+
+def lifecycle_main(workflow, root: str, *, evaluator=None, live_reader=None,
+                   holdout_reader=None, engine=None,
+                   config: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Bounded lifecycle loop; returns a JSON-able run summary."""
+    from ..workflow import WorkflowModel
+    cfg = dict(config or {})
+    if evaluator is None:
+        from ..evaluators import OpBinaryClassificationEvaluator
+        evaluator = OpBinaryClassificationEvaluator()
+    flog = FailureLog()
+    outcomes: List[Optional[Dict[str, Any]]] = []
+    ingested = 0
+    with use_failure_log(flog), preemption_guard("lifecycle"), \
+            span("lifecycle.run", root=root):
+        # seed: an empty root gets a first trained bundle, so there is
+        # always an incumbent to monitor and gate against
+        try:
+            latest = find_latest_valid(root)
+        except Exception:  # noqa: BLE001 — empty or absent root
+            seed = workflow.train()
+            latest = next_version_dir(root)
+            seed.save(latest)
+            event("lifecycle.seeded", bundle=latest)
+        incumbent = WorkflowModel.load(latest)
+        from ..telemetry import REGISTRY
+        monitor = DriftMonitor.for_model(
+            incumbent, registry=REGISTRY,
+            psi_threshold=float(cfg.get("psiThreshold", 0.25)),
+            score_psi_threshold=float(cfg.get("scorePsiThreshold", 0.25)),
+            fill_delta_threshold=float(cfg.get("fillDeltaThreshold", 0.2)),
+            min_rows=int(cfg.get("minRows", 50)),
+            bins=int(cfg.get("bins", 10)))
+        if live_reader is not None and \
+                hasattr(live_reader, "set_raw_features"):
+            live_reader.set_raw_features(
+                [f for f in incumbent.raw_features if not f.is_response])
+        controller = LifecycleController(
+            lambda: workflow, root, evaluator,
+            holdout_reader=holdout_reader or workflow.reader,
+            monitor=monitor, policies=_build_policies(cfg, monitor),
+            engine=engine, tolerance=float(cfg.get("tolerance", 0.0)),
+            warm_start=bool(cfg.get("warmStart", True)))
+        stream = (iter(live_reader.stream())
+                  if live_reader is not None and
+                  hasattr(live_reader, "stream") else None)
+        per_check = cfg.get("batchesPerCheck")
+        per_check = int(per_check) if per_check is not None else None
+        iterations = int(cfg.get("maxIterations", 1))
+        shadow = incumbent
+        for i in range(iterations):
+            if shutdown_requested(key=f"lifecycle-{i}"):
+                break
+            if stream is not None and monitor is not None:
+                ingested += pump_stream(monitor, stream, shadow_model=shadow,
+                                        max_batches=per_check)
+            outcome = controller.run_once()
+            outcomes.append(outcome.to_json() if outcome else None)
+            if outcome is not None and outcome.status == "promoted" and \
+                    outcome.candidate_path:
+                shadow = WorkflowModel.load(outcome.candidate_path)
+            if i + 1 < iterations and cfg.get("pollS"):
+                time.sleep(float(cfg["pollS"]))
+    return {"root": root, "iterations": len(outcomes),
+            "batchesIngested": ingested,
+            "state": controller.state.to_json(), "outcomes": outcomes,
+            "driftReport": (monitor.last_report.to_json()
+                            if monitor is not None and
+                            monitor.last_report is not None else None),
+            "driftEnabled": monitor is not None,
+            "failures": flog.summary()}
+
+
+def drift_check_main(model_location: str, records_path: str, *,
+                     psi_threshold: float = 0.25,
+                     score_psi_threshold: float = 0.25,
+                     fill_delta_threshold: float = 0.2, min_rows: int = 50,
+                     shadow_score: bool = False, out=print) -> int:
+    """``lifecycle`` CLI subcommand: drift-check a JSONL sample of raw
+    records against a saved model's baselines.  Exit codes: 0 ok, 2 drift
+    breach, 3 baselines missing (drift disabled)."""
+    from ..workflow import WorkflowModel
+    model = WorkflowModel.load(model_location)
+    monitor = DriftMonitor.for_model(
+        model, psi_threshold=psi_threshold,
+        score_psi_threshold=score_psi_threshold,
+        fill_delta_threshold=fill_delta_threshold, min_rows=min_rows)
+    if monitor is None:
+        out(json.dumps({"enabled": False,
+                        "reason": "bundle has no baselines.json (saved by a "
+                                  "pre-lifecycle build)"}, indent=2))
+        return 3
+    with open(records_path) as fh:
+        records = [json.loads(line) for line in fh if line.strip()]
+    monitor.observe_records(records)
+    if shadow_score and monitor.baselines.score_feature is not None:
+        from ..readers import DataReader
+        batch = DataReader(records=records).generate_batch(
+            monitor.raw_features)
+        try:
+            scored = model.score(batch=batch)
+            col = scored.get(monitor.baselines.score_feature)
+            if col is not None and isinstance(col.values, dict):
+                vals = col.values.get(monitor.baselines.score_field)
+                if vals is not None:
+                    monitor.observe_scores(np.asarray(vals,
+                                                      dtype=np.float64))
+        except Exception as e:  # noqa: BLE001
+            record_failure("lifecycle", "swallowed", e, point="drift.observe")
+    report = monitor.evaluate()
+    out(json.dumps(report.to_json(), indent=2))
+    return 2 if report.breached else 0

@@ -41,6 +41,11 @@ class OpParams:
     # telemetry knobs: traceDir (where chrome-trace + telemetry.json land),
     # enabled (default: true when traceDir is set), summaryTopN
     telemetry: Dict[str, Any] = field(default_factory=dict)
+    # lifecycle knobs (run-type "lifecycle"): policy, psiThreshold,
+    # scorePsiThreshold, fillDeltaThreshold, minRows, intervalS,
+    # minRetrainIntervalS, tolerance, warmStart, maxIterations,
+    # batchesPerCheck, pollS, forceRetrain
+    lifecycle: Dict[str, Any] = field(default_factory=dict)
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "OpParams":
@@ -61,7 +66,8 @@ class OpParams:
             collect_metrics=bool(d.get("collectMetrics", False)),
             serving=d.get("servingParams") or {},
             racing=d.get("racingParams") or {},
-            telemetry=d.get("telemetryParams") or {})
+            telemetry=d.get("telemetryParams") or {},
+            lifecycle=d.get("lifecycleParams") or {})
 
     @staticmethod
     def load(path: str) -> "OpParams":
@@ -85,6 +91,7 @@ class OpParams:
             "servingParams": self.serving,
             "racingParams": self.racing,
             "telemetryParams": self.telemetry,
+            "lifecycleParams": self.lifecycle,
         }
 
     def apply_stage_params(self, stages) -> None:

@@ -119,7 +119,9 @@ class FailureLog:
                "swallowed",    # best-effort side work failed silently before
                "resumed",      # unit of work replayed from a checkpoint
                "preempted",    # graceful stop requested mid-run
-               "reloaded")     # serving swapped in a newer model version
+               "reloaded",     # serving swapped in a newer model version
+               "promoted",     # lifecycle candidate won the holdout gate
+               "rejected")     # lifecycle candidate lost; incumbent kept
 
     def __init__(self):
         self._events: List[FailureEvent] = []
@@ -453,4 +455,7 @@ INJECTION_POINTS = {
     "preemption": "a candidate/batch boundary's graceful-stop check",
     "serving.batch": "scoring one coalesced serving micro-batch",
     "serving.reload": "hot-swapping a newer model version into the engine",
+    "lifecycle.retrain": "starting a policy-triggered lifecycle retrain",
+    "lifecycle.promote": "committing a lifecycle promotion decision (after "
+                         "the holdout gate, before the bundle write)",
 }

@@ -35,8 +35,10 @@ class RunType:
     FEATURES = "features"
     EVALUATE = "evaluate"
     SERVE = "serve"
+    LIFECYCLE = "lifecycle"
 
-    ALL = (TRAIN, SCORE, STREAMING_SCORE, FEATURES, EVALUATE, SERVE)
+    ALL = (TRAIN, SCORE, STREAMING_SCORE, FEATURES, EVALUATE, SERVE,
+           LIFECYCLE)
 
 
 @dataclass
@@ -120,6 +122,8 @@ class OpWorkflowRunner:
                 result = self._evaluate(params, timer)
             elif run_type == RunType.SERVE:
                 result = self._serve(params, timer)
+            elif run_type == RunType.LIFECYCLE:
+                result = self._lifecycle(params, timer)
             else:
                 raise ValueError(f"unknown run type {run_type!r}; "
                                  f"expected one of {RunType.ALL}")
@@ -393,6 +397,26 @@ class OpWorkflowRunner:
                        request_deadline_s=sv.get("requestDeadlineS", 30.0),
                        reload_poll_s=float(sv.get("reloadPollS", 10.0)))
         return OpWorkflowRunnerResult(RunType.SERVE)
+
+    def _lifecycle(self, params: OpParams, timer: PhaseTimer
+                   ) -> OpWorkflowRunnerResult:
+        """Drift-gated retrain loop over a versioned checkpoint root.
+        Knobs ride in ``params.lifecycle`` (see ``OpParams``); the live
+        feed is the runner's ``score_reader``, holdout defaults to the
+        train reader."""
+        if self.workflow is None:
+            raise ValueError("run-type 'lifecycle' needs a workflow")
+        if not params.model_location:
+            raise ValueError("run-type 'lifecycle' needs --model-location")
+        from .lifecycle.service import lifecycle_main
+        with timer.phase("lifecycle"):
+            result = lifecycle_main(
+                self.workflow, params.model_location,
+                evaluator=self.evaluator,
+                live_reader=self.score_reader,
+                holdout_reader=self.train_reader or self.workflow.reader,
+                config=params.lifecycle or {})
+        return OpWorkflowRunnerResult(RunType.LIFECYCLE, metrics=result)
 
 
 def _write_scores(batch, path: str):

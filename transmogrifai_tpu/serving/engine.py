@@ -31,7 +31,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from ..checkpoint import bundle_version, find_latest_valid, is_bundle_dir
+from ..checkpoint import (bundle_version, find_latest_valid, is_bundle_dir,
+                          read_manifest)
 from ..columns import ColumnBatch, column_from_values
 from ..local import extract_raw_value, score_function
 from ..resilience import (WatchdogTimeout, maybe_inject, record_failure,
@@ -102,6 +103,16 @@ class _ModelEntry:
         self.version = bundle_version(bundle_path)
         self.local_fn: Callable = score_function(model)
         self.result_names = [f.name for f in model.result_features]
+        # staleness anchors: the bundle's manifest createdAt when it has
+        # one, else when this process loaded it
+        created = None
+        try:
+            created = (read_manifest(bundle_path) or {}).get("createdAt")
+        except Exception:  # noqa: BLE001 — legacy bundle, no manifest
+            pass
+        self.created_at: Optional[float] = (
+            float(created) if isinstance(created, (int, float)) else None)
+        self.loaded_at: float = time.time()
 
 
 def _result_row(scored: ColumnBatch, names: Sequence[str], i: int
@@ -159,6 +170,11 @@ class ScoringEngine:
         self.metrics.gauge("compiled_path_active",
                            lambda: int(self._compiled_ok))
 
+        # lifecycle hooks: batch observers see every successfully-scored
+        # (records, results) pair; the drift monitor is one such observer
+        self._batch_observers: List[Callable] = []
+        self.drift_monitor = None
+
         self._entry = self._load_entry()
         if warm:
             self._warm(self._entry)
@@ -209,8 +225,47 @@ class ScoringEngine:
             return self._entry.version
 
     @property
+    def active_bundle_path(self) -> str:
+        with self._swap_lock:
+            return self._entry.bundle_path
+
+    @property
+    def model_staleness_s(self) -> float:
+        """Seconds since the active bundle was created (manifest
+        ``createdAt``; falls back to when this process loaded it)."""
+        with self._swap_lock:
+            entry = self._entry
+        ref = entry.created_at if entry.created_at is not None \
+            else entry.loaded_at
+        return max(0.0, time.time() - ref)
+
+    @property
     def compiled_path_active(self) -> bool:
         return self._compiled_ok
+
+    # -- lifecycle hooks ---------------------------------------------------
+    def add_batch_observer(self, fn: Callable) -> None:
+        """Register ``fn(records, results)`` to run after each micro-batch
+        (successfully-scored records only).  Observer errors are swallowed
+        into the FailureLog — observability never fails a request."""
+        self._batch_observers.append(fn)
+
+    def attach_drift_monitor(self, **kw):
+        """Build a ``DriftMonitor`` from the active bundle's baselines,
+        register it as a batch observer, and export its gauges through this
+        engine's registry (→ ``/metrics``).  Returns the monitor, or
+        ``None`` (recorded as a degradation) when the bundle carries no
+        ``baselines.json``."""
+        from ..lifecycle.drift import DriftMonitor
+        with self._swap_lock:
+            entry = self._entry
+        monitor = DriftMonitor.for_model(entry.model, registry=self.metrics,
+                                         **kw)
+        if monitor is None:
+            return None
+        self.drift_monitor = monitor
+        self.add_batch_observer(monitor.observe_serving)
+        return monitor
 
     def reload_now(self) -> bool:
         """Check the checkpoint root once; swap if a newer valid version
@@ -245,6 +300,15 @@ class ScoringEngine:
         self.metrics.counter("reloads_total").inc()
         record_failure("serving", "reloaded", None, point="serving.reload",
                        previous=old, current=entry.version)
+        if self.drift_monitor is not None:
+            # the swapped-in model brings its own training baselines: the
+            # monitor rebases onto them and starts a fresh window
+            try:
+                self.drift_monitor.rebase_to_model(entry.model)
+            except Exception as e:  # noqa: BLE001 — monitoring must not
+                #                     fail a successful swap
+                record_failure("serving", "swallowed", e,
+                               point="serving.reload")
         return True
 
     def _watch_loop(self) -> None:
@@ -417,6 +481,21 @@ class ScoringEngine:
         self.metrics.counter("batches_total").inc()
         self.metrics.counter("batch_rows_total").inc(len(batch))
         self.batch_latency.observe(time.perf_counter() - t0)
+        if self._batch_observers:
+            # before the waiters wake: a client that returns and immediately
+            # inspects the drift monitor sees its own batch accounted for
+            ok = [(req.record, res) for req, res in zip(batch, results)
+                  if not isinstance(res, BaseException)]
+            if ok:
+                recs = [r for r, _ in ok]
+                outs = [o for _, o in ok]
+                for fn in list(self._batch_observers):
+                    try:
+                        fn(recs, outs)
+                    except Exception as e:  # noqa: BLE001 — observers are
+                        #                     observability, not the hot path
+                        record_failure("serving", "swallowed", e,
+                                       point="serving.batch")
         for req, res in zip(batch, results):
             if isinstance(res, BaseException):
                 req.error = res

@@ -95,6 +95,47 @@ def render_metrics(engine: ScoringEngine) -> str:
           "Grid points pruned by selector racing")
     gauge("host_link_bytes_total", reg.get("host_link.bytes", 0),
           "Tracked host-to-device transfer bytes")
+    gauge("model_staleness_seconds", round(engine.model_staleness_s, 3),
+          "Seconds since the active bundle was created")
+    # drift families: the attached DriftMonitor (engine.attach_drift_monitor)
+    # writes drift.* gauges/counters into THIS engine's registry; per-feature
+    # PSI and fill-rate deltas surface with a feature label
+    eng_gauges = engine.metrics.snapshot()["gauges"]
+    for metric, prefix, help_ in (
+            ("drift_feature_psi", "drift.psi.",
+             "Per-feature PSI of the live window vs training baselines"),
+            ("drift_feature_fill_delta", "drift.fill_delta.",
+             "Per-feature |fill-rate - baseline fill-rate|")):
+        labeled = sorted((k[len(prefix):], v) for k, v in eng_gauges.items()
+                         if k.startswith(prefix))
+        if labeled:
+            full = f"{_METRIC_PREFIX}_{metric}"
+            lines.append(f"# HELP {full} {help_}")
+            lines.append(f"# TYPE {full} gauge")
+            for feature, v in labeled:
+                lines.append(f'{full}{{feature={json.dumps(feature)}}} '
+                             f'{v:.6g}')
+    gauge("drift_score_psi", eng_gauges.get("drift.score_psi", 0),
+          "PSI of the live score distribution vs the training baseline")
+    gauge("drift_rows_observed", eng_gauges.get("drift.rows_observed", 0),
+          "Rows in the current drift observation window")
+    counter("drift_evaluations_total", c.get("drift.evaluations_total", 0),
+            "Drift evaluations performed")
+    counter("drift_breaches_total", c.get("drift.breaches_total", 0),
+            "Drift evaluations that breached a threshold")
+    # lifecycle counters live in the process-wide registry (the controller
+    # may run in another thread of this process); families always render so
+    # dashboards see explicit zeros
+    lc = REGISTRY.snapshot()["counters"]
+    for fam, help_ in (("retrains", "Lifecycle retrains started"),
+                       ("promotions", "Candidates promoted to serving"),
+                       ("rejections", "Candidates that lost the holdout "
+                                      "gate"),
+                       ("preemptions", "Retrains preempted mid-sweep "
+                                       "(resumable)"),
+                       ("failed_retrains", "Retrains that errored out")):
+        counter(f"lifecycle_{fam}_total",
+                lc.get(f"lifecycle.{fam}_total", 0), help_)
     lines.append(f"# HELP {_METRIC_PREFIX}_model_info Serving model version")
     lines.append(f"# TYPE {_METRIC_PREFIX}_model_info gauge")
     lines.append(f'{_METRIC_PREFIX}_model_info'
@@ -147,8 +188,13 @@ class _Handler(BaseHTTPRequestHandler):
             if self.server.draining:
                 self._reply(503, {"status": "draining"})
             else:
+                from ..checkpoint import bundle_version
                 self._reply(200, {"status": "ok",
                                   "modelVersion": engine.model_version,
+                                  "bundleVersion": bundle_version(
+                                      engine.active_bundle_path),
+                                  "modelStalenessS": round(
+                                      engine.model_staleness_s, 3),
                                   "queueDepth": engine.queue_depth})
         elif self.path == "/metrics":
             self._reply(200, render_metrics(engine).encode(),

@@ -8,7 +8,7 @@ choice and one COLUMN per label value."""
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -263,6 +263,21 @@ class StreamingHistogram:
             s += n1 / 2.0 + (n1 + nb) / 2.0 * frac
             break
         return float(s)
+
+    # -- serialization -----------------------------------------------------
+    def to_json(self) -> Dict[str, Any]:
+        """JSON form of the sketch — the bundle-baseline representation.
+        Round-trips exactly: the points ARE the sketch state."""
+        return {"maxBins": self.max_bins,
+                "points": [[float(p), float(n)] for p, n in self._points]}
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "StreamingHistogram":
+        h = StreamingHistogram(int(d.get("maxBins", 64)))
+        for p, n in d.get("points") or []:
+            h._insert(float(p), float(n))
+        h._compress()
+        return h
 
     def to_fixed_bins(self, n_bins: int, lo: Optional[float] = None,
                       hi: Optional[float] = None) -> np.ndarray:

@@ -511,6 +511,7 @@ class WorkflowModel(_WorkflowCore):
         self.train_batch: Optional[ColumnBatch] = None
         self.app_metrics = None     # AppMetrics from train() (profiling.py)
         self.failure_log = None     # FailureLog from train() (resilience.py)
+        self.baselines = None       # ModelBaselines from load() (lifecycle)
 
     # -- access ------------------------------------------------------------
     @property
@@ -730,6 +731,21 @@ class WorkflowModel(_WorkflowCore):
         with open(os.path.join(path, MODEL_JSON), "w") as fh:
             json.dump(manifest, fh, indent=2, default=str)
         np.savez_compressed(os.path.join(path, PARAMS_NPZ), **arrays)
+        # training-time drift baselines (lifecycle/baselines.py): the
+        # retained train batch sketches into baselines.json, digest-covered
+        # by the bundle manifest.  A model with no train batch (loaded and
+        # re-saved) simply ships without baselines — drift monitoring then
+        # reports itself disabled for that bundle.
+        try:
+            from .lifecycle.baselines import build_baselines
+            baselines = build_baselines(self)
+            if baselines is not None:
+                baselines.save(path)
+        except Exception as e:  # noqa: BLE001 — baselines are observability,
+            #                     never a reason to fail a model save
+            from .resilience import record_failure
+            record_failure("workflow.save", "swallowed", e,
+                           point="checkpoint.save", detail="baselines.json")
         from .telemetry import active_tracer, write_telemetry_summary
         if active_tracer() is not None:
             # traced run: bundle the run's timeline summary next to the
@@ -848,4 +864,20 @@ class WorkflowModel(_WorkflowCore):
             blacklisted=[feats[u] for u in manifest.get("blacklistedFeaturesUids", ())
                          if u in feats],
             parameters=manifest.get("parameters") or {})
+        # 4. training-time drift baselines ride along when present;
+        # manifested bundles without them predate the lifecycle subsystem —
+        # they load and serve fine, drift monitoring just stays off
+        try:
+            from .lifecycle.baselines import load_baselines
+            model.baselines = load_baselines(path)
+        except Exception as e:  # noqa: BLE001 — corrupt baselines degrade
+            #                     to disabled monitoring, never a load error
+            record_failure("checkpoint", "degraded", e,
+                           point="checkpoint.load", bundle=path,
+                           detail="unreadable baselines.json")
+        if model.baselines is None and manifest_meta is not None:
+            record_failure("checkpoint", "degraded",
+                           "bundle has no baselines.json (pre-lifecycle "
+                           "build); drift monitoring disabled",
+                           point="checkpoint.load", bundle=path)
         return model

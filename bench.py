@@ -27,8 +27,19 @@ honored via a process pool (OpValidator.scala:372-378) and recorded in
 BASELINE_MEASURED.json.  Ratios only apply at the pinned workload sizes on an
 accelerator; reduced CPU smoke runs report 1.0.
 
+4. **text_sparse** (ISSUE 7 tentpole): high-cardinality hashed text through
+   the sparse COO path — 100k hashed columns whose dense [N, num_hashes]
+   matrix never materializes.  Reports nnz/density and the process peak RSS
+   against the dense-equivalent footprint.
+
+5. **selector_smoke** (ISSUE 7 satellite): small multiclass + regression
+   selector sweeps proving both ride the racing + fused-metric-panel hot
+   path (zero per-candidate fallbacks).
+
 Env knobs: BENCH_ROWS (dense rows), BENCH_TRANSMOG_ROWS, BENCH_SCORE_ROWS,
-BENCH_WORKLOAD (dense|transmog|score|all, default all).
+BENCH_SPARSE_ROWS, BENCH_SPARSE_HASHES,
+BENCH_WORKLOAD (dense|transmog|score|text_sparse|selector_smoke|all,
+default all).
 """
 
 import json
@@ -495,6 +506,168 @@ def run_score(N: int, on_accel: bool, platform: str):
     }
 
 
+def _peak_rss_mb():
+    import resource
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def make_sparse_text_columns(n: int, vocab_size: int = 30_000, seed: int = 3):
+    """Label-correlated token rows over a large vocabulary (disjoint
+    positive/negative halves) + one dense real column."""
+    rng = np.random.default_rng(seed)
+    half = vocab_size // 2
+    vpos = np.asarray([f"pos{i}" for i in range(half)])
+    vneg = np.asarray([f"neg{i}" for i in range(half)])
+    y = rng.integers(0, 2, n)
+    toks_pos = vpos[rng.integers(0, half, size=(n, 8))]
+    toks_neg = vneg[rng.integers(0, half, size=(n, 8))]
+    txt = np.where(y[:, None] == 1, toks_pos, toks_neg)
+    records = [{"label": float(y[i]), "txt": " ".join(txt[i]),
+                "x0": float(v)}
+               for i, v in enumerate(rng.normal(size=n))]
+    return records, y
+
+
+def run_text_sparse(N: int, on_accel: bool, platform: str):
+    """Sparse hashed-text workload: train + score in ONE process with peak
+    memory bounded by nnz, not rows x num_hashes (the dense-equivalent
+    matrix at the default 100k hash columns would be ``N * 400KB``)."""
+    from transmogrifai_tpu.dag import apply_dag
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                            ModelCandidate, grid)
+    from transmogrifai_tpu.sparse.transform import (reset_sparse_stats,
+                                                    sparse_stats)
+    from transmogrifai_tpu.workflow import Workflow
+
+    num_hashes = int(os.environ.get("BENCH_SPARSE_HASHES", "100000"))
+    records, y = make_sparse_text_columns(N)
+
+    label = FeatureBuilder.RealNN("label").as_response()
+    txt = FeatureBuilder.Text("txt").as_predictor()
+    x0 = FeatureBuilder.Real("x0").as_predictor()
+    fv = transmogrify([txt, x0], num_hashes=num_hashes)
+    selector = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(),
+                       grid(reg_param=[0.01, 0.1], max_iter=[50]),
+                       "OpLogisticRegression")])
+    selector.set_input(label, fv)
+    pred = selector.get_output()
+
+    reset_sparse_stats()
+    wf = Workflow().set_input_records(records).set_result_features(pred)
+    t0 = time.time()
+    model = wf.train()
+    train_wall = time.time() - t0
+    stats = sparse_stats()
+
+    # compiled scoring in the SAME process — the acceptance bar is one
+    # process training AND scoring with nnz-bounded peak memory
+    batch = model.generate_raw_data()
+    prog = model.score_program()
+    t0 = time.time()
+    scored = prog(batch)
+    pred_vals = np.asarray(scored[pred.name].values["prediction"])
+    score_wall = time.time() - t0
+    acc = float((pred_vals == y).mean())
+
+    peak_mb = _peak_rss_mb()
+    dense_equiv_mb = N * num_hashes * 4 / 1e6
+    return {
+        "metric": f"OpWorkflow.train wall (sparse text {N} rows x "
+                  f"{num_hashes} hashed cols, 3-fold CV LR grid, {platform})",
+        "value": round(train_wall, 2),
+        "unit": "s",
+        "vs_baseline": 1.0,
+        "aux": {
+            "rows": N, "num_hashes": num_hashes, "platform": platform,
+            "train_accuracy": round(acc, 4),
+            "score_wall_s": round(score_wall, 2),
+            "score_rows_per_s": round(N / max(score_wall, 1e-9)),
+            "nnz_total": stats["nnz_total"],
+            "density": round(stats["density"], 6),
+            "peak_rss_mb": round(peak_mb, 1),
+            "dense_equivalent_mb": round(dense_equiv_mb, 1),
+            "rss_vs_dense_equivalent": round(peak_mb / dense_equiv_mb, 4),
+        },
+    }
+
+
+def run_selector_smoke(on_accel: bool, platform: str):
+    """Multiclass + regression selector sweeps on the fused-panel hot path:
+    counts selector.batched_metrics fallback events (must be 0) so a
+    regression that silently demotes either family to the per-candidate
+    path shows up in the bench artifact."""
+    from transmogrifai_tpu.columns import Column, ColumnBatch
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.selector import (MultiClassificationModelSelector,
+                                            RegressionModelSelector)
+    from transmogrifai_tpu.types import RealNN
+    from transmogrifai_tpu.workflow import Workflow
+
+    n = int(os.environ.get("BENCH_SELECTOR_SMOKE_ROWS", "4000"))
+    d = 16
+    rng = np.random.default_rng(11)
+
+    def train(selector_cls, y, X, models):
+        label = FeatureBuilder.RealNN("label").as_response()
+        feats = [FeatureBuilder.RealNN(f"f{i}").as_predictor()
+                 for i in range(d)]
+        from transmogrifai_tpu.ops.transmogrify import transmogrify
+        fv = transmogrify(feats)
+        sel = selector_cls(models=models)
+        sel.set_input(label, fv)
+        cols = {"label": Column(RealNN, y.astype(np.float32))}
+        for i in range(d):
+            cols[f"f{i}"] = Column(RealNN, X[:, i].astype(np.float32))
+        batch = ColumnBatch(cols, n)
+        wf = (Workflow().set_input_batch(batch)
+              .set_result_features(sel.get_output()))
+        t0 = time.time()
+        model = wf.train()
+        return model, time.time() - t0
+
+    def fallbacks(model):
+        # train() scopes its own FailureLog on the returned model
+        return sum(1 for e in model.failure_log.to_json()
+                   if e.get("point") == "selector.batched_metrics")
+
+    C = 4
+    ym = rng.integers(0, C, n)
+    centers = rng.normal(size=(C, d)) * 2.5
+    Xm = (centers[ym] + rng.normal(size=(n, d))).astype(np.float32)
+
+    w = rng.normal(size=d).astype(np.float32)
+    Xr = rng.normal(size=(n, d)).astype(np.float32)
+    yr = Xr @ w + 0.3 * rng.normal(size=n).astype(np.float32)
+
+    mc_model, mc_wall = train(
+        MultiClassificationModelSelector, ym, Xm,
+        MultiClassificationModelSelector.compact_models())
+    reg_model, reg_wall = train(RegressionModelSelector, yr, Xr,
+                                RegressionModelSelector.compact_models())
+    fb = fallbacks(mc_model) + fallbacks(reg_model)
+    mc_sum = mc_model.selected_model.summary
+    reg_sum = reg_model.selected_model.summary
+    return {
+        "metric": f"multiclass+regression selector smoke wall "
+                  f"({n} rows x {d}, compact grids, {platform})",
+        "value": round(mc_wall + reg_wall, 2),
+        "unit": "s",
+        "vs_baseline": 1.0,
+        "aux": {
+            "rows": n, "platform": platform,
+            "multiclass_wall_s": round(mc_wall, 2),
+            "multiclass_best_model": mc_sum.best_model_name,
+            "regression_wall_s": round(reg_wall, 2),
+            "regression_best_model": reg_sum.best_model_name,
+            "batched_metric_fallbacks": fb,
+        },
+    }
+
+
 def last_json_line(stdout: str):
     """The last JSON result line of a bench process' stdout (shared with
     scripts/run_scale_bench.py)."""
@@ -632,6 +805,10 @@ def main():
         ("score", lambda: run_score(
             rows("BENCH_SCORE_ROWS", 1_000_000, 20_000),
             on_accel, platform)),
+        ("text_sparse", lambda: run_text_sparse(
+            rows("BENCH_SPARSE_ROWS", 100_000, 5_000),
+            on_accel, platform)),
+        ("selector_smoke", lambda: run_selector_smoke(on_accel, platform)),
     ]
     can_retry = (os.environ.get("BENCH_NO_RETRY") != "1" and on_accel)
     broken = False

@@ -1,0 +1,78 @@
+"""CI smoke for the sparse feature subsystem (ISSUE 7): train + score a
+5k-row x 50k-hashed-column text workflow in ONE process and assert the
+peak RSS stays well under the dense ``[N, num_hashes]`` matrix that the
+pre-sparse path would have materialized — the memory bound IS the feature.
+
+Usage:
+    python scripts/ci_sparse_smoke.py run OUT_DIR       # train+score+export
+    python scripts/ci_sparse_smoke.py validate OUT_DIR  # parse + assert
+
+``run`` reuses the ``text_sparse`` bench workload so CI uploads the same
+one-JSON-line artifact shape the bench emits; ``validate`` asserts the
+planted-vocab accuracy, a non-trivial nnz/density, and the peak-RSS bound.
+"""
+
+import json
+import os
+import sys
+
+# runnable as `python scripts/ci_sparse_smoke.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+ROWS = int(os.environ.get("SPARSE_SMOKE_ROWS", "5000"))
+HASHES = int(os.environ.get("SPARSE_SMOKE_HASHES", "50000"))
+# the 5k x 50k dense equivalent is ~1 GB; the sparse run (including the
+# ~250 MB Python+JAX process baseline) must stay under 60% of it
+RSS_BOUND_FRACTION = 0.6
+
+
+def run(out_dir):
+    os.environ["BENCH_SPARSE_HASHES"] = str(HASHES)
+    import bench
+
+    os.makedirs(out_dir, exist_ok=True)
+    record = bench.run_text_sparse(ROWS, False, "cpu")
+    path = os.path.join(out_dir, "sparse-bench.json")
+    with open(path, "w") as fh:
+        fh.write(json.dumps(record) + "\n")
+    aux = record["aux"]
+    print(f"wrote {path}: train {record['value']}s, "
+          f"score {aux['score_wall_s']}s, acc {aux['train_accuracy']}, "
+          f"nnz {aux['nnz_total']}, peak RSS {aux['peak_rss_mb']} MB "
+          f"vs dense-equivalent {aux['dense_equivalent_mb']} MB")
+    return 0
+
+
+def validate(out_dir):
+    with open(os.path.join(out_dir, "sparse-bench.json")) as fh:
+        record = json.loads(fh.readline())
+    aux = record["aux"]
+    assert aux["rows"] == ROWS and aux["num_hashes"] == HASHES, aux
+    # planted disjoint pos/neg vocab: the sparse LR must separate it
+    assert aux["train_accuracy"] >= 0.99, aux
+    assert aux["score_rows_per_s"] > 0, aux
+    # the hash block really was sparse: nnz present, density far below 1
+    assert aux["nnz_total"] > 0, aux
+    assert 0 < aux["density"] < 0.01, aux
+    # THE acceptance bound: peak memory scales with nnz, not rows x cols —
+    # a dense [N, num_hashes] materialization anywhere in train or score
+    # would alone exceed this fraction of the dense-equivalent bytes
+    bound_mb = RSS_BOUND_FRACTION * aux["dense_equivalent_mb"]
+    assert aux["peak_rss_mb"] < bound_mb, (
+        f"peak RSS {aux['peak_rss_mb']} MB >= {bound_mb} MB "
+        f"({RSS_BOUND_FRACTION} x dense equivalent "
+        f"{aux['dense_equivalent_mb']} MB) — a dense [N, num_hashes] "
+        "materialization has crept back into the sparse path")
+    print(f"OK: peak RSS {aux['peak_rss_mb']} MB < {bound_mb:.0f} MB bound, "
+          f"nnz={aux['nnz_total']}, density={aux['density']}, "
+          f"acc={aux['train_accuracy']}")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "run":
+        sys.exit(run(sys.argv[2]))
+    if len(sys.argv) == 3 and sys.argv[1] == "validate":
+        sys.exit(validate(sys.argv[2]))
+    sys.exit(f"usage: {sys.argv[0]} run OUT_DIR | validate OUT_DIR")

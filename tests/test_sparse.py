@@ -1,0 +1,405 @@
+"""Sparse feature subsystem (ISSUE 7): padded flat-COO container, fused
+hash->COO transform, sparse-aware fitters, selector auto-routing, and the
+multiclass/regression fused-panel hot path that rides on it."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.sparse.matrix import SparseMatrix, nnz_capacity
+from transmogrifai_tpu.sparse.transform import (combine_blocks,
+                                                hash_tokens_to_sparse,
+                                                reset_sparse_stats,
+                                                sparse_from_hash_flat,
+                                                sparse_stats)
+
+
+def _random_sparse_dense(rng, n=40, d=23, density=0.15):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    x[rng.random((n, d)) > density] = 0.0
+    return x
+
+
+# ---------------------------------------------------------------------------
+# container
+# ---------------------------------------------------------------------------
+
+def test_nnz_capacity_ladder():
+    assert nnz_capacity(1) == 1024          # floor
+    assert nnz_capacity(1024) == 1024
+    assert nnz_capacity(1025) == 1536       # 1.5 * 2^10 rung
+    assert nnz_capacity(1537) == 2048
+    assert nnz_capacity(3000) == 3072
+    prev = 0
+    for n in range(1, 5000, 113):
+        cap = nnz_capacity(n)
+        assert cap >= n and cap >= prev
+        prev = cap
+
+
+def test_from_dense_roundtrip_and_matmul(rng):
+    x = _random_sparse_dense(rng)
+    sm = SparseMatrix.from_dense(x)
+    assert sm.shape == x.shape
+    assert sm.capacity == nnz_capacity(sm.nnz)
+    np.testing.assert_allclose(np.asarray(sm.to_dense()), x, atol=1e-6)
+    v = rng.normal(size=x.shape[1]).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(sm @ v), x @ v, atol=1e-4)
+    m = rng.normal(size=(x.shape[1], 5)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(sm @ m), x @ m, atol=1e-4)
+    u = rng.normal(size=x.shape[0]).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(sm.rmatvec(u)), x.T @ u, atol=1e-4)
+
+
+def test_refuses_implicit_densify(rng):
+    sm = SparseMatrix.from_dense(_random_sparse_dense(rng))
+    with pytest.raises(TypeError, match="to_dense"):
+        np.asarray(sm)
+
+
+def test_pad_rows_and_take_rows(rng):
+    x = _random_sparse_dense(rng, n=17)
+    sm = SparseMatrix.from_dense(x)
+    padded = sm.pad_rows(32)
+    assert padded.shape == (32, x.shape[1])
+    assert padded.nnz == sm.nnz  # empty rows own no entries
+    np.testing.assert_allclose(np.asarray(padded.to_dense())[:17], x,
+                               atol=1e-6)
+    assert np.asarray(padded.to_dense())[17:].sum() == 0.0
+    # duplicates and arbitrary order — the CV fold splitter relies on this
+    idx = np.array([3, 3, 0, 16, 7, 3])
+    sub = sm.take_rows(idx)
+    np.testing.assert_allclose(np.asarray(sub.to_dense()), x[idx], atol=1e-6)
+
+
+def test_pytree_crosses_jit(rng):
+    import jax
+    x = _random_sparse_dense(rng)
+    sm = SparseMatrix.from_dense(x)
+    v = rng.normal(size=x.shape[1]).astype(np.float32)
+
+    @jax.jit
+    def f(sm, v):
+        return sm @ v
+
+    np.testing.assert_allclose(np.asarray(f(sm, v)), x @ v, atol=1e-4)
+    leaves, treedef = jax.tree_util.tree_flatten(sm)
+    rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert rebuilt.shape == sm.shape
+    # nnz is NOT aux data (anti-retrace): a rebuilt matrix reports capacity,
+    # which is exact for device math because the padding is zero entries
+    assert rebuilt.nnz == sm.capacity
+    np.testing.assert_allclose(np.asarray(rebuilt.to_dense()), x, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# transform: sparse path == dense hashing-trick path (satellite 2)
+# ---------------------------------------------------------------------------
+
+def _token_rows(rng, n=60, vocab=40):
+    words = [f"w{i}" for i in range(vocab)]
+    rows = []
+    for i in range(n):
+        k = int(rng.integers(0, 9))  # includes empty-token rows
+        toks = list(rng.choice(words, size=k))
+        if k and rng.random() < 0.5:
+            toks.append(toks[0])  # force duplicate (row, bucket) hits
+        rows.append(toks)
+    return rows
+
+
+@pytest.mark.parametrize("binary", [False, True])
+def test_sparse_matches_dense_hash_counts(rng, binary):
+    from transmogrifai_tpu.ops.text import hash_tokens_to_counts
+    tokens = _token_rows(rng)
+    for num_hashes in (16, 128):  # 16 forces hash collisions
+        dense = hash_tokens_to_counts(tokens, num_hashes, binary=binary)
+        sm = hash_tokens_to_sparse(tokens, num_hashes, binary=binary)
+        assert sm.shape == dense.shape
+        np.testing.assert_allclose(np.asarray(sm.to_dense()), dense,
+                                   atol=1e-6)
+
+
+def test_hash_buckets_stable_across_processes():
+    """FNV-1a bucket assignment must not depend on PYTHONHASHSEED — a model
+    trained in one process has to score the same buckets in another."""
+    from transmogrifai_tpu.ops.text import hash_tokens_flat
+    tokens = [["alpha", "beta"], [], ["gamma", "alpha", "delta"]]
+    lens, flat = hash_tokens_flat(tokens, 97)
+    code = (
+        "from transmogrifai_tpu.ops.text import hash_tokens_flat\n"
+        "lens, flat = hash_tokens_flat("
+        "[['alpha','beta'],[],['gamma','alpha','delta']], 97)\n"
+        "print(','.join(map(str, lens)) + '|' + ','.join(map(str, flat)))\n")
+    import os
+    env = dict(os.environ, PYTHONPATH=".", PYTHONHASHSEED="12345",
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        check=True, env=env, cwd=__file__.rsplit("/", 2)[0])
+    got_lens, got_flat = out.stdout.strip().split("|")
+    assert got_lens == ",".join(map(str, lens))
+    assert got_flat == ",".join(map(str, flat))
+
+
+def test_sparse_from_hash_flat_empty_and_padding():
+    sm = sparse_from_hash_flat([0, 0, 0], [], 50_000, record=False)
+    assert sm.shape == (3, 50_000)
+    assert sm.nnz == 0
+    sm2 = sparse_from_hash_flat([2, 0, 1], [7, 7, 9], 64, row_pad=8,
+                                record=False)
+    assert sm2.shape == (8, 64)
+    dense = np.asarray(sm2.to_dense())
+    assert dense[0, 7] == 2.0 and dense[2, 9] == 1.0
+    assert dense.sum() == 3.0
+
+
+def test_combine_blocks_layout_and_shortcircuit(rng):
+    xs = _random_sparse_dense(rng, n=12, d=9)
+    xd = rng.normal(size=(12, 4)).astype(np.float32)
+    sm = SparseMatrix.from_dense(xs)
+    out = combine_blocks([sm, xd], 12, record=False)
+    np.testing.assert_allclose(np.asarray(out.to_dense()),
+                               np.concatenate([xs, xd], axis=1), atol=1e-6)
+    # single sparse block: identity (keeps the combine jit-traceable)
+    assert combine_blocks([sm], 12, record=False) is sm
+    with pytest.raises(ValueError, match="rows"):
+        combine_blocks([sm, xd[:5]], 12, record=False)
+
+
+def test_sparse_stats_gauges(rng):
+    reset_sparse_stats()
+    sm = sparse_from_hash_flat([1, 2], [3, 4, 4], 32)
+    s = sparse_stats()
+    assert s["matrices"] == 1
+    assert s["nnz_total"] == sm.nnz == 2
+    assert s["density"] == pytest.approx(sm.density)
+    from transmogrifai_tpu.telemetry import REGISTRY
+    gauges = REGISTRY.snapshot()["gauges"]
+    assert gauges["sparse.nnz_total"] == 2
+    assert gauges["sparse.matrices"] == 1
+    reset_sparse_stats()
+    assert sparse_stats()["nnz_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# routing: SmartTextVectorizer hash-vs-pivot / sparse-vs-dense decision
+# ---------------------------------------------------------------------------
+
+def _text_batch(n=80, seed=0):
+    from transmogrifai_tpu import types as T
+    from transmogrifai_tpu.columns import ColumnBatch, column_from_values
+    rng = np.random.default_rng(seed)
+    vocab = [f"tok{i}" for i in range(300)]
+    txt = [" ".join(rng.choice(vocab, size=5)) for _ in range(n)]
+    return ColumnBatch({"txt": column_from_values(T.Text, txt)}, n)
+
+
+@pytest.mark.parametrize("num_hashes,sparse_hashing,expect_sparse", [
+    (4096, "auto", True),    # >= SPARSE_MIN_HASHES -> sparse
+    (64, "auto", False),     # small hash space stays dense
+    (4096, False, False),    # explicit opt-out
+    (64, True, True),        # explicit opt-in
+])
+def test_smart_text_vectorizer_sparse_routing(num_hashes, sparse_hashing,
+                                              expect_sparse):
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.ops.text import SmartTextVectorizer
+    batch = _text_batch()
+    st = SmartTextVectorizer(max_cardinality=5, num_hashes=num_hashes,
+                             sparse_hashing=sparse_hashing
+                             ).set_input(FeatureBuilder.Text("txt")
+                                         .as_predictor())
+    vm = st.fit(batch)
+    col = vm.transform(batch)
+    assert bool(vm.metadata.get("sparse")) is expect_sparse
+    # width is num_hashes plus any tracked-null indicator columns
+    assert isinstance(col.values, SparseMatrix) is expect_sparse
+    assert col.values.shape[0] == len(batch)
+    assert col.values.shape[1] >= num_hashes
+
+
+def test_selector_sparse_end_to_end():
+    """Hash-routed text -> combiner -> selector CV -> scoring, all sparse."""
+    from transmogrifai_tpu import types as T
+    from transmogrifai_tpu.columns import ColumnBatch, column_from_values
+    from transmogrifai_tpu.features import Feature, FeatureBuilder
+    from transmogrifai_tpu.ops.combiner import VectorsCombiner
+    from transmogrifai_tpu.ops.text import SmartTextVectorizer
+    from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+
+    rng = np.random.default_rng(0)
+    n = 240
+    vocab_pos = [f"good{i}" for i in range(200)]
+    vocab_neg = [f"bad{i}" for i in range(200)]
+    y = rng.integers(0, 2, n)
+    txt = [" ".join(rng.choice(vocab_pos if yi else vocab_neg, size=6))
+           for yi in y]
+    batch = ColumnBatch({
+        "txt": column_from_values(T.Text, txt),
+        "label": column_from_values(T.RealNN, y.astype(np.float64)),
+    }, n)
+    flab = Feature("label", T.RealNN, True, None, parents=())
+
+    vm = SmartTextVectorizer(max_cardinality=5, num_hashes=4096).set_input(
+        FeatureBuilder.Text("txt").as_predictor()).fit(batch)
+    col = vm.transform(batch)
+    assert isinstance(col.values, SparseMatrix)
+    batch = batch.with_column(vm.output_name(), col)
+
+    comb = VectorsCombiner().set_input(
+        Feature(vm.output_name(), T.OPVector, False, None, parents=()))
+    ccol = comb.transform(batch)
+    assert isinstance(ccol.values, SparseMatrix)
+    batch = batch.with_column(comb.output_name(), ccol)
+
+    sel = BinaryClassificationModelSelector(
+        num_folds=3,
+        models=BinaryClassificationModelSelector.compact_models())
+    sel.set_input(flab, Feature(comb.output_name(), T.OPVector, False, None,
+                                parents=()))
+    model = sel.fit(batch)
+    assert model.summary.best_model_name == "OpLogisticRegression"
+    pred = np.asarray(model.transform(batch).values["prediction"])
+    assert float((pred == y).mean()) > 0.95
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: multiclass + regression selectors on the fused-panel hot path
+# ---------------------------------------------------------------------------
+
+def _fit_selector(selector_cls, y, X, models):
+    from transmogrifai_tpu import types as T
+    from transmogrifai_tpu.columns import ColumnBatch, column_from_values
+    from transmogrifai_tpu.features import Feature
+    n = len(y)
+    batch = ColumnBatch(
+        {"label": column_from_values(T.RealNN, y.astype(np.float64)),
+         "fv": column_from_values(T.OPVector, X.astype(np.float64))}, n)
+    sel = selector_cls(num_folds=3, models=models)
+    sel.set_input(Feature("label", T.RealNN, True, None, parents=()),
+                  Feature("fv", T.OPVector, False, None, parents=()))
+    model = sel.fit(batch)
+    s = model.summary
+    res = {(r.model_name, tuple(sorted(r.params.items()))):
+           {k: float(v) for k, v in r.metric_values.items()}
+           for r in s.validation_results}
+    return s.best_model_name, res
+
+
+def _panel_fallbacks():
+    from transmogrifai_tpu.resilience import active_failure_log
+    return [e for e in active_failure_log()._events
+            if e.point == "selector.batched_metrics"]
+
+
+def _assert_parity(res_batched, res_percand, rel_tol):
+    for key, mb in res_batched.items():
+        mp = res_percand.get(key)
+        if mp is None:
+            continue
+        for mk, vb in mb.items():
+            vp = mp.get(mk, float("nan"))
+            if np.isnan(vb) and np.isnan(vp):
+                continue
+            assert abs(vb - vp) < rel_tol * max(1.0, abs(vp)), (key, mk, vb,
+                                                                vp)
+
+
+def test_multiclass_selector_fused_panel_parity(monkeypatch):
+    """The batched (fold x grid) panel must reproduce the per-candidate CV
+    metrics for multinomial LR + forest and pick the same winner, with ZERO
+    fallback events (the panel really ran, it didn't silently bail)."""
+    from transmogrifai_tpu.selector import MultiClassificationModelSelector
+    from transmogrifai_tpu.tuning import OpValidator
+    rng = np.random.default_rng(7)
+    n, d, C = 300, 8, 3
+    y = rng.integers(0, C, n)
+    centers = rng.normal(size=(C, d)) * 3.0
+    X = centers[y] + rng.normal(size=(n, d))
+
+    before = len(_panel_fallbacks())
+    win_b, res_b = _fit_selector(
+        MultiClassificationModelSelector, y, X,
+        MultiClassificationModelSelector.compact_models())
+    assert len(_panel_fallbacks()) == before
+
+    monkeypatch.setattr(OpValidator, "_record_grid_metrics_batched",
+                        lambda self, *a, **k: False)
+    win_p, res_p = _fit_selector(
+        MultiClassificationModelSelector, y, X,
+        MultiClassificationModelSelector.compact_models())
+    assert win_b == win_p
+    _assert_parity(res_b, res_p, 2e-4)
+
+
+def test_regression_selector_fused_panel_parity(monkeypatch):
+    from transmogrifai_tpu.models.trees import OpGBTRegressor
+    from transmogrifai_tpu.selector import (ModelCandidate,
+                                            RegressionModelSelector, grid)
+    from transmogrifai_tpu.tuning import OpValidator
+    rng = np.random.default_rng(8)
+    n, d = 300, 8
+    X = rng.normal(size=(n, d))
+    y = X @ rng.normal(size=d) + 0.3 * rng.normal(size=n)
+
+    def models():
+        ms = RegressionModelSelector.compact_models()
+        ms.append(ModelCandidate(OpGBTRegressor(max_iter=6, max_depth=3),
+                                 grid(step_size=[0.1]), "OpGBTRegressor"))
+        return ms
+
+    before = len(_panel_fallbacks())
+    win_b, res_b = _fit_selector(RegressionModelSelector, y, X, models())
+    assert len(_panel_fallbacks()) == before
+
+    monkeypatch.setattr(OpValidator, "_record_grid_metrics_batched",
+                        lambda self, *a, **k: False)
+    win_p, res_p = _fit_selector(RegressionModelSelector, y, X, models())
+    assert win_b == win_p
+    _assert_parity(res_b, res_p, 2e-3)
+
+
+def test_selector_winner_parity_sparse_vs_dense():
+    """Same hashed-text design matrix fed sparse and densified must produce
+    the same winner with metrics within tolerance (acceptance criterion)."""
+    from transmogrifai_tpu import types as T
+    from transmogrifai_tpu.columns import Column, ColumnBatch, \
+        column_from_values
+    from transmogrifai_tpu.features import Feature
+    from transmogrifai_tpu.selector import BinaryClassificationModelSelector
+
+    rng = np.random.default_rng(9)
+    n = 300
+    vocab_pos = [f"up{i}" for i in range(60)]
+    vocab_neg = [f"dn{i}" for i in range(60)]
+    y = rng.integers(0, 2, n)
+    tokens = [list(rng.choice(vocab_pos if yi else vocab_neg, size=5))
+              for yi in y]
+    sm = hash_tokens_to_sparse(tokens, 512)
+    dense = np.asarray(sm.to_dense())
+
+    def run(col):
+        batch = ColumnBatch(
+            {"label": column_from_values(T.RealNN, y.astype(np.float64)),
+             "fv": col}, n)
+        sel = BinaryClassificationModelSelector(
+            num_folds=3,
+            models=BinaryClassificationModelSelector.compact_models())
+        sel.set_input(Feature("label", T.RealNN, True, None, parents=()),
+                      Feature("fv", T.OPVector, False, None, parents=()))
+        s = sel.fit(batch).summary
+        ev = {f"{ek}.{mk}": float(mv)
+              for ek, emap in s.train_evaluation.items()
+              for mk, mv in emap.items() if isinstance(mv, (int, float))}
+        return s.best_model_name, ev
+
+    win_s, ev_s = run(Column(T.OPVector, sm))
+    win_d, ev_d = run(Column(T.OPVector, dense.astype(np.float32)))
+    assert win_s == win_d
+    for k in ev_s.keys() & ev_d.keys():
+        if np.isnan(ev_s[k]) and np.isnan(ev_d[k]):
+            continue
+        assert ev_s[k] == pytest.approx(ev_d[k], abs=5e-3), k

@@ -221,6 +221,9 @@ class Column:
                         d[f"{base}_{j}"] = float(v)
             return Prediction(d)
         if issubclass(k, OPVector):
+            from .sparse.matrix import SparseMatrix
+            if isinstance(self.values, SparseMatrix):
+                return OPVector(list(self.values.dense_rows([i])[0].tolist()))
             return OPVector(list(np.asarray(self.values)[i].tolist()))
         if issubclass(k, Geolocation) and not self.is_host_object():
             if self.mask is not None and not bool(np.asarray(self.mask)[i]):
@@ -355,10 +358,13 @@ class ColumnBatch:
 
     def take_rows(self, idx: np.ndarray) -> "ColumnBatch":
         """Row subset (host-side gather; used by splitters/CV on small data)."""
+        from .sparse.matrix import SparseMatrix
         out: Dict[str, Column] = {}
         for name, c in self._cols.items():
             if isinstance(c.values, dict):
                 vals = {k: np.asarray(v)[idx] for k, v in c.values.items()}
+            elif isinstance(c.values, SparseMatrix):
+                vals = c.values.take_rows(idx)   # stays sparse end-to-end
             else:
                 vals = np.asarray(c.values)[idx]
             mask = None if c.mask is None else np.asarray(c.mask)[idx]

@@ -143,6 +143,12 @@ class OpEvaluatorBase:
     name: str = "evaluator"
     default_metric: str = ""
     is_larger_better: bool = True
+    # What the fused CV panels (evaluate_masked_grid / _fold_grid) expect in
+    # each S column: "scores" = any rank-preserving score (margins suffice),
+    # "predictions" = the model's actual prediction values (class ids for
+    # classification, real values for regression).  The validator uses this
+    # to build the right panel per model family.
+    grid_panel_input: str = "scores"
 
     def __init__(self, default_metric: Optional[str] = None,
                  is_larger_better: Optional[bool] = None):
@@ -331,6 +337,7 @@ class OpMultiClassificationEvaluator(OpEvaluatorBase):
 
     name = "multiEval"
     default_metric = "F1"
+    grid_panel_input = "predictions"
 
     def __init__(self, top_ns: Sequence[int] = (1, 3), n_bins: int = 10, **kw):
         super().__init__(**kw)
@@ -407,6 +414,28 @@ class OpMultiClassificationEvaluator(OpEvaluatorBase):
             y_dev, pred, w_dev, n_classes=C), dtype=np.float64)
         return self._conf_panel(conf)[self.default_metric]
 
+    def evaluate_masked_grid(self, y_dev, S, W):
+        # S [N, K] carries integer PREDICTION columns (grid_panel_input)
+        if self.default_metric not in ("Precision", "Recall", "F1", "Error"):
+            return None
+        import jax.numpy as jnp
+
+        from .metrics_device import masked_multiclass_metric_grid
+        C = int(jnp.maximum(jnp.max(y_dev), jnp.max(S))) + 1
+        return masked_multiclass_metric_grid(
+            y_dev, S, W, n_classes=C, metric=self.default_metric)
+
+    def evaluate_masked_fold_grid(self, y_dev, S, W):
+        # S [N, F, G] integer predictions, W [F, N] fold masks -> [F, G]
+        if self.default_metric not in ("Precision", "Recall", "F1", "Error"):
+            return None
+        import jax.numpy as jnp
+
+        from .metrics_device import masked_multiclass_metric_fold_grid
+        C = int(jnp.maximum(jnp.max(y_dev), jnp.max(S))) + 1
+        return masked_multiclass_metric_fold_grid(
+            y_dev, S, W, n_classes=C, metric=self.default_metric)
+
     def evaluate_all_device(self, y_dev, device_out, w_dev):
         pred = device_out.get("prediction")
         if pred is None or not len(y_dev):
@@ -450,6 +479,7 @@ class OpRegressionEvaluator(OpEvaluatorBase):
     name = "regEval"
     default_metric = "RootMeanSquaredError"
     is_larger_better = False
+    grid_panel_input = "predictions"
 
     def __init__(self, hist_bins: int = 20, **kw):
         super().__init__(**kw)
@@ -501,6 +531,27 @@ class OpRegressionEvaluator(OpEvaluatorBase):
         return {"RootMeanSquaredError": float(np.sqrt(mse)),
                 "MeanSquaredError": mse,
                 "MeanAbsoluteError": mae}[self.default_metric]
+
+    def evaluate_masked_grid(self, y_dev, S, W):
+        # S [N, K] carries PREDICTION columns — for linear regression the
+        # margins ARE the predictions, so the fused panel is exact
+        if self.default_metric not in (
+                "RootMeanSquaredError", "MeanSquaredError",
+                "MeanAbsoluteError"):
+            return None
+        from .metrics_device import masked_reg_metric_grid
+        return masked_reg_metric_grid(y_dev, S, W,
+                                      metric=self.default_metric)
+
+    def evaluate_masked_fold_grid(self, y_dev, S, W):
+        # S [N, F, G] predictions, W [F, N] fold masks -> [F, G]
+        if self.default_metric not in (
+                "RootMeanSquaredError", "MeanSquaredError",
+                "MeanAbsoluteError"):
+            return None
+        from .metrics_device import masked_reg_metric_fold_grid
+        return masked_reg_metric_fold_grid(y_dev, S, W,
+                                           metric=self.default_metric)
 
     def evaluate_all_device(self, y_dev, device_out, w_dev):
         pred = device_out.get("prediction")

@@ -140,6 +140,94 @@ def masked_multiclass_confusion(y: jnp.ndarray, yhat: jnp.ndarray,
     return (yo * w[:, None]).T @ ho
 
 
+def _masked_reg_metric(y, yhat, w, metric):
+    errs = masked_reg_errors(y, yhat, w)
+    if metric == "RootMeanSquaredError":
+        return jnp.sqrt(errs[0])
+    if metric == "MeanSquaredError":
+        return errs[0]
+    return errs[1]                                  # MeanAbsoluteError
+
+
+@functools.partial(jax.jit, static_argnames=("metric",))
+def masked_reg_metric_grid(y: jnp.ndarray, S: jnp.ndarray, W: jnp.ndarray,
+                           *, metric: str):
+    """Regression analog of ``masked_auroc_grid``: S [N, K] holds K
+    candidates' PREDICTION columns (linear-regression margins ARE the
+    predictions, so the panel is exact, not merely rank-equivalent) →
+    [K] device scalars of the chosen error metric."""
+    if W.ndim == 1:
+        return jax.vmap(lambda s: _masked_reg_metric(y, s, W, metric),
+                        in_axes=1)(S)
+    return jax.vmap(lambda s, w: _masked_reg_metric(y, s, w, metric),
+                    in_axes=(1, 0))(S, W)
+
+
+@functools.partial(jax.jit, static_argnames=("metric",))
+def masked_reg_metric_fold_grid(y: jnp.ndarray, S: jnp.ndarray,
+                                W: jnp.ndarray, *, metric: str):
+    """Whole (fold × grid) regression panel: S [N, F, G] predictions,
+    W [F, N] fold masks → [F, G]."""
+    return jax.vmap(
+        lambda s, w: jax.vmap(
+            lambda c: _masked_reg_metric(y, c, w, metric), in_axes=1)(s),
+        in_axes=(1, 0))(S, W)
+
+
+def _conf_metric(conf, metric):
+    """Weighted Precision/Recall/F1/Error from a [C, C] device confusion
+    matrix — the jnp twin of OpMultiClassificationEvaluator._conf_panel
+    (identical zero-guard semantics, so the fused panel matches the host
+    per-candidate path bit-for-bit up to f32 rounding)."""
+    support = conf.sum(axis=1)
+    tp = jnp.diagonal(conf)
+    if metric == "Error":
+        return 1.0 - tp.sum() / jnp.maximum(support.sum(), 1.0)
+    pred_count = conf.sum(axis=0)
+    prec_c = jnp.where(pred_count > 0, tp / jnp.maximum(pred_count, 1e-30),
+                       0.0)
+    rec_c = jnp.where(support > 0, tp / jnp.maximum(support, 1e-30), 0.0)
+    wts = support / jnp.maximum(support.sum(), 1.0)
+    if metric == "Precision":
+        return wts @ prec_c
+    if metric == "Recall":
+        return wts @ rec_c
+    f1_c = jnp.where(prec_c + rec_c > 0,
+                     2.0 * prec_c * rec_c / jnp.maximum(prec_c + rec_c,
+                                                        1e-30), 0.0)
+    return wts @ f1_c
+
+
+@functools.partial(jax.jit, static_argnames=("n_classes", "metric"))
+def masked_multiclass_metric_grid(y: jnp.ndarray, P: jnp.ndarray,
+                                  W: jnp.ndarray, *, n_classes: int,
+                                  metric: str):
+    """Multiclass analog of ``masked_auroc_grid``: P [N, K] holds K
+    candidates' integer PREDICTION columns → [K] device scalars of the
+    weighted confusion metric.  Classes absent from the data contribute
+    zero support/zero weight, so a generous static ``n_classes`` is exact."""
+    def one(p, w):
+        conf = masked_multiclass_confusion(y, p, w, n_classes=n_classes)
+        return _conf_metric(conf, metric)
+    if W.ndim == 1:
+        return jax.vmap(lambda p: one(p, W), in_axes=1)(P)
+    return jax.vmap(one, in_axes=(1, 0))(P, W)
+
+
+@functools.partial(jax.jit, static_argnames=("n_classes", "metric"))
+def masked_multiclass_metric_fold_grid(y: jnp.ndarray, P: jnp.ndarray,
+                                       W: jnp.ndarray, *, n_classes: int,
+                                       metric: str):
+    """Whole (fold × grid) multiclass panel: P [N, F, G] integer
+    predictions, W [F, N] fold masks → [F, G]."""
+    def one(p, w):
+        conf = masked_multiclass_confusion(y, p, w, n_classes=n_classes)
+        return _conf_metric(conf, metric)
+    return jax.vmap(
+        lambda p, w: jax.vmap(lambda c: one(c, w), in_axes=1)(p),
+        in_axes=(1, 0))(P, W)
+
+
 @jax.jit
 def masked_threshold_confusion(y: jnp.ndarray, scores: jnp.ndarray,
                                w: jnp.ndarray, thresholds: jnp.ndarray):

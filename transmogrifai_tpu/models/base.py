@@ -39,10 +39,18 @@ def extract_xy(batch: ColumnBatch, label_feature, features_feature
     forcing a host copy here would cross the (slow) accelerator link twice."""
     import jax
 
+    from ..sparse.matrix import SparseMatrix
+
     ycol = batch[label_feature.name]
     xcol = batch[features_feature.name]
     y = np.asarray(ycol.values, dtype=np.float32)
     xv = xcol.values
+    if isinstance(xv, SparseMatrix):
+        # sparse device representation passes through untouched — fitters
+        # that understand it consume the COO entry stream directly, and
+        # densifying here would be exactly the [N, num_hashes] blow-up the
+        # representation exists to avoid
+        return xv, y
     if isinstance(xv, jax.Array):
         # bf16 feature-matrix STORAGE passes through — fitters fuse the
         # upcast into their matmuls; forcing f32 here would materialize a
@@ -75,8 +83,15 @@ class PredictionModel(TransformerModel):
     def transform(self, batch: ColumnBatch) -> Column:
         import jax
 
+        from ..sparse.matrix import SparseMatrix
+
         feats = self.input_features[1]
         xv = batch[feats.name].values
+        if isinstance(xv, SparseMatrix) and self.supports_device_scores():
+            out = self.device_scores(xv, full=True)
+            return prediction_column(out["prediction"],
+                                     out.get("probability"),
+                                     out.get("rawPrediction"))
         if isinstance(xv, jax.Array) and self.supports_device_scores():
             # device-resident matrix: score in HBM and keep the per-row
             # results as device arrays — pulling X over the (slow) host link

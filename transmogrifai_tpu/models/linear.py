@@ -19,9 +19,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..columns import device_matrix, to_device_f32
+from ..sparse.matrix import SparseMatrix
 from .base import PredictionModel, PredictorEstimator
 from .solvers import (FitResult, fista_fit, linear_grid_fit, naive_bayes_fit,
-                      ridge_fit, ridge_grid_fit, standardize, unscale_params)
+                      ridge_fit, ridge_grid_fit, sparse_fista_fit,
+                      sparse_linear_grid_fit, standardize, unscale_params)
 
 
 def _n_classes(y) -> int:
@@ -51,7 +53,8 @@ def _grouped_grid_fit(est, X, y, fold_weights, grids, *, loss: str,
         groups[(int(m.get("max_iter", 100)), bool(m.get("fit_intercept", True)),
                 bool(m.get("standardization", True)),
                 float(m.get("tol", 1e-6)))].append(gi)
-    Xj = device_matrix(X)
+    sparse = isinstance(X, SparseMatrix)
+    Xj = X if sparse else device_matrix(X)
     yj = jnp.asarray(y, jnp.float32)
     Wj = to_device_f32(fold_weights, exact=True)
     nc = 1 if n_classes <= 2 else n_classes
@@ -60,7 +63,17 @@ def _grouped_grid_fit(est, X, y, fold_weights, grids, *, loss: str,
         l2s = jnp.asarray([p[0] for p in pens], jnp.float32)
         l1s = jnp.asarray([p[1] for p in pens], jnp.float32)
         from ..profiling import cost_analysis_enabled, record_program_cost
-        if loss == "squared" and all(p[1] == 0.0 for p in pens):
+        if sparse:
+            # flat-COO path: FISTA via take+segment_sum for every loss
+            # (the closed-form ridge would need an [D, D] Gram — at the
+            # 100k-column regime this path exists for, that is the dense
+            # blow-up the representation is here to avoid)
+            res = sparse_linear_grid_fit(
+                Xj.values, Xj.indices, Xj.row_ids, yj, Wj, l2s, l1s,
+                n_rows=Xj.n_rows, n_cols=Xj.n_cols, loss=loss,
+                fit_intercept=fit_intercept, standardization=standardization,
+                max_iter=max_iter, tol=tol, n_classes=nc)
+        elif loss == "squared" and all(p[1] == 0.0 for p in pens):
             res = ridge_grid_fit(Xj, yj, Wj, l2s, fit_intercept=fit_intercept,
                                  standardization=standardization)
             if cost_analysis_enabled():
@@ -119,14 +132,24 @@ def _linear_device_scores(Xd, coef, intercept, *, kind: str, full: bool,
     version dispatched 4-7 separate tiny executables (matmul, sigmoid,
     greater, stack, ...) per call, each paying dispatch latency (and a
     first-time executable load) on the tunneled TPU."""
+    return _scores_from_linear(Xd @ coef, intercept, kind=kind, full=full,
+                               family=family)
+
+
+@functools.partial(jax.jit, static_argnames=("kind", "full", "family"))
+def _scores_from_linear(lin, intercept, *, kind: str, full: bool,
+                        family: str = "gaussian"):
+    """Score-chain tail given the linear predictor ``lin = X @ coef`` — the
+    shared seam that lets the sparse path swap in a segment-sum matvec
+    while keeping the post-processing program identical to the dense one."""
     if kind == "multinomial":
-        logits = Xd @ coef + intercept
+        logits = lin + intercept
         out = {"prediction": jnp.argmax(logits, axis=1).astype(jnp.float32),
                "probability": jax.nn.softmax(logits, axis=-1)}
         if full:
             out["rawPrediction"] = logits
         return out
-    margin = Xd @ coef + (intercept[0] if intercept.ndim else intercept)
+    margin = lin + (intercept[0] if intercept.ndim else intercept)
     if kind == "binary":
         p1 = jax.nn.sigmoid(margin)
         out = {"prediction": (margin > 0).astype(jnp.float32), "scores": p1}
@@ -160,21 +183,28 @@ class LinearPredictionModel(PredictionModel):
         ``full=True`` mirrors ``predict_arrays``' key set exactly (probability
         + rawPrediction) so the Prediction schema is residency-independent."""
         kind = self.fitted["kind"]
+        if isinstance(Xd, SparseMatrix):
+            # margin via segment-sum matvec; identical post-processing
+            return _scores_from_linear(
+                Xd @ jnp.asarray(self.fitted["coef"]),
+                jnp.asarray(self.fitted["intercept"]), kind=kind,
+                full=bool(full), family=self.fitted.get("family", "gaussian"))
         return _linear_device_scores(
             Xd, jnp.asarray(self.fitted["coef"]),
             jnp.asarray(self.fitted["intercept"]), kind=kind,
             full=bool(full), family=self.fitted.get("family", "gaussian"))
 
-    def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+    def predict_arrays(self, X) -> Dict[str, np.ndarray]:
         coef = np.asarray(self.fitted["coef"], dtype=np.float32)
         intercept = np.asarray(self.fitted["intercept"], dtype=np.float32)
         kind = self.fitted["kind"]
+        lin = np.asarray(X @ coef) if isinstance(X, SparseMatrix) else X @ coef
         if kind == "multinomial":
-            logits = X @ coef + intercept
+            logits = lin + intercept
             prob = _np_softmax(logits)
             return {"prediction": np.argmax(logits, axis=1).astype(np.float32),
                     "probability": prob, "rawPrediction": logits}
-        margin = X @ coef + (intercept[0] if intercept.ndim else intercept)
+        margin = lin + (intercept[0] if intercept.ndim else intercept)
         if kind == "binary":
             return _binary_outputs(margin)
         if kind == "svc":
@@ -207,13 +237,24 @@ class OpLogisticRegression(PredictorEstimator):
         reg = float(self.get("reg_param", 0.0))
         en = float(self.get("elastic_net_param", 0.0))
         l1, l2 = reg * en, reg * (1.0 - en)
+        loss = "logistic" if C <= 2 else "softmax"
+        nc = 1 if C <= 2 else C
+        if isinstance(X, SparseMatrix):
+            res = sparse_fista_fit(
+                X, jnp.asarray(y), w, l2, l1, loss=loss,
+                fit_intercept=self.get("fit_intercept", True),
+                standardization=self.get("standardization", True),
+                max_iter=int(self.get("max_iter", 100)),
+                tol=float(self.get("tol", 1e-6)), n_classes=nc)
+            return {"coef": np.asarray(res.coef),
+                    "intercept": np.asarray(res.intercept),
+                    "kind": "binary" if C <= 2 else "multinomial",
+                    "n_classes": C, "n_iter": int(res.n_iter)}
         Xj = jnp.asarray(X)
         if self.get("standardization", True):
             Xs, mean, scale = standardize(Xj, w, center=self.get("fit_intercept", True))
         else:
             Xs, mean, scale = Xj, jnp.zeros(d), jnp.ones(d)
-        loss = "logistic" if C <= 2 else "softmax"
-        nc = 1 if C <= 2 else C
         res = fista_fit(Xs, jnp.asarray(y), w, jnp.float32(l2), jnp.float32(l1),
                         loss=loss, fit_intercept=self.get("fit_intercept", True),
                         max_iter=int(self.get("max_iter", 100)),
@@ -253,6 +294,17 @@ class OpLinearSVC(PredictorEstimator):
     def fit_arrays(self, X, y, sample_weight=None) -> Dict[str, Any]:
         n, d = X.shape
         w = jnp.ones(n, jnp.float32) if sample_weight is None else jnp.asarray(sample_weight)
+        if isinstance(X, SparseMatrix):
+            res = sparse_fista_fit(
+                X, jnp.asarray(y), w, float(self.get("reg_param", 0.0)), 0.0,
+                loss="squared_hinge",
+                fit_intercept=self.get("fit_intercept", True),
+                standardization=self.get("standardization", True),
+                max_iter=int(self.get("max_iter", 100)),
+                tol=float(self.get("tol", 1e-6)))
+            return {"coef": np.asarray(res.coef),
+                    "intercept": np.asarray(res.intercept),
+                    "kind": "svc", "n_classes": 2, "n_iter": int(res.n_iter)}
         Xj = jnp.asarray(X)
         if self.get("standardization", True):
             Xs, mean, scale = standardize(Xj, w, center=self.get("fit_intercept", True))
@@ -295,6 +347,16 @@ class OpLinearRegression(PredictorEstimator):
         reg = float(self.get("reg_param", 0.0))
         en = float(self.get("elastic_net_param", 0.0))
         l1, l2 = reg * en, reg * (1.0 - en)
+        if isinstance(X, SparseMatrix):
+            res = sparse_fista_fit(
+                X, jnp.asarray(y), w, l2, l1, loss="squared",
+                fit_intercept=self.get("fit_intercept", True),
+                standardization=self.get("standardization", True),
+                max_iter=int(self.get("max_iter", 100)),
+                tol=float(self.get("tol", 1e-6)))
+            return {"coef": np.asarray(res.coef),
+                    "intercept": np.asarray(res.intercept),
+                    "kind": "regression", "n_iter": int(res.n_iter)}
         Xj, yj = jnp.asarray(X), jnp.asarray(y)
         if self.get("standardization", True):
             Xs, mean, scale = standardize(Xj, w, center=self.get("fit_intercept", True))
@@ -345,6 +407,16 @@ class OpGeneralizedLinearRegression(PredictorEstimator):
                 "poisson": "poisson", "gamma": "gamma"}.get(family)
         if loss is None:
             raise ValueError(f"unsupported GLM family {family!r}")
+        if isinstance(X, SparseMatrix):
+            res = sparse_fista_fit(
+                X, jnp.asarray(y), w, float(self.get("reg_param", 0.0)), 0.0,
+                loss=loss, fit_intercept=self.get("fit_intercept", True),
+                max_iter=int(self.get("max_iter", 50)),
+                tol=float(self.get("tol", 1e-6)))
+            return {"coef": np.asarray(res.coef),
+                    "intercept": np.asarray(res.intercept),
+                    "kind": "glm", "family": family,
+                    "n_iter": int(res.n_iter)}
         Xj, yj = jnp.asarray(X), jnp.asarray(y)
         Xs, mean, scale = standardize(Xj, w, center=self.get("fit_intercept", True))
         res = fista_fit(Xs, yj, w, jnp.float32(self.get("reg_param", 0.0)),
@@ -378,10 +450,11 @@ class GLMPredictionModel(LinearPredictionModel):
         "gaussian": lambda eta: eta,
     }
 
-    def predict_arrays(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+    def predict_arrays(self, X) -> Dict[str, np.ndarray]:
         coef = np.asarray(self.fitted["coef"], dtype=np.float32)
         intercept = np.asarray(self.fitted["intercept"], dtype=np.float32)
-        eta = X @ coef + (intercept[0] if intercept.ndim else intercept)
+        lin = np.asarray(X @ coef) if isinstance(X, SparseMatrix) else X @ coef
+        eta = lin + (intercept[0] if intercept.ndim else intercept)
         inv = self._INVERSE_LINK[self.fitted.get("family", "gaussian")]
         return {"prediction": inv(eta).astype(np.float32)}
 

@@ -24,6 +24,9 @@ from typing import Callable, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from transmogrifai_tpu.sparse.matrix import (sp_matmat, sp_matvec,
+                                             sp_rmatmat, sp_rmatvec)
+
 
 # --------------------------------------------------------------------------
 # losses: value-and-grad of the smooth part, given margins/logits
@@ -128,63 +131,25 @@ def _spectral_norm_sq_weighted(X: jnp.ndarray, wn: jnp.ndarray,
     return jnp.vdot(v, mv(v))
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("loss", "fit_intercept", "max_iter", "n_classes"))
-def fista_fit(X: jnp.ndarray, y: jnp.ndarray, sample_weight: jnp.ndarray,
-              l2: jnp.ndarray, l1: jnp.ndarray, *, loss: str = "logistic",
-              fit_intercept: bool = True, max_iter: int = 100,
-              tol: float = 1e-6, n_classes: int = 1,
-              mean: Optional[jnp.ndarray] = None,
-              scale: Optional[jnp.ndarray] = None,
-              sigma_sq: Optional[jnp.ndarray] = None) -> FitResult:
-    """Accelerated proximal gradient with adaptive restart.
+def _loss_target(loss: str, y: jnp.ndarray, n_classes: int) -> jnp.ndarray:
+    if loss == "softmax":
+        return jax.nn.one_hot(y.astype(jnp.int32), n_classes,
+                              dtype=jnp.float32)
+    if loss == "squared_hinge":
+        return jnp.where(y > 0.5, 1.0, -1.0).astype(jnp.float32)
+    return y.astype(jnp.float32)
 
-    minimises  mean_loss(Xs w + b) + l2/2 ||w||² + l1 ||w||₁  (no penalty on b)
-    where Xs = (X - mean)/scale is the IMPLICITLY standardized matrix when
-    ``mean``/``scale`` are given — the standardized copy is never
-    materialized, so every (fold × grid) vmap lane shares the single
-    HBM-resident ``X`` and XLA batches the lanes' matvecs into one matmul.
-    The returned coefficients live in the standardized basis (caller
-    un-scales, matching Spark ML's internal-standardization contract).
 
-    ``l2``/``l1`` may be traced scalars → vmap over a regularisation grid.
-    ``sigma_sq`` (λ_max of the weighted Gram) may be shared across grid
-    lanes; computed here when absent.
-    """
-    n, d = X.shape
+def _fista_loop(xs_mv: Callable, xs_tmv: Callable, target: jnp.ndarray,
+                w: jnp.ndarray, l2: jnp.ndarray, l1: jnp.ndarray, *,
+                loss: str, d: int, n_classes: int, fit_intercept: bool,
+                max_iter: int, tol: float, sigma_sq: jnp.ndarray) -> FitResult:
+    """The FISTA iteration shared by the dense and sparse fitters: the data
+    matrix enters ONLY through the ``xs_mv``/``xs_tmv`` closures, so the same
+    loop serves both the implicit-standardized dense matmuls and the
+    take+segment_sum flat-COO matvecs."""
     C = n_classes
     loss_fn = LOSSES[loss]
-    w = sample_weight.astype(jnp.float32)
-
-    if loss == "softmax":
-        target = jax.nn.one_hot(y.astype(jnp.int32), C, dtype=jnp.float32)
-    elif loss == "squared_hinge":
-        target = jnp.where(y > 0.5, 1.0, -1.0).astype(jnp.float32)
-    else:
-        target = y.astype(jnp.float32)
-
-    std = scale is not None
-    mu = mean if std else jnp.zeros((d,), jnp.float32)
-    sc = scale if std else jnp.ones((d,), jnp.float32)
-
-    def xs_mv(coef):
-        """Xs @ coef without materializing Xs ([N] or [N, C])."""
-        v = coef / (sc[:, None] if coef.ndim == 2 else sc)
-        return X @ v - mu @ v
-
-    def xs_tmv(glin):
-        """Xs^T @ glin ([D] or [D, C])."""
-        if glin.ndim == 2:
-            sg = jnp.sum(glin, axis=0)
-            num = X.T @ glin - mu[:, None] * sg[None, :]
-            return num / sc[:, None]
-        return (X.T @ glin - mu * jnp.sum(glin)) / sc
-
-    # step size from Lipschitz bound: c * sigma_max(Xs_w)^2 (+ l2)
-    wn = w / jnp.sum(w)
-    if sigma_sq is None:
-        sigma_sq = _spectral_norm_sq_weighted(X, wn, mu, sc)
     L = _LOSS_CURVATURE[loss] * sigma_sq + l2
     step0 = 1.0 / jnp.maximum(L, 1e-12)
     backtrack = loss in _BACKTRACK_LOSSES
@@ -267,6 +232,61 @@ def fista_fit(X: jnp.ndarray, y: jnp.ndarray, sample_weight: jnp.ndarray,
     k, coef, intercept, *_ = jax.lax.while_loop(cond, body, init)
     obj = smooth_val(coef, intercept) + l1 * jnp.sum(jnp.abs(coef))
     return FitResult(coef, jnp.atleast_1d(intercept), k, obj)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("loss", "fit_intercept", "max_iter", "n_classes"))
+def fista_fit(X: jnp.ndarray, y: jnp.ndarray, sample_weight: jnp.ndarray,
+              l2: jnp.ndarray, l1: jnp.ndarray, *, loss: str = "logistic",
+              fit_intercept: bool = True, max_iter: int = 100,
+              tol: float = 1e-6, n_classes: int = 1,
+              mean: Optional[jnp.ndarray] = None,
+              scale: Optional[jnp.ndarray] = None,
+              sigma_sq: Optional[jnp.ndarray] = None) -> FitResult:
+    """Accelerated proximal gradient with adaptive restart.
+
+    minimises  mean_loss(Xs w + b) + l2/2 ||w||² + l1 ||w||₁  (no penalty on b)
+    where Xs = (X - mean)/scale is the IMPLICITLY standardized matrix when
+    ``mean``/``scale`` are given — the standardized copy is never
+    materialized, so every (fold × grid) vmap lane shares the single
+    HBM-resident ``X`` and XLA batches the lanes' matvecs into one matmul.
+    The returned coefficients live in the standardized basis (caller
+    un-scales, matching Spark ML's internal-standardization contract).
+
+    ``l2``/``l1`` may be traced scalars → vmap over a regularisation grid.
+    ``sigma_sq`` (λ_max of the weighted Gram) may be shared across grid
+    lanes; computed here when absent.
+    """
+    n, d = X.shape
+    C = n_classes
+    w = sample_weight.astype(jnp.float32)
+    target = _loss_target(loss, y, C)
+
+    std = scale is not None
+    mu = mean if std else jnp.zeros((d,), jnp.float32)
+    sc = scale if std else jnp.ones((d,), jnp.float32)
+
+    def xs_mv(coef):
+        """Xs @ coef without materializing Xs ([N] or [N, C])."""
+        v = coef / (sc[:, None] if coef.ndim == 2 else sc)
+        return X @ v - mu @ v
+
+    def xs_tmv(glin):
+        """Xs^T @ glin ([D] or [D, C])."""
+        if glin.ndim == 2:
+            sg = jnp.sum(glin, axis=0)
+            num = X.T @ glin - mu[:, None] * sg[None, :]
+            return num / sc[:, None]
+        return (X.T @ glin - mu * jnp.sum(glin)) / sc
+
+    # step size from Lipschitz bound: c * sigma_max(Xs_w)^2 (+ l2)
+    wn = w / jnp.sum(w)
+    if sigma_sq is None:
+        sigma_sq = _spectral_norm_sq_weighted(X, wn, mu, sc)
+    return _fista_loop(xs_mv, xs_tmv, target, w, l2, l1, loss=loss, d=d,
+                       n_classes=C, fit_intercept=fit_intercept,
+                       max_iter=max_iter, tol=tol, sigma_sq=sigma_sq)
 
 
 @functools.partial(jax.jit, static_argnames=("fit_intercept",))
@@ -443,6 +463,118 @@ def standardize(X: jnp.ndarray, sample_weight: jnp.ndarray,
     un-scales the coefficients; we do the same).  Returns (Xs, mean, scale)."""
     mu, scale = standardize_moments(X, sample_weight, center)
     return (X - mu) / scale, mu, scale
+
+
+# --------------------------------------------------------------------------
+# sparse (flat-COO) fitters: same FISTA loop, matvecs via take + segment_sum
+# --------------------------------------------------------------------------
+
+def _sp_col_scale(values, indices, row_ids, wn, n_cols):
+    """Weighted per-column scale sqrt(E[x²] - E[x]²) from COO entries only.
+
+    Sparse standardization is SCALE-ONLY (Spark's ``withMean=False``
+    convention for sparse vectors): subtracting the mean would densify
+    every row, defeating the representation.  Absent columns have
+    variance 0 and clamp to scale 1e-6-ish — their coefficients stay 0.
+    """
+    mean = sp_rmatvec(values, indices, row_ids, wn, n_cols=n_cols)
+    ex2 = sp_rmatvec(values * values, indices, row_ids, wn, n_cols=n_cols)
+    var = jnp.maximum(ex2 - mean * mean, 0.0)
+    return jnp.sqrt(jnp.maximum(var, 1e-12))
+
+
+def _sp_spectral_norm_sq(values, indices, row_ids, wn, scale,
+                         n_rows: int, n_cols: int,
+                         iters: int = 16) -> jnp.ndarray:
+    """λ_max of Xs^T diag(wn) Xs for the implicitly scaled sparse matrix."""
+    v = jnp.full((n_cols,), 1.0 / jnp.sqrt(n_cols), jnp.float32)
+
+    def mv(v):
+        u = wn * sp_matvec(values, indices, row_ids, v / scale, n_rows=n_rows)
+        return sp_rmatvec(values, indices, row_ids, u, n_cols=n_cols) / scale
+
+    def body(_, v):
+        u = mv(v)
+        return u / (jnp.linalg.norm(u) + 1e-12)
+
+    v = jax.lax.fori_loop(0, iters, body, v)
+    return jnp.vdot(v, mv(v))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("loss", "fit_intercept", "standardization", "max_iter",
+                     "n_classes", "n_rows", "n_cols"))
+def sparse_linear_grid_fit(values, indices, row_ids, y, fold_weights,
+                           l2s, l1s, *, n_rows: int, n_cols: int,
+                           loss: str = "logistic", fit_intercept: bool = True,
+                           standardization: bool = True, max_iter: int = 100,
+                           tol: float = 1e-6, n_classes: int = 1) -> FitResult:
+    """``linear_grid_fit`` for a flat-COO matrix: the whole (fold × grid) CV
+    block as one XLA program, with every lane sharing the single device-
+    resident entry stream — nothing in the program is ever [N, n_cols].
+
+    Pad entries (value 0.0) and zero-weight pad rows both contribute
+    nothing to any segment sum, so the ladder padding is exact here just
+    like in the dense weighted path.  Standardization is scale-only (see
+    ``_sp_col_scale``); coefficients are returned un-scaled.
+    """
+    C = n_classes
+    target = _loss_target(loss, y, C)
+    zeros_d = jnp.zeros((n_cols,), jnp.float32)
+
+    def one_fold(w):
+        w = w.astype(jnp.float32)
+        wn = w / jnp.sum(w)
+        if standardization:
+            scale = _sp_col_scale(values, indices, row_ids, wn, n_cols)
+        else:
+            scale = jnp.ones((n_cols,), jnp.float32)
+        sigma_sq = _sp_spectral_norm_sq(values, indices, row_ids, wn, scale,
+                                        n_rows, n_cols)
+
+        def xs_mv(coef):
+            if coef.ndim == 2:
+                return sp_matmat(values, indices, row_ids,
+                                 coef / scale[:, None], n_rows=n_rows)
+            return sp_matvec(values, indices, row_ids, coef / scale,
+                             n_rows=n_rows)
+
+        def xs_tmv(glin):
+            if glin.ndim == 2:
+                return sp_rmatmat(values, indices, row_ids, glin,
+                                  n_cols=n_cols) / scale[:, None]
+            return sp_rmatvec(values, indices, row_ids, glin,
+                              n_cols=n_cols) / scale
+
+        def one_pt(l2, l1):
+            res = _fista_loop(xs_mv, xs_tmv, target, w, l2, l1, loss=loss,
+                              d=n_cols, n_classes=C,
+                              fit_intercept=fit_intercept, max_iter=max_iter,
+                              tol=tol, sigma_sq=sigma_sq)
+            return unscale_params(res, zeros_d, scale, C)
+
+        return jax.vmap(one_pt)(l2s, l1s)
+
+    return jax.vmap(one_fold)(fold_weights)
+
+
+def sparse_fista_fit(sm, y, sample_weight, l2: float, l1: float, *,
+                     loss: str = "logistic", fit_intercept: bool = True,
+                     standardization: bool = True, max_iter: int = 100,
+                     tol: float = 1e-6, n_classes: int = 1) -> FitResult:
+    """Single-point sparse fit: the G=1, F=1 slice of the grid program (one
+    code path to test, and the single-fit case replays the grid executable
+    when shapes match).  ``sm`` is a ``sparse.matrix.SparseMatrix``."""
+    w = jnp.asarray(sample_weight, jnp.float32)
+    res = sparse_linear_grid_fit(
+        sm.values, sm.indices, sm.row_ids, jnp.asarray(y), w[None, :],
+        jnp.asarray([l2], jnp.float32), jnp.asarray([l1], jnp.float32),
+        n_rows=sm.n_rows, n_cols=sm.n_cols, loss=loss,
+        fit_intercept=fit_intercept, standardization=standardization,
+        max_iter=max_iter, tol=tol, n_classes=n_classes)
+    return FitResult(res.coef[0, 0], res.intercept[0, 0],
+                     res.n_iter[0, 0], res.objective[0, 0])
 
 
 def unscale_params(res: FitResult, mean: jnp.ndarray, scale: jnp.ndarray,

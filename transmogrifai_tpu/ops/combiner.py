@@ -25,8 +25,14 @@ class VectorsCombiner(Transformer):
 
     def transform(self, batch: ColumnBatch) -> Column:
         from ..columns import feature_matrix_dtype
+        from ..sparse.matrix import SparseMatrix
 
         import jax
+        import numpy as np
+
+        cols = [batch[f.name] for f in self.input_features]
+        if any(isinstance(c.values, SparseMatrix) for c in cols):
+            return self._transform_sparse(batch, cols)
 
         arrays, metas = [], []
         width = 0
@@ -51,3 +57,31 @@ class VectorsCombiner(Transformer):
         dtype = feature_matrix_dtype(n * width)
         arrays = [a if a.dtype == dtype else a.astype(dtype) for a in arrays]
         return Column(OPVector, jnp.concatenate(arrays, axis=1), meta=meta)
+
+    def _transform_sparse(self, batch: ColumnBatch, cols) -> Column:
+        """Any sparse input block makes the combined matrix sparse: dense
+        sibling blocks contribute their nonzero cells to the shared COO
+        stream at the same column offsets the dense concat would use, so
+        the lineage metadata stays layout-identical."""
+        import numpy as np
+
+        from ..sparse.matrix import SparseMatrix
+        from ..sparse.transform import combine_blocks
+
+        blocks, metas = [], []
+        for f, col in zip(self.input_features, cols):
+            v = col.values
+            if not isinstance(v, SparseMatrix):
+                v = np.asarray(v, dtype=np.float32)
+                if v.ndim == 1:
+                    v = v[:, None]
+            w = v.shape[1]
+            blocks.append(v)
+            if col.meta is not None:
+                metas.append(col.meta)
+            else:
+                metas.append(VectorMeta(f.name, [
+                    VectorColumnMeta(f.name, f.kind.__name__)
+                    for _ in range(w)]))
+        meta = VectorMeta.flatten(self.output_name(), metas)
+        return Column(OPVector, combine_blocks(blocks, len(batch)), meta=meta)

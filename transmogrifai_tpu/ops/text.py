@@ -87,9 +87,15 @@ def _counts_from_flat(lens: np.ndarray, flat: np.ndarray, num_hashes: int,
     if not flat.size:
         return out
     rows = np.repeat(np.arange(len(lens)), lens)
-    np.add.at(out, (rows, flat), 1.0)
     if binary:
-        out = (out > 0).astype(np.float32)
+        # dedupe (row, bucket) pairs on int64 keys and write the indicator
+        # into the single output buffer — the old `(out > 0).astype(...)`
+        # allocated a SECOND dense [N, H] copy just to threshold it, pure
+        # waste whenever empty-token rows leave most of the matrix zero
+        keys = np.unique(rows.astype(np.int64) * num_hashes + flat)
+        out[keys // num_hashes, keys % num_hashes] = 1.0
+        return out
+    np.add.at(out, (rows, flat), 1.0)
     return out
 
 
@@ -227,6 +233,11 @@ def _sentinel3(num_hashes: int) -> np.int32:
 # elements (16 MB of f32) — below it, host numpy + one bf16-wire transfer
 # in the combiner is cheaper than per-block dispatch latency
 _DEVICE_ASSEMBLE_ELEMS = 1 << 22
+
+# hash spaces at/above this width vectorize SPARSE by default (the dense
+# [N, num_hashes] block at 4096+ columns starts to dominate memory while
+# its density collapses); override per stage with sparse_hashing=True/False
+SPARSE_MIN_HASHES = 4096
 
 
 def _one_hot_on_device(ids: np.ndarray, width: int, dtype=jnp.float32):
@@ -403,6 +414,8 @@ class SmartTextVectorizerModel(TransformerModel):
         from .categorical import encode_column
         from .text_profile import column_profile
 
+        if self.fitted.get("sparse"):
+            return None          # sparse representation assembles host-side
         num_hashes = self.get("num_hashes")
         if num_hashes >= 1024:
             return None          # packed 10-bit wire only
@@ -480,10 +493,49 @@ class SmartTextVectorizerModel(TransformerModel):
 
         return wire, body
 
+    def _transform_sparse(self, batch: ColumnBatch) -> Column:
+        """Fused hashed-text -> device SparseMatrix: the flat bucket stream
+        dedupes host-side and ships as COO entries — the dense
+        [N, num_hashes] matrix is NEVER materialized, so peak memory scales
+        with nnz instead of rows x num_hashes.  Pivot/null blocks ride along
+        as (tiny) dense blocks folded into the same entry stream."""
+        from ..sparse.transform import combine_blocks, sparse_from_hash_flat
+        from .categorical import encode_column
+        from .text_profile import column_profile
+
+        num_hashes = self.get("num_hashes")
+        n = len(batch)
+        strategies = self.fitted["strategies"]
+        track_nulls = self.get("track_nulls", True)
+        blocks: List[Any] = []
+        for f in self.input_features:
+            strat = strategies[f.name]
+            prof = column_profile(batch[f.name])
+            if strat == "pivot":
+                vocab = self.fitted["vocabs"][f.name]
+                other = len(vocab)
+                ids = encode_column(batch[f.name], vocab, other)
+                width = other + 2  # OTHER + null
+                blocks.append(np.asarray(
+                    ids[:, None] == np.arange(width)[None, :], np.float32))
+            elif strat == "ignore":
+                if track_nulls:
+                    blocks.append(prof.null.astype(np.float32)[:, None])
+            else:  # hash
+                lens, flat = prof.buckets(num_hashes)
+                blocks.append(sparse_from_hash_flat(
+                    lens, flat, num_hashes, record=False))
+                if track_nulls:
+                    blocks.append(prof.null.astype(np.float32)[:, None])
+        sm = combine_blocks(blocks, n)
+        return Column(OPVector, sm, meta=self.fitted["meta"])
+
     def transform(self, batch: ColumnBatch) -> Column:
         from ..columns import feature_matrix_dtype
         from .text_profile import column_profile
 
+        if self.fitted.get("sparse"):
+            return self._transform_sparse(batch)
         num_hashes = self.get("num_hashes")
         n = len(batch)
         strategies = self.fitted["strategies"]
@@ -548,12 +600,16 @@ class SmartTextVectorizer(Estimator):
     def __init__(self, max_cardinality: int = 30, top_k: int = 20,
                  min_support: int = 10, num_hashes: int = 512,
                  track_nulls: bool = True, auto_detect_languages: bool = False,
-                 min_length_std_dev: float = 0.0, **params):
+                 min_length_std_dev: float = 0.0,
+                 sparse_hashing: Any = "auto", **params):
+        # sparse_hashing: "auto" -> sparse when num_hashes >= SPARSE_MIN_HASHES
+        # and any feature hashes; True/False force/forbid the sparse output
         super().__init__(max_cardinality=max_cardinality, top_k=top_k,
                          min_support=min_support, num_hashes=num_hashes,
                          track_nulls=track_nulls,
                          auto_detect_languages=auto_detect_languages,
-                         min_length_std_dev=min_length_std_dev, **params)
+                         min_length_std_dev=min_length_std_dev,
+                         sparse_hashing=sparse_hashing, **params)
 
     def fit(self, batch: ColumnBatch) -> TransformerModel:
         from collections import Counter
@@ -604,10 +660,17 @@ class SmartTextVectorizer(Estimator):
                     cols_meta.append(VectorColumnMeta(
                         f.name, f.kind.__name__, indicator_value=NULL_INDICATOR))
         meta = VectorMeta(self.output_name(), cols_meta)
+        mode = self.get("sparse_hashing", "auto")
+        use_sparse = (any(s == "hash" for s in strategies.values())
+                      and (mode is True
+                           or (mode == "auto" and self.get("num_hashes")
+                               >= SPARSE_MIN_HASHES)))
         model = SmartTextVectorizerModel(
-            fitted={"strategies": strategies, "vocabs": vocabs, "meta": meta},
+            fitted={"strategies": strategies, "vocabs": vocabs, "meta": meta,
+                    "sparse": use_sparse},
             **self.params)
         model.metadata["strategies"] = dict(strategies)
+        model.metadata["sparse"] = use_sparse
         return self._finalize_model(model)
 
 

@@ -300,11 +300,16 @@ class ModelSelector(Estimator):
                 F, rows, lanes = meta["folds"], meta["rows"], meta["lanes"]
             else:
                 F, rows, lanes = shape[0], shape[1], len(cand.grid)
+            from .sparse.matrix import SparseMatrix
+
             pad = rows - X.shape[0]
             if pad:
-                Xj = X if isinstance(X, jax.Array) else jnp.asarray(
-                    X, jnp.float32)
-                X = jnp.pad(Xj, ((0, pad), (0, 0)))
+                if isinstance(X, SparseMatrix):
+                    X = X.pad_rows(rows)   # empty rows, zero-weight below
+                else:
+                    Xj = X if isinstance(X, jax.Array) else jnp.asarray(
+                        X, jnp.float32)
+                    X = jnp.pad(Xj, ((0, pad), (0, 0)))
                 y = jnp.pad(jnp.asarray(y, jnp.float32), (0, pad))
             # all-ones fold weights materialize ON DEVICE — zero wire bytes;
             # padded rows get weight 0 so they can't perturb the fit
@@ -335,9 +340,12 @@ class ModelSelector(Estimator):
         import jax
         import jax.numpy as jnp
 
+        from .sparse.matrix import SparseMatrix
+
         out: Dict[str, Any] = {}
         dev_out = y_dev = w_dev = None
-        if isinstance(X, jax.Array) and hasattr(model, "device_scores"):
+        if (isinstance(X, (jax.Array, SparseMatrix))
+                and hasattr(model, "device_scores")):
             try:
                 dev_out = model.device_scores(X, full=True)
                 y_dev = jnp.asarray(y, jnp.float32)

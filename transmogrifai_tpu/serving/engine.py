@@ -113,6 +113,15 @@ class _ModelEntry:
         self.created_at: Optional[float] = (
             float(created) if isinstance(created, (int, float)) else None)
         self.loaded_at: float = time.time()
+        # sparse-model detection: a SmartTextVectorizer that routed text to
+        # the COO path stamps metadata["sparse"]=True on its fitted stage
+        # (metadata round-trips through the bundle) — /metrics exposes this
+        # so operators can see which serving processes run sparse bundles
+        self.sparse: bool = any(
+            bool(getattr(st, "metadata", None)
+                 and st.metadata.get("sparse"))
+            for layer in (getattr(model, "fitted_dag", None) or [])
+            for st in layer)
 
 
 def _result_row(scored: ColumnBatch, names: Sequence[str], i: int
@@ -242,6 +251,13 @@ class ScoringEngine:
     @property
     def compiled_path_active(self) -> bool:
         return self._compiled_ok
+
+    @property
+    def sparse_model_active(self) -> bool:
+        """True when the active bundle vectorizes through the sparse COO
+        path (any fitted stage with ``metadata["sparse"]``)."""
+        with self._swap_lock:
+            return self._entry.sparse
 
     # -- lifecycle hooks ---------------------------------------------------
     def add_batch_observer(self, fn: Callable) -> None:

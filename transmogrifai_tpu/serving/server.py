@@ -95,6 +95,17 @@ def render_metrics(engine: ScoringEngine) -> str:
           "Grid points pruned by selector racing")
     gauge("host_link_bytes_total", reg.get("host_link.bytes", 0),
           "Tracked host-to-device transfer bytes")
+    # sparse feature family (ISSUE 7): volumes from the COO transform path
+    # plus whether the ACTIVE bundle vectorizes sparse at all
+    gauge("sparse_model_active", int(engine.sparse_model_active),
+          "1 when the active bundle vectorizes text through the sparse "
+          "COO path")
+    gauge("sparse_nnz_total", reg.get("sparse.nnz_total", 0),
+          "COO entries built by the sparse transform in this process")
+    gauge("sparse_matrices_total", reg.get("sparse.matrices", 0),
+          "Sparse matrices built by the transform in this process")
+    gauge("sparse_matrix_density", reg.get("sparse.density", 0),
+          "Density of the most recently built sparse matrix")
     gauge("model_staleness_seconds", round(engine.model_staleness_s, 3),
           "Seconds since the active bundle was created")
     # drift families: the attached DriftMonitor (engine.attach_drift_monitor)

@@ -1,0 +1,48 @@
+"""Sparse device representation for high-cardinality hashed features.
+
+The dense transmogrification path materializes a ``[N, num_hashes]``
+feature matrix; at 100k+ hashed columns that matrix dominates memory even
+though almost every cell is zero.  This package provides the second device
+data representation the rest of the pipeline threads through:
+
+- :mod:`transmogrifai_tpu.sparse.matrix` — ``SparseMatrix``, a padded
+  flat-COO container whose nnz capacity and row count sit on the same
+  zero-pad size ladders as the dense path, so fitted executables replay
+  from the persistent compile cache across batches.
+- :mod:`transmogrifai_tpu.sparse.transform` — the fused
+  ``hash_tokens_flat`` → device sparse matrix transform (the dense
+  ``[N, num_hashes]`` array is never materialized), plus process-wide
+  nnz/density stats feeding the telemetry gauges.
+"""
+
+from transmogrifai_tpu.sparse.matrix import (  # noqa: F401
+    SparseMatrix,
+    nnz_capacity,
+    sp_matmat,
+    sp_matvec,
+    sp_rmatmat,
+    sp_rmatvec,
+)
+from transmogrifai_tpu.sparse.transform import (  # noqa: F401
+    combine_blocks,
+    hash_tokens_to_sparse,
+    record_sparse_stats,
+    reset_sparse_stats,
+    sparse_from_hash_flat,
+    sparse_stats,
+)
+
+__all__ = [
+    "SparseMatrix",
+    "nnz_capacity",
+    "sp_matvec",
+    "sp_rmatvec",
+    "sp_matmat",
+    "sp_rmatmat",
+    "sparse_from_hash_flat",
+    "hash_tokens_to_sparse",
+    "combine_blocks",
+    "sparse_stats",
+    "record_sparse_stats",
+    "reset_sparse_stats",
+]

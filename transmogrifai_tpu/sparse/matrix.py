@@ -1,0 +1,250 @@
+"""Padded flat-COO device container for hashed-text feature matrices.
+
+Layout: three flat device arrays — ``values [cap] f32``, ``indices [cap]
+int32`` (column ids) and ``row_ids [cap] int32`` — where the first ``nnz``
+entries are real and the remainder is padding (``value 0.0`` at
+``row 0 / col 0``, which contributes nothing to any segment sum).  The
+entry capacity sits on the same {2^k, 1.5*2^k} size ladder as the dense
+batch ladder, and the row count can be padded with empty rows, so the
+fitted/scoring executables specialize on a small set of shapes and replay
+from the persistent compile cache across batches.
+
+This is COO rather than row-pointer CSR because every consumer is a
+gather/segment-sum (`matvec`, `rmatvec`, column moments): with
+``num_segments`` static, XLA lowers those to a single sorted scatter-add
+and no kernel ever needs ``row_ptr``.  ``row_ids`` is also what keeps the
+pad semantics trivial — a pad entry is just a zero addend.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NNZ_FLOOR = 1024
+
+
+def nnz_capacity(n, floor=_NNZ_FLOOR):
+    """Smallest ladder rung {2^k, 1.5*2^k} >= n, with a floor.
+
+    Mirrors the dense batch ladder so sparse executables enjoy the same
+    compile-cache replay guarantees.
+    """
+    n = max(int(n), 1)
+    cap = floor
+    while cap < n:
+        if (cap * 3) // 2 >= n:
+            return (cap * 3) // 2
+        cap *= 2
+    return cap
+
+
+@functools.partial(jax.jit, static_argnames=("n_rows",))
+def sp_matvec(values, indices, row_ids, v, *, n_rows):
+    """``X @ v`` for flat-COO ``X`` — [cap] entries -> [n_rows]."""
+    return jax.ops.segment_sum(values * jnp.take(v, indices),
+                               row_ids, num_segments=n_rows)
+
+
+@functools.partial(jax.jit, static_argnames=("n_cols",))
+def sp_rmatvec(values, indices, row_ids, u, *, n_cols):
+    """``X.T @ u`` for flat-COO ``X`` — [cap] entries -> [n_cols]."""
+    return jax.ops.segment_sum(values * jnp.take(u, row_ids),
+                               indices, num_segments=n_cols)
+
+
+@functools.partial(jax.jit, static_argnames=("n_rows",))
+def sp_matmat(values, indices, row_ids, m, *, n_rows):
+    """``X @ M`` for flat-COO ``X`` and dense ``M [n_cols, k]`` -> [n_rows, k]."""
+    prod = values[:, None] * jnp.take(m, indices, axis=0)
+    return jax.ops.segment_sum(prod, row_ids, num_segments=n_rows)
+
+
+@functools.partial(jax.jit, static_argnames=("n_cols",))
+def sp_rmatmat(values, indices, row_ids, g, *, n_cols):
+    """``X.T @ G`` for flat-COO ``X`` and dense ``G [n_rows, k]`` -> [n_cols, k]."""
+    prod = values[:, None] * jnp.take(g, row_ids, axis=0)
+    return jax.ops.segment_sum(prod, indices, num_segments=n_cols)
+
+
+def _concat_ranges(starts, counts):
+    """Vectorized ``concatenate([arange(s, s+c) for s, c in ...])``."""
+    counts = np.asarray(counts, dtype=np.int64)
+    total = int(counts.sum())
+    if total == 0:
+        return np.zeros(0, dtype=np.int64)
+    nz = counts > 0
+    s, c = np.asarray(starts, dtype=np.int64)[nz], counts[nz]
+    out = np.ones(total, dtype=np.int64)
+    out[0] = s[0]
+    if len(s) > 1:
+        cum = np.cumsum(c)[:-1]
+        out[cum] = s[1:] - (s[:-1] + c[:-1] - 1)
+    return np.cumsum(out)
+
+
+class SparseMatrix:
+    """Device-resident padded flat-COO matrix (see module docstring)."""
+
+    __slots__ = ("values", "indices", "row_ids", "n_rows", "n_cols", "nnz",
+                 "__weakref__")
+
+    def __init__(self, values, indices, row_ids, n_rows, n_cols, nnz=None):
+        self.values = jnp.asarray(values, dtype=jnp.float32)
+        self.indices = jnp.asarray(indices, dtype=jnp.int32)
+        self.row_ids = jnp.asarray(row_ids, dtype=jnp.int32)
+        self.n_rows = int(n_rows)
+        self.n_cols = int(n_cols)
+        self.nnz = int(self.values.shape[0] if nnz is None else nnz)
+        if not (self.values.shape == self.indices.shape == self.row_ids.shape):
+            raise ValueError("values/indices/row_ids must share one flat shape")
+
+    # ---- shape protocol (what the dense code paths probe) -------------
+    @property
+    def shape(self):
+        return (self.n_rows, self.n_cols)
+
+    @property
+    def ndim(self):
+        return 2
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def capacity(self):
+        return int(self.values.shape[0])
+
+    @property
+    def density(self):
+        cells = self.n_rows * self.n_cols
+        return float(self.nnz) / cells if cells else 0.0
+
+    @property
+    def nbytes(self):
+        return int(self.values.nbytes + self.indices.nbytes
+                   + self.row_ids.nbytes)
+
+    def __len__(self):
+        return self.n_rows
+
+    def __repr__(self):
+        return (f"SparseMatrix(shape={self.shape}, nnz={self.nnz}, "
+                f"capacity={self.capacity}, density={self.density:.2e})")
+
+    def __array__(self, dtype=None, copy=None):
+        raise TypeError(
+            "refusing to densify SparseMatrix implicitly "
+            f"(shape {self.shape}); call .to_dense() explicitly")
+
+    # ---- construction -------------------------------------------------
+    @classmethod
+    def from_coo(cls, rows, cols, vals, n_rows, n_cols, nnz_pad=None):
+        """Build from host COO triples; pads entry count to the ladder."""
+        rows = np.asarray(rows, dtype=np.int32)
+        cols = np.asarray(cols, dtype=np.int32)
+        vals = np.asarray(vals, dtype=np.float32)
+        nnz = len(vals)
+        cap = nnz_capacity(nnz) if nnz_pad is None else int(nnz_pad)
+        if cap < nnz:
+            raise ValueError(f"nnz_pad {cap} < nnz {nnz}")
+        if cap > nnz:
+            pad = cap - nnz
+            rows = np.concatenate([rows, np.zeros(pad, np.int32)])
+            cols = np.concatenate([cols, np.zeros(pad, np.int32)])
+            vals = np.concatenate([vals, np.zeros(pad, np.float32)])
+        return cls(vals, cols, rows, n_rows, n_cols, nnz=nnz)
+
+    @classmethod
+    def from_dense(cls, x, nnz_pad=None):
+        """Test/interop helper: dense [N, D] -> SparseMatrix."""
+        x = np.asarray(x, dtype=np.float32)
+        rows, cols = np.nonzero(x)
+        return cls.from_coo(rows, cols, x[rows, cols], x.shape[0],
+                            x.shape[1], nnz_pad=nnz_pad)
+
+    # ---- device linear algebra ----------------------------------------
+    def matvec(self, v):
+        return sp_matvec(self.values, self.indices, self.row_ids,
+                         jnp.asarray(v), n_rows=self.n_rows)
+
+    def rmatvec(self, u):
+        return sp_rmatvec(self.values, self.indices, self.row_ids,
+                          jnp.asarray(u), n_cols=self.n_cols)
+
+    def matmat(self, m):
+        return sp_matmat(self.values, self.indices, self.row_ids,
+                         jnp.asarray(m), n_rows=self.n_rows)
+
+    def __matmul__(self, other):
+        other = jnp.asarray(other)
+        if other.ndim == 1:
+            return self.matvec(other)
+        return self.matmat(other)
+
+    def to_dense(self):
+        """Materialize the dense [n_rows, n_cols] matrix (tests/small data)."""
+        out = jnp.zeros((self.n_rows, self.n_cols), dtype=self.values.dtype)
+        return out.at[self.row_ids, self.indices].add(self.values)
+
+    # ---- padding / slicing (ladder semantics) -------------------------
+    def pad_rows(self, n_rows):
+        """Grow to ``n_rows`` with empty rows (exact: pads own no entries)."""
+        if n_rows < self.n_rows:
+            raise ValueError(f"pad_rows {n_rows} < n_rows {self.n_rows}")
+        if n_rows == self.n_rows:
+            return self
+        return SparseMatrix(self.values, self.indices, self.row_ids,
+                            n_rows, self.n_cols, nnz=self.nnz)
+
+    def host_coo(self):
+        """Real (unpadded) entries as host numpy (rows, cols, vals)."""
+        k = self.nnz
+        return (np.asarray(self.row_ids[:k]), np.asarray(self.indices[:k]),
+                np.asarray(self.values[:k]))
+
+    def take_rows(self, idx):
+        """Row-subset (duplicates allowed) -> new SparseMatrix."""
+        idx = np.asarray(idx, dtype=np.int64)
+        rows, cols, vals = self.host_coo()
+        order = np.argsort(rows, kind="stable")
+        rows, cols, vals = rows[order], cols[order], vals[order]
+        starts = np.searchsorted(rows, idx, side="left")
+        ends = np.searchsorted(rows, idx, side="right")
+        counts = ends - starts
+        gather = _concat_ranges(starts, counts)
+        out_rows = np.repeat(np.arange(len(idx), dtype=np.int64), counts)
+        return SparseMatrix.from_coo(out_rows, cols[gather], vals[gather],
+                                     len(idx), self.n_cols)
+
+    def dense_rows(self, idx):
+        """Densify a small row subset as host numpy [len(idx), n_cols]."""
+        sub = self.take_rows(idx)
+        return np.asarray(sub.to_dense())
+
+
+# pytree registration lets a SparseMatrix cross jit boundaries (compiled
+# scoring passes one as a fused-program argument) and ride vmap/grad with the
+# COO arrays as leaves.  ``nnz`` is deliberately NOT aux data: it varies per
+# batch while the padded capacity sits on the ladder, and keying the jit
+# cache on it would retrace every batch.  A reconstructed matrix therefore
+# reports nnz == capacity — exact for all device math (padding is zero
+# entries), only host_coo/density on a rebuilt object over-count the pad.
+def _sm_flatten(sm):
+    return (sm.values, sm.indices, sm.row_ids), (sm.n_rows, sm.n_cols)
+
+
+def _sm_unflatten(aux, leaves):
+    values, indices, row_ids = leaves
+    sm = object.__new__(SparseMatrix)
+    sm.values, sm.indices, sm.row_ids = values, indices, row_ids
+    sm.n_rows, sm.n_cols = aux
+    sm.nnz = int(getattr(values, "shape", (0,))[0] or 0)
+    return sm
+
+
+jax.tree_util.register_pytree_node(SparseMatrix, _sm_flatten, _sm_unflatten)

@@ -1,0 +1,134 @@
+"""Fused hashed-text -> device sparse matrix transform.
+
+``ops.text.hash_tokens_flat`` already produces the flat bucket stream
+``(lens [N], flat [total_tokens])`` on the host.  The dense path scatters
+that stream into a ``[N, num_hashes]`` count matrix; here we instead
+deduplicate ``(row, bucket)`` pairs on the host (one ``np.unique`` over
+int64 keys — O(tokens log tokens), no ``num_hashes``-sized allocation
+anywhere) and ship the COO triples to the device as a
+:class:`~transmogrifai_tpu.sparse.matrix.SparseMatrix`.  Peak memory is
+O(nnz), independent of ``num_hashes``.
+
+Also home to the process-wide sparse stats behind the
+``sparse.nnz_total`` / ``sparse.density`` telemetry gauges.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from transmogrifai_tpu.sparse.matrix import SparseMatrix
+
+_LOCK = threading.Lock()
+_STATS = {"nnz_total": 0, "cells_total": 0, "matrices": 0, "density": 0.0}
+
+
+def record_sparse_stats(sm):
+    """Fold one built matrix into the process-wide sparse gauges."""
+    with _LOCK:
+        _STATS["nnz_total"] += int(sm.nnz)
+        _STATS["cells_total"] += int(sm.n_rows) * int(sm.n_cols)
+        _STATS["matrices"] += 1
+        _STATS["density"] = float(sm.density)
+
+
+def sparse_stats():
+    """Snapshot: cumulative nnz/cells plus the last-built matrix density."""
+    with _LOCK:
+        return dict(_STATS)
+
+
+def reset_sparse_stats():
+    with _LOCK:
+        _STATS.update(nnz_total=0, cells_total=0, matrices=0, density=0.0)
+
+
+def sparse_from_hash_flat(lens, flat, num_hashes, *, binary=False,
+                          row_pad=None, nnz_pad=None, col_offset=0,
+                          n_cols=None, record=True):
+    """Flat hashed-bucket stream -> deduplicated device SparseMatrix.
+
+    ``lens [N] int`` is tokens-per-row, ``flat [sum(lens)] int`` the bucket
+    ids.  Duplicate ``(row, bucket)`` hits either count (``binary=False``)
+    or collapse to 1.0 (``binary=True``).  Empty-token rows simply own no
+    entries — no dense intermediate exists for any row shape.
+    """
+    lens = np.asarray(lens, dtype=np.int64)
+    flat = np.asarray(flat, dtype=np.int64)
+    n = len(lens)
+    rows = np.repeat(np.arange(n, dtype=np.int64), lens)
+    # one int64 key per token: dedupe (row, bucket) in a single unique()
+    keys, counts = np.unique(rows * num_hashes + flat, return_counts=True)
+    out_rows = keys // num_hashes
+    out_cols = keys % num_hashes + col_offset
+    vals = (np.ones(len(keys), dtype=np.float32) if binary
+            else counts.astype(np.float32))
+    sm = SparseMatrix.from_coo(out_rows, out_cols, vals, n,
+                               num_hashes if n_cols is None else n_cols,
+                               nnz_pad=nnz_pad)
+    if row_pad is not None:
+        sm = sm.pad_rows(row_pad)
+    if record:
+        record_sparse_stats(sm)
+    return sm
+
+
+def hash_tokens_to_sparse(token_lists, num_hashes, *, binary=False,
+                          row_pad=None, nnz_pad=None):
+    """Tokenized rows -> device SparseMatrix via the shared FNV-1a hasher."""
+    from transmogrifai_tpu.ops.text import hash_tokens_flat
+    lens, flat = hash_tokens_flat(token_lists, num_hashes)
+    return sparse_from_hash_flat(lens, flat, num_hashes, binary=binary,
+                                 row_pad=row_pad, nnz_pad=nnz_pad)
+
+
+def combine_blocks(blocks, n_rows, *, record=True):
+    """Horizontally stack feature blocks into one SparseMatrix.
+
+    ``blocks`` is a list of either ``SparseMatrix`` or dense host/device
+    ``[n_rows, w]`` blocks (dense blocks contribute their nonzero cells —
+    exact for every linear consumer).  Column offsets follow block order,
+    matching the dense ``VectorsCombiner`` concat layout.
+    """
+    if (len(blocks) == 1 and isinstance(blocks[0], SparseMatrix)
+            and blocks[0].n_rows == n_rows):
+        # single sparse block: no host COO roundtrip, and — because nothing
+        # here touches entry VALUES — the combine stays jit-traceable, so
+        # the compiled score path can fuse combiner + model forward
+        if record:
+            record_sparse_stats(blocks[0])
+        return blocks[0]
+    rows_all, cols_all, vals_all = [], [], []
+    offset = 0
+    for blk in blocks:
+        if isinstance(blk, SparseMatrix):
+            if blk.n_rows != n_rows:
+                raise ValueError(
+                    f"block rows {blk.n_rows} != batch rows {n_rows}")
+            r, c, v = blk.host_coo()
+            rows_all.append(r.astype(np.int64))
+            cols_all.append(c.astype(np.int64) + offset)
+            vals_all.append(v)
+            offset += blk.n_cols
+        else:
+            arr = np.asarray(blk, dtype=np.float32)
+            if arr.ndim == 1:
+                arr = arr[:, None]
+            if arr.shape[0] != n_rows:
+                raise ValueError(
+                    f"block rows {arr.shape[0]} != batch rows {n_rows}")
+            r, c = np.nonzero(arr)
+            rows_all.append(r.astype(np.int64))
+            cols_all.append(c.astype(np.int64) + offset)
+            vals_all.append(arr[r, c])
+            offset += arr.shape[1]
+    if not rows_all:
+        return SparseMatrix.from_coo([], [], [], n_rows, 0)
+    sm = SparseMatrix.from_coo(np.concatenate(rows_all),
+                               np.concatenate(cols_all),
+                               np.concatenate(vals_all), n_rows, offset)
+    if record:
+        record_sparse_stats(sm)
+    return sm

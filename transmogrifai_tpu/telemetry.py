@@ -392,6 +392,17 @@ def _default_registry() -> MetricsRegistry:
     reg.gauge("racing.points_pruned",
               lambda: racing_stats()["points_pruned"])
     reg.gauge("host_link.bytes", host_link_bytes)
+
+    def _sparse_stat(key):
+        def read():
+            # lazy import: telemetry must not pull jax at module import
+            from .sparse.transform import sparse_stats
+            return sparse_stats()[key]
+        return read
+
+    reg.gauge("sparse.nnz_total", _sparse_stat("nnz_total"))
+    reg.gauge("sparse.matrices", _sparse_stat("matrices"))
+    reg.gauge("sparse.density", _sparse_stat("density"))
     return reg
 
 

@@ -292,6 +292,28 @@ def _grid_margins(X, C, b):
     return _GRID_MARGINS_JIT(X, C, b)
 
 
+_MULTI_PRED_JIT = None
+
+
+def _multinomial_pred_grid(X, C3, B):
+    """[N, K] argmax class predictions for K multinomial candidates in one
+    dispatch (coef stack [K, C, D], intercepts [K, C]).  Softmax is
+    monotone per row, so argmax over raw margins reproduces each model's
+    prediction exactly."""
+    global _MULTI_PRED_JIT
+    if _MULTI_PRED_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fn(X, C3, B):
+            m = jnp.einsum("nd,kdc->nkc", X, C3,
+                           preferred_element_type=jnp.float32) + B[None]
+            return jnp.argmax(m, axis=-1).astype(jnp.int32)
+        _MULTI_PRED_JIT = fn
+    return _MULTI_PRED_JIT(X, C3, B)
+
+
 # fit-program row-count canonicalization (ISSUE 4 compile reuse): pad N up a
 # geometric ladder with zero-weight rows so re-trains at nearby sizes hit the
 # SAME compiled fit executable.  Zero-weight padding is exact for the linear
@@ -483,24 +505,59 @@ class OpValidator:
         if kinds <= {"forest", "gbt"}:
             return self._record_tree_grid_metrics(cand, ci, fitted_grid, X,
                                                   y_dev, va_masks_dev, record)
+        panel_input = getattr(self.evaluator, "grid_panel_input", "scores")
+        multinomial = kinds == {"multinomial"}
+        if multinomial and panel_input != "predictions":
+            return False    # C margin columns don't collapse to one score
         coefs, intercepts = [], []
         for f in range(F):
             for gi in range(G):
                 fitted = fitted_grid[f][gi]
-                if (not isinstance(fitted, dict) or "coef" not in fitted
-                        or fitted.get("kind") not in ("binary", "svc",
-                                                      "regression")):
+                if not isinstance(fitted, dict) or "coef" not in fitted:
                     return False
                 c = fitted["coef"]
-                if getattr(c, "ndim", 1) != 1:
+                if multinomial:
+                    if (fitted.get("kind") != "multinomial"
+                            or getattr(c, "ndim", 0) != 2):
+                        return False
+                elif (fitted.get("kind") not in ("binary", "svc",
+                                                 "regression")
+                        or getattr(c, "ndim", 1) != 1):
                     return False
                 coefs.append(c)
                 intercepts.append(fitted.get("intercept", 0.0))
         try:
-            C = jnp.stack([jnp.asarray(c, jnp.float32) for c in coefs])
-            b = jnp.stack([jnp.asarray(i, jnp.float32).reshape(-1)[0]
-                           for i in intercepts])
-            S = _grid_margins(X, C, b)                     # [N, F*G]
+            from .sparse.matrix import SparseMatrix
+            if multinomial:
+                # multinomial coef is stored [D, C] (see LinearPredictionModel)
+                C3 = jnp.stack([jnp.asarray(c, jnp.float32) for c in coefs])
+                B = jnp.stack([jnp.asarray(i, jnp.float32).reshape(-1)
+                               for i in intercepts])       # [F*G, C]
+                if isinstance(X, SparseMatrix):
+                    K_, D_, Cc = C3.shape
+                    M = jnp.transpose(C3, (1, 0, 2)).reshape(D_, K_ * Cc)
+                    m = (X @ M).reshape(X.shape[0], K_, Cc) + B[None]
+                    S = jnp.argmax(m, axis=-1).astype(jnp.int32)
+                else:
+                    S = _multinomial_pred_grid(X, C3, B)   # [N, F*G] int32
+            else:
+                C = jnp.stack([jnp.asarray(c, jnp.float32) for c in coefs])
+                b = jnp.stack([jnp.asarray(i, jnp.float32).reshape(-1)[0]
+                               for i in intercepts])
+                if isinstance(X, SparseMatrix):
+                    # sparse margins: one sp_matmat over the COO entry
+                    # stream — the dense einsum would need the [N, D] matrix
+                    # that never materializes on the sparse path
+                    S = (X @ C.T) + b[None, :]             # [N, F*G]
+                else:
+                    S = _grid_margins(X, C, b)             # [N, F*G]
+                if panel_input == "predictions":
+                    if kinds <= {"binary", "svc"}:
+                        # hard class ids: p1 > 0.5  <=>  margin > 0
+                        S = (S > 0).astype(jnp.int32)
+                    elif kinds != {"regression"}:
+                        return False
+                    # regression margins ARE the predictions — use as-is
             # the whole (fold × grid) metric panel as ONE program when the
             # evaluator supports it — masks stay [F, N] (no per-grid-point
             # mask HBM duplication in the near-capacity regime), and the F
@@ -555,6 +612,7 @@ class OpValidator:
 
         F = len(va_masks_dev)
         G = len(cand.grid)
+        panel_input = getattr(self.evaluator, "grid_panel_input", "scores")
         groups = defaultdict(list)
         for f in range(F):
             for gi in range(G):
@@ -562,9 +620,13 @@ class OpValidator:
                 if not isinstance(fitted, dict) or fitted.get("kind") not in (
                         "forest", "gbt"):
                     return False
-                if fitted["kind"] == "forest" and fitted.get(
-                        "n_classes", 2) != 2:
-                    return False     # binary evaluator only
+                task = fitted.get("task", "classification")
+                if task == "regression":
+                    if panel_input != "predictions":
+                        return False   # scores evaluator on regression leaves
+                elif fitted["kind"] == "forest" and fitted.get(
+                        "n_classes", 2) != 2 and panel_input != "predictions":
+                    return False   # multiclass forest needs a prediction panel
                 shp = tuple(np.shape(fitted["feature"]))
                 if len(shp) != 2:
                     return False
@@ -584,20 +646,36 @@ class OpValidator:
                     [jnp.asarray(m["leaf"]) for _, m in members])
                 sums = predict_trees_sum_grouped(X, feat, thr, lf, lv,
                                                  md + 1, K)   # [N, K, V]
+                task = members[0][1].get("task", "classification")
                 if kind == "forest":
-                    S = sums[..., 1]
+                    if task == "regression":
+                        # mean leaf value IS the prediction — exact
+                        S = sums[..., 0] / float(_shp[0])
+                    elif panel_input == "predictions":
+                        # argmax of summed per-class leaf mass == argmax of
+                        # the normalized mean probs (positive scaling)
+                        S = jnp.argmax(sums, axis=-1).astype(jnp.int32)
+                    else:
+                        S = sums[..., 1]
                 else:
-                    # reproduce the per-candidate path's sigmoid(margin)
-                    # EXACTLY — raw sums rank identically in exact math, but
-                    # f32 sigmoid saturation creates tie groups the raw sums
-                    # would not, shifting AUC on confidently-separated data
                     import jax
                     eta = jnp.asarray([float(m["eta"]) for _, m in members],
                                       jnp.float32)
                     base = jnp.asarray([float(m["base"]) for _, m in members],
                                        jnp.float32)
-                    S = jax.nn.sigmoid(base[None, :]
-                                       + eta[None, :] * sums[..., 0])
+                    margin = base[None, :] + eta[None, :] * sums[..., 0]
+                    if task == "regression":
+                        S = margin                  # prediction, exact
+                    elif panel_input == "predictions":
+                        # sigmoid(margin) > 0.5  <=>  margin > 0
+                        S = (margin > 0).astype(jnp.int32)
+                    else:
+                        # reproduce the per-candidate path's sigmoid(margin)
+                        # EXACTLY — raw sums rank identically in exact math,
+                        # but f32 sigmoid saturation creates tie groups the
+                        # raw sums would not, shifting AUC on confidently-
+                        # separated data
+                        S = jax.nn.sigmoid(margin)
                 vals = self.evaluator.evaluate_masked_grid(
                     y_dev, S, va_masks_dev[f])
                 if vals is None or getattr(vals, "shape", (0,)) != (K,):
@@ -772,9 +850,11 @@ class OpValidator:
         # only one fold's full-size matrix is resident at a time.
         def _col_values(b):
             """Feature matrix in its native residency: device arrays stay on
-            device (the host link is the bottleneck on real TPU hardware)."""
+            device (the host link is the bottleneck on real TPU hardware);
+            sparse matrices pass through — densifying one here is exactly
+            the [N, num_hashes] blow-up the representation avoids."""
             v = b[features].values
-            if isinstance(v, jax.Array):
+            if isinstance(v, (jax.Array, SparseMatrix)):
                 return v
             return np.asarray(v, dtype=np.float32)
 
@@ -798,6 +878,8 @@ class OpValidator:
 
         import jax
         import jax.numpy as jnp
+
+        from .sparse.matrix import SparseMatrix
 
         def drain_deferred():
             """Pull every pending device-scalar metric in one stacked
@@ -863,12 +945,15 @@ class OpValidator:
             self.last_mesh = None
         from .columns import to_device_f32
         for X, fsplits in fold_groups():
-            if not isinstance(X, jax.Array):
+            is_sparse = isinstance(X, SparseMatrix)
+            if not isinstance(X, jax.Array) and not is_sparse:
                 # ONE host→device transfer shared by every candidate family —
                 # the host link is the scarce resource on tunneled TPUs
                 X = to_device_f32(X)
             N = X.shape[0]
-            mesh = self._maybe_mesh(N)
+            # sparse matrices stay single-device: the COO entry stream has no
+            # row-sharding story, and jnp.asarray on one raises by design
+            mesh = None if is_sparse else self._maybe_mesh(N)
             self.last_mesh = mesh
             from .parallel import data_sharding
             if mesh is not None:
@@ -878,7 +963,7 @@ class OpValidator:
                 Xj = X if isinstance(X, jax.Array) else jnp.asarray(
                     X, jnp.float32)
                 X = jax.device_put(Xj, data_sharding(mesh, 2))
-            is_dev = isinstance(X, jax.Array)
+            is_dev = isinstance(X, jax.Array) or is_sparse
             y_dev = None
             if is_dev:
                 # exact wire (bf16 only when verified lossless), shared with
@@ -949,7 +1034,12 @@ class OpValidator:
                             for c in candidates)):
                 pad_rows = _fit_pad_rows(N) - N
             if pad_rows:
-                if is_dev:
+                if is_sparse:
+                    # empty rows own no COO entries and carry weight 0 —
+                    # exact for the weight-normalized sparse fitters
+                    X_pad = X.pad_rows(N + pad_rows)
+                    y_pad = jnp.pad(y_dev, (0, pad_rows))
+                elif is_dev:
                     X_pad = jnp.pad(X, ((0, pad_rows), (0, 0)))
                     y_pad = jnp.pad(y_dev, (0, pad_rows))
                 else:
@@ -1068,7 +1158,13 @@ class OpValidator:
                 fallback candidate shares one transfer."""
                 if f not in va_cache:
                     nonlocal X_host
-                    if is_dev:
+                    if is_sparse:
+                        # the slice STAYS sparse: sparse-capable models
+                        # consume the COO stream in predict_arrays; models
+                        # without a sparse path fail loudly (__array__
+                        # raises) and the resilience layer skips them
+                        xv = X.take_rows(np.asarray(va_idx))
+                    elif is_dev:
                         # gather ONLY the validation slice on device, then
                         # pull — the full matrix is folds-times bigger and
                         # the link is the bottleneck.  Cast bf16-stored

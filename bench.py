@@ -38,8 +38,8 @@ accelerator; reduced CPU smoke runs report 1.0.
 
 Env knobs: BENCH_ROWS (dense rows), BENCH_TRANSMOG_ROWS, BENCH_SCORE_ROWS,
 BENCH_SPARSE_ROWS, BENCH_SPARSE_HASHES,
-BENCH_WORKLOAD (dense|transmog|score|text_sparse|selector_smoke|all,
-default all).
+BENCH_WORKLOAD (dense|transmog|score|text_sparse|selector_smoke|
+serving_chaos|all, default all).
 """
 
 import json
@@ -595,6 +595,39 @@ def run_text_sparse(N: int, on_accel: bool, platform: str):
     }
 
 
+def run_serving_chaos(on_accel: bool, platform: str):
+    """Closed-loop chaos SLO drill (ISSUE 8): the scripts/chaos_slo.py
+    harness at bench scale — N concurrent clients against the real HTTP
+    server with serving.batch/serving.reload faults injected.  The metric
+    is accepted-request p99; the aux carries the full outcome contract
+    (every request 2xx/429/503, breaker demote + half-open recovery) so a
+    serving-robustness regression shows up in the bench artifact."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "scripts"))
+    from chaos_slo import run_chaos_slo
+
+    clients = int(os.environ.get("BENCH_CHAOS_CLIENTS", "32"))
+    requests = int(os.environ.get("BENCH_CHAOS_REQUESTS", "10"))
+    t0 = time.perf_counter()
+    summary = run_chaos_slo(clients=clients, requests_per_client=requests,
+                            batch_fault_rate=0.08, reload_fault_rate=0.25,
+                            seed=0, request_deadline_s=15.0)
+    wall = time.perf_counter() - t0
+    return {"metric": f"serving chaos SLO accepted p99 "
+                      f"({clients} clients x {requests} reqs, "
+                      f"8%/25% faults) [{platform}]",
+            "value": summary["acceptedP99S"], "unit": "s",
+            "vs_baseline": 0.0,
+            "aux": {"passed": summary["passed"],
+                    "checks": summary["checks"],
+                    "outcomes": summary["outcomes"],
+                    "faults_fired": summary["faultsFired"],
+                    "breaker_transitions": summary["breakerTransitions"],
+                    "failure_summary": summary["failureSummary"],
+                    "storm_seconds": summary["stormSeconds"],
+                    "wall_seconds": round(wall, 2)}}
+
+
 def run_selector_smoke(on_accel: bool, platform: str):
     """Multiclass + regression selector sweeps on the fused-panel hot path:
     counts selector.batched_metrics fallback events (must be 0) so a
@@ -809,6 +842,7 @@ def main():
             rows("BENCH_SPARSE_ROWS", 100_000, 5_000),
             on_accel, platform)),
         ("selector_smoke", lambda: run_selector_smoke(on_accel, platform)),
+        ("serving_chaos", lambda: run_serving_chaos(on_accel, platform)),
     ]
     can_retry = (os.environ.get("BENCH_NO_RETRY") != "1" and on_accel)
     broken = False

@@ -1,0 +1,336 @@
+"""Closed-loop chaos SLO harness for the serving overload control plane.
+
+Boots the real HTTP server on a tiny trained model, injects faults at
+``serving.batch`` (compiled execution) and ``serving.reload`` (hot swap)
+via the deterministic ``FaultInjector``, drives N concurrent closed-loop
+clients, and asserts the request-outcome contract:
+
+* every request terminates with 2xx, 429 or 503 — zero hangs, zero
+  connection drops, zero unclassified outcomes;
+* accepted-request (2xx) p99 stays within the configured deadline;
+* the compiled-path breaker opens under the injected failures (batches
+  demote to the local fallback) and later recovers via a half-open probe
+  — both transitions visible in telemetry events AND ``/metrics``.
+
+Artifacts written to ``--out-dir``: ``outcomes.jsonl`` (one line per
+request), ``metrics.txt`` (final ``/metrics`` snapshot), and
+``summary.json`` (the verdict, also printed).  Exit 0 on a clean pass,
+1 on any contract violation.
+
+Usage:
+    python scripts/chaos_slo.py --out-dir /tmp/chaos_slo \
+        [--clients 32] [--requests 20] [--batch-fault-rate 0.08] \
+        [--reload-fault-rate 0.25] [--seed 0]
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+# runnable as `python scripts/chaos_slo.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _train_model(seed=0):
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, ModelCandidate, grid)
+    from transmogrifai_tpu.workflow import Workflow
+    rng = np.random.default_rng(seed)
+    records = [{"y": float(i % 2), "x": float(rng.normal() + (i % 2))}
+               for i in range(120)]
+    y = FeatureBuilder.RealNN("y").as_response()
+    x = FeatureBuilder.Real("x").as_predictor()
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]),
+                       "LR")])
+    sel.set_input(y, transmogrify([x]))
+    return (Workflow().set_input_records(records)
+            .set_result_features(sel.get_output()).train())
+
+
+def _post(port, payload, timeout):
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/score", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers)
+
+
+def _get(port, path, timeout=30):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def _classify(status):
+    if 200 <= status < 300:
+        return "2xx"
+    if status in (429, 503):
+        return str(status)
+    return f"unclassified_{status}"
+
+
+def _percentile(values, q):
+    if not values:
+        return 0.0
+    xs = sorted(values)
+    idx = min(len(xs) - 1, max(0, math.ceil(q * len(xs)) - 1))
+    return xs[idx]
+
+
+def run_chaos_slo(*, clients=32, requests_per_client=20,
+                  batch_fault_rate=0.08, reload_fault_rate=0.25, seed=0,
+                  request_deadline_s=15.0, out_dir=None, model_root=None):
+    """Run the harness; returns the summary dict (``summary["passed"]``
+    is the verdict).  Importable — the ``serving_chaos`` bench workload
+    and the chaos test suite reuse exactly this closed loop."""
+    from transmogrifai_tpu.checkpoint import next_version_dir
+    from transmogrifai_tpu.resilience import (FailureLog, FaultInjector,
+                                              inject_faults,
+                                              use_failure_log)
+    from transmogrifai_tpu.serving.overload import OverloadConfig
+    from transmogrifai_tpu.serving.server import start_server
+    from transmogrifai_tpu.telemetry import Tracer, use_tracer
+
+    import tempfile
+    own_root = model_root is None
+    if own_root:
+        model_root = tempfile.mkdtemp(prefix="chaos-slo-")
+    model = _train_model(seed)
+    model.save(next_version_dir(model_root))
+
+    tracer = Tracer(run_name="chaos-slo")
+    flog = FailureLog()
+    # breaker tuned so the storm demonstrates the full cycle: a short fuse
+    # (3 consecutive failures), a sub-second reset so recovery probes land
+    # inside the run, and fail_keys pinning three consecutive early batch
+    # keys so the open transition is deterministic at any fault rate
+    overload = OverloadConfig(
+        latency_target_ms=250.0, breaker_failures=3, breaker_window=8,
+        breaker_min_calls=6, breaker_reset_s=0.5, half_open_probes=1,
+        reload_breaker_failures=2, reload_breaker_reset_s=1.0)
+    injector = FaultInjector(
+        rates={"serving.batch": float(batch_fault_rate),
+               "serving.reload": float(reload_fault_rate)},
+        fail_keys={"serving.batch": [1, 2, 3]}, seed=seed)
+
+    outcomes = []
+    outcomes_lock = threading.Lock()
+    summary = {}
+    with use_tracer(tracer), use_failure_log(flog):
+        server, thread = start_server(
+            model_root, port=0, max_batch=8, linger_ms=1.0,
+            queue_bound=max(64, clients * 4),
+            request_deadline_s=request_deadline_s, overload=overload)
+        engine = server.engine
+        port = server.port
+        try:
+            with inject_faults(injector):
+                stop_reload = threading.Event()
+
+                def reload_churn():
+                    # keep publishing fresh versions so serving.reload
+                    # faults fire and the reload breaker gets exercise
+                    while not stop_reload.is_set():
+                        try:
+                            model.save(next_version_dir(model_root))
+                            engine.reload_now()
+                        except Exception:  # noqa: BLE001 — chaos; the
+                            pass           # engine must survive regardless
+                        stop_reload.wait(0.25)
+
+                churn = threading.Thread(target=reload_churn, daemon=True)
+                churn.start()
+
+                def client(cid):
+                    for i in range(requests_per_client):
+                        t0 = time.perf_counter()
+                        try:
+                            status, _ = _post(
+                                port, {"x": float((cid * 37 + i) % 11) / 5},
+                                timeout=request_deadline_s + 15.0)
+                        except urllib.error.HTTPError as e:
+                            status = e.code
+                            e.read()
+                        except Exception as e:  # noqa: BLE001 — timeout or
+                            #            dropped connection: a contract hang
+                            status = -1
+                            err = f"{type(e).__name__}: {e}"
+                        dt = time.perf_counter() - t0
+                        klass = ("hang" if status == -1
+                                 else _classify(status))
+                        row = {"client": cid, "i": i, "status": status,
+                               "latencyS": round(dt, 4), "class": klass}
+                        if klass == "hang":
+                            row["error"] = err
+                        with outcomes_lock:
+                            outcomes.append(row)
+
+                threads = [threading.Thread(target=client, args=(c,),
+                                            daemon=True)
+                           for c in range(clients)]
+                t_start = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    # generous join bound: a stuck client thread IS the
+                    # hang the contract forbids
+                    t.join(timeout=request_deadline_s + 60.0)
+                hung_threads = sum(1 for t in threads if t.is_alive())
+                storm_s = time.perf_counter() - t_start
+                stop_reload.set()
+                churn.join(timeout=5.0)
+
+            # chaos over: faults cleared.  Drive traffic until the breaker
+            # recovers through its half-open probe — deterministic, because
+            # probes can no longer be failed
+            breaker = engine.overload.compiled_breaker
+            recovery_deadline = time.monotonic() + 30.0
+            while (breaker.current_state() != breaker.CLOSED
+                   and time.monotonic() < recovery_deadline):
+                try:
+                    _post(port, {"x": 0.5}, timeout=request_deadline_s)
+                except Exception:  # noqa: BLE001 — drain stragglers
+                    pass
+                time.sleep(0.1)
+            recovered = breaker.current_state() == breaker.CLOSED
+
+            _, metrics_text = _get(port, "/metrics")
+            _, healthz = _get(port, "/healthz")
+            _, readyz_status = (lambda s: (None, s))(
+                _get(port, "/readyz")[0])
+        finally:
+            server.drain_and_close()
+            thread.join(timeout=10.0)
+
+    # -- verdict -----------------------------------------------------------
+    classes = {}
+    for row in outcomes:
+        classes[row["class"]] = classes.get(row["class"], 0) + 1
+    accepted = [r["latencyS"] for r in outcomes if r["class"] == "2xx"]
+    p99 = _percentile(accepted, 0.99)
+    transitions = [s for s in tracer.spans
+                   if s.name == "breaker.transition"
+                   and s.attrs.get("breaker") == "serving.batch"]
+    opened_at = [i for i, s in enumerate(transitions)
+                 if s.attrs.get("to_state") == "open"]
+    closed_at = [i for i, s in enumerate(transitions)
+                 if s.attrs.get("to_state") == "closed"]
+    demote_then_recover = bool(
+        opened_at and closed_at and max(closed_at) > min(opened_at))
+    metrics_show_cycle = (
+        "compiled_breaker_open_transitions_total" in metrics_text
+        and "compiled_breaker_closed_transitions_total" in metrics_text
+        and _metric_value(metrics_text,
+                          "compiled_breaker_open_transitions_total") >= 1
+        and _metric_value(metrics_text,
+                          "compiled_breaker_closed_transitions_total") >= 1)
+    bad_classes = {k: v for k, v in classes.items()
+                   if k not in ("2xx", "429", "503")}
+    total = clients * requests_per_client
+    checks = {
+        "all_requests_terminated": len(outcomes) == total
+        and hung_threads == 0,
+        "only_contract_outcomes": not bad_classes,
+        "some_requests_accepted": classes.get("2xx", 0) > 0,
+        "accepted_p99_within_deadline": p99 <= request_deadline_s,
+        "breaker_demoted_then_recovered": demote_then_recover and recovered,
+        "cycle_visible_in_metrics": metrics_show_cycle,
+        "faults_actually_fired": any(p == "serving.batch"
+                                     for p, _ in injector.fired),
+    }
+    summary = {
+        "passed": all(checks.values()),
+        "checks": checks,
+        "clients": clients,
+        "requestsPerClient": requests_per_client,
+        "totalRequests": total,
+        "outcomes": classes,
+        "hungClientThreads": hung_threads,
+        "stormSeconds": round(storm_s, 2),
+        "acceptedP99S": round(p99, 4),
+        "requestDeadlineS": request_deadline_s,
+        "batchFaultRate": batch_fault_rate,
+        "reloadFaultRate": reload_fault_rate,
+        "faultsFired": {"serving.batch": sum(
+            1 for p, _ in injector.fired if p == "serving.batch"),
+            "serving.reload": sum(
+            1 for p, _ in injector.fired if p == "serving.reload")},
+        "breakerTransitions": [
+            {"to": s.attrs.get("to_state"),
+             "reason": s.attrs.get("reason", "")[:120]}
+            for s in transitions],
+        "reloadBreaker": engine.overload.reload_breaker.snapshot(),
+        "failureSummary": flog.summary(),
+        "finalHealthz": json.loads(healthz),
+        "finalReadyzStatus": readyz_status,
+    }
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "outcomes.jsonl"), "w") as fh:
+            for row in outcomes:
+                fh.write(json.dumps(row) + "\n")
+        with open(os.path.join(out_dir, "metrics.txt"), "w") as fh:
+            fh.write(metrics_text)
+        with open(os.path.join(out_dir, "summary.json"), "w") as fh:
+            json.dump(summary, fh, indent=2)
+        tracer.export_chrome_trace(os.path.join(out_dir,
+                                                "trace-chaos.json"))
+    return summary
+
+
+def _metric_value(metrics_text, name):
+    """Last plain-sample value of ``transmogrifai_serving_<name>``."""
+    full = f"transmogrifai_serving_{name}"
+    val = 0.0
+    for line in metrics_text.splitlines():
+        if line.startswith(full + " "):
+            try:
+                val = float(line.split()[-1])
+            except ValueError:
+                pass
+    return val
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out-dir", required=True)
+    ap.add_argument("--clients", type=int, default=32)
+    ap.add_argument("--requests", type=int, default=20)
+    ap.add_argument("--batch-fault-rate", type=float, default=0.08)
+    ap.add_argument("--reload-fault-rate", type=float, default=0.25)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--request-deadline-s", type=float, default=15.0)
+    args = ap.parse_args(argv)
+    if args.batch_fault_rate < 0.05 or args.reload_fault_rate < 0.05:
+        print("warning: fault rates below the 5% acceptance floor",
+              file=sys.stderr)
+    summary = run_chaos_slo(
+        clients=args.clients, requests_per_client=args.requests,
+        batch_fault_rate=args.batch_fault_rate,
+        reload_fault_rate=args.reload_fault_rate, seed=args.seed,
+        request_deadline_s=args.request_deadline_s, out_dir=args.out_dir)
+    print(json.dumps(summary, indent=2))
+    if not summary["passed"]:
+        failing = [k for k, ok in summary["checks"].items() if not ok]
+        print(f"chaos SLO FAILED: {failing}", file=sys.stderr)
+        return 1
+    print("chaos SLO passed", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -418,14 +418,23 @@ class TestHTTPServer:
             eng.model_staleness_s, abs=5.0)
 
     def test_healthz_reports_draining(self, server):
+        # /healthz is pure liveness: a draining process is still alive
+        # (200, status "draining"); /readyz is what takes it out of
+        # rotation (503 + Retry-After)
         server.draining = True
         try:
+            status, body = _get(server.port, "/healthz")
+            assert status == 200
+            assert json.loads(body)["status"] == "draining"
             try:
-                _get(server.port, "/healthz")
-                raise AssertionError("draining must 503")
+                _get(server.port, "/readyz")
+                raise AssertionError("draining must 503 on /readyz")
             except urllib.error.HTTPError as e:
                 assert e.code == 503
-                assert json.loads(e.read())["status"] == "draining"
+                out = json.loads(e.read())
+                assert out["ready"] is False
+                assert "draining" in out["reasons"]
+                assert int(e.headers["Retry-After"]) >= 1
         finally:
             server.draining = False
 

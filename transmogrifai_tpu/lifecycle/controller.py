@@ -328,7 +328,20 @@ class LifecycleController:
               candidate_metric=cand_m, incumbent_metric=inc_m)
         swapped = False
         if self.engine is not None:
-            swapped = bool(self.engine.reload_now())
+            # respect the serving reload breaker: when repeated bad bundles
+            # opened it, the promotion is committed on disk but the hot swap
+            # is deferred to the engine's watcher (which probes the breaker)
+            breaker = getattr(getattr(self.engine, "overload", None),
+                              "reload_breaker", None)
+            if breaker is not None and \
+                    breaker.current_state() == breaker.OPEN:
+                record_failure(
+                    "lifecycle", "skipped",
+                    f"serving reload breaker open; hot swap of {version} "
+                    f"deferred (next probe in {breaker.retry_after_s():.1f}s)",
+                    point="lifecycle.promote", bundle=path)
+            else:
+                swapped = bool(self.engine.reload_now())
         elif self.monitor is not None:
             # no engine to rebase it on swap — rebase directly
             from .baselines import load_baselines

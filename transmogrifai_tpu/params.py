@@ -33,7 +33,12 @@ class OpParams:
     custom_params: Dict[str, Any] = field(default_factory=dict)
     collect_metrics: bool = False
     # online-serving knobs (run-type "serve"): host, port, maxBatch,
-    # lingerMs, queueBound, requestDeadlineS, reloadPollS
+    # lingerMs, queueBound, requestDeadlineS, reloadPollS, plus the
+    # overload control plane (serving.overload.OverloadConfig.from_params):
+    # latencyTargetMs, adaptiveLimit, minLimit, queueDeadlineMs,
+    # brownoutHigh, brownoutLow, breakerWindow, breakerFailures,
+    # breakerRate, breakerMinCalls, breakerResetS, halfOpenProbes,
+    # reloadBreakerFailures, reloadBreakerResetS
     serving: Dict[str, Any] = field(default_factory=dict)
     # sweep-racing knobs applied to every ModelSelector validator: enabled,
     # eta, minSurvivors (see DefaultSelectorParams.RACING*)

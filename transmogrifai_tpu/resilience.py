@@ -121,7 +121,11 @@ class FailureLog:
                "preempted",    # graceful stop requested mid-run
                "reloaded",     # serving swapped in a newer model version
                "promoted",     # lifecycle candidate won the holdout gate
-               "rejected")     # lifecycle candidate lost; incumbent kept
+               "rejected",     # lifecycle candidate lost; incumbent kept
+               "shed",         # admission control rejected work up front
+               "breaker_open",       # circuit breaker tripped: calls skipped
+               "breaker_half_open",  # breaker probing for recovery
+               "breaker_closed")     # breaker recovered: calls flow again
 
     def __init__(self):
         self._events: List[FailureEvent] = []
@@ -257,30 +261,66 @@ def run_with_deadline(fn: Callable[..., Any], timeout_s: Optional[float],
     ``timeout_s``.  A call that never returns (a native hang in device init
     or dispatch — OUTAGE_r5.json's failure mode) is *abandoned*, not
     interrupted: Python cannot cancel native code, so the worker leaks by
-    design and the host loop stays alive.  ``timeout_s=None`` runs inline."""
+    design and the host loop stays alive.  An abandoned worker that later
+    completes drops its result/exception instead of pinning it in memory,
+    and records the orphaned completion into the FailureLog that was ambient
+    at call time.  Worker exceptions re-raise in the caller with the
+    worker's own traceback attached.  ``timeout_s=None`` runs inline."""
     if timeout_s is None:
         return fn(*args, **kwargs)
     box: Dict[str, Any] = {}
     done = threading.Event()
+    state_lock = threading.Lock()
+    abandoned = False
+    # captured NOW: by the time an abandoned worker finishes, the caller's
+    # use_failure_log() context may have exited
+    log = active_failure_log()
+    label = description or getattr(fn, "__name__", "call")
 
     def target():
+        err: Optional[BaseException] = None
         try:
-            box["value"] = fn(*args, **kwargs)
+            value = fn(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001 — re-raised in the caller
-            box["error"] = e
-        finally:
-            done.set()
+            err, value = e, None
+        with state_lock:
+            orphaned = abandoned
+            if not orphaned:
+                if err is None:
+                    box["value"] = value
+                else:
+                    box["error"] = err
+        done.set()
+        if orphaned:
+            # the caller gave up long ago: do NOT keep the (possibly large)
+            # result alive; leave an audit trail instead
+            try:
+                log.record("watchdog", "swallowed",
+                           err if err is not None else
+                           "worker completed after its deadline; "
+                           "result dropped",
+                           point="watchdog.orphan", description=label)
+            except Exception:  # noqa: BLE001 — never crash an orphan thread
+                pass
 
     worker = threading.Thread(target=target, daemon=True,
-                              name=f"watchdog:{description or fn.__name__}")
+                              name=f"watchdog:{label}")
     worker.start()
     if not done.wait(timeout_s):
-        raise WatchdogTimeout(
-            f"{description or getattr(fn, '__name__', 'call')} exceeded its "
-            f"{timeout_s:g}s deadline; worker thread abandoned (native hangs "
-            "cannot be interrupted from Python — see OUTAGE_r5.json)")
+        with state_lock:
+            # re-check under the lock: the worker may have delivered between
+            # the wait timing out and us abandoning it
+            if "value" not in box and "error" not in box:
+                abandoned = True
+        if abandoned:
+            raise WatchdogTimeout(
+                f"{label} exceeded its "
+                f"{timeout_s:g}s deadline; worker thread abandoned (native "
+                "hangs cannot be interrupted from Python — see "
+                "OUTAGE_r5.json)")
     if "error" in box:
-        raise box["error"]
+        err = box["error"]
+        raise err.with_traceback(err.__traceback__)
     return box.get("value")
 
 
@@ -336,6 +376,289 @@ class RetryPolicy:
                            point=point, attempt=attempt, key=str(key))
                 sleep(self.delay_for(attempt, key=key))
         raise last  # pragma: no cover — loop always returns or raises
+
+
+# --------------------------------------------------------------------------
+# circuit breaker
+# --------------------------------------------------------------------------
+
+class CircuitOpenError(RuntimeError):
+    """The breaker is open: the protected call was skipped outright.
+
+    Carries ``retry_after_s`` — how long until the breaker will grant a
+    recovery probe — so admission layers can surface an honest
+    ``Retry-After`` instead of a guess."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class CircuitBreaker:
+    """Thread-safe closed → open → half-open breaker with deterministic
+    recovery probes.
+
+    * **closed** — outcomes feed a sliding window.  The breaker opens on
+      ``failure_threshold`` consecutive failures, or when the window holds
+      at least ``min_calls`` outcomes and the failure fraction reaches
+      ``failure_rate``.
+    * **open** — ``allow()`` refuses every call until ``reset_timeout_s``
+      has elapsed (``retry_after_s()`` says how long is left).
+    * **half-open** — after the reset timeout, exactly ``half_open_probes``
+      calls are granted as recovery probes (deterministic: a fixed permit
+      count, no randomness).  If every probe succeeds the breaker closes
+      and the window clears; any probe failure re-opens it for another
+      full ``reset_timeout_s``.
+
+    Transitions are recorded into the ambient ``FailureLog``
+    (``breaker_open`` / ``breaker_half_open`` / ``breaker_closed``), as
+    telemetry events (``breaker.transition``), and — when a registry is
+    supplied — as per-breaker counters plus a state gauge
+    (0 closed / 1 half-open / 2 open)."""
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+    _STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+    def __init__(self, name: str, *, window: int = 20,
+                 failure_threshold: int = 5, failure_rate: float = 0.5,
+                 min_calls: int = 10, reset_timeout_s: float = 30.0,
+                 half_open_probes: int = 1,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: Optional[Any] = None):
+        self.name = str(name)
+        self.window = max(1, int(window))
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.failure_rate = float(failure_rate)
+        self.min_calls = max(1, int(min_calls))
+        self.reset_timeout_s = float(reset_timeout_s)
+        self.half_open_probes = max(1, int(half_open_probes))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._outcomes: List[bool] = []   # sliding window, True = failure
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_permits = 0
+        self._probe_successes = 0
+        self._last_cause = ""
+        self._registry = registry
+        if registry is not None:
+            registry.gauge(f"breaker.{self.name}.state", self.state_code)
+
+    # -- state inspection --------------------------------------------------
+    def state_code(self) -> int:
+        return self._STATE_CODES[self.current_state()]
+
+    def current_state(self) -> str:
+        """The externally-visible state.  An open breaker whose reset
+        timeout has elapsed reads as half-open (the next ``allow()`` will
+        grant a probe) without mutating anything."""
+        with self._lock:
+            if (self._state == self.OPEN
+                    and self._clock() - self._opened_at
+                    >= self.reset_timeout_s):
+                return self.HALF_OPEN
+            return self._state
+
+    def retry_after_s(self) -> float:
+        """Seconds until the breaker will grant a recovery probe (0 when
+        not open)."""
+        with self._lock:
+            if self._state != self.OPEN:
+                return 0.0
+            return max(0.0, self._opened_at + self.reset_timeout_s
+                       - self._clock())
+
+    def snapshot(self) -> Dict[str, Any]:
+        state = self.current_state()
+        with self._lock:
+            failures = sum(self._outcomes)
+            return {"name": self.name, "state": state,
+                    "window_calls": len(self._outcomes),
+                    "window_failures": failures,
+                    "consecutive_failures": self._consecutive_failures,
+                    "last_cause": self._last_cause,
+                    "retry_after_s": (max(
+                        0.0, self._opened_at + self.reset_timeout_s
+                        - self._clock())
+                        if self._state == self.OPEN else 0.0)}
+
+    # -- the protocol ------------------------------------------------------
+    def allow(self) -> bool:
+        """May this call proceed?  Open→half-open happens here (lazily, on
+        the first call after the reset timeout)."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                if (self._clock() - self._opened_at
+                        < self.reset_timeout_s):
+                    return False
+                self._transition(self.HALF_OPEN,
+                                 f"reset timeout {self.reset_timeout_s:g}s "
+                                 "elapsed")
+                self._probe_permits = self.half_open_probes
+                self._probe_successes = 0
+            # half-open: grant the remaining probe permits, refuse the rest
+            if self._probe_permits > 0:
+                self._probe_permits -= 1
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state == self.HALF_OPEN:
+                self._probe_successes += 1
+                if self._probe_successes >= self.half_open_probes:
+                    self._transition(
+                        self.CLOSED,
+                        f"{self._probe_successes} recovery probe(s) "
+                        "succeeded")
+                    self._outcomes.clear()
+                    self._last_cause = ""
+                return
+            if self._state == self.CLOSED:
+                self._push_outcome(False)
+
+    def record_failure(self, cause: Any = None) -> None:
+        with self._lock:
+            self._last_cause = _format_cause(cause)
+            if self._state == self.HALF_OPEN:
+                self._open(f"recovery probe failed: {self._last_cause}")
+                return
+            if self._state == self.OPEN:
+                return   # already open; nothing new to learn
+            self._push_outcome(True)
+            self._consecutive_failures += 1
+            failures = sum(self._outcomes)
+            if self._consecutive_failures >= self.failure_threshold:
+                self._open(f"{self._consecutive_failures} consecutive "
+                           f"failures; last: {self._last_cause}")
+            elif (len(self._outcomes) >= self.min_calls
+                    and failures / len(self._outcomes)
+                    >= self.failure_rate):
+                self._open(f"failure rate {failures}/{len(self._outcomes)} "
+                           f">= {self.failure_rate:g}; last: "
+                           f"{self._last_cause}")
+
+    def call(self, fn: Callable[[], Any]) -> Any:
+        """Run ``fn`` under the breaker: raise ``CircuitOpenError`` without
+        calling it when open, otherwise report its outcome."""
+        if not self.allow():
+            raise CircuitOpenError(
+                f"breaker {self.name!r} is open "
+                f"(last: {self._last_cause or 'unknown'})",
+                retry_after_s=self.retry_after_s())
+        try:
+            result = fn()
+        except BaseException as e:
+            self.record_failure(e)
+            raise
+        self.record_success()
+        return result
+
+    # -- internals (call with self._lock held) -----------------------------
+    def _push_outcome(self, failed: bool) -> None:
+        self._outcomes.append(failed)
+        if len(self._outcomes) > self.window:
+            del self._outcomes[:len(self._outcomes) - self.window]
+
+    def _open(self, reason: str) -> None:
+        self._opened_at = self._clock()
+        self._probe_permits = 0
+        self._probe_successes = 0
+        self._transition(self.OPEN, reason)
+
+    def _transition(self, to: str, reason: str) -> None:
+        frm, self._state = self._state, to
+        action = {self.OPEN: "breaker_open",
+                  self.HALF_OPEN: "breaker_half_open",
+                  self.CLOSED: "breaker_closed"}[to]
+        try:
+            active_failure_log().record(
+                "breaker", action, reason, point=f"breaker.{self.name}",
+                breaker=self.name)
+        except Exception:  # noqa: BLE001 — bookkeeping must not break calls
+            pass
+        try:
+            from .telemetry import event
+            event("breaker.transition", breaker=self.name,
+                  from_state=frm, to_state=to, reason=reason)
+        except Exception:  # noqa: BLE001
+            pass
+        if self._registry is not None:
+            try:
+                self._registry.counter(
+                    f"breaker.{self.name}.{to}_total").inc()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+# --------------------------------------------------------------------------
+# adaptive concurrency limit (AIMD)
+# --------------------------------------------------------------------------
+
+class AdaptiveConcurrencyLimit:
+    """AIMD admission limit driven by observed batch latency vs. a target.
+
+    Every completed batch calls ``observe(latency_s)``: latencies at or
+    under ``target_latency_s`` grow the limit additively (``increase`` per
+    observation); latencies over it shrink the limit multiplicatively
+    (``decrease`` factor) — the TCP-congestion-control shape, which
+    converges to the deepest queue the backend can drain within the
+    latency target.  The limit is clamped to ``[min_limit, max_limit]``;
+    ``max_limit`` is the static ceiling (the old ``queue_bound``) that
+    still backstops the adaptive signal."""
+
+    def __init__(self, *, target_latency_s: float, max_limit: int,
+                 min_limit: int = 4, increase: float = 1.0,
+                 decrease: float = 0.75,
+                 initial: Optional[int] = None):
+        if max_limit < 1:
+            raise ValueError("max_limit must be >= 1")
+        self.target_latency_s = float(target_latency_s)
+        self.max_limit = int(max_limit)
+        self.min_limit = max(1, min(int(min_limit), self.max_limit))
+        self.increase = float(increase)
+        self.decrease = float(decrease)
+        if not 0.0 < self.decrease < 1.0:
+            raise ValueError("decrease must be in (0, 1)")
+        self._limit = float(initial if initial is not None
+                            else self.max_limit)
+        self._limit = min(max(self._limit, self.min_limit), self.max_limit)
+        self._lock = threading.Lock()
+        self._observations = 0
+        self._decreases = 0
+
+    @property
+    def limit(self) -> int:
+        with self._lock:
+            return int(self._limit)
+
+    def observe(self, latency_s: float) -> int:
+        """Feed one batch latency; returns the updated limit."""
+        with self._lock:
+            self._observations += 1
+            if latency_s <= self.target_latency_s:
+                self._limit = min(self.max_limit,
+                                  self._limit + self.increase)
+            else:
+                self._decreases += 1
+                self._limit = max(self.min_limit,
+                                  self._limit * self.decrease)
+            return int(self._limit)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"limit": int(self._limit),
+                    "min_limit": self.min_limit,
+                    "max_limit": self.max_limit,
+                    "target_latency_s": self.target_latency_s,
+                    "observations": self._observations,
+                    "decreases": self._decreases}
 
 
 # --------------------------------------------------------------------------

@@ -65,7 +65,8 @@ class OpWorkflowRunner:
                  evaluator=None, evaluation_feature=None,
                  features_to_compute=None,
                  retry_policy: Optional[RetryPolicy] = None,
-                 failure_log: Optional[FailureLog] = None):
+                 failure_log: Optional[FailureLog] = None,
+                 dead_letter_max: int = 256):
         # score / streaming-score / evaluate / features run types load a
         # saved model and need no workflow; only train requires one
         self.workflow = workflow
@@ -75,9 +76,14 @@ class OpWorkflowRunner:
         self.evaluation_feature = evaluation_feature
         self.features_to_compute = features_to_compute
         # resilience: transient streaming-batch failures retry per policy;
-        # exhausted batches dead-letter instead of killing the stream
+        # exhausted batches dead-letter instead of killing the stream.
+        # The DLQ is bounded (a persistently-failing stream would otherwise
+        # grow it without limit): past ``dead_letter_max`` the OLDEST entry
+        # is evicted — its index stays in the failure log even though the
+        # batch payload is gone
         self.retry_policy = retry_policy
         self.failure_log = failure_log
+        self.dead_letter_max = max(1, int(dead_letter_max))
         self._completion_callbacks: List[Callable[[AppMetrics], None]] = []
 
     def add_application_completion_handler(self, fn: Callable[[AppMetrics], None]):
@@ -261,6 +267,26 @@ class OpWorkflowRunner:
             max_attempts=3, base_delay_s=0.02, max_delay_s=0.5)
         flog = self.failure_log if self.failure_log is not None else FailureLog()
         dead_letters: List[Dict[str, Any]] = []
+        evicted_count = 0
+
+        def dead_letter(entry: Dict[str, Any]) -> None:
+            # bounded DLQ: oldest-first eviction past dead_letter_max, so a
+            # persistently failing stream cannot grow memory without limit
+            nonlocal evicted_count
+            dead_letters.append(entry)
+            if len(dead_letters) <= self.dead_letter_max:
+                return
+            victim = dead_letters.pop(0)
+            if evicted_count == 0:
+                flog.record("streaming", "degraded",
+                            f"dead-letter queue reached its bound "
+                            f"({self.dead_letter_max}); evicting oldest "
+                            "entries — reprocess from the failure log",
+                            point="streaming.batch",
+                            first_evicted_index=victim["index"])
+            evicted_count += 1
+            from .telemetry import REGISTRY
+            REGISTRY.counter("streaming.dead_letters_evicted_total").inc()
         loc = params.write_location
         if loc:
             os.makedirs(loc, exist_ok=True)
@@ -331,7 +357,7 @@ class OpWorkflowRunner:
                         flog.record("streaming", "dead_letter", e,
                                     point="streaming.batch", batch_index=i,
                                     attempt=policy.max_attempts)
-                        dead_letters.append(
+                        dead_letter(
                             {"index": i,
                              "error": f"{type(e).__name__}: {e}",
                              "batch": batch})
@@ -351,6 +377,7 @@ class OpWorkflowRunner:
                      "skippedBatches": next_batch,
                      "preempted": was_preempted,
                      "deadLetterBatches": [d["index"] for d in dead_letters],
+                     "deadLettersEvicted": evicted_count,
                      "failures": flog.summary()},
             failure_log=flog, dead_letters=dead_letters)
 
@@ -385,6 +412,7 @@ class OpWorkflowRunner:
         ``params.serving`` (see ``OpParams``)."""
         if not params.model_location:
             raise ValueError("run-type 'serve' needs --model-location")
+        from .serving.overload import OverloadConfig
         from .serving.server import serve_main
         sv = params.serving or {}
         with timer.phase("serve"):
@@ -395,7 +423,8 @@ class OpWorkflowRunner:
                        linger_ms=float(sv.get("lingerMs", 2.0)),
                        queue_bound=int(sv.get("queueBound", 256)),
                        request_deadline_s=sv.get("requestDeadlineS", 30.0),
-                       reload_poll_s=float(sv.get("reloadPollS", 10.0)))
+                       reload_poll_s=float(sv.get("reloadPollS", 10.0)),
+                       overload=OverloadConfig.from_params(sv))
         return OpWorkflowRunnerResult(RunType.SERVE)
 
     def _lifecycle(self, params: OpParams, timer: PhaseTimer

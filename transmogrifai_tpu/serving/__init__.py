@@ -8,4 +8,7 @@ admission control, hot model reload, and SIGTERM draining.
 
 from .engine import (DeadlineExceeded, EngineClosed,  # noqa: F401
                      OverloadedError, ScoringEngine)
+from .overload import (BROWNOUT, DEGRADED, DRAINING,  # noqa: F401
+                       HEALTH_STATES, SERVING, HealthStateMachine,
+                       OverloadConfig, OverloadController)
 from .server import ScoringHTTPServer, serve_main  # noqa: F401

@@ -40,10 +40,18 @@ from ..resilience import (WatchdogTimeout, maybe_inject, record_failure,
 from ..stages.generator import FeatureGeneratorStage
 from ..telemetry import MetricsRegistry, span
 from ..types import FeatureType, Prediction
+from .overload import BROWNOUT, OverloadConfig, OverloadController
 
 
 class OverloadedError(RuntimeError):
-    """Queue depth exceeded ``queue_bound`` — shed this request (HTTP 429)."""
+    """Admission control shed this request (HTTP 429): queue past the
+    adaptive limit / ``queue_bound``, or the estimated queue wait would
+    blow the request deadline.  ``retry_after_s`` is the controller's
+    honest estimate of when to come back."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
 
 
 class EngineClosed(RuntimeError):
@@ -148,7 +156,8 @@ class ScoringEngine:
                  linger_ms: float = 2.0, queue_bound: int = 256,
                  batch_deadline_s: Optional[float] = 30.0,
                  reload_poll_s: float = 0.0, warm: bool = True,
-                 warm_record: Optional[Dict[str, Any]] = None):
+                 warm_record: Optional[Dict[str, Any]] = None,
+                 overload: Optional[OverloadConfig] = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.model_location = model_location
@@ -179,6 +188,14 @@ class ScoringEngine:
         self.metrics.gauge("compiled_path_active",
                            lambda: int(self._compiled_ok))
 
+        # the overload control plane: adaptive admission, the compiled-path
+        # and reload circuit breakers, and the health state machine.  It
+        # shares this engine's registry so /metrics sees everything.
+        self.overload = OverloadController(
+            overload, queue_bound=lambda: self.queue_bound,
+            max_batch=self.max_batch, linger_s=self.linger_s,
+            registry=self.metrics)
+
         # lifecycle hooks: batch observers see every successfully-scored
         # (records, results) pair; the drift monitor is one such observer
         self._batch_observers: List[Callable] = []
@@ -187,6 +204,9 @@ class ScoringEngine:
         self._entry = self._load_entry()
         if warm:
             self._warm(self._entry)
+        # a model demoted at warmup starts DEGRADED, not SERVING
+        self.overload.refresh_health(queue_depth=0, draining=False,
+                                     compiled_ok=self._compiled_ok)
 
         self._batcher = threading.Thread(
             target=self._batch_loop, name="scoring-batcher", daemon=True)
@@ -298,10 +318,23 @@ class ScoringEngine:
             current = self._entry.version
         if bundle_version(latest) == current:
             return False
+        breaker = self.overload.reload_breaker
+        if not breaker.allow():
+            # repeated corrupt/faulty candidates opened the breaker: stop
+            # re-verifying and re-loading the same bundle on every watcher
+            # poll; the next probe is granted after reset_timeout_s
+            self.metrics.counter("reload_breaker_skipped_total").inc()
+            record_failure(
+                "serving", "skipped",
+                f"reload breaker open; next probe in "
+                f"{breaker.retry_after_s():.1f}s",
+                point="serving.reload", bundle=latest)
+            return False
         try:
             maybe_inject("serving.reload", key=bundle_version(latest))
             entry = self._load_entry(latest)
         except Exception as e:  # noqa: BLE001 — keep serving the old model
+            breaker.record_failure(e)
             record_failure("serving", "skipped", e, point="serving.reload",
                            bundle=latest)
             return False
@@ -313,6 +346,7 @@ class ScoringEngine:
         with self._swap_lock:
             old = self._entry.version
             self._entry = entry
+        breaker.record_success()
         self.metrics.counter("reloads_total").inc()
         record_failure("serving", "reloaded", None, point="serving.reload",
                        previous=old, current=entry.version)
@@ -345,7 +379,7 @@ class ScoringEngine:
         """Score one record; returns ``(result, model_version)``.  Blocks
         until the coalesced batch containing it completes, the engine
         closes, or ``timeout_s`` elapses (→ ``DeadlineExceeded``)."""
-        req = self._submit(record)
+        req = self._submit(record, deadline_s=timeout_s)
         if not req.event.wait(timeout_s):
             raise DeadlineExceeded(
                 f"no result within {timeout_s}s (queue depth "
@@ -363,7 +397,7 @@ class ScoringEngine:
         """Score a client-provided list: every record rides the same queue
         as single requests (admission control applies to the whole list)."""
         with self._cv:
-            self._check_admission(extra=len(records))
+            self._check_admission(extra=len(records), deadline_s=timeout_s)
             reqs = [_Request(r) for r in records]
             self._queue.extend(reqs)
             self.metrics.counter("requests_total").inc(len(reqs))
@@ -390,18 +424,27 @@ class ScoringEngine:
     def queue_depth(self) -> int:
         return len(self._queue)
 
-    def _check_admission(self, extra: int = 1) -> None:
+    def _check_admission(self, extra: int = 1,
+                         deadline_s: Optional[float] = None) -> None:
         if self._closed or self._draining:
             raise EngineClosed("engine is shutting down")
-        if len(self._queue) + extra > self.queue_bound:
+        decision = self.overload.admit(len(self._queue), extra,
+                                       deadline_s=deadline_s)
+        if decision is not None:
             self.metrics.counter("shed_total").inc()
-            raise OverloadedError(
-                f"queue depth {len(self._queue)} + {extra} exceeds bound "
-                f"{self.queue_bound}")
+            self.metrics.counter(f"shed_{decision.kind}_total").inc()
+            record_failure("serving", "shed", decision.message,
+                           point="serving.admit", kind=decision.kind)
+            self.overload.refresh_health(
+                queue_depth=len(self._queue), draining=False,
+                compiled_ok=self._compiled_ok)
+            raise OverloadedError(decision.message,
+                                  retry_after_s=decision.retry_after_s)
 
-    def _submit(self, record: Dict[str, Any]) -> _Request:
+    def _submit(self, record: Dict[str, Any],
+                deadline_s: Optional[float] = None) -> _Request:
         with self._cv:
-            self._check_admission()
+            self._check_admission(deadline_s=deadline_s)
             req = _Request(record)
             self._queue.append(req)
             self.metrics.counter("requests_total").inc()
@@ -448,7 +491,15 @@ class ScoringEngine:
         records = [r.record for r in batch]
         t0 = time.perf_counter()
         results: Optional[List[Dict[str, Any]]] = None
-        if self._compiled_ok:
+        # the breaker gates the compiled path: while open, batches go
+        # straight to the local fallback (no failure paid per batch); after
+        # the reset timeout it grants half-open probes that either recover
+        # the compiled path or re-open it
+        use_compiled = self._compiled_ok \
+            and self.overload.compiled_breaker.allow()
+        if self._compiled_ok and not use_compiled:
+            self.metrics.counter("breaker_demoted_batches_total").inc()
+        if use_compiled:
             try:
                 from ..compiled import trace_count
                 with self._score_lock:
@@ -462,6 +513,7 @@ class ScoringEngine:
                             description=f"serving micro-batch of "
                                         f"{len(records)}")
                     traced = trace_count() - before
+                self.overload.compiled_breaker.record_success()
                 if traced > 0:
                     # an online trace means this model's frontier shapes are
                     # content-dependent (e.g. text wire arrays): every batch
@@ -473,12 +525,14 @@ class ScoringEngine:
                         fallback="local row scoring",
                         detail=f"{traced} online trace(s) after warmup")
             except WatchdogTimeout as e:
+                self.overload.compiled_breaker.record_failure(e)
                 record_failure("serving", "fallback", e,
                                point="serving.batch",
                                fallback="local row scoring")
                 self.metrics.counter("batch_deadline_total").inc()
                 results = None
             except Exception as e:  # noqa: BLE001 — per-record fallback
+                self.overload.compiled_breaker.record_failure(e)
                 record_failure("serving", "fallback", e,
                                point="serving.batch",
                                fallback="local row scoring")
@@ -496,8 +550,19 @@ class ScoringEngine:
                     results.append(e)
         self.metrics.counter("batches_total").inc()
         self.metrics.counter("batch_rows_total").inc(len(batch))
-        self.batch_latency.observe(time.perf_counter() - t0)
-        if self._batch_observers:
+        batch_s = time.perf_counter() - t0
+        self.batch_latency.observe(batch_s)
+        self.overload.observe_batch(batch_s)
+        health = self.overload.refresh_health(
+            queue_depth=self.queue_depth,
+            draining=self._draining or self._closed,
+            compiled_ok=self._compiled_ok)
+        if self._batch_observers and health == BROWNOUT:
+            # brownout sheds optional work first: observers (drift, record
+            # insights, shadow scoring) are skipped so their cycles go to
+            # draining the queue — user traffic is never the first casualty
+            self.metrics.counter("brownout_sheds_total").inc()
+        elif self._batch_observers:
             # before the waiters wake: a client that returns and immediately
             # inspects the drift monitor sees its own batch accounted for
             ok = [(req.record, res) for req, res in zip(batch, results)
@@ -541,6 +606,7 @@ class ScoringEngine:
                 "queue_depth": self.queue_depth,
                 "model_version": version,
                 "compiled_path_active": self._compiled_ok,
+                "overload": self.overload.snapshot(),
                 "request_latency": self.request_latency.snapshot(),
                 "batch_latency": self.batch_latency.snapshot()}
 
@@ -550,6 +616,9 @@ class ScoringEngine:
         everything already queued before the thread exits (the SIGTERM
         path — ``preemption_guard`` delivers the signal, the server calls
         this)."""
+        self.overload.refresh_health(queue_depth=self.queue_depth,
+                                     draining=True,
+                                     compiled_ok=self._compiled_ok)
         with self._cv:
             self._draining = True
             if not drain:

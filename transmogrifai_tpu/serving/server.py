@@ -9,9 +9,16 @@ Endpoints:
   ``results[i]["_modelVersion"]`` only if they differ — a hot swap can land
   mid-list).  429 + ``Retry-After`` under shed load, 504 on deadline,
   503 while draining.
-* ``GET /healthz`` — 200 ``{"status": "ok", ...}`` / 503 when draining.
+* ``GET /healthz`` — process *liveness*: always 200 while the process can
+  answer HTTP, with the health state (``SERVING``/``DEGRADED``/
+  ``BROWNOUT``/``DRAINING``) and transition reason in the body.  A
+  draining server is still alive — do not restart it.
+* ``GET /readyz`` — traffic-worthiness: 200 only when the model is
+  loaded, the compiled-path breaker is not open, and the server is not
+  draining; 503 + ``Retry-After`` otherwise.  Point load balancers here.
 * ``GET /metrics`` — Prometheus text exposition: request/batch counters,
-  queue depth, latency summaries with p50/p95/p99.
+  queue depth, overload/breaker/health families, latency summaries with
+  p50/p95/p99.
 
 ``serve_main`` wires the whole thing behind ``preemption_guard``: SIGTERM
 stops the accept loop, drains in-flight batches, then exits.
@@ -20,6 +27,7 @@ stops the accept loop, drains in-flight batches, then exits.
 from __future__ import annotations
 
 import json
+import math
 import socket
 import threading
 import time
@@ -27,11 +35,23 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..checkpoint import preemption_guard, shutdown_requested
-from ..resilience import WatchdogTimeout
+from ..resilience import CircuitBreaker, WatchdogTimeout
 from .engine import (DeadlineExceeded, EngineClosed, OverloadedError,
                      ScoringEngine)
+from .overload import HEALTH_CODES, OverloadConfig
 
 _METRIC_PREFIX = "transmogrifai_serving"
+
+_BREAKER_CODES = {CircuitBreaker.CLOSED: 0, CircuitBreaker.HALF_OPEN: 1,
+                  CircuitBreaker.OPEN: 2}
+
+
+def _retry_after(seconds: float) -> str:
+    """HTTP Retry-After is whole seconds; never advertise less than 1."""
+    try:
+        return str(max(1, int(math.ceil(float(seconds)))))
+    except (TypeError, ValueError):
+        return "1"
 
 
 def render_metrics(engine: ScoringEngine) -> str:
@@ -147,6 +167,52 @@ def render_metrics(engine: ScoringEngine) -> str:
                        ("failed_retrains", "Retrains that errored out")):
         counter(f"lifecycle_{fam}_total",
                 lc.get(f"lifecycle.{fam}_total", 0), help_)
+    # overload control plane: health state machine, adaptive admission and
+    # both circuit breakers — the families the chaos SLO harness asserts on
+    ov = s.get("overload") or {}
+    health = ov.get("health") or {}
+    gauge("health_state", HEALTH_CODES.get(health.get("state"), 0),
+          "Engine health: 0 SERVING / 1 DEGRADED / 2 BROWNOUT / 3 DRAINING")
+    state_name = health.get("state", "SERVING")
+    lines.append(f"# HELP {_METRIC_PREFIX}_health_info Current health "
+                 "state and transition reason")
+    lines.append(f"# TYPE {_METRIC_PREFIX}_health_info gauge")
+    lines.append(f'{_METRIC_PREFIX}_health_info{{state="{state_name}",'
+                 f'reason={json.dumps(health.get("reason", ""))}}} 1')
+    gauge("admission_limit", ov.get("admission_limit", 0),
+          "Queue slots currently granted by the adaptive AIMD limit "
+          "(queue_bound is its ceiling)")
+    counter("shed_limit_total", c.get("shed_limit_total", 0),
+            "Requests shed because the queue passed the admission limit")
+    counter("shed_deadline_total", c.get("shed_deadline_total", 0),
+            "Requests shed because the queue wait would blow their "
+            "deadline")
+    counter("brownout_sheds_total", c.get("brownout_sheds_total", 0),
+            "Batch-observer runs skipped while in BROWNOUT")
+    counter("health_transitions_total", c.get("health_transitions_total", 0),
+            "Health state machine transitions")
+    for short, brk in (("compiled", ov.get("compiled_breaker") or {}),
+                       ("reload", ov.get("reload_breaker") or {})):
+        gauge(f"{short}_breaker_state",
+              _BREAKER_CODES.get(brk.get("state"), 0),
+              f"The {short} circuit breaker: 0 closed / 1 half-open / "
+              "2 open")
+        name = brk.get("name", "")
+        for transition in ("open", "half_open", "closed"):
+            counter(f"{short}_breaker_{transition}_transitions_total",
+                    c.get(f"breaker.{name}.{transition}_total", 0),
+                    f"Times the {short} breaker entered {transition}")
+    counter("breaker_demoted_batches_total",
+            c.get("breaker_demoted_batches_total", 0),
+            "Micro-batches routed to the local fallback because the "
+            "compiled-path breaker was open")
+    counter("reload_breaker_skipped_total",
+            c.get("reload_breaker_skipped_total", 0),
+            "Hot-reload attempts skipped while the reload breaker was open")
+    gauge("streaming_dead_letters_evicted_total",
+          lc.get("streaming.dead_letters_evicted_total", 0),
+          "Dead-lettered batches evicted from the bounded streaming DLQ "
+          "in this process")
     lines.append(f"# HELP {_METRIC_PREFIX}_model_info Serving model version")
     lines.append(f"# TYPE {_METRIC_PREFIX}_model_info gauge")
     lines.append(f'{_METRIC_PREFIX}_model_info'
@@ -196,17 +262,42 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
         engine = self.server.engine
         if self.path == "/healthz":
-            if self.server.draining:
-                self._reply(503, {"status": "draining"})
+            # liveness, not readiness: a draining process is still alive
+            # (restarting it would abort the drain) — /readyz is the probe
+            # that takes it out of rotation
+            from ..checkpoint import bundle_version
+            health = engine.overload.health.snapshot()
+            status = ("draining" if self.server.draining else "ok")
+            self._reply(200, {"status": status,
+                              "health": health["state"],
+                              "healthReason": health["reason"],
+                              "modelVersion": engine.model_version,
+                              "bundleVersion": bundle_version(
+                                  engine.active_bundle_path),
+                              "modelStalenessS": round(
+                                  engine.model_staleness_s, 3),
+                              "queueDepth": engine.queue_depth})
+        elif self.path == "/readyz":
+            health = engine.overload.health.snapshot()
+            breaker = engine.overload.compiled_breaker
+            reasons: List[str] = []
+            if self.server.draining or health["state"] == "DRAINING":
+                reasons.append("draining")
+            if breaker.current_state() == breaker.OPEN:
+                reasons.append("compiled-path breaker open")
+            if not reasons:
+                self._reply(200, {"ready": True,
+                                  "health": health["state"],
+                                  "modelVersion": engine.model_version})
             else:
-                from ..checkpoint import bundle_version
-                self._reply(200, {"status": "ok",
-                                  "modelVersion": engine.model_version,
-                                  "bundleVersion": bundle_version(
-                                      engine.active_bundle_path),
-                                  "modelStalenessS": round(
-                                      engine.model_staleness_s, 3),
-                                  "queueDepth": engine.queue_depth})
+                retry = (breaker.retry_after_s()
+                         if "compiled-path breaker open" in reasons
+                         and not self.server.draining else 30.0)
+                self._reply(503, {"ready": False,
+                                  "health": health["state"],
+                                  "reasons": reasons},
+                            extra_headers={
+                                "Retry-After": _retry_after(retry)})
         elif self.path == "/metrics":
             self._reply(200, render_metrics(engine).encode(),
                         content_type="text/plain; version=0.0.4")
@@ -248,11 +339,13 @@ class _Handler(BaseHTTPRequestHandler):
                                            "list of objects"})
         except OverloadedError as e:
             self._reply(429, {"error": str(e)},
-                        extra_headers={"Retry-After": "1"})
+                        extra_headers={"Retry-After": _retry_after(
+                            getattr(e, "retry_after_s", 1.0))})
         except (DeadlineExceeded, WatchdogTimeout) as e:
             self._reply(504, {"error": str(e)})
         except EngineClosed as e:
-            self._reply(503, {"error": str(e)})
+            self._reply(503, {"error": str(e)},
+                        extra_headers={"Retry-After": "30"})
         except Exception as e:  # noqa: BLE001 — a bad record must not 500
             #                     the whole connection with a stack trace
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
@@ -291,14 +384,15 @@ def start_server(model_location: str, *, host: str = "127.0.0.1",
                  port: int = 0, max_batch: int = 64, linger_ms: float = 2.0,
                  queue_bound: int = 256,
                  request_deadline_s: Optional[float] = 30.0,
-                 reload_poll_s: float = 0.0,
-                 warm: bool = True) -> Tuple[ScoringHTTPServer,
-                                             threading.Thread]:
+                 reload_poll_s: float = 0.0, warm: bool = True,
+                 overload: Optional[OverloadConfig] = None
+                 ) -> Tuple[ScoringHTTPServer, threading.Thread]:
     """Build engine + server and start the accept loop in a daemon thread.
     ``port=0`` binds an ephemeral port (see ``server.port``)."""
     engine = ScoringEngine(model_location, max_batch=max_batch,
                            linger_ms=linger_ms, queue_bound=queue_bound,
-                           reload_poll_s=reload_poll_s, warm=warm)
+                           reload_poll_s=reload_poll_s, warm=warm,
+                           overload=overload)
     server = ScoringHTTPServer(engine, host=host, port=port,
                                request_deadline_s=request_deadline_s)
     thread = threading.Thread(target=server.serve_forever,
@@ -311,7 +405,8 @@ def serve_main(model_location: str, *, host: str = "127.0.0.1",
                port: int = 8180, max_batch: int = 64, linger_ms: float = 2.0,
                queue_bound: int = 256,
                request_deadline_s: Optional[float] = 30.0,
-               reload_poll_s: float = 10.0) -> int:
+               reload_poll_s: float = 10.0,
+               overload: Optional[OverloadConfig] = None) -> int:
     """Blocking entry point for the ``serve`` CLI subcommand: serve until
     SIGTERM/SIGINT, then drain in-flight batches and exit 0."""
     with preemption_guard("serve"):
@@ -319,7 +414,7 @@ def serve_main(model_location: str, *, host: str = "127.0.0.1",
             model_location, host=host, port=port, max_batch=max_batch,
             linger_ms=linger_ms, queue_bound=queue_bound,
             request_deadline_s=request_deadline_s,
-            reload_poll_s=reload_poll_s)
+            reload_poll_s=reload_poll_s, overload=overload)
         print(f"serving {server.engine.model_version} on "
               f"http://{host}:{server.port} (max_batch={max_batch}, "
               f"linger_ms={linger_ms})", flush=True)

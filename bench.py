@@ -36,10 +36,15 @@ accelerator; reduced CPU smoke runs report 1.0.
    selector sweeps proving both ride the racing + fused-metric-panel hot
    path (zero per-candidate fallbacks).
 
+6. **serve_cold_start** (ISSUE 9 tentpole): fresh-process time-to-first-score
+   from a bundle carrying AOT-serialized executables vs the same bundle
+   forced onto the JIT path — `new_compiles_at_serve` must be 0 on the AOT
+   run.
+
 Env knobs: BENCH_ROWS (dense rows), BENCH_TRANSMOG_ROWS, BENCH_SCORE_ROWS,
-BENCH_SPARSE_ROWS, BENCH_SPARSE_HASHES,
+BENCH_SPARSE_ROWS, BENCH_SPARSE_HASHES, BENCH_COLD_START_ROWS,
 BENCH_WORKLOAD (dense|transmog|score|text_sparse|selector_smoke|
-serving_chaos|all, default all).
+serving_chaos|serve_cold_start|all, default all).
 """
 
 import json
@@ -628,6 +633,122 @@ def run_serving_chaos(on_accel: bool, platform: str):
                     "wall_seconds": round(wall, 2)}}
 
 
+# fresh-process serve probe: loads the bundle, scores ONE record, reports
+# compile/trace activity.  Run as `python -c` so the measured process has
+# nothing warm — no jax client, no caches, no imported modules.
+_COLD_START_CHILD = r"""
+import json, sys, time
+t0 = time.time()
+from transmogrifai_tpu.serving.engine import ScoringEngine
+from transmogrifai_tpu.profiling import (install_compile_listeners,
+                                         new_compile_count)
+from transmogrifai_tpu.compiled import trace_count
+install_compile_listeners()  # count compiles from the very first dispatch
+eng = ScoringEngine(sys.argv[1], max_batch=int(sys.argv[2]), linger_ms=0.0)
+out = eng.score_record({"age": 31.0, "income": 5000.0, "city": "ny"})
+first = time.time() - t0
+stats = eng.stats()
+eng.close()
+print(json.dumps({"first_score_s": round(first, 3),
+                  "new_compiles": new_compile_count(),
+                  "traces": trace_count(),
+                  "aot_executables": stats.get("aot_executables", 0)}))
+"""
+
+
+def run_serve_cold_start(on_accel: bool, platform: str):
+    """Serve cold start (ISSUE 9 tentpole): train + save a bundle carrying
+    AOT-serialized executables, then measure fresh-process time-to-first-score
+    twice — once installing the shipped executables, once forced onto the JIT
+    path (TRANSMOGRIFAI_NO_AOT=1).  The headline is the AOT number; the aux
+    carries `new_compiles_at_serve` (the acceptance bar: 0) and the JIT
+    baseline wall so the killed compile time is visible in the artifact."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                            ModelCandidate, grid)
+    from transmogrifai_tpu.workflow import Workflow
+
+    n = int(os.environ.get("BENCH_COLD_START_ROWS", "2000"))
+    max_batch = int(os.environ.get("BENCH_COLD_START_MAX_BATCH", "64"))
+    rng = np.random.default_rng(5)
+    cities = ("ny", "sf", "la", "chi")
+    records = []
+    for i in range(n):
+        age = float(rng.normal(40, 10))
+        income = float(rng.normal(5000, 1000))
+        records.append({
+            "label": float(age / 40.0 + rng.normal() > 1.0),
+            "age": age, "income": income,
+            "city": cities[int(rng.integers(0, len(cities)))]})
+
+    label = FeatureBuilder.RealNN("label").as_response()
+    preds = [FeatureBuilder.Real("age").as_predictor(),
+             FeatureBuilder.Real("income").as_predictor(),
+             FeatureBuilder.PickList("city").as_predictor()]
+    fv = transmogrify(preds)
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(),
+                       grid(reg_param=[0.01], max_iter=[30]),
+                       "OpLogisticRegression")])
+    sel.set_input(label, fv)
+    wf = (Workflow().set_input_records(records)
+          .set_result_features(sel.get_output()))
+    model = wf.train()
+
+    out_dir = tempfile.mkdtemp(prefix="bench-cold-start-")
+    try:
+        bundle = os.path.join(out_dir, "model")
+        t0 = time.time()
+        model.save(bundle)
+        save_wall = time.time() - t0
+
+        def cold(no_aot: bool):
+            env = dict(os.environ)
+            env.pop("TRANSMOGRIFAI_NO_AOT", None)
+            if no_aot:
+                env["TRANSMOGRIFAI_NO_AOT"] = "1"
+            p = subprocess.run(
+                [sys.executable, "-c", _COLD_START_CHILD, bundle,
+                 str(max_batch)],
+                capture_output=True, text=True, env=env, timeout=600)
+            line = last_json_line(p.stdout)
+            if p.returncode != 0 or not line:
+                raise RuntimeError(
+                    f"cold-start child failed (rc={p.returncode}): "
+                    f"{p.stderr[-1500:]}")
+            return json.loads(line)
+
+        aot = cold(no_aot=False)
+        jit = cold(no_aot=True)
+    finally:
+        shutil.rmtree(out_dir, ignore_errors=True)
+    return {
+        "metric": f"serve cold start: fresh-process time to first score "
+                  f"(AOT bundle, max_batch={max_batch}, {platform})",
+        "value": aot["first_score_s"],
+        "unit": "s",
+        "vs_baseline": 1.0,
+        "aux": {
+            "rows_trained": n, "platform": platform,
+            "new_compiles_at_serve": aot["new_compiles"],
+            "traces_at_serve": aot["traces"],
+            "aot_executables": aot["aot_executables"],
+            "cold_start_noaot_s": jit["first_score_s"],
+            "noaot_new_compiles": jit["new_compiles"],
+            "noaot_traces": jit["traces"],
+            "speedup_vs_jit": round(
+                jit["first_score_s"] / max(aot["first_score_s"], 1e-9), 2),
+            "save_wall_s": round(save_wall, 2),
+        },
+    }
+
+
 def run_selector_smoke(on_accel: bool, platform: str):
     """Multiclass + regression selector sweeps on the fused-panel hot path:
     counts selector.batched_metrics fallback events (must be 0) so a
@@ -843,6 +964,8 @@ def main():
             on_accel, platform)),
         ("selector_smoke", lambda: run_selector_smoke(on_accel, platform)),
         ("serving_chaos", lambda: run_serving_chaos(on_accel, platform)),
+        ("serve_cold_start", lambda: run_serve_cold_start(on_accel,
+                                                          platform)),
     ]
     can_retry = (os.environ.get("BENCH_NO_RETRY") != "1" and on_accel)
     broken = False

@@ -48,7 +48,12 @@ import numpy as np
 from .resilience import InjectedFault, maybe_inject, record_failure
 
 MANIFEST_NAME = "MANIFEST.json"
-BUNDLE_FORMAT_VERSION = 1
+# version 2: manifests digest the whole tree recursively (relative POSIX
+# paths as keys) and bundles may carry per-platform AOT executable
+# subdirectories (aot-<platform>/, see aot.py) stamped under the manifest's
+# "aot" entry.  Version-1 bundles remain fully readable — they simply load
+# on the JIT path.
+BUNDLE_FORMAT_VERSION = 2
 _VERSION_DIR_PREFIX = "ckpt-"
 
 
@@ -150,14 +155,21 @@ def write_json_atomic(path: str, payload: Dict[str, Any]) -> None:
 
 def write_manifest(dirpath: str, extra: Optional[Dict[str, Any]] = None
                    ) -> Dict[str, Any]:
-    """Digest every file in ``dirpath`` into a ``MANIFEST.json``."""
+    """Digest every file under ``dirpath`` (recursively — AOT executables
+    live in per-platform subdirectories) into a ``MANIFEST.json``, keyed by
+    POSIX-style relative path so digests verify on any host."""
     files: Dict[str, Dict[str, Any]] = {}
-    for name in sorted(os.listdir(dirpath)):
-        p = os.path.join(dirpath, name)
-        if name == MANIFEST_NAME or not os.path.isfile(p):
-            continue
-        files[name] = {"sha256": _sha256_file(p),
-                       "bytes": os.path.getsize(p)}
+    for root, dirs, names in os.walk(dirpath):
+        dirs.sort()
+        rel_root = os.path.relpath(root, dirpath)
+        for name in sorted(names):
+            rel = name if rel_root == "." else f"{rel_root}/{name}"
+            rel = rel.replace(os.sep, "/")
+            p = os.path.join(root, name)
+            if rel == MANIFEST_NAME or not os.path.isfile(p):
+                continue
+            files[rel] = {"sha256": _sha256_file(p),
+                          "bytes": os.path.getsize(p)}
     manifest: Dict[str, Any] = {"formatVersion": BUNDLE_FORMAT_VERSION,
                                 "createdAt": time.time(), "files": files}
     if extra:
@@ -200,9 +212,10 @@ def atomic_bundle_write(path: str, overwrite: bool = True,
             # data files are written but before the bundle commits
             maybe_inject("checkpoint.save", key=os.path.basename(path))
             write_manifest(tmp, extra=manifest_extra)
-            for name in os.listdir(tmp):
-                _fsync_path(os.path.join(tmp, name))
-            _fsync_path(tmp)
+            for root, _dirs, names in os.walk(tmp, topdown=False):
+                for name in names:
+                    _fsync_path(os.path.join(root, name))
+                _fsync_path(root)
             if os.path.lexists(path):
                 old = f"{tmp}.old"
                 os.rename(path, old)

@@ -25,6 +25,8 @@ of the program).
 
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
@@ -43,9 +45,30 @@ _WIRE_SEP = "\x00"      # wire-entry names: "<uid>\x00<key>" — never a column
 # is actually tracing, never on a jit cache hit.
 _TRACE_COUNT = [0]
 
+# threads whose traces are deliberately off the books: AOT export warms the
+# ladder at save() time, and a save running concurrently with a serving
+# engine (lifecycle retrain+promote, the hot-reload tests) must not land its
+# warmup traces inside the engine's online-trace measurement window — the
+# engine would blame itself and demote to the local fallback.  jax traces on
+# the calling thread, so a thread-local flag attributes exactly the
+# suppressing thread's traces and nothing else.
+_TRACE_LOCAL = threading.local()
+
 
 def trace_count() -> int:
     return _TRACE_COUNT[0]
+
+
+@contextlib.contextmanager
+def suppress_trace_count():
+    """Traces on THIS thread don't count toward ``trace_count()`` while the
+    context is open (save-time AOT export warmup — see aot.py)."""
+    prev = getattr(_TRACE_LOCAL, "suppress", False)
+    _TRACE_LOCAL.suppress = True
+    try:
+        yield
+    finally:
+        _TRACE_LOCAL.suppress = prev
 
 
 def compile_attribution() -> Dict[str, Any]:
@@ -96,6 +119,25 @@ class ScoreProgram:
         self._demoted: Set[str] = set()   # uids proven untraceable
         self._jitted: Dict[Tuple, Any] = {}
         self._metas: Dict[Tuple, Dict[str, Any]] = {}
+        # AOT seams (see aot.py): per-key input avals captured at first call
+        # (what export lowers against), and keys whose entry is a
+        # deserialized pre-compiled executable rather than a jit wrapper
+        self._input_specs: Dict[Tuple, Any] = {}
+        self._aot_installed: Set[Tuple] = set()
+
+    def install_executable(self, key: Tuple, fn: Any,
+                           canon_out: Dict[str, str],
+                           metas: Dict[str, Any]) -> None:
+        """Install a deserialized AOT executable for ``key`` — subsequent
+        calls at that exact (stages, rows) signature dispatch straight to it
+        with zero traces and zero compiles.  A call-time failure (shape or
+        ABI drift the stamp missed) uninstalls it and falls back to jit."""
+        self._jitted[key] = (fn, dict(canon_out))
+        self._metas[key] = dict(metas)
+        self._aot_installed.add(key)
+
+    def aot_installed_count(self) -> int:
+        return len(self._aot_installed)
 
     # -- partition ----------------------------------------------------------
     def _partition(self, batch: ColumnBatch) -> List[Tuple[bool, List[Transformer]]]:
@@ -225,7 +267,8 @@ class ScoreProgram:
             canon_out = {n: f"o{i}" for i, n in enumerate(out_names)}
 
             def traced(arrays_c: Dict[str, Tuple[Any, Any]]):
-                _TRACE_COUNT[0] += 1
+                if not getattr(_TRACE_LOCAL, "suppress", False):
+                    _TRACE_COUNT[0] += 1
                 arrays = {inv_in[c]: vm for c, vm in arrays_c.items()}
                 cols = {n: Column(kinds[n], v, m, meta=metas_in[n])
                         for n, (v, m) in arrays.items()
@@ -268,6 +311,13 @@ class ScoreProgram:
                   for n in frontier}
         arrays.update({canon_in[k]: (_prep(v), None)
                        for k, v in wires.items()})
+        if key not in self._input_specs:
+            try:
+                # unsharded host-side avals — what AOT export lowers against
+                self._input_specs[key] = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), arrays)
+            except Exception:  # noqa: BLE001 — a non-array wire entry just
+                pass           # makes this key non-exportable
         # host-resident wire args copy to the device inside the jit call (or
         # in the sharding block below); count them toward the phase's link
         # bytes BEFORE _shard turns them into jax Arrays
@@ -313,6 +363,19 @@ class ScoreProgram:
             self._metas.pop(key, None)
             raise
         except Exception as e:  # noqa: BLE001
+            if key in self._aot_installed:
+                # the shipped executable rejected these inputs (shape/dtype
+                # drift the ABI stamp could not see) — uninstall it and
+                # retry on the ordinary jit path instead of going eager
+                record_failure("compiled", "degraded", e,
+                               point="compiled.aot",
+                               fallback="JIT recompile")
+                from .telemetry import REGISTRY
+                REGISTRY.counter("aot.fallback").inc()
+                self._aot_installed.discard(key)
+                self._jitted.pop(key, None)
+                self._metas.pop(key, None)
+                return self._apply_run(batch, run, later, keep_intermediate)
             # unexpected jit-boundary failure: never break scoring — run the
             # segment eagerly (≙ apply_dag) and stop attempting to compile
             record_failure("compiled", "demoted", e,

@@ -119,6 +119,19 @@ class PredictorEstimator(Estimator):
     out_kind = Prediction
     allow_label_as_input = True
     model_cls: Type[PredictionModel] = PredictionModel
+    # families whose fit_arrays_grid honours aot.pretrace_mode() — inside
+    # that mode the grid programs are lowered+compiled (populating the
+    # persistent compile cache from a background thread) but never executed
+    supports_pretrace = False
+
+    def pretrace_arrays_grid(self, X, y, fold_weights, grids) -> None:
+        """Compile-only dry run of :meth:`fit_arrays_grid` — the sweep
+        submits this to a background thread (see aot.pretrace_submit) so
+        ``new_compiles_during_train`` overlaps data prep instead of
+        serializing the fit loop."""
+        from ..aot import pretrace_scope
+        with pretrace_scope():
+            self.fit_arrays_grid(X, y, fold_weights, grids)
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray,
                    sample_weight: Optional[np.ndarray] = None) -> Dict[str, Any]:

@@ -62,6 +62,29 @@ def _grouped_grid_fit(est, X, y, fold_weights, grids, *, loss: str,
         pens = [l2l1({**est._params, **grids[gi]}) for gi in gidx]
         l2s = jnp.asarray([p[0] for p in pens], jnp.float32)
         l1s = jnp.asarray([p[1] for p in pens], jnp.float32)
+        from ..aot import pretrace_mode
+        if pretrace_mode():
+            # background pre-trace: lower+compile each group's program (the
+            # compile lands in the persistent cache, so the real fit below
+            # becomes a disk hit) without executing anything
+            if sparse:
+                sparse_linear_grid_fit.lower(
+                    Xj.values, Xj.indices, Xj.row_ids, yj, Wj, l2s, l1s,
+                    n_rows=Xj.n_rows, n_cols=Xj.n_cols, loss=loss,
+                    fit_intercept=fit_intercept,
+                    standardization=standardization,
+                    max_iter=max_iter, tol=tol, n_classes=nc).compile()
+            elif loss == "squared" and all(p[1] == 0.0 for p in pens):
+                ridge_grid_fit.lower(
+                    Xj, yj, Wj, l2s, fit_intercept=fit_intercept,
+                    standardization=standardization).compile()
+            else:
+                linear_grid_fit.lower(
+                    Xj, yj, Wj, l2s, l1s, loss=loss,
+                    fit_intercept=fit_intercept,
+                    standardization=standardization,
+                    max_iter=max_iter, tol=tol, n_classes=nc).compile()
+            continue
         from ..profiling import cost_analysis_enabled, record_program_cost
         if sparse:
             # flat-COO path: FISTA via take+segment_sum for every loss
@@ -222,6 +245,7 @@ class OpLogisticRegression(PredictorEstimator):
     # zero-weight padding rows leave the fit exact — lets the sweep pad N up
     # a ladder to reuse compiled executables across nearby dataset sizes
     weighted_pad_exact = True
+    supports_pretrace = True
 
     def __init__(self, reg_param: float = 0.0, elastic_net_param: float = 0.0,
                  max_iter: int = 100, tol: float = 1e-6,
@@ -284,6 +308,7 @@ class OpLinearSVC(PredictorEstimator):
 
     model_cls = LinearPredictionModel
     weighted_pad_exact = True   # see OpLogisticRegression
+    supports_pretrace = True
 
     def __init__(self, reg_param: float = 0.0, max_iter: int = 100,
                  tol: float = 1e-6, fit_intercept: bool = True,
@@ -333,6 +358,7 @@ class OpLinearRegression(PredictorEstimator):
 
     model_cls = LinearPredictionModel
     weighted_pad_exact = True   # see OpLogisticRegression
+    supports_pretrace = True
 
     def __init__(self, reg_param: float = 0.0, elastic_net_param: float = 0.0,
                  max_iter: int = 100, tol: float = 1e-6,
@@ -392,6 +418,7 @@ class OpGeneralizedLinearRegression(PredictorEstimator):
     BinaryClassificationModelSelector.scala / DefaultSelectorParams.scala:56-65)."""
 
     weighted_pad_exact = True   # see OpLogisticRegression
+    supports_pretrace = True
 
     def __init__(self, family: str = "gaussian", link: Optional[str] = None,
                  reg_param: float = 0.0, max_iter: int = 50, tol: float = 1e-6,

@@ -51,6 +51,10 @@ class OpParams:
     # minRetrainIntervalS, tolerance, warmStart, maxIterations,
     # batchesPerCheck, pollS, forceRetrain
     lifecycle: Dict[str, Any] = field(default_factory=dict)
+    # AOT-executable knobs (aot.py): enabled (default true — set false or
+    # pass --no-aot to save/load JIT-only bundles), ladderMax (largest
+    # padded batch size exported at save time)
+    aot: Dict[str, Any] = field(default_factory=dict)
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "OpParams":
@@ -72,7 +76,8 @@ class OpParams:
             serving=d.get("servingParams") or {},
             racing=d.get("racingParams") or {},
             telemetry=d.get("telemetryParams") or {},
-            lifecycle=d.get("lifecycleParams") or {})
+            lifecycle=d.get("lifecycleParams") or {},
+            aot=d.get("aotParams") or {})
 
     @staticmethod
     def load(path: str) -> "OpParams":
@@ -97,6 +102,7 @@ class OpParams:
             "racingParams": self.racing,
             "telemetryParams": self.telemetry,
             "lifecycleParams": self.lifecycle,
+            "aotParams": self.aot,
         }
 
     def apply_stage_params(self, stages) -> None:

@@ -98,6 +98,15 @@ class OpWorkflowRunner:
         import contextlib
 
         from .telemetry import Tracer, use_tracer
+        # aotParams: the "enabled" knob is a process-wide kill switch —
+        # train stops exporting executables into bundles, load stops
+        # installing them (JIT path everywhere)
+        ap = params.aot or {}
+        if ap.get("enabled") is False:
+            from .aot import set_aot_enabled
+            set_aot_enabled(False)
+        if ap.get("ladderMax") is not None:
+            os.environ["TRANSMOGRIFAI_AOT_LADDER_MAX"] = str(ap["ladderMax"])
         tele = params.telemetry or {}
         trace_dir = tele.get("traceDir")
         enabled = bool(tele.get("enabled", trace_dir is not None))
@@ -500,6 +509,10 @@ class OpApp:
         p.add_argument("--trace-dir",
                        help="trace this run and write Chrome-trace JSON + "
                             "telemetry.json into this directory")
+        p.add_argument("--no-aot", action="store_true",
+                       help="disable AOT-serialized executables: train "
+                            "saves JIT-only bundles, load/serve recompiles "
+                            "instead of installing shipped executables")
         return p.parse_args(argv)
 
     def main(self, argv: Optional[List[str]] = None) -> OpWorkflowRunnerResult:
@@ -526,5 +539,7 @@ class OpApp:
             params.racing["minSurvivors"] = args.racing_min_survivors
         if args.trace_dir:
             params.telemetry["traceDir"] = args.trace_dir
+        if args.no_aot:
+            params.aot["enabled"] = False
         runner = self.make_runner()
         return runner.run(args.run_type, params)

@@ -27,6 +27,7 @@ client can correlate scores across a hot swap.
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -225,7 +226,14 @@ class ScoringEngine:
             path = (self.model_location
                     if is_bundle_dir(self.model_location)
                     else find_latest_valid(self.model_location))
-        return _ModelEntry(WorkflowModel.load(path), path)
+        # AOT executables deserialize inside load; the span separates that
+        # (ideally compile-free) cost from warmup in run timelines
+        with span("serving.aot_load", bundle=os.path.basename(path)) as sp:
+            model = WorkflowModel.load(path)
+            if sp is not None:
+                sp.attrs["aotExecutables"] = getattr(
+                    model, "aot_executables", 0)
+        return _ModelEntry(model, path)
 
     def _warm(self, entry: _ModelEntry) -> None:
         """Score a synthetic record at every ladder size so jit compiles
@@ -602,9 +610,11 @@ class ScoringEngine:
     def stats(self) -> Dict[str, Any]:
         with self._swap_lock:
             version = self._entry.version
+            aot_execs = getattr(self._entry.model, "aot_executables", 0)
         return {"counters": self.metrics.counters(),
                 "queue_depth": self.queue_depth,
                 "model_version": version,
+                "aot_executables": aot_execs,
                 "compiled_path_active": self._compiled_ok,
                 "overload": self.overload.snapshot(),
                 "request_latency": self.request_latency.snapshot(),

@@ -109,6 +109,14 @@ def render_metrics(engine: ScoringEngine) -> str:
           "Persistent compile-cache hits")
     gauge("compile_cache_misses_total", reg.get("compile.cache_misses", 0),
           "Persistent compile-cache misses")
+    # AOT executable families (ISSUE 9): how many shipped executables this
+    # process installed from bundles vs. how many degraded back to JIT
+    reg_counters = REGISTRY.snapshot()["counters"]
+    counter("aot_executables_loaded_total",
+            reg_counters.get("aot.executables_loaded", 0),
+            "AOT-serialized executables installed from model bundles")
+    counter("aot_fallback_total", reg_counters.get("aot.fallback", 0),
+            "Bundles or executables that fell back to the JIT path")
     gauge("racing_cv_fits_saved_total", reg.get("racing.cv_fits_saved", 0),
           "CV fold-fits skipped by selector grid racing")
     gauge("racing_points_pruned_total", reg.get("racing.points_pruned", 0),

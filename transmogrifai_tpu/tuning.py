@@ -1045,11 +1045,51 @@ class OpValidator:
                 else:
                     X_pad = np.pad(X, ((0, pad_rows), (0, 0)))
                     y_pad = np.pad(y32, (0, pad_rows))
+                if not is_sparse:
+                    # tree families quantile-bin over the true rows only —
+                    # keeps padded split points identical to unpadded ones
+                    from .models.trees import register_real_rows
+                    register_real_rows(X_pad, N)
 
             def _pad_weight_cols(Wblk):
                 if isinstance(Wblk, np.ndarray):
                     return np.pad(Wblk, ((0, 0), (0, pad_rows)))
                 return jnp.pad(Wblk, ((0, 0), (0, pad_rows)))
+
+            # concurrent pre-trace (aot.py): lower+compile each supporting
+            # family's grid programs on a background thread NOW, so by the
+            # time the fit pool below reaches them the persistent compile
+            # cache already holds the executables and
+            # new_compiles_during_train collapses into overlapped wall time.
+            # Compile-only — sweep winners are bitwise unaffected.
+            from .aot import pretrace_enabled, pretrace_submit
+            if pretrace_enabled() and mesh is None:
+                for ci, cand in enumerate(candidates):
+                    if (ci in replayed or not getattr(
+                            cand.estimator, "supports_pretrace", False)):
+                        continue
+                    use_pad = bool(pad_rows) and getattr(
+                        cand.estimator, "weighted_pad_exact", False)
+                    Xf = X_pad if use_pad else X
+                    yf = (y_pad if use_pad
+                          else y_dev if y_dev is not None else y32)
+
+                    def _submit(Wblk, grid, est=cand.estimator, Xf=Xf,
+                                yf=yf, name=cand.model_name):
+                        Wf = _pad_weight_cols(Wblk) if use_pad else Wblk
+                        pretrace_submit(
+                            name, lambda: est.pretrace_arrays_grid(
+                                Xf, yf, Wf, grid))
+                    if raced_flags[ci]:
+                        # round A (full grid, fold 0) is certain; round B's
+                        # survivor subset is data-dependent — pre-trace a
+                        # same-sized prefix as a best-effort shape/static
+                        # match (a miss just forfeits the overlap)
+                        _submit(W[:1], cand.grid)
+                        _submit(W, cand.grid[:_survivor_count(
+                            len(cand.grid))])
+                    else:
+                        _submit(W, cand.grid)
 
             def fit_candidate(cand, Wblk, grid):
                 # per-candidate trace span: worker threads have no span of

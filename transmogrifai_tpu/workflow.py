@@ -664,19 +664,35 @@ class WorkflowModel(_WorkflowCore):
         return ModelInsights.extract(self).pretty()
 
     # -- persistence (≙ OpWorkflowModelWriter.toJson) -----------------------
-    def save(self, path: str, overwrite: bool = True):
+    def save(self, path: str, overwrite: bool = True,
+             aot: Optional[bool] = None):
         """Atomically write the model bundle to ``path``.
 
         The bundle is staged in a temp sibling directory, checksummed into
         a ``MANIFEST.json``, fsynced and renamed into place — a crash mid-
         save can never leave a torn bundle at ``path``.  With
         ``overwrite=False`` a non-empty ``path`` raises ``FileExistsError``
-        instead of being replaced."""
+        instead of being replaced.
+
+        Unless opted out (``aot=False`` / ``--no-aot`` /
+        ``TRANSMOGRIFAI_NO_AOT=1``), the fused scoring programs are AOT-
+        compiled across the serving padding ladder and shipped inside the
+        bundle as digest-covered serialized executables (see aot.py) — a
+        fresh process then serves its first score without invoking XLA."""
+        from .aot import abi_stamp, aot_enabled, export_bundle
         from .checkpoint import atomic_bundle_write
+        manifest_extra: Dict[str, Any] = {"kind": "workflow-model"}
+        do_aot = aot_enabled() if aot is None else (bool(aot) and aot_enabled())
         with atomic_bundle_write(path, overwrite=overwrite,
-                                 manifest_extra={"kind": "workflow-model"}
-                                 ) as tmp:
+                                 manifest_extra=manifest_extra) as tmp:
             self._write_bundle_files(tmp)
+            if do_aot:
+                n = export_bundle(self, tmp)
+                if n:
+                    # read by atomic_bundle_write at successful exit — the
+                    # stamp lands in MANIFEST only when export worked
+                    manifest_extra["aot"] = {"abi": abi_stamp(),
+                                             "executables": n}
 
     def _write_bundle_files(self, path: str) -> None:
         all_feats: Dict[str, Feature] = {}
@@ -880,4 +896,8 @@ class WorkflowModel(_WorkflowCore):
                            "bundle has no baselines.json (pre-lifecycle "
                            "build); drift monitoring disabled",
                            point="checkpoint.load", bundle=path)
+        # 5. AOT executables (formatVersion 2 bundles): deserialize straight
+        # into the score program — mismatch/corruption degrades to JIT
+        from .aot import install_bundle
+        model.aot_executables = install_bundle(model, path)
         return model

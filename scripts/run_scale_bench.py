@@ -6,18 +6,19 @@ the new shapes ("cold"), the second measures the steady state ("warm").
 Partial results are flushed after every run so a TPU-worker crash still
 leaves an artifact.
 
-When the COMBINED full-grid process fails at a size (the tunneled worker
-hard-faults under cumulative near-capacity HBM load even though every family
-passes in isolation — BENCH_11M_ATTEMPTS_r4.json), the script falls back to
-PER-FAMILY subprocess isolation (VERDICT r4 next #3): each candidate family's
-CV grid runs in a fresh process over identical data (same seed; the binned
-matrix and raw columns regenerate per process — the host/disk round-trip the
-fresh client needs anyway), with an automated budget/cache retry ladder, and
-the parent merges the scalar CV metrics into one full-grid record: every
-family's grid measured, winner selected across ALL candidates — the same
-selection the one-process grid performs, priced as the sum of family walls.
+DEFAULT PATH (ISSUE 10): the combined full grid runs IN ONE PROCESS with
+mesh sharding forced on (TRANSMOGRIFAI_TPU_MESH=1) and chunked host→device
+streaming, so the dataset is bounded by aggregate HBM across the mesh and
+transfer staging is O(TRANSMOGRIFAI_DEVICE_CHUNK_BYTES) — the regime that
+used to hard-fault a single worker (BENCH_11M_ATTEMPTS_r4.json).
 
-Usage: python scripts/run_scale_bench.py [out.json] [sizes...]
+FALLBACK (--subprocess-ladder): the retired PER-FAMILY subprocess isolation
+(VERDICT r4 next #3) — each candidate family's CV grid in a fresh process
+over identical data with an automated budget/cache retry ladder, scalar CV
+metrics merged into one full-grid record.  Kept for single-device hardware
+or post-mortems, no longer the default.
+
+Usage: python scripts/run_scale_bench.py [--subprocess-ladder] [out.json] [sizes...]
 """
 
 import json
@@ -121,11 +122,15 @@ def _per_family(n, flush):
 
 
 def main():
-    out_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
-        ROOT, "BENCH_11M.json")
-    sizes = ([int(float(a)) for a in sys.argv[2:]]
+    argv = list(sys.argv[1:])
+    use_ladder = "--subprocess-ladder" in argv
+    if use_ladder:
+        argv.remove("--subprocess-ladder")
+    out_path = argv[0] if argv else os.path.join(ROOT, "BENCH_11M.json")
+    sizes = ([int(float(a)) for a in argv[1:]]
              or [4_000_000, 8_000_000, 11_000_000])
     out = {"workload": "dense HIGGS-difficulty (bench.py run_dense)",
+           "path": "subprocess_ladder" if use_ladder else "mesh_sharded",
            "runs": []}
 
     def flush():
@@ -136,12 +141,24 @@ def main():
         combined_ok = False
         for phase in ("cold", "warm"):
             extra = {}
-            if n >= 8_000_000:
-                # cumulative HBM residency is what hard-faults the worker at
-                # 10M+ (VERDICT r3 #2): shrink the host→device transfer
-                # cache so stale raw-column copies evict, and lower the tree
-                # histogram budget below the near-capacity trigger
-                extra = dict(_LADDER[0])
+            if use_ladder:
+                if n >= 8_000_000:
+                    # cumulative HBM residency is what hard-faults the
+                    # worker at 10M+ (VERDICT r3 #2): shrink the
+                    # host→device transfer cache so stale raw-column copies
+                    # evict, and lower the tree histogram budget below the
+                    # near-capacity trigger
+                    extra = dict(_LADDER[0])
+            else:
+                # one-process mesh-sharded sweep (ISSUE 10): force the mesh
+                # on regardless of the row threshold and stream the matrix
+                # over in bounded chunks — resident data scales with
+                # aggregate HBM, staging with the chunk budget
+                extra = {"TRANSMOGRIFAI_TPU_MESH": "1"}
+                extra.setdefault("TRANSMOGRIFAI_DEVICE_CHUNK_BYTES",
+                                 os.environ.get(
+                                     "TRANSMOGRIFAI_DEVICE_CHUNK_BYTES",
+                                     str(256 << 20)))
             rec = {"rows": n, "phase": phase, **_run_bench(n, extra)}
             out["runs"].append(rec)
             flush()
@@ -151,6 +168,11 @@ def main():
             elif phase == "warm":
                 combined_ok = True
         if not combined_ok:
+            if not use_ladder:
+                print(f"size {n}: mesh-sharded run failed; re-run with "
+                      "--subprocess-ladder for per-family isolation",
+                      flush=True)
+                continue
             print(f"size {n}: combined grid failed; isolating families",
                   flush=True)
             merged = _per_family(n, flush)

@@ -83,8 +83,11 @@ def test_selector_on_mesh_matches_unsharded(monkeypatch):
 
 @pytest.mark.skipif(len(jax.devices()) < 2, reason="needs a multi-device mesh")
 def test_mesh_guard_on_indivisible_rows(monkeypatch):
-    """Row counts not divisible by the device count silently fall back to the
-    single-device path rather than failing."""
+    """Row counts not divisible by the device count still train and score
+    end-to-end: the sweep pads to the device-divisible quantum with
+    zero-weight rows (ISSUE 10) while stat/score stages that can't pad keep
+    their single-device fallback — either way, no failure and a full-length
+    scored column."""
     monkeypatch.setenv("TRANSMOGRIFAI_TPU_MESH", "1")
     wf, pred = _workflow(n=16387)
     model = wf.train()

@@ -62,6 +62,19 @@ def _grouped_grid_fit(est, X, y, fold_weights, grids, *, loss: str,
         pens = [l2l1({**est._params, **grids[gi]}) for gi in gidx]
         l2s = jnp.asarray([p[0] for p in pens], jnp.float32)
         l1s = jnp.asarray([p[1] for p in pens], jnp.float32)
+        if not sparse:
+            # mesh sweeps with a 'model' axis wider than 1: lay the penalty
+            # grid out over that axis (candidate_sharding) instead of
+            # replicating it, so each model-column of devices solves its own
+            # slice of the grid (SURVEY §2.6 P3) — the mesh rides in on X's
+            # sharding, no extra fit-signature plumbing
+            from ..parallel.mesh import candidate_mesh_for, candidate_sharding
+            cmesh = candidate_mesh_for(Xj, len(gidx))
+            if cmesh is not None:
+                import jax as _jax
+                csh = candidate_sharding(cmesh)
+                l2s = _jax.device_put(l2s, csh)
+                l1s = _jax.device_put(l1s, csh)
         from ..aot import pretrace_mode
         if pretrace_mode():
             # background pre-trace: lower+compile each group's program (the

@@ -8,17 +8,23 @@ reference's parallelism mechanisms (SURVEY.md §2.6):
   P7 Spark shuffle/broadcast                    → XLA collectives via GSPMD
 """
 
-from .mesh import (candidate_sharding, data_sharding, make_mesh,
-                   maybe_data_mesh, replicated_sharding)
+from .mesh import (candidate_mesh_for, candidate_sharding, data_axis_size,
+                   data_sharding, make_mesh, maybe_data_mesh,
+                   model_axis_size, model_axis_width, pad_rows_for,
+                   replicated_sharding)
 from .dist_fit import (fit_logreg_grid_sharded, sharded_col_stats,
                        sharded_forest_fit, sharded_gbt_round,
                        sharded_train_step)
 from .multihost import init_distributed, is_multihost
+from .streaming import (device_chunk_bytes, stream_to_device,
+                        streaming_stats)
 
 __all__ = [
     "make_mesh", "maybe_data_mesh", "data_sharding", "candidate_sharding",
-    "replicated_sharding",
+    "candidate_mesh_for", "replicated_sharding", "data_axis_size",
+    "model_axis_size", "model_axis_width", "pad_rows_for",
     "fit_logreg_grid_sharded", "sharded_col_stats", "sharded_forest_fit",
     "sharded_gbt_round", "sharded_train_step", "init_distributed",
     "is_multihost",
+    "stream_to_device", "streaming_stats", "device_chunk_bytes",
 ]

@@ -13,6 +13,7 @@ spans hosts and the same mesh code rides DCN across slices.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -43,17 +44,35 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(arr, axis_names)
 
 
-def maybe_data_mesh(n_rows: int) -> Optional[Mesh]:
+def model_axis_width() -> int:
+    """Requested 'model'-axis extent (TRANSMOGRIFAI_TPU_MESH_MODEL, default
+    1 = grid candidates replicated).  Silently clamps to 1 when the device
+    count is not divisible by the requested width."""
+    try:
+        w = int(os.environ.get("TRANSMOGRIFAI_TPU_MESH_MODEL", "1"))
+    except ValueError:
+        return 1
+    if w < 1 or len(jax.devices()) % w:
+        return 1
+    return w
+
+
+def maybe_data_mesh(n_rows: int, pad: bool = False) -> Optional[Mesh]:
     """The process-wide data-axis mesh policy, shared by every stage that
     row-shards (validator CV grid, SanityChecker stats, RawFeatureFilter
     reductions, the compiled score program): a mesh when several devices are
     visible and the batch is big enough to shard profitably.  Force on/off
     with TRANSMOGRIFAI_TPU_MESH=1/0; row threshold via
-    TRANSMOGRIFAI_TPU_MESH_MIN_ROWS.  Returns None when sharding would not
-    apply (single device, small batch, or rows not divisible — static shapes
-    stay exact, no padding surprises)."""
-    import os
+    TRANSMOGRIFAI_TPU_MESH_MIN_ROWS; 'model'-axis width via
+    TRANSMOGRIFAI_TPU_MESH_MODEL.
 
+    ``pad=False`` (stat reductions, score programs — callers that device_put
+    the batch as-is) keeps the historical bail on ``n_rows`` not divisible by
+    the data-axis extent: static shapes stay exact, no padding surprises.
+    ``pad=True`` (the validator sweep, which pads with zero-weight rows)
+    returns the mesh anyway and records a ``mesh.pad_rows`` telemetry event so
+    the padding is visible in traces instead of silently degrading to one
+    device."""
     n_dev = len(jax.devices())
     flag = os.environ.get("TRANSMOGRIFAI_TPU_MESH")
     if flag == "0" or n_dev < 2:
@@ -61,13 +80,38 @@ def maybe_data_mesh(n_rows: int) -> Optional[Mesh]:
     min_rows = int(os.environ.get("TRANSMOGRIFAI_TPU_MESH_MIN_ROWS", 262144))
     if flag != "1" and n_rows < min_rows:
         return None
-    if n_rows % n_dev:
-        return None
+    model = model_axis_width()
+    data_extent = n_dev // model
+    rem = n_rows % data_extent
+    if rem:
+        if not pad:
+            return None
+        from ..telemetry import event
+        event("mesh.pad_rows", rows=n_rows, pad_rows=data_extent - rem,
+              data_extent=data_extent, devices=n_dev)
     # resolve through the package attribute (not this module's global) so
     # callers/tests that instrument `parallel.make_mesh` see every mesh
     # construction
     from transmogrifai_tpu import parallel as _pkg
-    return _pkg.make_mesh()
+    mesh = _pkg.make_mesh(model_parallel=model)
+    from ..telemetry import REGISTRY
+    REGISTRY.gauge("mesh.devices").set(n_dev)
+    return mesh
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    return mesh.shape[DATA_AXIS]
+
+
+def model_axis_size(mesh: Mesh) -> int:
+    return mesh.shape[MODEL_AXIS]
+
+
+def pad_rows_for(n_rows: int, mesh: Mesh) -> int:
+    """Zero-weight rows needed to make ``n_rows`` divisible by the data-axis
+    extent (0 when already divisible)."""
+    extent = data_axis_size(mesh)
+    return (-n_rows) % extent
 
 
 def data_sharding(mesh: Mesh, ndim: int = 2, row_axis: int = 0) -> NamedSharding:
@@ -82,6 +126,27 @@ def candidate_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     """Shard axis 0 (grid candidates) over 'model'."""
     spec = P(MODEL_AXIS, *([None] * (ndim - 1)))
     return NamedSharding(mesh, spec)
+
+
+def candidate_mesh_for(X, n_candidates: int) -> Optional[Mesh]:
+    """The mesh riding on ``X``'s sharding, when its 'model' axis can shard
+    ``n_candidates`` grid points evenly (extent > 1, count divisible) — the
+    fitters use this to lay hyper-parameter vectors out over 'model' via
+    ``candidate_sharding`` instead of replicating them, without threading a
+    mesh argument through every fit signature."""
+    sh = getattr(X, "sharding", None)
+    mesh = getattr(sh, "mesh", None)
+    if mesh is None or not hasattr(mesh, "shape"):
+        return None
+    try:
+        width = dict(mesh.shape).get(MODEL_AXIS, 1)
+    except Exception:  # noqa: BLE001 — exotic sharding: replicate
+        return None
+    if width < 2 or n_candidates % width:
+        return None
+    if hasattr(mesh, "devices"):
+        return mesh
+    return None
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

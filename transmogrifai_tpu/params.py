@@ -55,6 +55,11 @@ class OpParams:
     # pass --no-aot to save/load JIT-only bundles), ladderMax (largest
     # padded batch size exported at save time)
     aot: Dict[str, Any] = field(default_factory=dict)
+    # mesh-sharded sweep knobs (parallel/mesh.py env equivalents): enabled
+    # (TRANSMOGRIFAI_TPU_MESH), modelWidth (TRANSMOGRIFAI_TPU_MESH_MODEL),
+    # chunkBytes (TRANSMOGRIFAI_DEVICE_CHUNK_BYTES), minRows
+    # (TRANSMOGRIFAI_TPU_MESH_MIN_ROWS)
+    mesh: Dict[str, Any] = field(default_factory=dict)
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "OpParams":
@@ -77,7 +82,8 @@ class OpParams:
             racing=d.get("racingParams") or {},
             telemetry=d.get("telemetryParams") or {},
             lifecycle=d.get("lifecycleParams") or {},
-            aot=d.get("aotParams") or {})
+            aot=d.get("aotParams") or {},
+            mesh=d.get("meshParams") or {})
 
     @staticmethod
     def load(path: str) -> "OpParams":
@@ -103,6 +109,7 @@ class OpParams:
             "telemetryParams": self.telemetry,
             "lifecycleParams": self.lifecycle,
             "aotParams": self.aot,
+            "meshParams": self.mesh,
         }
 
     def apply_stage_params(self, stages) -> None:

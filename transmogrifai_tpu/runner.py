@@ -107,6 +107,20 @@ class OpWorkflowRunner:
             set_aot_enabled(False)
         if ap.get("ladderMax") is not None:
             os.environ["TRANSMOGRIFAI_AOT_LADDER_MAX"] = str(ap["ladderMax"])
+        # meshParams: the mesh decision is made per-fit from the environment
+        # (parallel/mesh.py), so the per-run knobs ride the env knobs
+        mp = params.mesh or {}
+        if mp.get("enabled") is not None:
+            os.environ["TRANSMOGRIFAI_TPU_MESH"] = \
+                "1" if mp["enabled"] else "0"
+        if mp.get("modelWidth") is not None:
+            os.environ["TRANSMOGRIFAI_TPU_MESH_MODEL"] = str(mp["modelWidth"])
+        if mp.get("chunkBytes") is not None:
+            os.environ["TRANSMOGRIFAI_DEVICE_CHUNK_BYTES"] = \
+                str(mp["chunkBytes"])
+        if mp.get("minRows") is not None:
+            os.environ["TRANSMOGRIFAI_TPU_MESH_MIN_ROWS"] = \
+                str(mp["minRows"])
         tele = params.telemetry or {}
         trace_dir = tele.get("traceDir")
         enabled = bool(tele.get("enabled", trace_dir is not None))
@@ -513,6 +527,17 @@ class OpApp:
                        help="disable AOT-serialized executables: train "
                             "saves JIT-only bundles, load/serve recompiles "
                             "instead of installing shipped executables")
+        p.add_argument("--mesh", action="store_true",
+                       help="force the mesh-sharded CV sweep on regardless "
+                            "of the row-count heuristic")
+        p.add_argument("--no-mesh", action="store_true",
+                       help="disable mesh sharding (single-device sweep)")
+        p.add_argument("--mesh-model-width", type=int,
+                       help="width of the model axis carved out of the "
+                            "device mesh (grid candidates shard over it)")
+        p.add_argument("--mesh-chunk-bytes", type=int,
+                       help="host->device streaming chunk budget in bytes "
+                            "(peak host staging stays <= 2x this)")
         return p.parse_args(argv)
 
     def main(self, argv: Optional[List[str]] = None) -> OpWorkflowRunnerResult:
@@ -541,5 +566,11 @@ class OpApp:
             params.telemetry["traceDir"] = args.trace_dir
         if args.no_aot:
             params.aot["enabled"] = False
+        if args.mesh or args.no_mesh:
+            params.mesh["enabled"] = bool(args.mesh and not args.no_mesh)
+        if args.mesh_model_width is not None:
+            params.mesh["modelWidth"] = args.mesh_model_width
+        if args.mesh_chunk_bytes is not None:
+            params.mesh["chunkBytes"] = args.mesh_chunk_bytes
         runner = self.make_runner()
         return runner.run(args.run_type, params)

@@ -325,8 +325,15 @@ class ModelSelector(Estimator):
                 X = jax.device_put(
                     X if isinstance(X, jax.Array)
                     else jnp.asarray(X, jnp.float32), data_sharding(mesh, 2))
+                y = jax.device_put(jnp.asarray(y, jnp.float32),
+                                   data_sharding(mesh, 1))
                 W = jax.device_put(jnp.asarray(W),
                                    data_sharding(mesh, 2, row_axis=1))
+            if pad and not isinstance(X, SparseMatrix):
+                # tree families quantile-bin over the true rows only, same
+                # as the sweep's padded fit
+                from .models.trees import register_real_rows
+                register_real_rows(X, rows - pad)
             grids = [dict(result.best_params)] * lanes
             return cand.estimator.fit_arrays_grid(X, y, W, grids)[0][0]
         except Exception as e:  # noqa: BLE001 — reuse is an optimization only

@@ -403,6 +403,24 @@ def _default_registry() -> MetricsRegistry:
     reg.gauge("sparse.nnz_total", _sparse_stat("nnz_total"))
     reg.gauge("sparse.matrices", _sparse_stat("matrices"))
     reg.gauge("sparse.density", _sparse_stat("density"))
+
+    def _stream_stat(key):
+        def read():
+            # lazy import: telemetry must not pull jax at module import
+            from .parallel.streaming import streaming_stats
+            return streaming_stats()[key]
+        return read
+
+    # mesh streaming (ISSUE 10): mesh.devices / mesh.chunk_bytes are set by
+    # maybe_data_mesh / stream_to_device; peak staging + streamed pad rows
+    # read through the streamer's own stats.  host_to_device_bytes_total is
+    # a plain counter the streamer increments per chunk.
+    reg.gauge("mesh.devices")
+    reg.gauge("mesh.chunk_bytes")
+    reg.counter("host_to_device_bytes_total")
+    reg.gauge("mesh.peak_staging_bytes", _stream_stat("peak_staging_bytes"))
+    reg.gauge("mesh.stream_chunks", _stream_stat("chunks"))
+    reg.gauge("mesh.pad_rows_streamed", _stream_stat("pad_rows"))
     return reg
 
 

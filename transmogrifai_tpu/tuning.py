@@ -347,11 +347,20 @@ def _fit_padding_enabled() -> bool:
 
 _FOLD_MASK_FNS: Dict[int, Any] = {}
 
+# uint8 fold-assignment sentinels: 255 = "in no validation fold" (a TVS row
+# outside the held-out slice — it trains in every fold), 254 = "zero-weight
+# pad row" (mesh device-divisibility quantum / ladder rung — it belongs to
+# NO fold, training or validation)
+_NO_FOLD = 255
+_PAD_FOLD = 254
+
 
 def _fold_masks_from_assignment(assign, n_folds: int):
     """[N] uint8 validation-fold assignment → (train weights [F, N],
     validation masks [F, N]) built ON DEVICE: the host link carries one
-    byte per row instead of the materialized masks."""
+    byte per row instead of the materialized masks.  A sharded assignment
+    propagates its row sharding into the masks (axis 1), so the mesh path
+    never materializes [F, N] weights on the host."""
     import jax
     import jax.numpy as jnp
 
@@ -361,8 +370,8 @@ def _fold_masks_from_assignment(assign, n_folds: int):
         def fn(a):
             f = jnp.arange(n_folds, dtype=jnp.int32)[:, None]
             ai = a.astype(jnp.int32)[None, :]
-            return ((ai != f).astype(jnp.float32),
-                    (ai == f).astype(jnp.float32))
+            tr = ((ai != f) & (ai != _PAD_FOLD)).astype(jnp.float32)
+            return tr, (ai == f).astype(jnp.float32)
         _FOLD_MASK_FNS[n_folds] = fn
     return fn(assign)
 
@@ -477,10 +486,13 @@ class OpValidator:
             iters = nxt
         return np.asarray(out, dtype=np.int64)
 
-    def _maybe_mesh(self, n_rows: int):
-        """Shared data-axis mesh policy (parallel.mesh.maybe_data_mesh)."""
+    def _maybe_mesh(self, n_rows: int, pad: bool = False):
+        """Shared data-axis mesh policy (parallel.mesh.maybe_data_mesh).
+        ``pad=True`` lets the sweep take the mesh on non-divisible row counts
+        (the sweep appends zero-weight pad rows, which is exact for
+        ``weighted_pad_exact`` families)."""
         from .parallel.mesh import maybe_data_mesh
-        return maybe_data_mesh(n_rows)
+        return maybe_data_mesh(n_rows, pad=pad)
 
     def _record_grid_metrics_batched(self, cand, ci, fitted_grid, X, y_dev,
                                      va_masks_dev, record) -> bool:
@@ -734,16 +746,17 @@ class OpValidator:
         # floor covers its whole grid on the exact full-CV path — tiny grids
         # are bit-identical to an unraced sweep.
         racing_on, racing_eta, racing_min_surv = self._racing_config()
-        race_path_ok = (not in_fold_dag and len(splits) >= 2
-                        and self._maybe_mesh(len(y_all)) is None)
+        # racing runs on the mesh-sharded path too: round A/B fits are the
+        # same batched programs with a fold-sliced weight block, and GSPMD
+        # shards them identically — no single-device carve-out needed
+        race_path_ok = not in_fold_dag and len(splits) >= 2
         if racing_on and not race_path_ok:
             # the flag is on by default — say WHY this sweep runs unraced
             # instead of silently ignoring it (ISSUE 4 satellite)
             reason = ("in-fold DAG refits feature stages per fold"
                       if in_fold_dag else
                       "single train/validation split (racing needs >= 2 "
-                      "folds)" if len(splits) < 2 else
-                      "mesh-sharded fit path")
+                      "folds)")
             record_failure("validator", "degraded",
                            f"racing disabled: {reason}",
                            point="selector.racing",
@@ -944,48 +957,85 @@ class OpValidator:
             self.last_fit_shape = None
             self.last_mesh = None
         from .columns import to_device_f32
+        # zero-weight row padding (mesh divisibility quantum, ladder rungs)
+        # is exact only for families that declare it — one non-exact family
+        # in the grid keeps the whole shared matrix unpadded
+        pad_exact_all = all(getattr(c.estimator, "weighted_pad_exact", False)
+                            for c in candidates)
         for X, fsplits in fold_groups():
             is_sparse = isinstance(X, SparseMatrix)
-            if not isinstance(X, jax.Array) and not is_sparse:
-                # ONE host→device transfer shared by every candidate family —
-                # the host link is the scarce resource on tunneled TPUs
-                X = to_device_f32(X)
             N = X.shape[0]
             # sparse matrices stay single-device: the COO entry stream has no
             # row-sharding story, and jnp.asarray on one raises by design
-            mesh = None if is_sparse else self._maybe_mesh(N)
+            mesh = None if is_sparse else self._maybe_mesh(
+                N, pad=pad_exact_all)
             self.last_mesh = mesh
-            from .parallel import data_sharding
+            from .parallel import (data_axis_size, data_sharding,
+                                   pad_rows_for, stream_to_device)
+            N_fit = N
             if mesh is not None:
                 # multi-device: row-shard the matrix over the mesh 'data' axis
                 # and let GSPMD insert the collectives inside every batched
-                # fit/metric program (SURVEY §2.6 P1/P3 on the REAL path)
-                Xj = X if isinstance(X, jax.Array) else jnp.asarray(
-                    X, jnp.float32)
-                X = jax.device_put(Xj, data_sharding(mesh, 2))
+                # fit/metric program (SURVEY §2.6 P1/P3 on the REAL path).
+                # Row count pads up to the device-divisible quantum — and,
+                # with the compile cache on, up to the fit-shape ladder rung —
+                # with zero-weight rows; one padded matrix serves every
+                # family (all are weighted_pad_exact whenever N_fit > N).
+                extent = data_axis_size(mesh)
+                N_fit = N + pad_rows_for(N, mesh)
+                if _fit_padding_enabled() and pad_exact_all:
+                    rung = _fit_pad_rows(N)
+                    N_fit = max(N_fit, -(-rung // extent) * extent)
+                if N_fit > N and not pad_exact_all:
+                    N_fit = N   # divisible N, mixed families: no ladder pad
+                if isinstance(X, jax.Array):
+                    # already device-resident (upstream DAG output): pad on
+                    # device, then lay out over the mesh in one shot
+                    Xj = X if X.dtype == jnp.float32 else X.astype(
+                        jnp.float32)
+                    if N_fit > N:
+                        Xj = jnp.pad(Xj, ((0, N_fit - N), (0, 0)))
+                    X = jax.device_put(Xj, data_sharding(mesh, 2))
+                else:
+                    # chunked host→device streaming: assemble each device's
+                    # row shard from bounded host slices so peak staging is
+                    # O(TRANSMOGRIFAI_DEVICE_CHUNK_BYTES), not O(dataset) —
+                    # the one-shot device_put staged the whole matrix
+                    # (BENCH_11M_ATTEMPTS_r4 hard faults)
+                    X = stream_to_device(np.asarray(X, dtype=np.float32),
+                                         mesh, pad_to=N_fit)
+                if N_fit > N:
+                    # tree families quantile-bin over the true rows only —
+                    # keeps padded split points identical to unpadded ones
+                    from .models.trees import register_real_rows
+                    register_real_rows(X, N)
+            elif not isinstance(X, jax.Array) and not is_sparse:
+                # ONE host→device transfer shared by every candidate family —
+                # the host link is the scarce resource on tunneled TPUs
+                X = to_device_f32(X)
             is_dev = isinstance(X, jax.Array) or is_sparse
             y_dev = None
             if is_dev:
                 # exact wire (bf16 only when verified lossless), shared with
                 # every other consumer of the same label buffer
-                y_dev = (jax.device_put(jnp.asarray(y32),
-                                        data_sharding(mesh, 1))
+                y_dev = (stream_to_device(y32, mesh, pad_to=N_fit)
                          if mesh is not None else
                          to_device_f32(y32, exact=True))
             X_host = None if is_dev else X   # lazy d2h only if a fallback needs it
             va_slices = [va for _, va in fsplits]
             va_masks_dev = []
-            assign = np.full(N, 255, np.uint8)   # 255 = in no validation fold
+            assign = np.full(N_fit, _NO_FOLD, np.uint8)
+            if N_fit > N:
+                assign[N:] = _PAD_FOLD   # pad rows join NO fold, ever
             for f, (_, va_idx) in enumerate(fsplits):
                 assign[va_idx] = f
             # dense per-fold weight rows only materialize when a splitter
-            # may modify them (or the mesh/host path needs them below)
+            # may modify them (or the host path needs them below)
             W_rows = []
             neutral = splitter is None or (
                 type(splitter).validation_prepare_weights
                 is Splitter.validation_prepare_weights)
-            if not neutral or not (is_dev and mesh is None
-                                   and len(fsplits) < 255):
+            if not neutral or not (is_dev and len(fsplits) < _PAD_FOLD):
                 neutral = True
                 for f, (tr_idx, _) in enumerate(fsplits):
                     w = np.zeros(N, np.float32)
@@ -995,13 +1045,16 @@ class OpValidator:
                         neutral = neutral and w2 is w
                         w = w2
                     W_rows.append(w)
-            if (is_dev and mesh is None and neutral
-                    and len(fsplits) < 255):
+            if is_dev and neutral and len(fsplits) < _PAD_FOLD:
                 # fold masks from ONE [N] uint8 assignment shipped over the
                 # link — 1 byte/row instead of (folds+1)×4 bytes/row of
-                # train + validation masks
-                Wd, VAd = _fold_masks_from_assignment(
-                    jnp.asarray(assign), len(fsplits))
+                # train + validation masks.  On the mesh the assignment is
+                # row-sharded first so the [F, N] masks materialize directly
+                # with the fit programs' expected sharding.
+                aj = jnp.asarray(assign)
+                if mesh is not None:
+                    aj = jax.device_put(aj, data_sharding(mesh, 1))
+                Wd, VAd = _fold_masks_from_assignment(aj, len(fsplits))
                 W = Wd
                 va_masks_dev = [VAd[f] for f in range(len(fsplits))]
             else:
@@ -1010,13 +1063,14 @@ class OpValidator:
                     for va_idx in va_slices:
                         vm = np.zeros(N, np.float32)
                         vm[va_idx] = 1.0
-                        vmj = to_device_f32(vm)   # 0/1 mask: bf16 wire exact
                         if mesh is not None:
-                            vmj = jax.device_put(vmj, data_sharding(mesh, 1))
+                            # pad tail streams in as zeros — never validated
+                            vmj = stream_to_device(vm, mesh, pad_to=N_fit)
+                        else:
+                            vmj = to_device_f32(vm)  # 0/1 mask: bf16 exact
                         va_masks_dev.append(vmj)
                 if mesh is not None:
-                    W = jax.device_put(jnp.asarray(W),
-                                       data_sharding(mesh, 2, row_axis=1))
+                    W = stream_to_device(W, mesh, row_axis=1, pad_to=N_fit)
                 else:
                     # one shared transfer; family fits see a no-op conversion.
                     # exact=True: bf16 wire only when verified lossless (0/1
@@ -1026,7 +1080,9 @@ class OpValidator:
             # fit-shape canonicalization (ISSUE 4 compile reuse): one shared
             # zero-weight-row-padded copy of (X, y) serves every pad-exact
             # family, so nearby row counts land on the same ladder rung and
-            # hit the persistent compile cache
+            # hit the persistent compile cache.  The mesh path already folded
+            # its ladder rung into N_fit during streaming, so this separate
+            # padded copy exists only off-mesh.
             pad_rows = 0
             X_pad = y_pad = None
             if (_fit_padding_enabled() and mesh is None
@@ -1063,7 +1119,7 @@ class OpValidator:
             # new_compiles_during_train collapses into overlapped wall time.
             # Compile-only — sweep winners are bitwise unaffected.
             from .aot import pretrace_enabled, pretrace_submit
-            if pretrace_enabled() and mesh is None:
+            if pretrace_enabled():
                 for ci, cand in enumerate(candidates):
                     if (ci in replayed or not getattr(
                             cand.estimator, "supports_pretrace", False)):
@@ -1114,7 +1170,8 @@ class OpValidator:
                     self.family_fit_meta[cand.model_name] = {
                         "folds": len(out), "rows": int(Xf.shape[0]),
                         "real_rows": int(N), "lanes": len(grid),
-                        "padded": use_pad}
+                        # ladder copy OR mesh-streamed quantum/rung padding
+                        "padded": int(Xf.shape[0]) > int(N)}
                     return out
                 except Exception as e:  # noqa: BLE001
                     # batched fit failed as a block — retry per point so one
@@ -1139,8 +1196,12 @@ class OpValidator:
                                     est = copy.deepcopy(cand.estimator)
                                     for k, v in params.items():
                                         est.set(k, v)
+                                    # mesh path: X carries streamed pad rows,
+                                    # so pair it with the matching padded
+                                    # sharded label/weight vectors
+                                    yfb = y_dev if mesh is not None else y32
                                     row.append(est.fit_arrays(
-                                        X, y32, sample_weight=Wblk[f]))
+                                        X, yfb, sample_weight=Wblk[f]))
                                 except Exception as e2:  # noqa: BLE001
                                     record_failure(
                                         cand.model_name, "skipped", e2,
@@ -1228,9 +1289,8 @@ class OpValidator:
                 ``rec`` lets racing remap a survivor sub-grid's local
                 indices back to the family's full grid."""
                 masks = va_masks_dev[fold_offset:fold_offset + n_folds]
-                if (is_dev and mesh is None
-                        and self._record_grid_metrics_batched(
-                            cand, ci, fitted_grid, X, y_dev, masks, rec)):
+                if (is_dev and self._record_grid_metrics_batched(
+                        cand, ci, fitted_grid, X, y_dev, masks, rec)):
                     return
                 for f_local in range(n_folds):
                     f = fold_offset + f_local

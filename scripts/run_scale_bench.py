@@ -50,23 +50,22 @@ def _run_bench(n, extra_env, timeout_s=3600):
            # silent in-bench subprocess retry would report a crashed "warm"
            # run as rc=0 measured cold
            "BENCH_NO_RETRY": "1", **extra_env}
-    t0 = time.time()
-    try:
-        p = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
-                           capture_output=True, text=True, env=env, cwd=ROOT,
-                           timeout=timeout_s)
-        rc, stdout, stderr = p.returncode, p.stdout, p.stderr
-    except subprocess.TimeoutExpired as e:
-        rc = 124
-        stdout = (e.stdout or b"").decode() if isinstance(
-            e.stdout, bytes) else (e.stdout or "")
-        stderr = "timeout"
-    rec = {"rc": rc, "proc_wall_s": round(time.time() - t0, 1)}
-    line = last_json_line(stdout)
+    # supervised child: SIGTERM→SIGKILL escalation reclaims a bench whose
+    # native init hung (plain subprocess timeout leaves the hang alive —
+    # the OUTAGE_r5 / BENCH_11M_ATTEMPTS_r4 failure mode); rc=124 keeps
+    # the ladder's historical timeout convention
+    from transmogrifai_tpu.parallel.supervisor import run_supervised
+    r = run_supervised([sys.executable, os.path.join(ROOT, "bench.py")],
+                       timeout_s=timeout_s, grace_s=30.0, env=env, cwd=ROOT)
+    rec = {"rc": r.rc, "proc_wall_s": round(r.wall_s, 1)}
+    if r.escalated:
+        rec["escalated_sigkill"] = True
+    line = last_json_line(r.stdout)
     if line:
         rec["result"] = json.loads(line)
-    if rc != 0:
-        rec["stderr_tail"] = (stderr or "")[-2000:]
+    if r.rc != 0:
+        rec["stderr_tail"] = ("timeout" if r.timed_out
+                              else (r.stderr or ""))[-2000:]
     return rec
 
 

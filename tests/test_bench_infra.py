@@ -21,20 +21,22 @@ def _load(name, path):
 def test_probe_platform_detects_hang(monkeypatch):
     bench = _load("bench_probe_test", os.path.join(ROOT, "bench.py"))
     # a probe subprocess that sleeps forever must be classified as a hang
-    # within the configured timeout, once per backoff entry
+    # within the configured timeout, once per backoff entry.  The probe is
+    # the supervisor's now (subprocess-isolated, SIGTERM->SIGKILL); faking
+    # the child at the Popen seam exercises the real escalation path.
     monkeypatch.setenv("BENCH_PROBE_TIMEOUT_S", "1")
     monkeypatch.setenv("BENCH_PROBE_BACKOFFS", "0,0")
     real_executable = sys.executable
     import subprocess
 
-    orig_run = subprocess.run
+    orig_popen = subprocess.Popen
 
-    def fake_run(cmd, **kw):
+    def fake_popen(cmd, **kw):
         assert cmd[0] == real_executable
-        return orig_run([real_executable, "-c", "import time; time.sleep(30)"],
-                        **kw)
+        return orig_popen(
+            [real_executable, "-c", "import time; time.sleep(30)"], **kw)
 
-    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(subprocess, "Popen", fake_popen)
     platform, info = bench._probe_platform()
     assert platform is None
     assert [a["result"] for a in info["attempts"]] == ["hang", "hang"]
@@ -45,12 +47,14 @@ def test_probe_platform_success(monkeypatch):
     monkeypatch.setenv("BENCH_PROBE_TIMEOUT_S", "30")
     monkeypatch.setenv("BENCH_PROBE_BACKOFFS", "0")
     import subprocess
-    orig_run = subprocess.run
+    orig_popen = subprocess.Popen
+    verdict_line = ('import json; print(json.dumps({"platform": "tpu", '
+                    '"devices": ["TPU_0"], "matmul_finite": True}))')
 
-    def fake_run(cmd, **kw):
-        return orig_run([sys.executable, "-c", "print('tpu')"], **kw)
+    def fake_popen(cmd, **kw):
+        return orig_popen([sys.executable, "-c", verdict_line], **kw)
 
-    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(subprocess, "Popen", fake_popen)
     platform, info = bench._probe_platform()
     assert platform == "tpu"
     assert info["attempts"][0]["result"] == "tpu"

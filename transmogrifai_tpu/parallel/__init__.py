@@ -18,6 +18,12 @@ from .dist_fit import (fit_logreg_grid_sharded, sharded_col_stats,
 from .multihost import init_distributed, is_multihost
 from .streaming import (device_chunk_bytes, stream_to_device,
                         streaming_stats)
+from .supervisor import (DeviceLostError, Heartbeat, ProbeVerdict,
+                         SupervisedResult, TransferStallError,
+                         effective_device_count, is_device_loss,
+                         mark_device_loss, probe_devices, probe_with_backoff,
+                         reset_surviving_devices, run_supervised,
+                         supervisor_enabled, write_outage_record)
 
 __all__ = [
     "make_mesh", "maybe_data_mesh", "data_sharding", "candidate_sharding",
@@ -27,4 +33,9 @@ __all__ = [
     "sharded_gbt_round", "sharded_train_step", "init_distributed",
     "is_multihost",
     "stream_to_device", "streaming_stats", "device_chunk_bytes",
+    "DeviceLostError", "Heartbeat", "ProbeVerdict", "SupervisedResult",
+    "TransferStallError", "effective_device_count", "is_device_loss",
+    "mark_device_loss", "probe_devices", "probe_with_backoff",
+    "reset_surviving_devices", "run_supervised", "supervisor_enabled",
+    "write_outage_record",
 ]

@@ -72,8 +72,15 @@ def maybe_data_mesh(n_rows: int, pad: bool = False) -> Optional[Mesh]:
     ``pad=True`` (the validator sweep, which pads with zero-weight rows)
     returns the mesh anyway and records a ``mesh.pad_rows`` telemetry event so
     the padding is visible in traces instead of silently degrading to one
-    device."""
+    device.
+
+    After a mid-run device loss the supervisor caps the usable device count
+    (``supervisor.mark_device_loss``), so every mesh built here — including
+    the sweep-recovery rebuild — spans only the surviving devices.  Explicit
+    ``make_mesh(n)`` calls stay unclamped."""
     n_dev = len(jax.devices())
+    from .supervisor import effective_device_count
+    n_dev = effective_device_count(n_dev)
     flag = os.environ.get("TRANSMOGRIFAI_TPU_MESH")
     if flag == "0" or n_dev < 2:
         return None
@@ -81,6 +88,11 @@ def maybe_data_mesh(n_rows: int, pad: bool = False) -> Optional[Mesh]:
     if flag != "1" and n_rows < min_rows:
         return None
     model = model_axis_width()
+    if n_dev % model:
+        # surviving-device count may not divide the requested model width
+        # (8 devices / width 2 → 7 survivors): collapse the model axis
+        # rather than refuse to build the recovery mesh
+        model = 1
     data_extent = n_dev // model
     rem = n_rows % data_extent
     if rem:
@@ -93,7 +105,7 @@ def maybe_data_mesh(n_rows: int, pad: bool = False) -> Optional[Mesh]:
     # callers/tests that instrument `parallel.make_mesh` see every mesh
     # construction
     from transmogrifai_tpu import parallel as _pkg
-    mesh = _pkg.make_mesh(model_parallel=model)
+    mesh = _pkg.make_mesh(n_dev, model_parallel=model)
     from ..telemetry import REGISTRY
     REGISTRY.gauge("mesh.devices").set(n_dev)
     return mesh

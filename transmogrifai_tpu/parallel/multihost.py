@@ -55,20 +55,40 @@ def init_distributed(coordinator_address: Optional[str] = None,
     hang must surface as ``WatchdogTimeout`` — raised for an explicit
     coordinator request, recorded in the failure log and degraded to
     single-host for auto-detection.
+
+    .. note:: the watchdog can only *abandon* a hung native init thread, it
+       cannot reclaim it (the thread leaks; ``watchdog.abandoned_total``
+       counts them).  Callers that need the hang actually killed must
+       pre-flight with the subprocess-isolated
+       ``parallel.supervisor.probe_devices`` — a child process under
+       SIGTERM→SIGKILL escalation is the only reclaim that works.
+
+    Emits a ``multihost.init`` telemetry span around the attempt and sets
+    the ``multihost.process_count`` / ``multihost.initialized`` gauges, so
+    a degraded-to-single-host run is visible on dashboards and not just in
+    the failure log.
     """
+    from ..telemetry import REGISTRY, span
     already = getattr(jax.distributed, "is_initialized", None)
     if already is not None and already():
+        REGISTRY.gauge("multihost.initialized").set(1)
+        REGISTRY.gauge("multihost.process_count").set(jax.process_count())
         return jax.process_count() > 1
     if coordinator_address is None and not _cluster_env_present():
         return False
     try:
-        maybe_inject("multihost.init", key=coordinator_address or "auto")
-        run_with_deadline(
-            jax.distributed.initialize, timeout_s,
-            coordinator_address=coordinator_address,
-            num_processes=num_processes, process_id=process_id,
-            description="jax.distributed.initialize")
+        with span("multihost.init",
+                  coordinator=coordinator_address or "auto",
+                  requested_processes=int(num_processes or 0),
+                  timeout_s=float(timeout_s or 0)):
+            maybe_inject("multihost.init", key=coordinator_address or "auto")
+            run_with_deadline(
+                jax.distributed.initialize, timeout_s,
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id,
+                description="jax.distributed.initialize")
     except Exception as e:  # noqa: BLE001
+        REGISTRY.gauge("multihost.initialized").set(0)
         if coordinator_address is not None:
             # an EXPLICIT multi-host request that fails must not silently
             # degrade to single-host (every host would train divergently)
@@ -77,7 +97,10 @@ def init_distributed(coordinator_address: Optional[str] = None,
         # observably — exactly the demotion the round-5 probes did by hand
         record_failure("multihost.init_distributed", "degraded", e,
                        point="multihost.init", fallback="single-host")
+        REGISTRY.gauge("multihost.process_count").set(1)
         return False
+    REGISTRY.gauge("multihost.initialized").set(1)
+    REGISTRY.gauge("multihost.process_count").set(jax.process_count())
     return jax.process_count() > 1
 
 

@@ -36,6 +36,29 @@ from .mesh import data_sharding
 
 _DEFAULT_CHUNK_BYTES = 256 * 1024 * 1024
 
+
+def _put_chunk(buf, dev, seq: int):
+    """One supervised chunk transfer.  A hung host→device link (the
+    OUTAGE_r5 failure family) surfaces as a typed ``TransferStallError``
+    within the TRANSMOGRIFAI_CHUNK_DEADLINE_S budget instead of blocking
+    the stream forever; ``supervisor.chunk_stall`` is the chaos-injection
+    point, keyed by a monotone per-process chunk sequence so a sticky
+    fail_keys entry stalls one specific chunk and the sweep-recovery
+    re-stream proceeds cleanly."""
+    from ..resilience import (InjectedFault, WatchdogTimeout, maybe_inject,
+                              run_with_deadline)
+    from .supervisor import TransferStallError, chunk_deadline_s
+    deadline = chunk_deadline_s()
+    try:
+        maybe_inject("supervisor.chunk_stall", key=seq)
+        if deadline is None:
+            return jax.device_put(buf, dev)
+        return run_with_deadline(jax.device_put, deadline, buf, dev,
+                                 description="mesh.stream_chunk")
+    except (InjectedFault, WatchdogTimeout) as e:
+        raise TransferStallError(
+            f"host->device chunk {seq} to {dev} stalled: {e}") from e
+
 _lock = threading.Lock()
 _STATS = {
     "chunks": 0,
@@ -146,9 +169,16 @@ def stream_to_device(arr,
                 buf = np.ascontiguousarray(view, dtype=np_dtype)
                 nbytes = buf.nbytes
                 _stage(nbytes)
+                from .supervisor import next_chunk_key
+                seq = next_chunk_key()
                 with span("mesh.stream_chunk", device=str(dev),
-                          rows=int(end - pos), bytes=int(nbytes)):
-                    piece = jax.device_put(buf, dev)
+                          rows=int(end - pos), bytes=int(nbytes),
+                          seq=int(seq)):
+                    try:
+                        piece = _put_chunk(buf, dev, seq)
+                    except BaseException:
+                        _unstage(nbytes)
+                        raise
                 # double buffering: keep this chunk's host buffer alive while
                 # its transfer is in flight, but before slicing a third chunk
                 # retire the oldest one — at most two staging buffers exist.

@@ -60,6 +60,14 @@ class OpParams:
     # chunkBytes (TRANSMOGRIFAI_DEVICE_CHUNK_BYTES), minRows
     # (TRANSMOGRIFAI_TPU_MESH_MIN_ROWS)
     mesh: Dict[str, Any] = field(default_factory=dict)
+    # device-runtime supervisor knobs (parallel/supervisor.py env
+    # equivalents): enabled (TRANSMOGRIFAI_SUPERVISOR; --no-supervisor),
+    # probeTimeoutS (TRANSMOGRIFAI_PROBE_TIMEOUT_S), probeBackoffs
+    # (TRANSMOGRIFAI_PROBE_BACKOFFS), chunkDeadlineS
+    # (TRANSMOGRIFAI_CHUNK_DEADLINE_S), sweepRecoveries
+    # (TRANSMOGRIFAI_SWEEP_RECOVERIES), outageDir
+    # (TRANSMOGRIFAI_OUTAGE_DIR), heartbeatS (TRANSMOGRIFAI_HEARTBEAT_S)
+    supervisor: Dict[str, Any] = field(default_factory=dict)
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "OpParams":
@@ -83,7 +91,8 @@ class OpParams:
             telemetry=d.get("telemetryParams") or {},
             lifecycle=d.get("lifecycleParams") or {},
             aot=d.get("aotParams") or {},
-            mesh=d.get("meshParams") or {})
+            mesh=d.get("meshParams") or {},
+            supervisor=d.get("supervisorParams") or {})
 
     @staticmethod
     def load(path: str) -> "OpParams":
@@ -110,6 +119,7 @@ class OpParams:
             "lifecycleParams": self.lifecycle,
             "aotParams": self.aot,
             "meshParams": self.mesh,
+            "supervisorParams": self.supervisor,
         }
 
     def apply_stage_params(self, stages) -> None:

@@ -125,7 +125,9 @@ class FailureLog:
                "shed",         # admission control rejected work up front
                "breaker_open",       # circuit breaker tripped: calls skipped
                "breaker_half_open",  # breaker probing for recovery
-               "breaker_closed")     # breaker recovered: calls flow again
+               "breaker_closed",     # breaker recovered: calls flow again
+               "outage",       # device runtime declared down (supervisor)
+               "recovered")    # device runtime back after outage/degrade
 
     def __init__(self):
         self._events: List[FailureEvent] = []
@@ -313,6 +315,22 @@ def run_with_deadline(fn: Callable[..., Any], timeout_s: Optional[float],
             if "value" not in box and "error" not in box:
                 abandoned = True
         if abandoned:
+            # zombie-thread accumulation is an OUTAGE_r5 symptom: make every
+            # abandonment observable (counter + failure-log note) instead of
+            # silent.  Only the subprocess supervisor can actually RECLAIM a
+            # native hang — this records that we could not.
+            try:
+                from .telemetry import REGISTRY
+                REGISTRY.counter("watchdog.abandoned_total").inc()
+            except Exception:  # noqa: BLE001 — never mask the timeout
+                pass
+            try:
+                log.record("watchdog", "degraded",
+                           f"{label} worker thread abandoned after "
+                           f"{timeout_s:g}s (native hang; thread leaked)",
+                           point="watchdog.abandoned", description=label)
+            except Exception:  # noqa: BLE001
+                pass
             raise WatchdogTimeout(
                 f"{label} exceeded its "
                 f"{timeout_s:g}s deadline; worker thread abandoned (native "
@@ -781,4 +799,10 @@ INJECTION_POINTS = {
     "lifecycle.retrain": "starting a policy-triggered lifecycle retrain",
     "lifecycle.promote": "committing a lifecycle promotion decision (after "
                          "the holdout gate, before the bundle write)",
+    "supervisor.probe": "one subprocess-isolated device availability probe",
+    "supervisor.heartbeat": "one heartbeat supervision tick",
+    "supervisor.chunk_stall": "one host->device streaming chunk transfer "
+                              "(fires as a stalled/hung link)",
+    "supervisor.device_loss": "a device dropping out of the active mesh "
+                              "mid-sweep (fit or scoring)",
 }

@@ -121,14 +121,55 @@ class OpWorkflowRunner:
         if mp.get("minRows") is not None:
             os.environ["TRANSMOGRIFAI_TPU_MESH_MIN_ROWS"] = \
                 str(mp["minRows"])
+        # supervisorParams: same pattern — the supervisor reads the process
+        # env per call, so run-scoped knobs ride the env knobs
+        sup = params.supervisor or {}
+        if sup.get("enabled") is not None:
+            os.environ["TRANSMOGRIFAI_SUPERVISOR"] = \
+                "1" if sup["enabled"] else "0"
+        if sup.get("probeTimeoutS") is not None:
+            os.environ["TRANSMOGRIFAI_PROBE_TIMEOUT_S"] = \
+                str(sup["probeTimeoutS"])
+        if sup.get("probeBackoffs") is not None:
+            b = sup["probeBackoffs"]
+            os.environ["TRANSMOGRIFAI_PROBE_BACKOFFS"] = \
+                ",".join(str(x) for x in b) \
+                if isinstance(b, (list, tuple)) else str(b)
+        if sup.get("chunkDeadlineS") is not None:
+            os.environ["TRANSMOGRIFAI_CHUNK_DEADLINE_S"] = \
+                str(sup["chunkDeadlineS"])
+        if sup.get("sweepRecoveries") is not None:
+            os.environ["TRANSMOGRIFAI_SWEEP_RECOVERIES"] = \
+                str(sup["sweepRecoveries"])
+        if sup.get("outageDir") is not None:
+            os.environ["TRANSMOGRIFAI_OUTAGE_DIR"] = str(sup["outageDir"])
+        if sup.get("heartbeatS") is not None:
+            os.environ["TRANSMOGRIFAI_HEARTBEAT_S"] = str(sup["heartbeatS"])
         tele = params.telemetry or {}
         trace_dir = tele.get("traceDir")
         enabled = bool(tele.get("enabled", trace_dir is not None))
         tracer = Tracer(run_name=f"run:{run_type}") if enabled else None
         ctx = use_tracer(tracer) if tracer is not None \
             else contextlib.nullcontext()
-        with ctx:
-            result = self._run_dispatch(run_type, params)
+        # opt-in heartbeat supervision for the whole run: background
+        # re-probes feed the device-runtime breaker + AVAILABLE/DEGRADED/
+        # OUTAGE gauges while the run is in flight
+        hb = None
+        try:
+            hb_interval = float(os.environ.get("TRANSMOGRIFAI_HEARTBEAT_S",
+                                               "0"))
+        except ValueError:
+            hb_interval = 0.0
+        if hb_interval > 0:
+            from .parallel.supervisor import Heartbeat, supervisor_enabled
+            if supervisor_enabled():
+                hb = Heartbeat(interval_s=hb_interval).start()
+        try:
+            with ctx:
+                result = self._run_dispatch(run_type, params)
+        finally:
+            if hb is not None:
+                hb.stop()
         if tracer is not None:
             result.tracer = tracer
             if trace_dir:
@@ -538,6 +579,10 @@ class OpApp:
         p.add_argument("--mesh-chunk-bytes", type=int,
                        help="host->device streaming chunk budget in bytes "
                             "(peak host staging stays <= 2x this)")
+        p.add_argument("--no-supervisor", action="store_true",
+                       help="disable device-runtime supervision: no "
+                            "degrade-to-surviving-mesh sweep recovery, no "
+                            "heartbeat; device errors propagate unchanged")
         return p.parse_args(argv)
 
     def main(self, argv: Optional[List[str]] = None) -> OpWorkflowRunnerResult:
@@ -572,5 +617,7 @@ class OpApp:
             params.mesh["modelWidth"] = args.mesh_model_width
         if args.mesh_chunk_bytes is not None:
             params.mesh["chunkBytes"] = args.mesh_chunk_bytes
+        if args.no_supervisor:
+            params.supervisor["enabled"] = False
         runner = self.make_runner()
         return runner.run(args.run_type, params)

@@ -421,6 +421,29 @@ def _default_registry() -> MetricsRegistry:
     reg.gauge("mesh.peak_staging_bytes", _stream_stat("peak_staging_bytes"))
     reg.gauge("mesh.stream_chunks", _stream_stat("chunks"))
     reg.gauge("mesh.pad_rows_streamed", _stream_stat("pad_rows"))
+
+    # device-runtime supervision (ISSUE 11): the heartbeat sets
+    # supervisor.state (0 available / 1 degraded / 2 outage) and bumps the
+    # outage/probe counters; watchdog.abandoned_total counts zombie worker
+    # threads run_with_deadline left behind (the failure mode only the
+    # subprocess supervisor can actually reclaim); multihost gauges are set
+    # by init_distributed.
+    reg.gauge("supervisor.state")
+    reg.gauge("supervisor.last_probe_latency_s")
+    reg.counter("supervisor.probes_total")
+    reg.counter("supervisor.outages_total")
+    reg.counter("supervisor.mesh_degrades_total")
+    reg.counter("watchdog.abandoned_total")
+    reg.gauge("multihost.process_count")
+    reg.gauge("multihost.initialized")
+
+    def _device_cap():
+        # lazy import: telemetry must not pull jax at module import
+        from .parallel.supervisor import device_cap
+        c = device_cap()
+        return -1 if c is None else c
+
+    reg.gauge("supervisor.device_cap", _device_cap)
     return reg
 
 

@@ -707,13 +707,37 @@ class OpValidator:
                  label: str, features: str,
                  in_fold_dag: Optional[List[List[Any]]] = None,
                  splitter: Optional[Splitter] = None) -> ValidationResult:
+        """Run the sweep with degrade-to-surviving-mesh recovery: a mid-sweep
+        device loss (typed ``DeviceLostError``/``TransferStallError`` or a
+        runtime UNAVAILABLE/DEVICE_LOST) shrinks the supervisor's
+        surviving-device cap, rebuilds the mesh policy over the survivors
+        (``maybe_data_mesh`` consults the cap, re-padding to the new device
+        quantum), and re-enters the sweep — which resumes from the
+        ``SweepCheckpoint`` candidate boundary, replaying already-scored
+        families instead of refitting them.  Bounded by
+        TRANSMOGRIFAI_SWEEP_RECOVERIES (0 with ``--no-supervisor``: the
+        error propagates unchanged)."""
+        from .parallel import supervisor as _supervisor
         from .telemetry import span
-        with span("selector.sweep", candidates=len(candidates),
-                  validation_type=self.validation_type,
-                  grid_points=sum(len(c.grid) for c in candidates)):
-            return self._validate_impl(candidates, batch, label, features,
-                                       in_fold_dag=in_fold_dag,
-                                       splitter=splitter)
+        attempt = 0
+        while True:
+            self._sweep_attempt = attempt
+            try:
+                with span("selector.sweep", candidates=len(candidates),
+                          validation_type=self.validation_type,
+                          grid_points=sum(len(c.grid) for c in candidates),
+                          attempt=attempt):
+                    return self._validate_impl(candidates, batch, label,
+                                               features,
+                                               in_fold_dag=in_fold_dag,
+                                               splitter=splitter)
+            except Exception as e:  # noqa: BLE001 — classify, maybe recover
+                if (attempt >= _supervisor.max_sweep_recoveries()
+                        or not _supervisor.is_device_loss(e)):
+                    raise
+                _supervisor.note_sweep_device_loss(e, attempt=attempt,
+                                                   stage="validator")
+                attempt += 1
 
     def _validate_impl(self, candidates: Sequence[ModelCandidate],
                        batch: ColumnBatch, label: str, features: str,
@@ -852,6 +876,9 @@ class OpValidator:
                 pred = model.predict_arrays(X_va)
                 return self.evaluator.evaluate(y_va, pred)
             except Exception as e:  # noqa: BLE001 — candidate robustness
+                from .parallel.supervisor import is_device_loss
+                if is_device_loss(e):
+                    raise   # sweep-level recovery, not a NaN score
                 record_failure(cand.model_name, "skipped", e,
                                point="selector.candidate_metric",
                                params=dict(params))
@@ -1166,6 +1193,13 @@ class OpValidator:
                 Wf = _pad_weight_cols(Wblk) if use_pad else Wblk
                 try:
                     maybe_inject("selector.candidate_fit", key=cand.model_name)
+                    # chaos seam for mid-sweep device loss during a fit; the
+                    # key carries the sweep attempt so the post-recovery
+                    # retry is not re-killed by a sticky injector decision
+                    maybe_inject(
+                        "supervisor.device_loss",
+                        key=f"{cand.model_name}:fit:"
+                            f"a{getattr(self, '_sweep_attempt', 0)}")
                     out = cand.estimator.fit_arrays_grid(Xf, yf, Wf, grid)
                     self.family_fit_meta[cand.model_name] = {
                         "folds": len(out), "rows": int(Xf.shape[0]),
@@ -1174,6 +1208,12 @@ class OpValidator:
                         "padded": int(Xf.shape[0]) > int(N)}
                     return out
                 except Exception as e:  # noqa: BLE001
+                    # a lost device is NOT a bad candidate: per-point refits
+                    # on a dead mesh would fail K×|grid| more times — let the
+                    # sweep-level recovery rebuild the surviving mesh instead
+                    from .parallel.supervisor import is_device_loss
+                    if is_device_loss(e):
+                        raise
                     # batched fit failed as a block — retry per point so one
                     # bad candidate can't take down the family (≙ Try-wrapped
                     # fits in OpValidator.getSummary).  Per-point refits run
@@ -1203,6 +1243,8 @@ class OpValidator:
                                     row.append(est.fit_arrays(
                                         X, yfb, sample_weight=Wblk[f]))
                                 except Exception as e2:  # noqa: BLE001
+                                    if is_device_loss(e2):
+                                        raise
                                     record_failure(
                                         cand.model_name, "skipped", e2,
                                         point="selector.candidate_fit",
@@ -1288,6 +1330,13 @@ class OpValidator:
                 path first, device/host per-candidate fallback otherwise.
                 ``rec`` lets racing remap a survivor sub-grid's local
                 indices back to the family's full grid."""
+                # chaos seam: a device lost between fitting and scoring —
+                # fires AFTER earlier families checkpointed, so the recovery
+                # sweep demonstrably replays them from the SweepCheckpoint
+                maybe_inject(
+                    "supervisor.device_loss",
+                    key=f"{cand.model_name}:score:"
+                        f"a{getattr(self, '_sweep_attempt', 0)}")
                 masks = va_masks_dev[fold_offset:fold_offset + n_folds]
                 if (is_dev and self._record_grid_metrics_batched(
                         cand, ci, fitted_grid, X, y_dev, masks, rec)):

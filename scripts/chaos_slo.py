@@ -21,6 +21,12 @@ Usage:
     python scripts/chaos_slo.py --out-dir /tmp/chaos_slo \
         [--clients 32] [--requests 20] [--batch-fault-rate 0.08] \
         [--reload-fault-rate 0.25] [--seed 0]
+
+``--mode pool`` runs the multi-worker fault instead (ISSUE 12): boot an
+SO_REUSEPORT pool, SIGKILL one worker mid-storm, and require zero 5xx
+from the survivors, a supervisor restart, and parseable aggregated
+metrics (artifacts: ``outcomes-pool.jsonl``, ``metrics-pool.txt``,
+``summary-pool.json``).
 """
 
 import argparse
@@ -292,6 +298,181 @@ def run_chaos_slo(*, clients=32, requests_per_client=20,
     return summary
 
 
+def run_pool_chaos_slo(*, workers=2, clients=16, requests_per_client=25,
+                       seed=0, request_deadline_s=15.0, out_dir=None,
+                       model_root=None):
+    """Kill-one-worker chaos for the SO_REUSEPORT pool (ISSUE 12).
+
+    Boots a real multi-process pool, drives a closed-loop client storm on
+    the shared port, SIGKILLs one worker mid-storm, and asserts:
+
+    * surviving workers emit ZERO 5xx — every outcome is 2xx, 429, 503 or
+      a connection reset (only requests in flight on the killed worker's
+      socket may reset; the kernel stops routing new connects to a closed
+      listener);
+    * the supervisor restarts the killed worker and the pool ends at full
+      strength;
+    * the parent's aggregated ``/metrics`` stays parseable throughout.
+    """
+    import signal as _signal
+    import tempfile
+
+    from transmogrifai_tpu.checkpoint import next_version_dir
+    from transmogrifai_tpu.serving import wire
+    from transmogrifai_tpu.serving.pool import ServingPool
+
+    if model_root is None:
+        model_root = tempfile.mkdtemp(prefix="chaos-pool-")
+    model = _train_model(seed)
+    model.save(next_version_dir(model_root))
+
+    pool = ServingPool(model_root, workers=workers, max_batch=8,
+                       queue_bound=max(64, clients * 4),
+                       request_deadline_s=request_deadline_s,
+                       health_poll_s=0.2)
+    outcomes = []
+    outcomes_lock = threading.Lock()
+    try:
+        pool.start()
+        port = pool.port
+        victim = pool.slots[0]
+        victim_pid = victim.ready["pid"]
+        kill_at = threading.Event()
+
+        # alternate JSON and columnar bodies: the fault must not care
+        # which wire format the in-flight request used
+        col_body = wire.encode_records([{"x": 0.2}, {"x": 1.4}])
+
+        def client(cid):
+            for i in range(requests_per_client):
+                t0 = time.perf_counter()
+                err = ""
+                try:
+                    if (cid + i) % 2:
+                        status, _ = _post(
+                            port, {"x": float((cid * 37 + i) % 11) / 5},
+                            timeout=request_deadline_s + 15.0)
+                    else:
+                        req = urllib.request.Request(
+                            f"http://127.0.0.1:{port}/v1/score",
+                            data=col_body,
+                            headers={"Content-Type": wire.CONTENT_TYPE})
+                        with urllib.request.urlopen(
+                                req,
+                                timeout=request_deadline_s + 15.0) as r:
+                            status = r.status
+                            r.read()
+                except urllib.error.HTTPError as e:
+                    status = e.code
+                    e.read()
+                except Exception as e:  # noqa: BLE001 — a reset from the
+                    #     killed worker's socket is an ALLOWED outcome; a
+                    #     timeout is not (it would be a hang)
+                    status = -1
+                    err = f"{type(e).__name__}: {e}"
+                dt = time.perf_counter() - t0
+                if status == -1:
+                    klass = ("hang" if "timed out" in err.lower()
+                             else "reset")
+                else:
+                    klass = _classify(status)
+                row = {"client": cid, "i": i, "status": status,
+                       "latencyS": round(dt, 4), "class": klass}
+                if err:
+                    row["error"] = err
+                with outcomes_lock:
+                    outcomes.append(row)
+                if cid == 0 and i == max(2, requests_per_client // 5):
+                    kill_at.set()
+
+        threads = [threading.Thread(target=client, args=(c,), daemon=True)
+                   for c in range(clients)]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        # mid-storm: SIGKILL one worker outright (no drain, no warning)
+        kill_at.wait(timeout=60.0)
+        os.kill(victim_pid, _signal.SIGKILL)
+        killed_s = time.perf_counter() - t_start
+        for t in threads:
+            t.join(timeout=request_deadline_s + 60.0)
+        hung_threads = sum(1 for t in threads if t.is_alive())
+        storm_s = time.perf_counter() - t_start
+
+        # the supervisor must bring the victim back at a NEW pid
+        restart_deadline = time.monotonic() + 60.0
+        while time.monotonic() < restart_deadline:
+            status = pool.status()
+            ready = victim.ready
+            if (status["restartsTotal"] >= 1
+                    and status["alive"] == workers
+                    and ready and ready.get("pid")
+                    and ready["pid"] != victim_pid):
+                break
+            time.sleep(0.2)
+        status = pool.status()
+        new_pid = (victim.ready or {}).get("pid")
+        merged = pool.metrics()
+        metrics_parseable = (
+            "transmogrifai_serving_pool_workers_alive" in merged
+            and "transmogrifai_serving_requests_total" in merged
+            and f'worker_id="{victim.worker_id}"' in merged)
+    finally:
+        pool.stop(grace_s=30.0)
+
+    classes = {}
+    for row in outcomes:
+        classes[row["class"]] = classes.get(row["class"], 0) + 1
+    accepted = [r["latencyS"] for r in outcomes if r["class"] == "2xx"]
+    p99 = _percentile(accepted, 0.99)
+    total = clients * requests_per_client
+    five_xx = sum(v for k, v in classes.items()
+                  if k.startswith("unclassified_5")
+                  or (k.isdigit() and k.startswith("5")))
+    bad_classes = {k: v for k, v in classes.items()
+                   if k not in ("2xx", "429", "503", "reset")}
+    checks = {
+        "all_requests_terminated": len(outcomes) == total
+        and hung_threads == 0,
+        "zero_5xx_from_survivors": five_xx == 0,
+        "only_contract_outcomes": not bad_classes,
+        "some_requests_accepted": classes.get("2xx", 0) > 0,
+        "accepted_p99_within_deadline": p99 <= request_deadline_s,
+        "worker_restarted": status["restartsTotal"] >= 1
+        and status["alive"] == workers
+        and new_pid is not None and new_pid != victim_pid,
+        "aggregated_metrics_parseable": metrics_parseable,
+    }
+    summary = {
+        "passed": all(checks.values()),
+        "mode": "pool",
+        "checks": checks,
+        "workers": workers,
+        "clients": clients,
+        "requestsPerClient": requests_per_client,
+        "totalRequests": total,
+        "outcomes": classes,
+        "hungClientThreads": hung_threads,
+        "stormSeconds": round(storm_s, 2),
+        "killedAtS": round(killed_s, 2),
+        "acceptedP99S": round(p99, 4),
+        "requestDeadlineS": request_deadline_s,
+        "victimPid": victim_pid,
+        "restartedPid": new_pid,
+        "poolStatus": status,
+    }
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "outcomes-pool.jsonl"), "w") as fh:
+            for row in outcomes:
+                fh.write(json.dumps(row) + "\n")
+        with open(os.path.join(out_dir, "metrics-pool.txt"), "w") as fh:
+            fh.write(merged)
+        with open(os.path.join(out_dir, "summary-pool.json"), "w") as fh:
+            json.dump(summary, fh, indent=2)
+    return summary
+
+
 def _metric_value(metrics_text, name):
     """Last plain-sample value of ``transmogrifai_serving_<name>``."""
     full = f"transmogrifai_serving_{name}"
@@ -308,6 +489,11 @@ def _metric_value(metrics_text, name):
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--out-dir", required=True)
+    ap.add_argument("--mode", choices=("engine", "pool"), default="engine",
+                    help="engine: in-process fault injection; pool: "
+                    "SIGKILL one SO_REUSEPORT worker mid-storm")
+    ap.add_argument("--workers", type=int, default=2,
+                    help="pool mode: worker processes")
     ap.add_argument("--clients", type=int, default=32)
     ap.add_argument("--requests", type=int, default=20)
     ap.add_argument("--batch-fault-rate", type=float, default=0.08)
@@ -315,6 +501,19 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--request-deadline-s", type=float, default=15.0)
     args = ap.parse_args(argv)
+    if args.mode == "pool":
+        summary = run_pool_chaos_slo(
+            workers=args.workers, clients=args.clients,
+            requests_per_client=args.requests, seed=args.seed,
+            request_deadline_s=args.request_deadline_s,
+            out_dir=args.out_dir)
+        print(json.dumps(summary, indent=2))
+        if not summary["passed"]:
+            failing = [k for k, ok in summary["checks"].items() if not ok]
+            print(f"pool chaos SLO FAILED: {failing}", file=sys.stderr)
+            return 1
+        print("pool chaos SLO passed", file=sys.stderr)
+        return 0
     if args.batch_fault_rate < 0.05 or args.reload_fault_rate < 0.05:
         print("warning: fault rates below the 5% acceptance floor",
               file=sys.stderr)

@@ -1,0 +1,229 @@
+"""CI smoke for the SO_REUSEPORT serving pool (ISSUE 12): train a tiny
+model, save an AOT bundle, boot a 2-worker pool on one shared port, and
+require
+
+  * both workers score with ZERO backend compiles (the shipped AOT
+    executables absorbed the cold start in every process, not just one),
+  * a columnar round-trip on the shared port that lands bitwise on the
+    JSON path's floats,
+  * the parent's aggregated /metrics summing per-worker counters,
+  * a clean SIGTERM drain that leaves no orphan processes.
+
+Usage:
+    python scripts/ci_serving_pool_smoke.py run OUT_DIR
+    python scripts/ci_serving_pool_smoke.py validate OUT_DIR
+
+``run`` writes OUT_DIR/pool-smoke.json with the measurements; ``validate``
+asserts them so the failure mode in CI is a readable diff of the summary,
+not a half-dead pool.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+# runnable as `python scripts/ci_serving_pool_smoke.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SUMMARY_NAME = "pool-smoke.json"
+
+RECORDS = [{"x1": -0.25, "x2": 1.0, "cat": "a"},
+           {"x1": 0.1, "x2": 9.5, "cat": "b"},
+           {"x1": 2.0, "x2": 0.0, "cat": "c"},
+           {"x1": None, "x2": 4.2, "cat": "a"}]
+
+
+def _make_records(n, seed=7):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        x1 = float(rng.normal())
+        x2 = float(rng.uniform(0, 10))
+        recs.append({
+            "y": 1.0 if (x1 + 0.2 * x2 + rng.normal() * 0.3) > 1.0 else 0.0,
+            "x1": x1, "x2": x2, "cat": ["a", "b", "c"][i % 3],
+        })
+    return recs
+
+
+def _post(port, body, content_type, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/score", data=body,
+        headers={"Content-Type": content_type})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _get(port, path, timeout=30):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _metric(text, name, default=None):
+    """The value of the UNLABELED sample of family ``name``."""
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        head, _, value = line.rpartition(" ")
+        if head.rstrip() == name:
+            return float(value)
+    if default is None:
+        raise AssertionError(f"metric {name} missing")
+    return default
+
+
+def _alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def run(out_dir):
+    from transmogrifai_tpu import types as T
+    from transmogrifai_tpu.features import features_from_schema
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, ModelCandidate, grid)
+    from transmogrifai_tpu.serving import wire
+    from transmogrifai_tpu.serving.pool import ServingPool
+    from transmogrifai_tpu.workflow import Workflow
+
+    os.makedirs(out_dir, exist_ok=True)
+    schema = {"y": T.RealNN, "x1": T.Real, "x2": T.Real, "cat": T.PickList}
+    y, predictors = features_from_schema(schema, response="y")
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]),
+                       "OpLogisticRegression")])
+    sel.set_input(y, transmogrify(predictors))
+    model = (Workflow().set_input_records(_make_records(200))
+             .set_result_features(sel.get_output()).train())
+
+    bundle = os.path.join(out_dir, "model")
+    os.environ["TRANSMOGRIFAI_AOT_LADDER_MAX"] = "16"
+    model.save(bundle)
+
+    pool = ServingPool(bundle, workers=2, max_batch=16, queue_bound=256,
+                       run_dir=os.path.join(out_dir, "pool-run"))
+    summary = {"bundle": bundle, "port": pool.port}
+    pids = []
+    try:
+        t0 = time.time()
+        pool.start()
+        summary["bootWallS"] = round(time.time() - t0, 2)
+
+        # -- columnar round-trip on the shared port, bitwise vs JSON -------
+        status, jraw = _post(pool.port, json.dumps(RECORDS).encode(),
+                             "application/json")
+        assert status == 200
+        jout = json.loads(jraw)["results"]
+        pred_name = next(iter(jout[0]))
+        status, craw = _post(pool.port, wire.encode_records(RECORDS),
+                             wire.CONTENT_TYPE)
+        assert status == 200
+        arrays = wire.decode_response(craw)
+        parity_fields = []
+        for field in ("prediction", "probability_0", "probability_1"):
+            cvals = np.asarray(arrays[f"{pred_name}.{field}"][0],
+                               dtype=np.float64)
+            jvals = np.array([r[pred_name][field] for r in jout],
+                             dtype=np.float64)
+            assert np.array_equal(cvals.view(np.uint64),
+                                  jvals.view(np.uint64)), \
+                f"columnar/JSON bit mismatch on {field}"
+            parity_fields.append(field)
+        summary["parityFields"] = parity_fields
+
+        # spread a little more traffic so the shared port sees real load
+        for _ in range(20):
+            _post(pool.port, wire.encode_records(RECORDS),
+                  wire.CONTENT_TYPE)
+
+        # -- per-worker admin metrics: AOT absorbed every cold start -------
+        per_worker = {}
+        for slot in pool.slots:
+            admin = slot.ready["adminPort"]
+            text = _get(admin, "/metrics")
+            per_worker[str(slot.worker_id)] = {
+                "backendCompiles": _metric(
+                    text, "transmogrifai_serving_backend_compiles_total"),
+                "aotExecutablesLoaded": _metric(
+                    text,
+                    "transmogrifai_serving_aot_executables_loaded_total"),
+                "requests": _metric(
+                    text, "transmogrifai_serving_requests_total"),
+                "pid": slot.ready["pid"],
+            }
+        summary["perWorker"] = per_worker
+
+        # -- parent aggregation: counters sum across workers ---------------
+        merged = pool.metrics()
+        summary["aggregate"] = {
+            "requests": _metric(merged,
+                                "transmogrifai_serving_requests_total"),
+            "poolWorkers": _metric(
+                merged, "transmogrifai_serving_pool_workers"),
+            "poolWorkersAlive": _metric(
+                merged, "transmogrifai_serving_pool_workers_alive"),
+        }
+        summary["aggregateHasWorkerLabels"] = (
+            'worker_id="0"' in merged and 'worker_id="1"' in merged)
+
+        pids = [w["pid"] for w in per_worker.values()]
+    finally:
+        # -- clean SIGTERM drain, then prove nothing survived --------------
+        t0 = time.time()
+        pool.stop(grace_s=60.0)
+        summary["stopWallS"] = round(time.time() - t0, 2)
+    time.sleep(0.5)
+    summary["orphanPids"] = [p for p in pids if _alive(p)]
+
+    with open(os.path.join(out_dir, SUMMARY_NAME), "w") as fh:
+        json.dump(summary, fh, indent=2)
+    print(json.dumps(summary, indent=2))
+    return 0
+
+
+def validate(out_dir):
+    with open(os.path.join(out_dir, SUMMARY_NAME)) as fh:
+        s = json.load(fh)
+    workers = s["perWorker"]
+    assert len(workers) == 2, f"expected 2 workers: {workers}"
+    for wid, w in workers.items():
+        assert w["backendCompiles"] == 0, \
+            f"worker {wid} compiled {w['backendCompiles']} programs"
+        assert w["aotExecutablesLoaded"] > 0, \
+            f"worker {wid} loaded no AOT executables"
+    assert s["parityFields"], "no columnar/JSON parity fields checked"
+    agg = s["aggregate"]
+    assert agg["poolWorkers"] == 2 and agg["poolWorkersAlive"] == 2
+    per_worker_requests = sum(w["requests"] for w in workers.values())
+    assert agg["requests"] == per_worker_requests, \
+        (f"aggregate requests {agg['requests']} != sum of per-worker "
+         f"{per_worker_requests}")
+    assert agg["requests"] > 0, "no traffic was recorded"
+    assert s["aggregateHasWorkerLabels"], \
+        "merged /metrics lost worker_id labels"
+    assert s["orphanPids"] == [], f"orphan workers: {s['orphanPids']}"
+    print(f"OK: 2 workers on port {s['port']}, 0 compiles each, "
+          f"{agg['requests']:.0f} requests aggregated, bitwise columnar "
+          f"parity on {s['parityFields']}, clean stop in "
+          f"{s['stopWallS']}s with no orphans")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "run":
+        sys.exit(run(sys.argv[2]))
+    if len(sys.argv) == 3 and sys.argv[1] == "validate":
+        sys.exit(validate(sys.argv[2]))
+    sys.exit(f"usage: {sys.argv[0]} run OUT_DIR | validate OUT_DIR")

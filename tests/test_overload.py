@@ -226,6 +226,23 @@ class TestOverloadController:
         # a request with a generous deadline is still admitted
         assert ctl.admit(10, deadline_s=60.0) is None
 
+    def test_wait_estimate_is_pure_batch_latency_no_linger(self):
+        """The continuous batcher dispatches the moment the device frees:
+        the queue-wait estimate is exactly batches-ahead × EWMA batch
+        latency, with no additive linger constant left in Retry-After
+        math (ISSUE 12)."""
+        ctl = OverloadController(OverloadConfig(adaptive=False),
+                                 queue_bound=1000, max_batch=4)
+        assert ctl.estimate_wait_s(0) == 0.0          # no signal yet
+        for _ in range(200):
+            ctl.observe_batch(0.5)
+        ewma = ctl.ewma_batch_latency_s()
+        assert ewma == pytest.approx(0.5)
+        assert ctl.estimate_wait_s(0) == pytest.approx(ewma)
+        assert ctl.estimate_wait_s(3) == pytest.approx(ewma)
+        assert ctl.estimate_wait_s(7) == pytest.approx(2 * ewma)
+        assert not hasattr(ctl, "linger_s")
+
     def test_queue_deadline_ms_caps_every_request(self):
         ctl = OverloadController(
             OverloadConfig(adaptive=False, queue_deadline_ms=1.0),

@@ -1,0 +1,157 @@
+"""Pool metrics aggregation (ISSUE 12): the parent merges per-worker
+Prometheus expositions — counters sum, gauges max-merge, summaries combine
+``_sum``/``_count`` — with ``worker_id`` labels on per-worker samples and
+every existing family name unchanged (dashboards keep working).
+
+The live pool (spawn, SO_REUSEPORT traffic, kill-one-worker restart, clean
+SIGTERM drain) is covered end-to-end by ``scripts/ci_serving_pool_smoke.py``
+and the chaos harness — process orchestration stays out of tier-1."""
+
+import re
+
+from transmogrifai_tpu.serving.pool import (_with_worker_label,
+                                            merge_worker_metrics)
+
+W0 = """\
+# HELP transmogrifai_serving_requests_total Records accepted
+# TYPE transmogrifai_serving_requests_total counter
+transmogrifai_serving_requests_total 10
+# HELP transmogrifai_serving_queue_depth Rows waiting
+# TYPE transmogrifai_serving_queue_depth gauge
+transmogrifai_serving_queue_depth 3
+# TYPE transmogrifai_serving_health_state gauge
+transmogrifai_serving_health_state 0
+# TYPE transmogrifai_serving_drift_feature_psi gauge
+transmogrifai_serving_drift_feature_psi{feature="age"} 0.125
+# TYPE transmogrifai_serving_model_info gauge
+transmogrifai_serving_model_info{version="ckpt-000001"} 1
+# TYPE transmogrifai_serving_request_latency_seconds summary
+transmogrifai_serving_request_latency_seconds{quantile="0.5"} 0.01
+transmogrifai_serving_request_latency_seconds{quantile="0.99"} 0.04
+transmogrifai_serving_request_latency_seconds_sum 1.5
+transmogrifai_serving_request_latency_seconds_count 10
+"""
+
+W1 = """\
+# HELP transmogrifai_serving_requests_total Records accepted
+# TYPE transmogrifai_serving_requests_total counter
+transmogrifai_serving_requests_total 32
+# HELP transmogrifai_serving_queue_depth Rows waiting
+# TYPE transmogrifai_serving_queue_depth gauge
+transmogrifai_serving_queue_depth 1
+# TYPE transmogrifai_serving_health_state gauge
+transmogrifai_serving_health_state 2
+# TYPE transmogrifai_serving_drift_feature_psi gauge
+transmogrifai_serving_drift_feature_psi{feature="age"} 0.5
+# TYPE transmogrifai_serving_model_info gauge
+transmogrifai_serving_model_info{version="ckpt-000001"} 1
+# TYPE transmogrifai_serving_request_latency_seconds summary
+transmogrifai_serving_request_latency_seconds{quantile="0.5"} 0.02
+transmogrifai_serving_request_latency_seconds{quantile="0.99"} 0.09
+transmogrifai_serving_request_latency_seconds_sum 2.5
+transmogrifai_serving_request_latency_seconds_count 22
+"""
+
+
+def _sample(text, pattern):
+    """The value of the first sample line matching ``pattern``."""
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        if re.match(pattern + r"\s", line) or re.fullmatch(
+                pattern + r"\s+\S+", line):
+            return float(line.rsplit(None, 1)[1])
+    raise AssertionError(f"no sample matching {pattern!r} in:\n{text}")
+
+
+class TestMergeWorkerMetrics:
+    def test_counters_sum_across_workers(self):
+        merged = merge_worker_metrics([("0", W0), ("1", W1)])
+        assert _sample(merged,
+                       r"transmogrifai_serving_requests_total") == 42
+        assert _sample(
+            merged,
+            r'transmogrifai_serving_requests_total\{worker_id="0"\}') == 10
+        assert _sample(
+            merged,
+            r'transmogrifai_serving_requests_total\{worker_id="1"\}') == 32
+
+    def test_gauges_max_merge(self):
+        """A sum would fabricate states: health_state 0+2 is not a state,
+        max (the worst worker) is what an alert should see."""
+        merged = merge_worker_metrics([("0", W0), ("1", W1)])
+        assert _sample(merged, r"transmogrifai_serving_queue_depth") == 3
+        assert _sample(merged, r"transmogrifai_serving_health_state") == 2
+        assert _sample(
+            merged,
+            r'transmogrifai_serving_queue_depth\{worker_id="1"\}') == 1
+
+    def test_labeled_samples_keep_original_labels(self):
+        merged = merge_worker_metrics([("0", W0), ("1", W1)])
+        assert _sample(
+            merged,
+            r'transmogrifai_serving_drift_feature_psi\{feature="age"\}'
+        ) == 0.5  # gauge: max across workers
+        assert _sample(
+            merged,
+            r'transmogrifai_serving_drift_feature_psi\{worker_id="0",'
+            r'feature="age"\}') == 0.125
+        # model_info is a labeled gauge with value 1 on every worker: the
+        # aggregate stays 1, not 2
+        assert _sample(
+            merged,
+            r'transmogrifai_serving_model_info\{version="ckpt-000001"\}'
+        ) == 1
+
+    def test_summary_sums_and_counts_merge_quantiles_per_worker(self):
+        merged = merge_worker_metrics([("0", W0), ("1", W1)])
+        assert _sample(
+            merged,
+            r"transmogrifai_serving_request_latency_seconds_sum") == 4.0
+        assert _sample(
+            merged,
+            r"transmogrifai_serving_request_latency_seconds_count") == 32
+        # quantiles cannot merge without the raw stream: per-worker only
+        assert _sample(
+            merged,
+            r'transmogrifai_serving_request_latency_seconds\{'
+            r'worker_id="1",quantile="0\.99"\}') == 0.09
+        for line in merged.splitlines():
+            if line.startswith("transmogrifai_serving_request_latency_"
+                               "seconds{"):
+                assert "worker_id=" in line, \
+                    f"aggregate quantile sample leaked: {line}"
+
+    def test_family_names_unchanged_and_types_kept(self):
+        merged = merge_worker_metrics([("0", W0), ("1", W1)])
+        assert ("# TYPE transmogrifai_serving_requests_total counter"
+                in merged)
+        assert "# TYPE transmogrifai_serving_queue_depth gauge" in merged
+        assert ("# TYPE transmogrifai_serving_request_latency_seconds "
+                "summary" in merged)
+        # no *_worker_* renames of existing families
+        assert "requests_total_worker" not in merged
+
+    def test_family_only_one_worker_exposes_still_merges(self):
+        extra = W0 + ("# TYPE transmogrifai_serving_only_here gauge\n"
+                      "transmogrifai_serving_only_here 5\n")
+        merged = merge_worker_metrics([("0", extra), ("1", W1)])
+        assert _sample(merged, r"transmogrifai_serving_only_here") == 5
+
+    def test_single_worker_passthrough_values(self):
+        merged = merge_worker_metrics([("0", W0)])
+        assert _sample(merged,
+                       r"transmogrifai_serving_requests_total") == 10
+
+    def test_malformed_lines_are_skipped_not_fatal(self):
+        noisy = W0 + "this is not a metric line at all {{{\n"
+        merged = merge_worker_metrics([("0", noisy), ("1", W1)])
+        assert _sample(merged,
+                       r"transmogrifai_serving_requests_total") == 42
+
+
+class TestWorkerLabel:
+    def test_label_insertion(self):
+        assert _with_worker_label("", "3") == '{worker_id="3"}'
+        assert _with_worker_label('{a="b"}', "0") == \
+            '{worker_id="0",a="b"}'

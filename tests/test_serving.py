@@ -30,6 +30,7 @@ from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
                                         ModelCandidate, grid)
 from transmogrifai_tpu.serving import (EngineClosed, OverloadedError,
                                        ScoringEngine)
+from transmogrifai_tpu.serving import wire
 from transmogrifai_tpu.serving.server import render_metrics, start_server
 from transmogrifai_tpu.workflow import Workflow
 
@@ -87,6 +88,18 @@ def _get(port, path, timeout=30):
     with urllib.request.urlopen(
             f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
         return r.status, r.read().decode()
+
+
+def _post_columnar(port, body, timeout=60):
+    """POST raw bytes with the columnar content type; (status, body, hdrs)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/score", data=body,
+        headers={"Content-Type": wire.CONTENT_TYPE})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
 
 
 class TestEngine:
@@ -448,6 +461,86 @@ class TestHTTPServer:
         for line in text.splitlines():
             assert (line.startswith("# HELP") or line.startswith("# TYPE")
                     or line.startswith("transmogrifai_serving_"))
+
+
+class TestColumnarHTTP:
+    """ISSUE 12 satellite: the packed columnar body scores bitwise-identically
+    to the JSON path, and malformed columnar input degrades to a structured
+    400 — the server never crashes or wedges."""
+
+    RECORDS = [{"x": -0.25}, {"x": 0.1}, {"x": 2.0}, {"x": -3.0},
+               {"x": None}]
+
+    @pytest.fixture(scope="class")
+    def server(self, bundle):
+        path, _, _ = bundle
+        srv, thread = start_server(path, port=0, max_batch=8, queue_bound=64)
+        yield srv
+        srv.drain_and_close()
+        thread.join(timeout=10)
+
+    def test_columnar_json_bitwise_parity(self, server, bundle):
+        _, pred_name, _ = bundle
+        port = server.port
+        status, jout, _ = _post(port, self.RECORDS)
+        assert status == 200
+        body = wire.encode_records(self.RECORDS)
+        status, raw, headers = _post_columnar(port, body)
+        assert status == 200
+        assert headers.get("Content-Type") == wire.CONTENT_TYPE
+        assert headers.get("X-Model-Version") == server.engine.model_version
+        arrays = wire.decode_response(raw)
+        for field in ("prediction", "probability_0", "probability_1",
+                      "rawPrediction_0", "rawPrediction_1"):
+            cvals = np.asarray(arrays[f"{pred_name}.{field}"][0],
+                               dtype=np.float64)
+            jvals = np.array([r[pred_name][field] for r in jout["results"]],
+                             dtype=np.float64)
+            # bit-for-bit, not approx: both paths must build the identical
+            # device batch
+            assert np.array_equal(cvals.view(np.uint64),
+                                  jvals.view(np.uint64)), field
+
+    def test_malformed_columnar_is_structured_400_and_server_survives(
+            self, server, bundle):
+        _, pred_name, _ = bundle
+        port = server.port
+        good = wire.encode_records(self.RECORDS)
+        for bad in (b"", b"garbage-not-columnar", good[:12], good[:-3],
+                    b"XXXX" + good[4:]):
+            status, raw, _ = _post_columnar(port, bad)
+            assert status == 400, bad
+            out = json.loads(raw)
+            assert out["error"] == "malformed columnar body"
+            assert "detail" in out
+        # unknown dtype code inside an otherwise-valid envelope
+        corrupt = bytearray(wire.encode_records([{"x": 1.0}]))
+        corrupt[18 + len("x")] = 99    # dtype code follows the 16B header,
+        #                                name_len u16, and the name itself
+        status, raw, _ = _post_columnar(port, bytes(corrupt))
+        assert status == 400
+        # the server keeps serving both formats after every rejection
+        status, out, _ = _post(port, {"x": 0.5})
+        assert status == 200 and pred_name in out["result"]
+        status, raw, _ = _post_columnar(port, good)
+        assert status == 200
+        assert len(wire.decode_response(raw)
+                   [f"{pred_name}.prediction"][0]) == len(self.RECORDS)
+
+    def test_wire_format_json_rejects_columnar_with_415(self, bundle):
+        path, _, _ = bundle
+        srv, thread = start_server(path, port=0, max_batch=4, queue_bound=16,
+                                   wire_format="json")
+        try:
+            status, raw, _ = _post_columnar(
+                srv.port, wire.encode_records([{"x": 1.0}]))
+            assert status == 415
+            assert "error" in json.loads(raw)
+            status, out, _ = _post(srv.port, {"x": 1.0})
+            assert status == 200
+        finally:
+            srv.drain_and_close()
+            thread.join(timeout=10)
 
 
 class TestHotReloadMidTraffic:

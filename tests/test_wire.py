@@ -1,0 +1,199 @@
+"""Columnar wire format (ISSUE 12): encode/decode round trips, storage
+parity with the JSON record path, golden-fixture byte stability, and the
+malformed-input taxonomy (every corruption is a ``WireFormatError``, never
+an engine-visible crash)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.columns import column_from_values
+from transmogrifai_tpu.serving import wire
+from transmogrifai_tpu.types import (Binary, Integral, Real, RealNN, Text)
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data",
+                      "columnar_golden.bin")
+
+GOLDEN_RECORDS = [
+    {"age": 34.5, "income": 1200.0, "active": True, "visits": 7,
+     "city": "lisbon"},
+    {"age": None, "income": -3.25, "active": False, "visits": None,
+     "city": ""},
+    {"age": 0.0, "income": None, "active": None, "visits": -12,
+     "city": None},
+    {"age": 99.9, "income": 1e6, "active": True, "visits": 40000,
+     "city": "são paulo"},
+]
+
+
+class _Feature:
+    def __init__(self, name, kind):
+        self.name = name
+        self.kind = kind
+
+
+GOLDEN_FEATURES = [_Feature("age", Real), _Feature("income", Real),
+                   _Feature("active", Binary), _Feature("visits", Integral),
+                   _Feature("city", Text)]
+
+
+class TestRoundTrip:
+    def test_records_round_trip(self):
+        body = wire.encode_records(GOLDEN_RECORDS)
+        n, cols = wire.decode_columns(body)
+        assert n == len(GOLDEN_RECORDS)
+        assert list(cols) == ["age", "income", "active", "visits", "city"]
+        age_code, age_vals, age_mask = cols["age"]
+        assert age_code == wire.F64
+        assert list(age_mask) == [True, False, True, True]
+        np.testing.assert_array_equal(age_vals, [34.5, 0.0, 0.0, 99.9])
+        city_code, city_vals, city_mask = cols["city"]
+        assert city_code == wire.UTF8
+        # empty string encodes as a zero-length entry → decodes to None,
+        # the same normalization text_column applies on the JSON path
+        assert list(city_vals) == ["lisbon", None, None, "são paulo"]
+
+    def test_decode_is_zero_copy_for_numerics(self):
+        body = wire.encode_records(GOLDEN_RECORDS)
+        _n, cols = wire.decode_columns(body)
+        for name in ("age", "income", "visits"):
+            arr = cols[name][1]
+            assert arr.base is not None, f"{name} was copied, not viewed"
+
+    def test_decode_batch_matches_column_from_values(self):
+        """decode_batch must land bit-for-bit on the storage the JSON path
+        builds via ``column_from_values`` — the root of score parity."""
+        body = wire.encode_records(GOLDEN_RECORDS)
+        batch = wire.decode_batch(body, GOLDEN_FEATURES)
+        assert len(batch) == len(GOLDEN_RECORDS)
+        for f in GOLDEN_FEATURES:
+            want = column_from_values(
+                f.kind, [r.get(f.name) for r in GOLDEN_RECORDS])
+            got = batch[f.name]
+            assert got.kind is f.kind
+            if f.kind is Text:
+                assert list(got.values) == list(want.values)
+            else:
+                assert got.values.dtype == want.values.dtype
+                np.testing.assert_array_equal(
+                    np.nan_to_num(np.asarray(got.values, dtype=np.float64)),
+                    np.nan_to_num(np.asarray(want.values,
+                                             dtype=np.float64)))
+                if want.mask is None:
+                    assert got.mask is None
+                else:
+                    np.testing.assert_array_equal(got.mask, want.mask)
+
+    def test_feature_missing_from_wire_takes_monoid_zero(self):
+        body = wire.encode_records([{"age": 1.0}, {"age": 2.0}])
+        feats = [_Feature("age", Real), _Feature("y", RealNN),
+                 _Feature("city", Text)]
+        batch = wire.decode_batch(body, feats)
+        # non-nullable absent feature = monoid zero, like extract_column
+        np.testing.assert_array_equal(batch["y"].values,
+                                      np.zeros(2, dtype=np.float32))
+        assert batch["y"].mask is None
+        assert list(batch["city"].values) == [None, None]
+
+    def test_non_nullable_rejects_absent_rows(self):
+        body = wire.encode_records([{"y": 1.0}, {"y": None}])
+        with pytest.raises(wire.WireFormatError, match="empty values"):
+            wire.decode_batch(body, [_Feature("y", RealNN)])
+
+    def test_dtype_kind_mismatch_is_wire_error(self):
+        body = wire.encode_records([{"city": "x"}])
+        with pytest.raises(wire.WireFormatError, match="numeric"):
+            wire.decode_batch(body, [_Feature("city", Real)])
+        body = wire.encode_records([{"age": 1.5}])
+        with pytest.raises(wire.WireFormatError, match="text"):
+            wire.decode_batch(body, [_Feature("age", Text)])
+
+    def test_result_arrays_round_trip(self):
+        arrays = {"p.prediction": (np.array([1.0, 0.0]), None),
+                  "p.probability_1": (np.array([0.25, 0.75]), None)}
+        body = wire.encode_result_arrays(arrays, 2)
+        back = wire.decode_response(body)
+        for k, (vals, _mask) in arrays.items():
+            np.testing.assert_array_equal(back[k][0], vals)
+
+
+class TestGoldenFixture:
+    def test_encode_is_byte_stable(self):
+        """The checked-in golden bytes pin the v1 layout: any header or
+        packing change breaks this loudly instead of silently skewing
+        scores for deployed clients."""
+        with open(GOLDEN, "rb") as f:
+            golden = f.read()
+        assert wire.encode_records(GOLDEN_RECORDS) == golden
+
+    def test_golden_decodes_to_known_values(self):
+        with open(GOLDEN, "rb") as f:
+            golden = f.read()
+        batch = wire.decode_batch(golden, GOLDEN_FEATURES)
+        assert len(batch) == 4
+        np.testing.assert_array_equal(
+            batch["visits"].values, np.array([7, 0, -12, 40000],
+                                             dtype=np.int64))
+        assert list(batch["city"].values) == ["lisbon", None, None,
+                                              "são paulo"]
+
+
+class TestMalformed:
+    def _valid(self):
+        return wire.encode_records(GOLDEN_RECORDS)
+
+    def test_empty_and_truncated_bodies(self):
+        body = self._valid()
+        for bad in (b"", body[:8], body[:20], body[:len(body) // 2],
+                    body[:-1]):
+            with pytest.raises(wire.WireFormatError):
+                wire.decode_columns(bad)
+
+    def test_bad_magic_and_version(self):
+        body = self._valid()
+        with pytest.raises(wire.WireFormatError, match="magic"):
+            wire.decode_columns(b"XXXX" + body[4:])
+        with pytest.raises(wire.WireFormatError, match="version"):
+            wire.decode_columns(body[:4] + b"\x63\x00" + body[6:])
+
+    def test_reserved_flags_rejected(self):
+        body = self._valid()
+        with pytest.raises(wire.WireFormatError, match="flags"):
+            wire.decode_columns(body[:6] + b"\x01\x00" + body[8:])
+
+    def test_absurd_row_and_feature_counts_rejected(self):
+        """A hostile header cannot make the server allocate unbounded
+        memory: caps fire before any array is built."""
+        body = self._valid()
+        huge_rows = body[:8] + (2 ** 31).to_bytes(4, "little") + body[12:]
+        with pytest.raises(wire.WireFormatError, match="cap"):
+            wire.decode_columns(huge_rows)
+        huge_feats = body[:12] + (2 ** 31).to_bytes(4, "little") + body[16:]
+        with pytest.raises(wire.WireFormatError, match="cap"):
+            wire.decode_columns(huge_feats)
+
+    def test_unknown_dtype_code_rejected(self):
+        records = [{"a": 1.0}]
+        body = bytearray(wire.encode_records(records))
+        # descriptor for "a": name_len(2) + name(1) + code at offset 19
+        assert body[19] == wire.F64
+        body[19] = 99
+        with pytest.raises(wire.WireFormatError, match="dtype"):
+            wire.decode_columns(bytes(body))
+
+    def test_non_monotonic_utf8_offsets_rejected(self):
+        body = bytearray(wire.encode_records([{"s": "hello"}, {"s": "x"}]))
+        # find the utf8 offsets payload (3 u32 after the 8-aligned header+
+        # descriptor region) and scramble it
+        idx = bytes(body).find(b"hello")
+        assert idx > 0
+        offs_start = idx - 12
+        body[offs_start:offs_start + 4] = (7).to_bytes(4, "little")
+        with pytest.raises(wire.WireFormatError):
+            wire.decode_columns(bytes(body))
+
+    def test_truncated_text_blob_rejected(self):
+        body = wire.encode_records([{"s": "hello world"}])
+        with pytest.raises(wire.WireFormatError):
+            wire.decode_columns(body[:-4])

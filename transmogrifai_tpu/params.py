@@ -33,7 +33,11 @@ class OpParams:
     custom_params: Dict[str, Any] = field(default_factory=dict)
     collect_metrics: bool = False
     # online-serving knobs (run-type "serve"): host, port, maxBatch,
-    # lingerMs, queueBound, requestDeadlineS, reloadPollS, plus the
+    # queueBound, requestDeadlineS, reloadPollS, workers (>1 runs the
+    # SO_REUSEPORT pool with a parent supervisor; adminPort for its
+    # aggregated /metrics), wireFormat ("auto" accepts the packed columnar
+    # body per request Content-Type, "json" rejects it with 415),
+    # lingerMs (deprecated, ignored by the continuous batcher), plus the
     # overload control plane (serving.overload.OverloadConfig.from_params):
     # latencyTargetMs, adaptiveLimit, minLimit, queueDeadlineMs,
     # brownoutHigh, brownoutLow, breakerWindow, breakerFailures,

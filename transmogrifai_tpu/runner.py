@@ -479,16 +479,36 @@ class OpWorkflowRunner:
         from .serving.overload import OverloadConfig
         from .serving.server import serve_main
         sv = params.serving or {}
+        workers = int(sv.get("workers", 1))
         with timer.phase("serve"):
-            serve_main(params.model_location,
-                       host=sv.get("host", "127.0.0.1"),
-                       port=int(sv.get("port", 8180)),
-                       max_batch=int(sv.get("maxBatch", 64)),
-                       linger_ms=float(sv.get("lingerMs", 2.0)),
-                       queue_bound=int(sv.get("queueBound", 256)),
-                       request_deadline_s=sv.get("requestDeadlineS", 30.0),
-                       reload_poll_s=float(sv.get("reloadPollS", 10.0)),
-                       overload=OverloadConfig.from_params(sv))
+            if workers > 1:
+                import dataclasses
+
+                from .serving.pool import pool_serve_main
+                pool_serve_main(
+                    params.model_location, workers=workers,
+                    host=sv.get("host", "127.0.0.1"),
+                    port=int(sv.get("port", 8180)),
+                    admin_port=int(sv.get("adminPort", 0)),
+                    max_batch=int(sv.get("maxBatch", 64)),
+                    queue_bound=int(sv.get("queueBound", 256)),
+                    request_deadline_s=sv.get("requestDeadlineS", 30.0),
+                    reload_poll_s=float(sv.get("reloadPollS", 10.0)),
+                    overload=dataclasses.asdict(
+                        OverloadConfig.from_params(sv)),
+                    wire_format=sv.get("wireFormat", "auto"))
+            else:
+                serve_main(params.model_location,
+                           host=sv.get("host", "127.0.0.1"),
+                           port=int(sv.get("port", 8180)),
+                           max_batch=int(sv.get("maxBatch", 64)),
+                           linger_ms=float(sv.get("lingerMs", 2.0)),
+                           queue_bound=int(sv.get("queueBound", 256)),
+                           request_deadline_s=sv.get("requestDeadlineS",
+                                                     30.0),
+                           reload_poll_s=float(sv.get("reloadPollS", 10.0)),
+                           overload=OverloadConfig.from_params(sv),
+                           wire_format=sv.get("wireFormat", "auto"))
         return OpWorkflowRunnerResult(RunType.SERVE)
 
     def _lifecycle(self, params: OpParams, timer: PhaseTimer

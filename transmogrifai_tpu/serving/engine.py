@@ -10,9 +10,13 @@ when concurrent requests share one device dispatch.  The engine:
   sizes (powers of two up to ``max_batch``), so the jit cache — keyed on
   batch length — is fully populated before traffic arrives and concurrent
   load never triggers an online XLA recompile,
-* runs a micro-batcher thread: concurrent single-record requests coalesce
-  into one padded device batch under a ``linger_ms`` deadline
-  (Clipper/TF-Serving-style adaptive batching),
+* runs a continuous micro-batcher thread: the moment the device frees it
+  drains the request queue into the largest ladder-padded batch available
+  (Clipper/vLLM-style continuous batching — no fixed linger deadline, so
+  throughput never trades against an idle-latency constant; ``linger_ms``
+  is accepted for compatibility and ignored),
+* scores packed columnar requests (``serving/wire.py``) as pre-assembled
+  ``ColumnBatch`` slices — no per-record Python on that path,
 * watches the checkpoint root and atomically hot-swaps newer valid
   versions in (events through the ambient ``FailureLog``),
 * sheds load (``OverloadedError`` → HTTP 429) past ``queue_bound``, bounds
@@ -32,9 +36,11 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from ..checkpoint import (bundle_version, find_latest_valid, is_bundle_dir,
                           read_manifest)
-from ..columns import ColumnBatch, column_from_values
+from ..columns import Column, ColumnBatch, column_from_values
 from ..local import extract_raw_value, score_function
 from ..resilience import (WatchdogTimeout, maybe_inject, record_failure,
                           run_with_deadline)
@@ -102,6 +108,23 @@ class _Request:
         self.t_enqueue = time.perf_counter()
 
 
+class _ColumnarRequest:
+    """A pre-assembled ColumnBatch riding the same queue as record
+    requests.  It counts as ``len(batch)`` rows for admission and queue
+    depth, and the batcher dispatches it alone (sliced into ladder-sized
+    chunks) — record and columnar requests never mix in one device batch."""
+
+    __slots__ = ("batch", "rows", "event", "result", "error", "t_enqueue")
+
+    def __init__(self, batch: ColumnBatch):
+        self.batch = batch
+        self.rows = len(batch)
+        self.event = threading.Event()
+        self.result: Optional[Tuple[Dict[str, Any], str]] = None
+        self.error: Optional[BaseException] = None
+        self.t_enqueue = time.perf_counter()
+
+
 class _ModelEntry:
     """One loaded model version: the model, its identity, and its row-wise
     local scorer (the fallback AND the parity oracle)."""
@@ -163,6 +186,10 @@ class ScoringEngine:
             raise ValueError("max_batch must be >= 1")
         self.model_location = model_location
         self.max_batch = int(max_batch)
+        # linger_ms is deprecated and ignored: the continuous batcher
+        # dispatches as soon as the device frees, coalescing whatever is
+        # queued at that moment (kept as a kwarg so existing callers and
+        # configs keep working)
         self.linger_s = float(linger_ms) / 1000.0
         self.queue_bound = int(queue_bound)
         self.batch_deadline_s = batch_deadline_s
@@ -170,7 +197,9 @@ class ScoringEngine:
         self.ladder = _padding_ladder(self.max_batch)
         self._warm_record = dict(warm_record or {})
 
-        self._queue: "collections.deque[_Request]" = collections.deque()
+        self._queue: "collections.deque" = collections.deque()
+        self._queued_rows = 0  # rows, not entries: a columnar request
+        #                        counts its full row span (guarded by _cv)
         self._cv = threading.Condition()
         self._closed = False
         self._draining = False
@@ -194,8 +223,7 @@ class ScoringEngine:
         # shares this engine's registry so /metrics sees everything.
         self.overload = OverloadController(
             overload, queue_bound=lambda: self.queue_bound,
-            max_batch=self.max_batch, linger_s=self.linger_s,
-            registry=self.metrics)
+            max_batch=self.max_batch, registry=self.metrics)
 
         # lifecycle hooks: batch observers see every successfully-scored
         # (records, results) pair; the drift monitor is one such observer
@@ -408,6 +436,7 @@ class ScoringEngine:
             self._check_admission(extra=len(records), deadline_s=timeout_s)
             reqs = [_Request(r) for r in records]
             self._queue.extend(reqs)
+            self._queued_rows += len(reqs)
             self.metrics.counter("requests_total").inc(len(reqs))
             self._cv.notify()
         deadline = (time.monotonic() + timeout_s
@@ -428,15 +457,53 @@ class ScoringEngine:
             out.append(req.result)
         return out
 
+    def score_columns(self, batch: ColumnBatch,
+                      timeout_s: Optional[float] = None
+                      ) -> Tuple[Dict[str, Any], str]:
+        """Score a pre-assembled raw ``ColumnBatch`` (the columnar wire
+        path).  Returns ``(result_arrays, model_version)`` where
+        ``result_arrays`` is ``{name: (values, mask)}`` per
+        ``wire.result_arrays``.  Admission control sees the batch as
+        ``len(batch)`` rows."""
+        n = len(batch)
+        if n < 1:
+            raise ValueError("columnar batch must have at least one row")
+        with self._cv:
+            self._check_admission(extra=n, deadline_s=timeout_s)
+            req = _ColumnarRequest(batch)
+            self._queue.append(req)
+            self._queued_rows += n
+            self.metrics.counter("requests_total").inc(n)
+            self._cv.notify()
+        if not req.event.wait(timeout_s):
+            raise DeadlineExceeded(
+                f"no result within {timeout_s}s for columnar request of "
+                f"{n} rows (queue depth {self.queue_depth})")
+        if req.error is not None:
+            raise req.error
+        self.request_latency.observe(time.perf_counter() - req.t_enqueue)
+        self.metrics.counter("responses_total").inc(n)
+        assert req.result is not None
+        return req.result
+
     @property
     def queue_depth(self) -> int:
-        return len(self._queue)
+        """Queued ROWS awaiting dispatch (a columnar request counts its
+        full row span, so admission and Retry-After stay honest)."""
+        return self._queued_rows
+
+    @property
+    def raw_features(self) -> Sequence:
+        """The active model's raw feature schema (the wire decoder keys
+        columnar bodies against it)."""
+        with self._swap_lock:
+            return self._entry.model.raw_features
 
     def _check_admission(self, extra: int = 1,
                          deadline_s: Optional[float] = None) -> None:
         if self._closed or self._draining:
             raise EngineClosed("engine is shutting down")
-        decision = self.overload.admit(len(self._queue), extra,
+        decision = self.overload.admit(self._queued_rows, extra,
                                        deadline_s=deadline_s)
         if decision is not None:
             self.metrics.counter("shed_total").inc()
@@ -444,7 +511,7 @@ class ScoringEngine:
             record_failure("serving", "shed", decision.message,
                            point="serving.admit", kind=decision.kind)
             self.overload.refresh_health(
-                queue_depth=len(self._queue), draining=False,
+                queue_depth=self._queued_rows, draining=False,
                 compiled_ok=self._compiled_ok)
             raise OverloadedError(decision.message,
                                   retry_after_s=decision.retry_after_s)
@@ -455,13 +522,22 @@ class ScoringEngine:
             self._check_admission(deadline_s=deadline_s)
             req = _Request(record)
             self._queue.append(req)
+            self._queued_rows += 1
             self.metrics.counter("requests_total").inc()
             self._cv.notify()
         return req
 
-    # -- the micro-batcher -------------------------------------------------
+    # -- the continuous micro-batcher --------------------------------------
     def _batch_loop(self) -> None:
+        """Continuous batching: the instant the previous dispatch returns,
+        drain whatever is queued NOW into one ladder-padded batch (up to
+        ``max_batch``) and dispatch it.  No linger deadline — a lone
+        request under light load dispatches immediately, and under load
+        batches fill naturally because requests accumulate while the
+        device is busy."""
         while True:
+            columnar: Optional[_ColumnarRequest] = None
+            batch: List[_Request] = []
             with self._cv:
                 while not self._queue and not self._closed:
                     self._cv.wait(0.05)
@@ -469,25 +545,26 @@ class ScoringEngine:
                     if self._closed:
                         return
                     continue
-                batch = [self._queue.popleft()]
-            # linger: coalesce whatever arrives before the deadline, up to
-            # one full padded batch
-            with span("serving.assemble") as sp:
-                deadline = time.monotonic() + self.linger_s
-                while len(batch) < self.max_batch:
-                    with self._cv:
-                        if self._queue:
+                with span("serving.assemble") as sp:
+                    head = self._queue.popleft()
+                    if isinstance(head, _ColumnarRequest):
+                        self._queued_rows -= head.rows
+                        columnar = head
+                    else:
+                        batch.append(head)
+                        self._queued_rows -= 1
+                        while (len(batch) < self.max_batch and self._queue
+                               and not isinstance(self._queue[0],
+                                                  _ColumnarRequest)):
                             batch.append(self._queue.popleft())
-                            continue
-                        remaining = deadline - time.monotonic()
-                        if remaining <= 0 or self._closed:
-                            break
-                        self._cv.wait(remaining)
-                        if not self._queue:
-                            break
-                if sp is not None:
-                    sp.attrs["rows"] = len(batch)
-            self._process(batch)
+                            self._queued_rows -= 1
+                    if sp is not None:
+                        sp.attrs["rows"] = (columnar.rows if columnar
+                                            else len(batch))
+            if columnar is not None:
+                self._process_columnar(columnar)
+            else:
+                self._process(batch)
 
     def _process(self, batch: List[_Request]) -> None:
         with span("serving.batch", rows=len(batch)):
@@ -606,6 +683,172 @@ class ScoringEngine:
         return [_result_row(scored, entry.result_names, i)
                 for i in range(n)]
 
+    # -- the columnar path -------------------------------------------------
+    @staticmethod
+    def _slice_columns(batch: ColumnBatch, lo: int, hi: int) -> ColumnBatch:
+        """Contiguous row window as zero-copy array views."""
+        cols = {}
+        for name, c in batch.items():
+            mask = None if c.mask is None else c.mask[lo:hi]
+            cols[name] = Column(c.kind, c.values[lo:hi], mask=mask,
+                                meta=c.meta)
+        return ColumnBatch(cols, hi - lo)
+
+    @staticmethod
+    def _pad_columns(batch: ColumnBatch, size: int) -> ColumnBatch:
+        """Pad to a ladder rung by repeating the last row.  Scoring is
+        row-independent and the padded rows are sliced off the result, so
+        the pad content only has to be type-valid — the last real row is
+        by construction."""
+        n = len(batch)
+        if size == n:
+            return batch
+        pad = size - n
+        cols = {}
+        for name, c in batch.items():
+            vals = np.concatenate([c.values,
+                                   np.repeat(c.values[-1:], pad, axis=0)])
+            mask = None if c.mask is None else np.concatenate(
+                [c.mask, np.repeat(c.mask[-1:], pad)])
+            cols[name] = Column(c.kind, vals, mask=mask, meta=c.meta)
+        return ColumnBatch(cols, size)
+
+    def _score_columns_compiled(self, entry: _ModelEntry, chunk: ColumnBatch
+                                ) -> Dict[str, Any]:
+        from .wire import result_arrays
+        n = len(chunk)
+        size = next(s for s in self.ladder if s >= n)
+        scored = entry.model.score(batch=self._pad_columns(chunk, size))
+        return result_arrays(scored, entry.result_names, n)
+
+    def _local_fallback_columns(self, entry: _ModelEntry, chunk: ColumnBatch
+                                ) -> Dict[str, Any]:
+        """Row-at-a-time local scoring for a columnar chunk the compiled
+        path could not handle.  A row that fails even here is a dead
+        letter and fails the whole columnar request (arrays cannot carry a
+        per-row exception)."""
+        rows = []
+        for i in range(len(chunk)):
+            rec = {name: ft.value for name, ft in chunk.row(i).items()}
+            try:
+                row = entry.local_fn(rec)
+            except Exception:
+                self.metrics.counter("dead_letter_total").inc()
+                raise
+            flat: Dict[str, Any] = {}
+            for name, v in row.items():
+                if isinstance(v, dict):
+                    for k2, v2 in v.items():
+                        flat[f"{name}.{k2}"] = v2
+                else:
+                    flat[name] = v
+            rows.append(flat)
+        keys: List[str] = []
+        for r in rows:
+            for k in r:
+                if k not in keys:
+                    keys.append(k)
+        out: Dict[str, Any] = {}
+        for k in keys:
+            vals = [r.get(k) for r in rows]
+            if any(isinstance(v, str) for v in vals):
+                out[k] = (np.array(vals, dtype=object), None)
+            else:
+                mask = np.array([v is not None for v in vals], dtype=bool)
+                arr = np.array([0.0 if v is None else float(v)
+                                for v in vals], dtype=np.float64)
+                out[k] = (arr, None if mask.all() else mask)
+        return out
+
+    def _process_columnar(self, req: _ColumnarRequest) -> None:
+        with span("serving.batch", rows=req.rows, columnar=True):
+            try:
+                self._process_columnar_inner(req)
+            except BaseException as e:  # noqa: BLE001 — fail the request,
+                #                         never the batcher thread
+                self.metrics.counter("errors_total").inc()
+                req.error = e
+                req.event.set()
+
+    def _process_columnar_inner(self, req: _ColumnarRequest) -> None:
+        from .wire import concat_result_arrays
+        with self._swap_lock:
+            entry = self._entry
+        chunks: List[Dict[str, Any]] = []
+        for lo in range(0, req.rows, self.max_batch):
+            hi = min(lo + self.max_batch, req.rows)
+            chunk = self._slice_columns(req.batch, lo, hi)
+            t0 = time.perf_counter()
+            arrays: Optional[Dict[str, Any]] = None
+            use_compiled = self._compiled_ok \
+                and self.overload.compiled_breaker.allow()
+            if self._compiled_ok and not use_compiled:
+                self.metrics.counter("breaker_demoted_batches_total").inc()
+            if use_compiled:
+                try:
+                    from ..compiled import trace_count
+                    with self._score_lock:
+                        before = trace_count()
+                        maybe_inject(
+                            "serving.batch",
+                            key=int(self.metrics.counter(
+                                "batches_total").value))
+                        with span("serving.execute", rows=hi - lo,
+                                  columnar=True):
+                            arrays = run_with_deadline(
+                                self._score_columns_compiled,
+                                self.batch_deadline_s, entry, chunk,
+                                description=f"serving columnar chunk of "
+                                            f"{hi - lo}")
+                        traced = trace_count() - before
+                    self.overload.compiled_breaker.record_success()
+                    if traced > 0:
+                        self.metrics.counter("online_traces_total").inc(
+                            traced)
+                        self._compiled_ok = False
+                        record_failure(
+                            "serving", "degraded", None,
+                            point="serving.batch",
+                            fallback="local row scoring",
+                            detail=f"{traced} online trace(s) after warmup"
+                                   " (columnar)")
+                except WatchdogTimeout as e:
+                    self.overload.compiled_breaker.record_failure(e)
+                    record_failure("serving", "fallback", e,
+                                   point="serving.batch",
+                                   fallback="local row scoring")
+                    self.metrics.counter("batch_deadline_total").inc()
+                    arrays = None
+                except Exception as e:  # noqa: BLE001 — row fallback
+                    self.overload.compiled_breaker.record_failure(e)
+                    record_failure("serving", "fallback", e,
+                                   point="serving.batch",
+                                   fallback="local row scoring")
+                    arrays = None
+            if arrays is None:
+                self.metrics.counter("fallback_batches_total").inc()
+                arrays = self._local_fallback_columns(entry, chunk)
+            self.metrics.counter("batches_total").inc()
+            self.metrics.counter("batch_rows_total").inc(hi - lo)
+            batch_s = time.perf_counter() - t0
+            self.batch_latency.observe(batch_s)
+            self.overload.observe_batch(batch_s)
+            self.overload.refresh_health(
+                queue_depth=self.queue_depth,
+                draining=self._draining or self._closed,
+                compiled_ok=self._compiled_ok)
+            chunks.append(arrays)
+        if self._batch_observers:
+            # batch observers (drift, insights) consume per-record dicts;
+            # reconstructing them would put per-row Python back on the hot
+            # path, so the columnar path skips observers by design and
+            # counts the skipped rows (drift monitoring of columnar
+            # traffic is deferred — see README)
+            self.metrics.counter("columnar_observer_skips_total").inc(
+                req.rows)
+        req.result = (concat_result_arrays(chunks), entry.version)
+        req.event.set()
+
     # -- metrics / shutdown ------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         with self._swap_lock:
@@ -636,6 +879,7 @@ class ScoringEngine:
                     req.error = EngineClosed("engine closed before scoring")
                     req.event.set()
                 self._queue.clear()
+                self._queued_rows = 0
             self._cv.notify_all()
         if drain:
             deadline = (time.monotonic() + timeout_s

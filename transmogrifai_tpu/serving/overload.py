@@ -170,7 +170,7 @@ class OverloadController:
     from request threads and the batcher thread concurrently."""
 
     def __init__(self, config: Optional[OverloadConfig] = None, *,
-                 queue_bound: Any, max_batch: int, linger_s: float = 0.0,
+                 queue_bound: Any, max_batch: int,
                  registry: Optional[Any] = None):
         self.config = config or OverloadConfig()
         # int for a fixed ceiling, or a callable for a live one (the engine
@@ -180,7 +180,6 @@ class OverloadController:
         else:
             self._queue_bound_fn = lambda bound=int(queue_bound): bound
         self.max_batch = max(1, int(max_batch))
-        self.linger_s = float(linger_s)
         cfg = self.config
         self.limit: Optional[AdaptiveConcurrencyLimit] = None
         if cfg.adaptive:
@@ -229,14 +228,16 @@ class OverloadController:
 
     def estimate_wait_s(self, queue_depth: int) -> float:
         """Expected queue wait for a request arriving at ``queue_depth``:
-        batches ahead of it times the smoothed batch latency, plus one
-        linger window.  Zero until the first batch lands (no signal)."""
+        batches ahead of it times the smoothed batch latency.  The
+        continuous batcher dispatches the instant the device frees, so
+        there is no linger constant in this estimate — batch latency is
+        the whole story.  Zero until the first batch lands (no signal)."""
         with self._lock:
             ewma = self._ewma_batch_s
         if ewma is None:
             return 0.0
         batches_ahead = math.ceil((queue_depth + 1) / self.max_batch)
-        return batches_ahead * ewma + self.linger_s
+        return batches_ahead * ewma
 
     def admit(self, queue_depth: int, extra: int = 1,
               deadline_s: Optional[float] = None

@@ -9,6 +9,13 @@ Endpoints:
   ``results[i]["_modelVersion"]`` only if they differ — a hot swap can land
   mid-list).  429 + ``Retry-After`` under shed load, 504 on deadline,
   503 while draining.
+
+  With ``Content-Type: application/x-transmogrifai-columnar`` the body is
+  the packed columnar format (``serving/wire.py``): per-feature contiguous
+  arrays the engine scores without per-record Python.  The response is a
+  columnar body of result arrays with the model version in
+  ``X-Model-Version``.  A malformed columnar body is a structured 400,
+  never a worker crash; JSON stays the compatibility path.
 * ``GET /healthz`` — process *liveness*: always 200 while the process can
   answer HTTP, with the health state (``SERVING``/``DEGRADED``/
   ``BROWNOUT``/``DRAINING``) and transition reason in the body.  A
@@ -36,6 +43,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..checkpoint import preemption_guard, shutdown_requested
 from ..resilience import CircuitBreaker, WatchdogTimeout
+from . import wire
 from .engine import (DeadlineExceeded, EngineClosed, OverloadedError,
                      ScoringEngine)
 from .overload import HEALTH_CODES, OverloadConfig
@@ -92,6 +100,10 @@ def render_metrics(engine: ScoringEngine) -> str:
             "XLA traces triggered by traffic after warmup (should be 0)")
     counter("dead_letter_total", c.get("dead_letter_total", 0),
             "Records unservable by both the compiled and local paths")
+    counter("columnar_observer_skips_total",
+            c.get("columnar_observer_skips_total", 0),
+            "Rows that bypassed batch observers on the columnar path "
+            "(drift monitoring of columnar traffic is deferred)")
     gauge("queue_depth", s["queue_depth"],
           "Requests currently waiting for a micro-batch")
     gauge("compiled_path_active", int(s["compiled_path_active"]),
@@ -317,13 +329,45 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": f"unknown path {self.path}"})
             return
         engine = self.server.engine
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length)
+        ctype = (self.headers.get("Content-Type") or
+                 "").split(";")[0].strip().lower()
+        timeout_s = self.server.request_deadline_s
+        if ctype == wire.CONTENT_TYPE:
+            if self.server.wire_format == "json":
+                self._reply(415, {"error": "columnar wire format is "
+                                           "disabled on this server "
+                                           "(wire_format=json); send JSON"})
+                return
+            try:
+                batch = wire.decode_batch(body, engine.raw_features)
+                arrays, version = engine.score_columns(batch, timeout_s)
+                out = wire.encode_result_arrays(arrays, len(batch))
+                self._reply(200, out, content_type=wire.CONTENT_TYPE,
+                            extra_headers={"X-Model-Version": version})
+            except wire.WireFormatError as e:
+                # malformed body = client bug, never a worker crash: a
+                # structured 400 names exactly what failed to parse
+                self._reply(400, {"error": "malformed columnar body",
+                                  "detail": str(e)})
+            except OverloadedError as e:
+                self._reply(429, {"error": str(e)},
+                            extra_headers={"Retry-After": _retry_after(
+                                getattr(e, "retry_after_s", 1.0))})
+            except (DeadlineExceeded, WatchdogTimeout) as e:
+                self._reply(504, {"error": str(e)})
+            except EngineClosed as e:
+                self._reply(503, {"error": str(e)},
+                            extra_headers={"Retry-After": "30"})
+            except Exception as e:  # noqa: BLE001 — see JSON path below
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
         try:
-            length = int(self.headers.get("Content-Length") or 0)
-            payload = json.loads(self.rfile.read(length) or b"null")
+            payload = json.loads(body or b"null")
         except (ValueError, TypeError) as e:
             self._reply(400, {"error": f"invalid JSON body: {e}"})
             return
-        timeout_s = self.server.request_deadline_s
         try:
             if isinstance(payload, dict):
                 result, version = engine.score_record(payload, timeout_s)
@@ -370,10 +414,25 @@ class ScoringHTTPServer(ThreadingHTTPServer):
 
     def __init__(self, engine: ScoringEngine, host: str = "127.0.0.1",
                  port: int = 8180,
-                 request_deadline_s: Optional[float] = 30.0):
-        super().__init__((host, port), _Handler)
+                 request_deadline_s: Optional[float] = 30.0,
+                 reuse_port: bool = False, wire_format: str = "auto"):
+        # bind manually so SO_REUSEPORT is set BEFORE bind: N pool workers
+        # each bind the same (host, port) and the kernel load-balances
+        # accepted connections across them
+        super().__init__((host, port), _Handler, bind_and_activate=False)
+        try:
+            if reuse_port:
+                self.socket.setsockopt(socket.SOL_SOCKET,
+                                       socket.SO_REUSEPORT, 1)
+            self.server_bind()
+            self.server_activate()
+        except BaseException:
+            self.server_close()
+            raise
         self.engine = engine
         self.request_deadline_s = request_deadline_s
+        self.reuse_port = reuse_port
+        self.wire_format = wire_format  # "auto" | "json" (columnar → 415)
         self.draining = False
 
     @property
@@ -393,7 +452,8 @@ def start_server(model_location: str, *, host: str = "127.0.0.1",
                  queue_bound: int = 256,
                  request_deadline_s: Optional[float] = 30.0,
                  reload_poll_s: float = 0.0, warm: bool = True,
-                 overload: Optional[OverloadConfig] = None
+                 overload: Optional[OverloadConfig] = None,
+                 reuse_port: bool = False, wire_format: str = "auto"
                  ) -> Tuple[ScoringHTTPServer, threading.Thread]:
     """Build engine + server and start the accept loop in a daemon thread.
     ``port=0`` binds an ephemeral port (see ``server.port``)."""
@@ -402,7 +462,9 @@ def start_server(model_location: str, *, host: str = "127.0.0.1",
                            reload_poll_s=reload_poll_s, warm=warm,
                            overload=overload)
     server = ScoringHTTPServer(engine, host=host, port=port,
-                               request_deadline_s=request_deadline_s)
+                               request_deadline_s=request_deadline_s,
+                               reuse_port=reuse_port,
+                               wire_format=wire_format)
     thread = threading.Thread(target=server.serve_forever,
                               name="scoring-http", daemon=True)
     thread.start()
@@ -414,7 +476,8 @@ def serve_main(model_location: str, *, host: str = "127.0.0.1",
                queue_bound: int = 256,
                request_deadline_s: Optional[float] = 30.0,
                reload_poll_s: float = 10.0,
-               overload: Optional[OverloadConfig] = None) -> int:
+               overload: Optional[OverloadConfig] = None,
+               wire_format: str = "auto") -> int:
     """Blocking entry point for the ``serve`` CLI subcommand: serve until
     SIGTERM/SIGINT, then drain in-flight batches and exit 0."""
     with preemption_guard("serve"):
@@ -422,7 +485,8 @@ def serve_main(model_location: str, *, host: str = "127.0.0.1",
             model_location, host=host, port=port, max_batch=max_batch,
             linger_ms=linger_ms, queue_bound=queue_bound,
             request_deadline_s=request_deadline_s,
-            reload_poll_s=reload_poll_s, overload=overload)
+            reload_poll_s=reload_poll_s, overload=overload,
+            wire_format=wire_format)
         print(f"serving {server.engine.model_version} on "
               f"http://{host}:{server.port} (max_batch={max_batch}, "
               f"linger_ms={linger_ms})", flush=True)

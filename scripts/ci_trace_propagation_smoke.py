@@ -1,0 +1,225 @@
+"""CI smoke for distributed tracing (ISSUE 13): boot a 2-worker serving
+pool with ``trace_dir`` set, send traffic carrying a client-supplied
+``traceparent``, run a supervised child under the same trace, merge the
+per-process trace files with the ``trace-merge`` CLI, and require
+
+  * a ``serving.request`` span in the merged trace on the client's
+    trace_id,
+  * a ``serving.batch`` span that links back to a request span on that
+    trace_id,
+  * a ``supervisor.child`` span (the cross-process env propagation) on
+    that same trace_id,
+  * one clock_sync metadata event per merged file,
+  * a parseable OpenMetrics exemplar on the pool's merged /metrics whose
+    trace_id is the client's,
+  * the pool admin ``/traces`` endpoint listing every worker trace file.
+
+Usage:
+    python scripts/ci_trace_propagation_smoke.py run OUT_DIR
+    python scripts/ci_trace_propagation_smoke.py validate OUT_DIR
+
+``run`` writes OUT_DIR/trace-smoke.json with the measurements; ``validate``
+asserts them so the CI failure mode is a readable diff of the summary.
+"""
+
+import json
+import os
+import re
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+# runnable as `python scripts/ci_trace_propagation_smoke.py` from the root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SUMMARY_NAME = "trace-smoke.json"
+MERGED_NAME = "merged-trace.json"
+
+RECORDS = [{"x1": -0.25, "x2": 1.0, "cat": "a"},
+           {"x1": 0.1, "x2": 9.5, "cat": "b"},
+           {"x1": 2.0, "x2": 0.0, "cat": "c"}]
+
+_EXEMPLAR_RE = re.compile(r' # \{trace_id="([0-9a-f]{32})"\} [0-9.eE+-]+')
+
+
+def _make_records(n, seed=7):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        x1 = float(rng.normal())
+        x2 = float(rng.uniform(0, 10))
+        recs.append({
+            "y": 1.0 if (x1 + 0.2 * x2 + rng.normal() * 0.3) > 1.0 else 0.0,
+            "x1": x1, "x2": x2, "cat": ["a", "b", "c"][i % 3],
+        })
+    return recs
+
+
+def _post(port, payload, traceparent, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/score",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json",
+                 "traceparent": traceparent})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+def run(out_dir):
+    from transmogrifai_tpu import types as T
+    from transmogrifai_tpu.cli import main as cli_main
+    from transmogrifai_tpu.features import features_from_schema
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.parallel.supervisor import run_supervised
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, ModelCandidate, grid)
+    from transmogrifai_tpu.serving.pool import (ServingPool,
+                                                _make_admin_server)
+    from transmogrifai_tpu.telemetry import Tracer, use_tracer
+    from transmogrifai_tpu.workflow import Workflow
+
+    os.makedirs(out_dir, exist_ok=True)
+    trace_dir = os.path.join(out_dir, "traces")
+    schema = {"y": T.RealNN, "x1": T.Real, "x2": T.Real, "cat": T.PickList}
+    y, predictors = features_from_schema(schema, response="y")
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]),
+                       "OpLogisticRegression")])
+    sel.set_input(y, transmogrify(predictors))
+    model = (Workflow().set_input_records(_make_records(200))
+             .set_result_features(sel.get_output()).train())
+    bundle = os.path.join(out_dir, "model")
+    model.save(bundle)
+
+    tracer = Tracer("trace-smoke")
+    summary = {"traceId": tracer.trace_id}
+    with use_tracer(tracer):
+        pool = ServingPool(bundle, workers=2, max_batch=16,
+                           queue_bound=256, trace_dir=trace_dir,
+                           run_dir=os.path.join(out_dir, "pool-run"))
+        admin = _make_admin_server(pool, "127.0.0.1", 0)
+        threading.Thread(target=admin.serve_forever, daemon=True).start()
+        try:
+            pool.start()
+            # client-supplied traceparent on the pool's shared trace
+            client = tracer.root_context().child()
+            statuses = []
+            for _ in range(12):
+                code, _body, hdrs = _post(pool.port, RECORDS,
+                                          client.to_traceparent())
+                statuses.append(code)
+                assert hdrs["X-Request-Id"] == tracer.trace_id
+            summary["requestStatuses"] = sorted(set(statuses))
+            summary["responseTraceparentTraceId"] = \
+                hdrs["traceparent"].split("-")[1]
+
+            # supervised child under the same trace (env propagation)
+            with tracer.span("smoke.trigger"):
+                r = run_supervised(
+                    [sys.executable, "-c",
+                     "import os; print(os.environ.get("
+                     "'TRANSMOGRIFAI_TRACEPARENT', ''))"],
+                    timeout_s=120)
+            summary["supervisedRc"] = r.rc
+            summary["supervisedChildTraceId"] = \
+                (r.stdout.strip().split("-") + ["", ""])[1]
+
+            # merged /metrics must carry a parseable exemplar
+            merged_metrics = pool.metrics()
+            summary["exemplarTraceIds"] = sorted(
+                set(_EXEMPLAR_RE.findall(merged_metrics)))
+        finally:
+            pool.stop(grace_s=60.0)
+
+        # the admin /traces listing sees the exported worker files
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{admin.server_address[1]}/traces",
+                timeout=30) as resp:
+            summary["tracesEndpoint"] = json.loads(resp.read())
+        admin.shutdown()
+        admin.server_close()
+
+    # the parent process exports its own spans next to the workers'
+    parent_trace = os.path.join(trace_dir, "trace-parent.json")
+    tracer.export_chrome_trace(parent_trace)
+
+    files = sorted(os.path.join(trace_dir, f)
+                   for f in os.listdir(trace_dir)
+                   if f.startswith("trace-") and f.endswith(".json"))
+    summary["traceFiles"] = [os.path.basename(f) for f in files]
+    merged_path = os.path.join(out_dir, MERGED_NAME)
+    rc = cli_main(["trace-merge", *files, "--out", merged_path])
+    assert rc == 0
+    summary["mergedPath"] = merged_path
+
+    with open(merged_path) as fh:
+        merged = json.load(fh)
+    evs = merged["traceEvents"]
+    tid = tracer.trace_id
+    xs = [e for e in evs if e.get("ph") == "X"]
+
+    def on_trace(name):
+        return [e for e in xs if e["name"] == name
+                and e.get("args", {}).get("traceId") == tid]
+
+    req_spans = on_trace("serving.request")
+    batch_linked = [e for e in on_trace("serving.batch")
+                    if any(l.get("traceId") == tid
+                           for l in e["args"].get("links", []))]
+    summary["requestSpans"] = len(req_spans)
+    summary["batchSpansLinkedToRequest"] = len(batch_linked)
+    summary["supervisorChildSpans"] = len(on_trace("supervisor.child"))
+    summary["clockSyncs"] = sum(1 for e in evs if e.get("ph") == "c")
+    summary["mergedFiles"] = len(merged["otherData"]["files"])
+
+    with open(os.path.join(out_dir, SUMMARY_NAME), "w") as fh:
+        json.dump(summary, fh, indent=2)
+    print(json.dumps(summary, indent=2))
+    return 0
+
+
+def validate(out_dir):
+    with open(os.path.join(out_dir, SUMMARY_NAME)) as fh:
+        s = json.load(fh)
+    tid = s["traceId"]
+    assert s["requestStatuses"] == [200], \
+        f"non-200 responses: {s['requestStatuses']}"
+    assert s["responseTraceparentTraceId"] == tid, \
+        "response traceparent did not adopt the client trace"
+    assert s["supervisedRc"] == 0
+    assert s["supervisedChildTraceId"] == tid, \
+        "TRANSMOGRIFAI_TRACEPARENT did not reach the supervised child"
+    assert s["requestSpans"] > 0, "no serving.request span on the trace"
+    assert s["batchSpansLinkedToRequest"] > 0, \
+        "no serving.batch span links back to a request span"
+    assert s["supervisorChildSpans"] > 0, \
+        "no supervisor.child span on the trace"
+    assert tid in s["exemplarTraceIds"], \
+        (f"client trace {tid} missing from /metrics exemplars "
+         f"{s['exemplarTraceIds']}")
+    assert s["mergedFiles"] == len(s["traceFiles"]) >= 3, \
+        f"expected parent + 2 worker trace files: {s['traceFiles']}"
+    assert s["clockSyncs"] == s["mergedFiles"], \
+        "merged trace lost clock_sync metadata"
+    listed = {t["name"] for t in s["tracesEndpoint"]["traces"]}
+    assert {"trace-worker-0.json", "trace-worker-1.json"} <= listed, \
+        f"/traces endpoint missing worker files: {sorted(listed)}"
+    print(f"OK: one trace {tid} across {s['mergedFiles']} processes — "
+          f"{s['requestSpans']} request spans, "
+          f"{s['batchSpansLinkedToRequest']} linked batch spans, "
+          f"{s['supervisorChildSpans']} supervised child spans, "
+          f"exemplar on /metrics, /traces lists {sorted(listed)}")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "run":
+        sys.exit(run(sys.argv[2]))
+    if len(sys.argv) == 3 and sys.argv[1] == "validate":
+        sys.exit(validate(sys.argv[2]))
+    sys.exit(f"usage: {sys.argv[0]} run OUT_DIR | validate OUT_DIR")

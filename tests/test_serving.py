@@ -327,7 +327,8 @@ class TestHTTPServer:
     def test_metrics_unified_registry_families(self, server):
         """/metrics surfaces the central-registry families (ISSUE 5) —
         dead-letter, compile, racing, host-link — alongside the stable
-        serving names, and every sample line parses as Prometheus text."""
+        serving names, and every sample line parses as Prometheus text
+        (modulo an optional OpenMetrics exemplar suffix)."""
         status, text = _get(server.port, "/metrics")
         assert status == 200
         samples = {}
@@ -335,7 +336,9 @@ class TestHTTPServer:
             if not ln or ln.startswith("#"):
                 continue
             name, _, value = ln.partition(" ")
-            samples[name.partition("{")[0]] = float(value)
+            # latency/shed lines may carry an ` # {trace_id="..."} v`
+            # exemplar once any traced request has been scored
+            samples[name.partition("{")[0]] = float(value.partition(" # ")[0])
         for family in ("dead_letter_total", "compile_seconds_total",
                        "backend_compiles_total", "compile_cache_hits_total",
                        "compile_cache_misses_total",

@@ -195,10 +195,13 @@ class TestExports:
             doc = json.load(fh)
         assert doc["otherData"]["runName"] == "export-test"
         evs = doc["traceEvents"]
-        assert all(e["ph"] == "X" for e in evs)
-        assert {e["name"] for e in evs} == {"workflow.train",
-                                            "selector.sweep",
-                                            "selector.racing.prune"}
+        # X span events plus the process_name ("M") and clock_sync ("c")
+        # metadata prelude
+        assert {e["ph"] for e in evs} <= {"X", "M", "c"}
+        xs = [e for e in evs if e["ph"] == "X"]
+        assert {e["name"] for e in xs} == {"workflow.train",
+                                           "selector.sweep",
+                                           "selector.racing.prune"}
         # span tree survives via args
         spans = load_trace(path)
         by_name = {s["name"]: s for s in spans}

@@ -34,7 +34,8 @@ from ..checkpoint import (TrainingPreempted, bundle_version,
                           find_latest_valid, next_version_dir,
                           preemption_guard, write_json_atomic)
 from ..resilience import maybe_inject, record_failure
-from ..telemetry import REGISTRY, MetricsRegistry, event, span
+from ..telemetry import (REGISTRY, MetricsRegistry, current_trace_context,
+                         event, span)
 from .drift import DriftMonitor, DriftReport
 
 SWEEP_SUBDIR = os.path.join("lifecycle", "sweep")
@@ -234,7 +235,13 @@ class LifecycleController:
         self.state.last_retrain_s = time.time()
         self.registry.counter("lifecycle.retrains_total").inc()
         sweep_dir = os.path.join(self.root, SWEEP_SUBDIR)
-        with span("lifecycle.retrain", reason=reason, policy=policy,
+        # nest the retrain under the triggering request/monitor span (or the
+        # TRANSMOGRIFAI_TRACEPARENT a parent process exported), so lifecycle
+        # work shows up on the same distributed trace as its cause
+        parent_ctx = current_trace_context()
+        with span("lifecycle.retrain",
+                  ctx=parent_ctx.child() if parent_ctx else None,
+                  reason=reason, policy=policy,
                   attempt=self.state.retrains_total):
             event("lifecycle.retrain", reason=reason, policy=policy)
             outcome = self._retrain_inner(reason, policy, sweep_dir)

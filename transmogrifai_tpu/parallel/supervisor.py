@@ -234,30 +234,49 @@ class SupervisedResult:
 def run_supervised(cmd: Sequence[str], *, timeout_s: float,
                    grace_s: float = 10.0,
                    env: Optional[Dict[str, str]] = None,
-                   cwd: Optional[str] = None) -> SupervisedResult:
+                   cwd: Optional[str] = None,
+                   traceparent: Optional[str] = None) -> SupervisedResult:
     """Run ``cmd`` under a SIGTERM→SIGKILL escalation deadline.
 
     On deadline: SIGTERM, wait ``grace_s``, then SIGKILL — the only kill
     that reliably works on a native-hung jax init (OUTAGE_r5.json).  The
     child is always reaped before returning (no zombies), and pipes are
-    drained after the kill so a chatty child cannot deadlock the parent."""
+    drained after the kill so a chatty child cannot deadlock the parent.
+
+    The child inherits a trace context through ``TRANSMOGRIFAI_TRACEPARENT``
+    (from ``traceparent`` when given, else the caller's ambient span) so a
+    traced child nests under the triggering span across the process
+    boundary; the run itself is recorded as a ``supervisor.child`` span."""
+    from ..telemetry import (TRACEPARENT_ENV, TraceContext,
+                             current_trace_context, span)
+    parent_ctx = (TraceContext.parse(traceparent) if traceparent
+                  else current_trace_context())
+    child_ctx = parent_ctx.child() if parent_ctx else None
+    env = dict(os.environ if env is None else env)
+    if child_ctx is not None:
+        env[TRACEPARENT_ENV] = child_ctx.to_traceparent()
     t0 = time.time()
-    p = subprocess.Popen(list(cmd), stdout=subprocess.PIPE,
-                         stderr=subprocess.PIPE, text=True, env=env,
-                         cwd=cwd, start_new_session=True)
-    timed_out = escalated = False
-    try:
-        out, err = p.communicate(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        timed_out = True
-        p.terminate()
+    with span("supervisor.child", ctx=child_ctx,
+              argv0=os.path.basename(str(cmd[0]))) as sp:
+        p = subprocess.Popen(list(cmd), stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True, env=env,
+                             cwd=cwd, start_new_session=True)
+        timed_out = escalated = False
         try:
-            out, err = p.communicate(timeout=max(0.1, grace_s))
+            out, err = p.communicate(timeout=timeout_s)
         except subprocess.TimeoutExpired:
-            escalated = True
-            p.kill()
-            out, err = p.communicate()
-    rc = 124 if timed_out else int(p.returncode)
+            timed_out = True
+            p.terminate()
+            try:
+                out, err = p.communicate(timeout=max(0.1, grace_s))
+            except subprocess.TimeoutExpired:
+                escalated = True
+                p.kill()
+                out, err = p.communicate()
+        rc = 124 if timed_out else int(p.returncode)
+        if sp is not None:
+            sp.attrs.update(pid=p.pid, rc=rc, timed_out=timed_out,
+                            escalated=escalated)
     return SupervisedResult(rc=rc, stdout=out or "", stderr=err or "",
                             wall_s=time.time() - t0, timed_out=timed_out,
                             escalated=escalated, pid=p.pid)

@@ -48,7 +48,10 @@ class OpParams:
     # eta, minSurvivors (see DefaultSelectorParams.RACING*)
     racing: Dict[str, Any] = field(default_factory=dict)
     # telemetry knobs: traceDir (where chrome-trace + telemetry.json land),
-    # enabled (default: true when traceDir is set), summaryTopN
+    # enabled (default: true when traceDir is set), summaryTopN,
+    # traceparent (W3C `traceparent` header value — joins this run's spans
+    # to the caller's distributed trace; defaults to the
+    # TRANSMOGRIFAI_TRACEPARENT env var a supervising parent exported)
     telemetry: Dict[str, Any] = field(default_factory=dict)
     # lifecycle knobs (run-type "lifecycle"): policy, psiThreshold,
     # scorePsiThreshold, fillDeltaThreshold, minRows, intervalS,

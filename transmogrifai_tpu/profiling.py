@@ -237,11 +237,17 @@ class LatencyHistogram:
         self._sum = 0.0
         self._min: Optional[float] = None
         self._max: Optional[float] = None
+        # OpenMetrics exemplars: last {traceId, value} per bucket plus the
+        # overall last — a p99 spike in Prometheus links to a concrete trace
+        self._bucket_exemplars: Dict[int, Dict[str, Any]] = {}
+        self._last_exemplar: Optional[Dict[str, Any]] = None
 
-    def observe(self, seconds: float) -> None:
+    def observe(self, seconds: float,
+                trace_id: Optional[str] = None) -> None:
         """Record one observation.  Every mutation — bucket increment,
         count/sum, min/max — happens under the instance lock, so concurrent
-        server threads never lose an update."""
+        server threads never lose an update.  ``trace_id`` (when the request
+        carried one) is remembered as the bucket's exemplar."""
         s = float(seconds)
         i = bisect.bisect_left(self._BOUNDS, s)
         with self._lock:
@@ -252,6 +258,24 @@ class LatencyHistogram:
                 self._min = s
             if self._max is None or s > self._max:
                 self._max = s
+            if trace_id:
+                ex = {"traceId": trace_id, "value": s}
+                self._bucket_exemplars[i] = ex
+                self._last_exemplar = ex
+
+    def exemplar(self, slowest: bool = False) -> Optional[Dict[str, Any]]:
+        """The exemplar to attach to a rendered sample: the last traced
+        observation, or with ``slowest=True`` the one from the highest
+        occupied bucket (the trace a p99 spike points at).  None when no
+        traced observation has landed yet."""
+        with self._lock:
+            if not self._bucket_exemplars:
+                return None
+            if slowest:
+                return dict(self._bucket_exemplars[
+                    max(self._bucket_exemplars)])
+            return dict(self._last_exemplar) \
+                if self._last_exemplar else None
 
     @property
     def count(self) -> int:

@@ -148,7 +148,17 @@ class OpWorkflowRunner:
         tele = params.telemetry or {}
         trace_dir = tele.get("traceDir")
         enabled = bool(tele.get("enabled", trace_dir is not None))
-        tracer = Tracer(run_name=f"run:{run_type}") if enabled else None
+        # telemetryParams.traceparent (or the TRANSMOGRIFAI_TRACEPARENT a
+        # supervising parent exported) joins this run's spans — including a
+        # lifecycle retrain — to the caller's distributed trace
+        parent = None
+        if enabled:
+            from .telemetry import TraceContext
+            tp = tele.get("traceparent")
+            parent = (TraceContext.parse(str(tp)) if tp
+                      else TraceContext.from_env())
+        tracer = Tracer(run_name=f"run:{run_type}",
+                        parent=parent) if enabled else None
         ctx = use_tracer(tracer) if tracer is not None \
             else contextlib.nullcontext()
         # opt-in heartbeat supervision for the whole run: background
@@ -584,6 +594,10 @@ class OpApp:
         p.add_argument("--trace-dir",
                        help="trace this run and write Chrome-trace JSON + "
                             "telemetry.json into this directory")
+        p.add_argument("--traceparent",
+                       help="W3C traceparent header value joining this run "
+                            "to the caller's distributed trace (defaults "
+                            "to $TRANSMOGRIFAI_TRACEPARENT)")
         p.add_argument("--no-aot", action="store_true",
                        help="disable AOT-serialized executables: train "
                             "saves JIT-only bundles, load/serve recompiles "
@@ -629,6 +643,8 @@ class OpApp:
             params.racing["minSurvivors"] = args.racing_min_survivors
         if args.trace_dir:
             params.telemetry["traceDir"] = args.trace_dir
+        if args.traceparent:
+            params.telemetry["traceparent"] = args.traceparent
         if args.no_aot:
             params.aot["enabled"] = False
         if args.mesh or args.no_mesh:

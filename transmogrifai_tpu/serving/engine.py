@@ -45,7 +45,7 @@ from ..local import extract_raw_value, score_function
 from ..resilience import (WatchdogTimeout, maybe_inject, record_failure,
                           run_with_deadline)
 from ..stages.generator import FeatureGeneratorStage
-from ..telemetry import MetricsRegistry, span
+from ..telemetry import MetricsRegistry, TraceContext, span
 from ..types import FeatureType, Prediction
 from .overload import BROWNOUT, OverloadConfig, OverloadController
 
@@ -98,14 +98,16 @@ def records_to_batch(raw_features: Sequence, records: List[Dict[str, Any]]
 
 
 class _Request:
-    __slots__ = ("record", "event", "result", "error", "t_enqueue")
+    __slots__ = ("record", "event", "result", "error", "t_enqueue", "ctx")
 
-    def __init__(self, record: Dict[str, Any]):
+    def __init__(self, record: Dict[str, Any],
+                 ctx: Optional[TraceContext] = None):
         self.record = record
         self.event = threading.Event()
         self.result: Optional[Tuple[Dict[str, Any], str]] = None
         self.error: Optional[BaseException] = None
         self.t_enqueue = time.perf_counter()
+        self.ctx = ctx
 
 
 class _ColumnarRequest:
@@ -114,15 +116,18 @@ class _ColumnarRequest:
     depth, and the batcher dispatches it alone (sliced into ladder-sized
     chunks) — record and columnar requests never mix in one device batch."""
 
-    __slots__ = ("batch", "rows", "event", "result", "error", "t_enqueue")
+    __slots__ = ("batch", "rows", "event", "result", "error", "t_enqueue",
+                 "ctx")
 
-    def __init__(self, batch: ColumnBatch):
+    def __init__(self, batch: ColumnBatch,
+                 ctx: Optional[TraceContext] = None):
         self.batch = batch
         self.rows = len(batch)
         self.event = threading.Event()
         self.result: Optional[Tuple[Dict[str, Any], str]] = None
         self.error: Optional[BaseException] = None
         self.t_enqueue = time.perf_counter()
+        self.ctx = ctx
 
 
 class _ModelEntry:
@@ -410,31 +415,38 @@ class ScoringEngine:
 
     # -- public scoring API ------------------------------------------------
     def score_record(self, record: Dict[str, Any],
-                     timeout_s: Optional[float] = None
+                     timeout_s: Optional[float] = None,
+                     ctx: Optional[TraceContext] = None
                      ) -> Tuple[Dict[str, Any], str]:
         """Score one record; returns ``(result, model_version)``.  Blocks
         until the coalesced batch containing it completes, the engine
-        closes, or ``timeout_s`` elapses (→ ``DeadlineExceeded``)."""
-        req = self._submit(record, deadline_s=timeout_s)
+        closes, or ``timeout_s`` elapses (→ ``DeadlineExceeded``).
+        ``ctx`` is the request's trace position: the dispatching batch
+        span links back to it and latency/shed exemplars carry its
+        trace id."""
+        req = self._submit(record, deadline_s=timeout_s, ctx=ctx)
         if not req.event.wait(timeout_s):
             raise DeadlineExceeded(
                 f"no result within {timeout_s}s (queue depth "
                 f"{self.queue_depth})")
         if req.error is not None:
             raise req.error
-        self.request_latency.observe(time.perf_counter() - req.t_enqueue)
+        self.request_latency.observe(time.perf_counter() - req.t_enqueue,
+                                     trace_id=ctx.trace_id if ctx else None)
         self.metrics.counter("responses_total").inc()
         assert req.result is not None
         return req.result
 
     def score_records(self, records: List[Dict[str, Any]],
-                      timeout_s: Optional[float] = None
+                      timeout_s: Optional[float] = None,
+                      ctx: Optional[TraceContext] = None
                       ) -> List[Tuple[Dict[str, Any], str]]:
         """Score a client-provided list: every record rides the same queue
         as single requests (admission control applies to the whole list)."""
         with self._cv:
-            self._check_admission(extra=len(records), deadline_s=timeout_s)
-            reqs = [_Request(r) for r in records]
+            self._check_admission(extra=len(records), deadline_s=timeout_s,
+                                  ctx=ctx)
+            reqs = [_Request(r, ctx=ctx) for r in records]
             self._queue.extend(reqs)
             self._queued_rows += len(reqs)
             self.metrics.counter("requests_total").inc(len(reqs))
@@ -451,14 +463,16 @@ class ScoringEngine:
             if req.error is not None:
                 raise req.error
             self.request_latency.observe(
-                time.perf_counter() - req.t_enqueue)
+                time.perf_counter() - req.t_enqueue,
+                trace_id=ctx.trace_id if ctx else None)
             self.metrics.counter("responses_total").inc()
             assert req.result is not None
             out.append(req.result)
         return out
 
     def score_columns(self, batch: ColumnBatch,
-                      timeout_s: Optional[float] = None
+                      timeout_s: Optional[float] = None,
+                      ctx: Optional[TraceContext] = None
                       ) -> Tuple[Dict[str, Any], str]:
         """Score a pre-assembled raw ``ColumnBatch`` (the columnar wire
         path).  Returns ``(result_arrays, model_version)`` where
@@ -469,8 +483,8 @@ class ScoringEngine:
         if n < 1:
             raise ValueError("columnar batch must have at least one row")
         with self._cv:
-            self._check_admission(extra=n, deadline_s=timeout_s)
-            req = _ColumnarRequest(batch)
+            self._check_admission(extra=n, deadline_s=timeout_s, ctx=ctx)
+            req = _ColumnarRequest(batch, ctx=ctx)
             self._queue.append(req)
             self._queued_rows += n
             self.metrics.counter("requests_total").inc(n)
@@ -481,7 +495,8 @@ class ScoringEngine:
                 f"{n} rows (queue depth {self.queue_depth})")
         if req.error is not None:
             raise req.error
-        self.request_latency.observe(time.perf_counter() - req.t_enqueue)
+        self.request_latency.observe(time.perf_counter() - req.t_enqueue,
+                                     trace_id=ctx.trace_id if ctx else None)
         self.metrics.counter("responses_total").inc(n)
         assert req.result is not None
         return req.result
@@ -500,14 +515,17 @@ class ScoringEngine:
             return self._entry.model.raw_features
 
     def _check_admission(self, extra: int = 1,
-                         deadline_s: Optional[float] = None) -> None:
+                         deadline_s: Optional[float] = None,
+                         ctx: Optional[TraceContext] = None) -> None:
         if self._closed or self._draining:
             raise EngineClosed("engine is shutting down")
         decision = self.overload.admit(self._queued_rows, extra,
                                        deadline_s=deadline_s)
         if decision is not None:
-            self.metrics.counter("shed_total").inc()
-            self.metrics.counter(f"shed_{decision.kind}_total").inc()
+            trace_id = ctx.trace_id if ctx else None
+            self.metrics.counter("shed_total").inc(trace_id=trace_id)
+            self.metrics.counter(f"shed_{decision.kind}_total").inc(
+                trace_id=trace_id)
             record_failure("serving", "shed", decision.message,
                            point="serving.admit", kind=decision.kind)
             self.overload.refresh_health(
@@ -517,10 +535,11 @@ class ScoringEngine:
                                   retry_after_s=decision.retry_after_s)
 
     def _submit(self, record: Dict[str, Any],
-                deadline_s: Optional[float] = None) -> _Request:
+                deadline_s: Optional[float] = None,
+                ctx: Optional[TraceContext] = None) -> _Request:
         with self._cv:
-            self._check_admission(deadline_s=deadline_s)
-            req = _Request(record)
+            self._check_admission(deadline_s=deadline_s, ctx=ctx)
+            req = _Request(record, ctx=ctx)
             self._queue.append(req)
             self._queued_rows += 1
             self.metrics.counter("requests_total").inc()
@@ -567,10 +586,17 @@ class ScoringEngine:
                 self._process(batch)
 
     def _process(self, batch: List[_Request]) -> None:
-        with span("serving.batch", rows=len(batch)):
-            self._process_inner(batch)
+        # the batch span adopts the FIRST linked request's trace (so the
+        # coalesced work shows up in that request's distributed trace) and
+        # records links to EVERY request it serves — one dispatch, N
+        # requests, all correlated
+        links = [r.ctx for r in batch if r.ctx is not None]
+        bctx = links[0].child() if links else None
+        with span("serving.batch", ctx=bctx, links=links, rows=len(batch)):
+            self._process_inner(batch, links=links)
 
-    def _process_inner(self, batch: List[_Request]) -> None:
+    def _process_inner(self, batch: List[_Request],
+                       links: Optional[List[TraceContext]] = None) -> None:
         with self._swap_lock:
             entry = self._entry
         records = [r.record for r in batch]
@@ -591,7 +617,9 @@ class ScoringEngine:
                     before = trace_count()
                     maybe_inject("serving.batch",
                                  key=int(self.metrics.counter("batches_total").value))
-                    with span("serving.execute", rows=len(records)):
+                    with span("serving.execute",
+                              ctx=links[0].child() if links else None,
+                              links=links, rows=len(records)):
                         results = run_with_deadline(
                             self._score_compiled, self.batch_deadline_s,
                             entry, records,
@@ -761,7 +789,9 @@ class ScoringEngine:
         return out
 
     def _process_columnar(self, req: _ColumnarRequest) -> None:
-        with span("serving.batch", rows=req.rows, columnar=True):
+        links = [req.ctx] if req.ctx is not None else []
+        with span("serving.batch", ctx=links[0].child() if links else None,
+                  links=links, rows=req.rows, columnar=True):
             try:
                 self._process_columnar_inner(req)
             except BaseException as e:  # noqa: BLE001 — fail the request,
@@ -772,6 +802,7 @@ class ScoringEngine:
 
     def _process_columnar_inner(self, req: _ColumnarRequest) -> None:
         from .wire import concat_result_arrays
+        links = [req.ctx] if req.ctx is not None else []
         with self._swap_lock:
             entry = self._entry
         chunks: List[Dict[str, Any]] = []
@@ -793,7 +824,10 @@ class ScoringEngine:
                             "serving.batch",
                             key=int(self.metrics.counter(
                                 "batches_total").value))
-                        with span("serving.execute", rows=hi - lo,
+                        with span("serving.execute",
+                                  ctx=(links[0].child() if links
+                                       else None),
+                                  links=links, rows=hi - lo,
                                   columnar=True):
                             arrays = run_with_deadline(
                                 self._score_columns_compiled,

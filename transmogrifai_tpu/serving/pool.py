@@ -58,19 +58,51 @@ def _fmt(v: float) -> str:
     return f"{v:.6g}"
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus label-value escaping: backslash first, then quote and
+    newline — a worker_id (or any label) containing ``"`` or ``\\``
+    survives the text round-trip instead of corrupting the exposition."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _with_worker_label(labels: str, worker_id: str) -> str:
     """``{a="b"}`` or ``""`` → same labels plus ``worker_id``."""
-    tag = f'worker_id="{worker_id}"'
+    tag = f'worker_id="{_escape_label_value(worker_id)}"'
     if not labels:
         return "{" + tag + "}"
     inner = labels[1:-1].strip()
     return "{" + (f"{tag},{inner}" if inner else tag) + "}"
 
 
+def _find_label_close(line: str, brace: int) -> int:
+    """Index of the ``}`` closing the label set opened at ``brace``,
+    honouring quoted values with ``\\"``/``\\\\`` escapes (a value may
+    contain ``}``); -1 when unterminated."""
+    i = brace + 1
+    in_quote = False
+    while i < len(line):
+        ch = line[i]
+        if in_quote:
+            if ch == "\\":
+                i += 2
+                continue
+            if ch == '"':
+                in_quote = False
+        elif ch == '"':
+            in_quote = True
+        elif ch == "}":
+            return i
+        i += 1
+    return -1
+
+
 def _parse_exposition(text: str):
     """Prometheus text exposition → ordered ``{family: {"type", "help",
-    "samples": [(sample_name, labels, value)]}}``.  Summary ``_sum`` /
-    ``_count`` samples resolve to their base family."""
+    "samples": [(sample_name, labels, value, exemplar)]}}``.  Summary
+    ``_sum`` / ``_count`` samples resolve to their base family.
+    ``exemplar`` is the verbatim OpenMetrics suffix (`` # {...} v``) or
+    ``""`` — the merge re-emits it so trace links survive aggregation."""
     families: Dict[str, Dict[str, Any]] = {}
     order: List[str] = []
 
@@ -96,10 +128,19 @@ def _parse_exposition(text: str):
             continue
         if line.startswith("#"):
             continue
+        # split off an OpenMetrics exemplar (`value # {labels} exval`)
+        # BEFORE locating the label braces: the exemplar carries its own
+        # brace pair that a naive rfind("}") would mistake for the end of
+        # the sample's label set
+        exemplar = ""
+        ex_at = line.find(" # {")
+        if ex_at >= 0:
+            exemplar = line[ex_at + 1:]
+            line = line[:ex_at].rstrip()
         brace = line.find("{")
         if brace >= 0:
-            close = line.rfind("}")
-            if close < brace:
+            close = _find_label_close(line, brace)
+            if close < 0:
                 continue  # malformed sample: skip, don't fail the scrape
             sample_name = line[:brace]
             labels = line[brace:close + 1]
@@ -117,7 +158,7 @@ def _parse_exposition(text: str):
                     and families[base[:-len(suffix)]]["type"] == "summary":
                 base = base[:-len(suffix)]
                 break
-        fam(base)["samples"].append((sample_name, labels, value))
+        fam(base)["samples"].append((sample_name, labels, value, exemplar))
     return families, order
 
 
@@ -156,21 +197,25 @@ def merge_worker_metrics(worker_texts: List[Tuple[str, str]]) -> str:
         if help_:
             lines.append(f"# HELP {name} {help_}")
         lines.append(f"# TYPE {name} {type_}")
-        # aggregate per (sample_name, labels) across workers
+        # aggregate per (sample_name, labels) across workers; exemplars
+        # can't be summed, so the aggregate sample carries the last
+        # non-empty one seen (a trace link survives the merge)
         agg: Dict[Tuple[str, str], float] = {}
+        agg_ex: Dict[Tuple[str, str], str] = {}
         agg_order: List[Tuple[str, str]] = []
         per_worker: List[str] = []
         for wid, families, _o in parsed:
             f = families.get(name)
             if f is None:
                 continue
-            for sample_name, labels, value in f["samples"]:
+            for sample_name, labels, value, exemplar in f["samples"]:
                 is_quantile = type_ == "summary" and not (
                     sample_name.endswith("_sum")
                     or sample_name.endswith("_count"))
+                ex_suffix = f" {exemplar}" if exemplar else ""
                 per_worker.append(
                     f"{sample_name}{_with_worker_label(labels, wid)} "
-                    f"{_fmt(value)}")
+                    f"{_fmt(value)}{ex_suffix}")
                 if is_quantile:
                     continue  # no cross-worker quantile merge
                 key = (sample_name, labels)
@@ -181,9 +226,13 @@ def merge_worker_metrics(worker_texts: List[Tuple[str, str]]) -> str:
                     agg[key] = max(agg[key], value)
                 else:
                     agg[key] += value
+                if exemplar:
+                    agg_ex[key] = exemplar
         for sample_name, labels in agg_order:
-            lines.append(f"{sample_name}{labels} "
-                         f"{_fmt(agg[(sample_name, labels)])}")
+            key = (sample_name, labels)
+            ex = agg_ex.get(key, "")
+            lines.append(f"{sample_name}{labels} {_fmt(agg[key])}"
+                         f"{' ' + ex if ex else ''}")
         lines.extend(per_worker)
     return "\n".join(lines) + "\n"
 
@@ -203,7 +252,10 @@ def worker_main(config_path: str) -> int:
     """One pool worker: full engine + continuous batcher, a
     ``SO_REUSEPORT`` traffic server on the shared port and a private admin
     server on an ephemeral port, draining cleanly on SIGTERM."""
+    import contextlib
+
     from ..checkpoint import preemption_guard, shutdown_requested
+    from ..telemetry import TraceContext, Tracer, use_tracer
     from .overload import OverloadConfig
     from .server import ScoringHTTPServer
     from .engine import ScoringEngine
@@ -213,7 +265,21 @@ def worker_main(config_path: str) -> int:
     worker_id = str(cfg["workerId"])
     overload = (OverloadConfig(**cfg["overload"])
                 if cfg.get("overload") else None)
-    with preemption_guard("serve-worker"):
+    # distributed tracing (opt-in via traceDir): the worker records every
+    # request/batch span into its own tracer, seeded from the parent's
+    # TRANSMOGRIFAI_TRACEPARENT when the pool exported one, and writes
+    # trace-worker-<id>.json on drain — `trace-merge` (and the pool's
+    # /traces endpoint) assemble the per-worker files into one timeline
+    trace_dir = cfg.get("traceDir")
+    tracer = None
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+        tracer = Tracer(run_name=f"serve-worker-{worker_id}",
+                        parent=TraceContext.from_env(),
+                        worker_id=worker_id)
+    with preemption_guard("serve-worker"), \
+            (use_tracer(tracer) if tracer is not None
+             else contextlib.nullcontext()):
         engine = ScoringEngine(
             cfg["modelLocation"],
             max_batch=int(cfg.get("maxBatch", 64)),
@@ -249,6 +315,12 @@ def worker_main(config_path: str) -> int:
             traffic.server_close()
             admin.shutdown()
             admin.server_close()
+            if tracer is not None:
+                try:
+                    tracer.export_chrome_trace(os.path.join(
+                        trace_dir, f"trace-worker-{worker_id}.json"))
+                except OSError:
+                    pass  # trace export must not fail the drain
     return 0
 
 
@@ -292,7 +364,8 @@ class ServingPool:
                  health_poll_s: float = 1.0,
                  health_probes_fatal: int = 3,
                  worker_boot_timeout_s: float = 180.0,
-                 max_restarts: int = 20):
+                 max_restarts: int = 20,
+                 trace_dir: Optional[str] = None):
         if workers < 1:
             raise ValueError("workers must be >= 1")
         self.model_location = model_location
@@ -308,6 +381,9 @@ class ServingPool:
         self.run_dir = run_dir or tempfile.mkdtemp(
             prefix="transmogrifai-pool-")
         os.makedirs(self.run_dir, exist_ok=True)
+        self.trace_dir = trace_dir
+        if self.trace_dir:
+            os.makedirs(self.trace_dir, exist_ok=True)
         self._stopping = False
         self._lock = threading.Lock()
         self._restarts_total = 0
@@ -318,7 +394,8 @@ class ServingPool:
             "requestDeadlineS": request_deadline_s,
             "reloadPollS": float(reload_poll_s),
             "overload": dict(overload) if overload else None,
-            "wireFormat": wire_format, "runDir": self.run_dir}
+            "wireFormat": wire_format, "runDir": self.run_dir,
+            "traceDir": self.trace_dir}
         self.slots = [self._make_slot(i) for i in range(self.workers)]
         self._supervisor: Optional[threading.Thread] = None
 
@@ -343,6 +420,12 @@ class ServingPool:
         root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        # seed the worker's root span from the pool's ambient trace so
+        # worker-side spans land on the same trace_id as the spawner
+        from ..telemetry import TRACEPARENT_ENV, current_trace_context
+        ctx = current_trace_context()
+        if ctx is not None:
+            env[TRACEPARENT_ENV] = ctx.child().to_traceparent()
         log = open(slot.log_path, "ab")
         try:
             # own session: SIGTERM/SIGKILL hit exactly this worker, and a
@@ -588,6 +671,23 @@ def _make_admin_server(pool: ServingPool, host: str, port: int):
                     code = 200 if st["alive"] > 0 else 503
                 self._reply(code, json.dumps(st).encode(),
                             "application/json")
+            elif self.path == "/traces":
+                traces = []
+                if pool.trace_dir and os.path.isdir(pool.trace_dir):
+                    for name in sorted(os.listdir(pool.trace_dir)):
+                        if not (name.startswith("trace-")
+                                and name.endswith(".json")):
+                            continue
+                        p = os.path.join(pool.trace_dir, name)
+                        try:
+                            st_ = os.stat(p)
+                        except OSError:
+                            continue
+                        traces.append({"name": name, "sizeBytes": st_.st_size,
+                                       "mtimeS": st_.st_mtime})
+                self._reply(200, json.dumps(
+                    {"traceDir": pool.trace_dir,
+                     "traces": traces}).encode(), "application/json")
             else:
                 self._reply(404, json.dumps(
                     {"error": f"unknown path {self.path}"}).encode(),
@@ -607,7 +707,8 @@ def pool_serve_main(model_location: str, *, workers: int,
                     request_deadline_s: Optional[float] = 30.0,
                     reload_poll_s: float = 10.0,
                     overload: Optional[Dict[str, Any]] = None,
-                    wire_format: str = "auto") -> int:
+                    wire_format: str = "auto",
+                    trace_dir: Optional[str] = None) -> int:
     """Blocking entry point for ``serve --workers N``: run the pool until
     SIGTERM/SIGINT, then drain every worker and exit 0."""
     from ..checkpoint import preemption_guard, shutdown_requested
@@ -617,7 +718,7 @@ def pool_serve_main(model_location: str, *, workers: int,
             max_batch=max_batch, queue_bound=queue_bound,
             request_deadline_s=request_deadline_s,
             reload_poll_s=reload_poll_s, overload=overload,
-            wire_format=wire_format).start()
+            wire_format=wire_format, trace_dir=trace_dir).start()
         admin = _make_admin_server(pool, host, admin_port)
         threading.Thread(target=admin.serve_forever, name="pool-admin",
                          daemon=True).start()

@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..checkpoint import preemption_guard, shutdown_requested
 from ..resilience import CircuitBreaker, WatchdogTimeout
+from ..telemetry import TraceContext, span
 from . import wire
 from .engine import (DeadlineExceeded, EngineClosed, OverloadedError,
                      ScoringEngine)
@@ -67,11 +68,18 @@ def render_metrics(engine: ScoringEngine) -> str:
     s = engine.stats()
     lines: List[str] = []
 
-    def counter(name: str, value, help_: str) -> None:
+    def _exemplar_suffix(ex) -> str:
+        """OpenMetrics exemplar: `` # {trace_id="..."} value`` appended to
+        a sample line — Prometheus/Grafana link the sample to the trace."""
+        if not ex:
+            return ""
+        return f' # {{trace_id="{ex["traceId"]}"}} {ex["value"]:.6g}'
+
+    def counter(name: str, value, help_: str, exemplar=None) -> None:
         full = f"{_METRIC_PREFIX}_{name}"
         lines.append(f"# HELP {full} {help_}")
         lines.append(f"# TYPE {full} counter")
-        lines.append(f"{full} {value}")
+        lines.append(f"{full} {value}{_exemplar_suffix(exemplar)}")
 
     def gauge(name: str, value, help_: str) -> None:
         full = f"{_METRIC_PREFIX}_{name}"
@@ -87,7 +95,8 @@ def render_metrics(engine: ScoringEngine) -> str:
     counter("errors_total", c.get("errors_total", 0),
             "Records that failed to score")
     counter("shed_total", c.get("shed_total", 0),
-            "Requests shed by admission control (HTTP 429)")
+            "Requests shed by admission control (HTTP 429)",
+            exemplar=engine.metrics.counter("shed_total").exemplar())
     counter("batches_total", c.get("batches_total", 0),
             "Coalesced micro-batches dispatched")
     counter("batch_rows_total", c.get("batch_rows_total", 0),
@@ -203,10 +212,13 @@ def render_metrics(engine: ScoringEngine) -> str:
           "Queue slots currently granted by the adaptive AIMD limit "
           "(queue_bound is its ceiling)")
     counter("shed_limit_total", c.get("shed_limit_total", 0),
-            "Requests shed because the queue passed the admission limit")
+            "Requests shed because the queue passed the admission limit",
+            exemplar=engine.metrics.counter("shed_limit_total").exemplar())
     counter("shed_deadline_total", c.get("shed_deadline_total", 0),
             "Requests shed because the queue wait would blow their "
-            "deadline")
+            "deadline",
+            exemplar=engine.metrics.counter(
+                "shed_deadline_total").exemplar())
     counter("brownout_sheds_total", c.get("brownout_sheds_total", 0),
             "Batch-observer runs skipped while in BROWNOUT")
     counter("health_transitions_total", c.get("health_transitions_total", 0),
@@ -237,19 +249,26 @@ def render_metrics(engine: ScoringEngine) -> str:
     lines.append(f"# TYPE {_METRIC_PREFIX}_model_info gauge")
     lines.append(f'{_METRIC_PREFIX}_model_info'
                  f'{{version="{s["model_version"]}"}} 1')
-    for hist_name, snap in (("request_latency_seconds",
-                             s["request_latency"]),
-                            ("batch_latency_seconds", s["batch_latency"])):
+    for hist_name, hist, snap in (
+            ("request_latency_seconds", engine.request_latency,
+             s["request_latency"]),
+            ("batch_latency_seconds", engine.batch_latency,
+             s["batch_latency"])):
         full = f"{_METRIC_PREFIX}_{hist_name}"
         lines.append(f"# HELP {full} End-to-end latency summary")
         lines.append(f"# TYPE {full} summary")
+        # the slowest-bucket exemplar rides the highest quantile: a p99
+        # spike in Prometheus links straight to a concrete request trace
+        slow_ex = hist.exemplar(slowest=True)
         for q in ("0.5", "0.95", "0.99"):
             key = "p" + q.replace("0.", "").ljust(2, "0")
             v = snap.get(key)
             if v is not None:
-                lines.append(f'{full}{{quantile="{q}"}} {v:.6g}')
+                suffix = _exemplar_suffix(slow_ex) if q == "0.99" else ""
+                lines.append(f'{full}{{quantile="{q}"}} {v:.6g}{suffix}')
         lines.append(f"{full}_sum {snap['sum']:.6g}")
-        lines.append(f"{full}_count {snap['count']}")
+        lines.append(f"{full}_count {snap['count']}"
+                     f"{_exemplar_suffix(hist.exemplar())}")
     return "\n".join(lines) + "\n"
 
 
@@ -261,6 +280,17 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: D102
         pass
 
+    def _request_context(self) -> TraceContext:
+        """The per-request W3C position: continue the client's trace when
+        it sent a valid ``traceparent``, start a fresh one otherwise.  A
+        malformed or oversized header parses to None and falls through to
+        a fresh context — never an error."""
+        parent = TraceContext.parse(self.headers.get("traceparent"))
+        ctx = parent.child() if parent else TraceContext.new()
+        self._req_ctx = ctx
+        self._req_span = None
+        return ctx
+
     def _reply(self, code: int, payload: Any,
                content_type: str = "application/json",
                extra_headers: Optional[Dict[str, str]] = None) -> None:
@@ -268,9 +298,20 @@ class _Handler(BaseHTTPRequestHandler):
                 else json.dumps(payload).encode()
                 if content_type == "application/json"
                 else str(payload).encode())
+        ctx: Optional[TraceContext] = getattr(self, "_req_ctx", None)
+        if ctx is None:
+            ctx = self._request_context()
+        sp = getattr(self, "_req_span", None)
+        if sp is not None:
+            sp.attrs.setdefault("httpStatus", code)
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        # EVERY response — including 400/415/429/503/504 sheds — carries
+        # the request's trace position, so a client can correlate any
+        # outcome with the server-side trace
+        self.send_header("traceparent", ctx.to_traceparent())
+        self.send_header("X-Request-Id", ctx.trace_id)
         for k, v in (extra_headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -280,6 +321,7 @@ class _Handler(BaseHTTPRequestHandler):
             pass  # client went away; nothing to salvage
 
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        self._request_context()
         engine = self.server.engine
         if self.path == "/healthz":
             # liveness, not readiness: a draining process is still alive
@@ -325,6 +367,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self) -> None:  # noqa: N802
+        ctx = self._request_context()
         if self.path != "/v1/score":
             self._reply(404, {"error": f"unknown path {self.path}"})
             return
@@ -334,35 +377,52 @@ class _Handler(BaseHTTPRequestHandler):
         ctype = (self.headers.get("Content-Type") or
                  "").split(";")[0].strip().lower()
         timeout_s = self.server.request_deadline_s
-        if ctype == wire.CONTENT_TYPE:
-            if self.server.wire_format == "json":
-                self._reply(415, {"error": "columnar wire format is "
-                                           "disabled on this server "
-                                           "(wire_format=json); send JSON"})
-                return
-            try:
-                batch = wire.decode_batch(body, engine.raw_features)
-                arrays, version = engine.score_columns(batch, timeout_s)
-                out = wire.encode_result_arrays(arrays, len(batch))
-                self._reply(200, out, content_type=wire.CONTENT_TYPE,
-                            extra_headers={"X-Model-Version": version})
-            except wire.WireFormatError as e:
-                # malformed body = client bug, never a worker crash: a
-                # structured 400 names exactly what failed to parse
-                self._reply(400, {"error": "malformed columnar body",
-                                  "detail": str(e)})
-            except OverloadedError as e:
-                self._reply(429, {"error": str(e)},
-                            extra_headers={"Retry-After": _retry_after(
-                                getattr(e, "retry_after_s", 1.0))})
-            except (DeadlineExceeded, WatchdogTimeout) as e:
-                self._reply(504, {"error": str(e)})
-            except EngineClosed as e:
-                self._reply(503, {"error": str(e)},
-                            extra_headers={"Retry-After": "30"})
-            except Exception as e:  # noqa: BLE001 — see JSON path below
-                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+        columnar = ctype == wire.CONTENT_TYPE
+        # the request span is pinned to the request's W3C position (ctx),
+        # so the engine's batch span — which links back to ctx — and any
+        # supervised child this request triggers share its trace id
+        with span("serving.request", ctx=ctx,
+                  wire="columnar" if columnar else "json") as req_sp:
+            self._req_span = req_sp
+            if columnar:
+                self._post_columnar(engine, body, timeout_s, ctx)
+            else:
+                self._post_json(engine, body, timeout_s, ctx)
+
+    def _post_columnar(self, engine: ScoringEngine, body: bytes,
+                       timeout_s: Optional[float],
+                       ctx: TraceContext) -> None:
+        if self.server.wire_format == "json":
+            self._reply(415, {"error": "columnar wire format is "
+                                       "disabled on this server "
+                                       "(wire_format=json); send JSON"})
             return
+        try:
+            batch = wire.decode_batch(body, engine.raw_features)
+            arrays, version = engine.score_columns(batch, timeout_s,
+                                                   ctx=ctx)
+            out = wire.encode_result_arrays(arrays, len(batch))
+            self._reply(200, out, content_type=wire.CONTENT_TYPE,
+                        extra_headers={"X-Model-Version": version})
+        except wire.WireFormatError as e:
+            # malformed body = client bug, never a worker crash: a
+            # structured 400 names exactly what failed to parse
+            self._reply(400, {"error": "malformed columnar body",
+                              "detail": str(e)})
+        except OverloadedError as e:
+            self._reply(429, {"error": str(e)},
+                        extra_headers={"Retry-After": _retry_after(
+                            getattr(e, "retry_after_s", 1.0))})
+        except (DeadlineExceeded, WatchdogTimeout) as e:
+            self._reply(504, {"error": str(e)})
+        except EngineClosed as e:
+            self._reply(503, {"error": str(e)},
+                        extra_headers={"Retry-After": "30"})
+        except Exception as e:  # noqa: BLE001 — see JSON path below
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _post_json(self, engine: ScoringEngine, body: bytes,
+                   timeout_s: Optional[float], ctx: TraceContext) -> None:
         try:
             payload = json.loads(body or b"null")
         except (ValueError, TypeError) as e:
@@ -370,13 +430,14 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             if isinstance(payload, dict):
-                result, version = engine.score_record(payload, timeout_s)
+                result, version = engine.score_record(payload, timeout_s,
+                                                      ctx=ctx)
                 self._reply(200, {"modelVersion": version, "result": result})
             elif isinstance(payload, list):
                 if not all(isinstance(r, dict) for r in payload):
                     self._reply(400, {"error": "list items must be objects"})
                     return
-                pairs = engine.score_records(payload, timeout_s)
+                pairs = engine.score_records(payload, timeout_s, ctx=ctx)
                 versions = {v for _, v in pairs}
                 out: Dict[str, Any] = {
                     "modelVersion": pairs[0][1] if pairs else

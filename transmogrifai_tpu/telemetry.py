@@ -37,23 +37,98 @@ chaos-test failure points at the exact span.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import itertools
 import json
+import os
+import re
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Union
+from typing import Any, Callable, Dict, Iterable, List, Optional, Union
 
 from .profiling import (LatencyHistogram, compile_stats, host_link_bytes,
                         racing_stats)
 
 __all__ = [
-    "Span", "Tracer", "use_tracer", "active_tracer", "span", "event",
-    "current_span_id", "Counter", "Gauge", "MetricsRegistry", "REGISTRY",
-    "LatencyHistogram", "telemetry_summary", "write_telemetry_summary",
-    "render_trace_summary", "load_trace",
+    "Span", "Tracer", "TraceContext", "TRACEPARENT_ENV", "use_tracer",
+    "active_tracer", "span", "event", "current_span_id",
+    "current_trace_context", "Counter", "Gauge", "MetricsRegistry",
+    "REGISTRY", "LatencyHistogram", "telemetry_summary",
+    "write_telemetry_summary", "render_trace_summary", "load_trace",
+    "merge_traces",
 ]
+
+
+# --------------------------------------------------------------------------
+# W3C trace context
+# --------------------------------------------------------------------------
+
+#: Env var carrying the parent ``traceparent`` into supervised children
+#: (probe subprocesses, chaos children, pool workers, lifecycle retrains).
+TRACEPARENT_ENV = "TRANSMOGRIFAI_TRACEPARENT"
+
+_TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+#: Hard cap on accepted header length — anything longer is dropped without
+#: even running the regex (oversized headers must never cost a 500).
+_TRACEPARENT_MAX_LEN = 64
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """A W3C trace-context position: the 128-bit ``trace_id`` every span in
+    one distributed request shares, plus the 64-bit ``span_id`` of the
+    current position in the tree (both lowercase hex).  Frozen — deriving a
+    child position returns a new instance."""
+
+    trace_id: str
+    span_id: str
+    flags: int = 1          # 01 = sampled; we always record
+
+    @staticmethod
+    def new() -> "TraceContext":
+        """A fresh root context (random 128-bit trace / 64-bit span id)."""
+        return TraceContext(trace_id=os.urandom(16).hex(),
+                            span_id=os.urandom(8).hex())
+
+    def child(self) -> "TraceContext":
+        """Same trace, fresh span id — the position handed to a callee."""
+        return TraceContext(trace_id=self.trace_id,
+                            span_id=os.urandom(8).hex(),
+                            flags=self.flags)
+
+    def to_traceparent(self) -> str:
+        """Serialize as a W3C ``traceparent`` header value."""
+        return f"00-{self.trace_id}-{self.span_id}-{self.flags:02x}"
+
+    @staticmethod
+    def parse(header: Optional[str]) -> Optional["TraceContext"]:
+        """Strict W3C parse.  Malformed, oversized, wrong-version or
+        all-zero-id headers return None — callers fall back to a fresh
+        context; a bad header must never break a request."""
+        if not header or not isinstance(header, str):
+            return None
+        header = header.strip()
+        if len(header) > _TRACEPARENT_MAX_LEN:
+            return None
+        # no .lower(): the W3C grammar is lowercase-only, and uppercase hex
+        # is specified as invalid rather than normalizable
+        m = _TRACEPARENT_RE.match(header)
+        if m is None:
+            return None
+        trace_id, span_id, flags = m.group(1), m.group(2), m.group(3)
+        if trace_id == "0" * 32 or span_id == "0" * 16:
+            return None
+        return TraceContext(trace_id=trace_id, span_id=span_id,
+                            flags=int(flags, 16))
+
+    @staticmethod
+    def from_env() -> Optional["TraceContext"]:
+        """Parse the context a parent process exported for us, if any."""
+        return TraceContext.parse(os.environ.get(TRACEPARENT_ENV))
 
 
 # --------------------------------------------------------------------------
@@ -73,20 +148,31 @@ class Span:
     attrs: Dict[str, Any] = field(default_factory=dict)
     thread: int = 0
     start_wall_s: float = 0.0   # absolute wall clock at span start
+    trace_id: str = ""          # W3C 128-bit trace id (hex)
+    w3c_id: str = ""            # W3C 64-bit span id (hex)
+    links: List[Dict[str, str]] = field(default_factory=list)
 
     @property
     def duration_s(self) -> float:
         return (self.end_s if self.end_s is not None else self.start_s) \
             - self.start_s
 
+    def context(self) -> TraceContext:
+        """This span's position as a propagatable TraceContext."""
+        return TraceContext(trace_id=self.trace_id, span_id=self.w3c_id)
+
     def to_json(self) -> Dict[str, Any]:
-        return {"name": self.name, "spanId": self.span_id,
-                "parentId": self.parent_id,
-                "startS": round(self.start_s, 6),
-                "durationS": round(self.duration_s, 6),
-                "status": self.status, "attrs": dict(self.attrs),
-                "thread": self.thread,
-                "startWallS": round(self.start_wall_s, 3)}
+        out = {"name": self.name, "spanId": self.span_id,
+               "parentId": self.parent_id,
+               "startS": round(self.start_s, 6),
+               "durationS": round(self.duration_s, 6),
+               "status": self.status, "attrs": dict(self.attrs),
+               "thread": self.thread,
+               "startWallS": round(self.start_wall_s, 3),
+               "traceId": self.trace_id, "w3cSpanId": self.w3c_id}
+        if self.links:
+            out["links"] = [dict(l) for l in self.links]
+        return out
 
 
 class Tracer:
@@ -94,15 +180,85 @@ class Tracer:
     rule; all mutation happens under one lock, so concurrent serving/
     validator threads can record freely."""
 
-    def __init__(self, run_name: str = "run"):
+    #: Default span ring-buffer bound: a serving process records forever,
+    #: so the completed-span store must not grow without bound.
+    DEFAULT_MAX_SPANS = 65536
+
+    def __init__(self, run_name: str = "run", *,
+                 max_spans: Optional[int] = None,
+                 parent: Optional[TraceContext] = None,
+                 worker_id: Optional[str] = None):
         self.run_name = run_name
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
-        self._spans: List[Span] = []          # completed, in finish order
+        # completed spans, finish order; bounded ring (oldest dropped first)
+        self._spans: "collections.deque[Span]" = collections.deque()
         self._stacks: Dict[int, List[Span]] = {}   # open spans per thread
         self._install_thread: Optional[int] = None
         self.t0_mono = time.monotonic()
         self.t0_wall = time.time()
+        if max_spans is None:
+            try:
+                max_spans = int(os.environ.get(
+                    "TRANSMOGRIFAI_TRACE_MAX_SPANS", self.DEFAULT_MAX_SPANS))
+            except ValueError:
+                max_spans = self.DEFAULT_MAX_SPANS
+        self.max_spans = max(1, max_spans)
+        self._dropped = 0
+        self._drop_noted = False
+        self.parent_ctx = parent
+        self.worker_id = worker_id
+        # every span this tracer records shares one trace id unless an
+        # explicit per-request ctx overrides it
+        self.trace_id = parent.trace_id if parent else os.urandom(16).hex()
+        self._root_w3c = parent.span_id if parent else os.urandom(8).hex()
+
+    def root_context(self) -> TraceContext:
+        """The tracer-level context new work inherits when no request
+        context is active (the parent ctx we were seeded with, else the
+        tracer's own root position)."""
+        if self.parent_ctx is not None:
+            return self.parent_ctx
+        return TraceContext(trace_id=self.trace_id, span_id=self._root_w3c)
+
+    @property
+    def spans_dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def _record_locked(self, sp: Span) -> int:
+        """Append a completed span, evicting the oldest past the bound.
+        Caller holds ``self._lock``; returns how many spans were evicted
+        (the drop NOTE must be emitted after the lock is released —
+        ``record_failure`` re-enters this tracer via ``current_span_id``)."""
+        self._spans.append(sp)
+        dropped = 0
+        while len(self._spans) > self.max_spans:
+            self._spans.popleft()
+            dropped += 1
+        self._dropped += dropped
+        return dropped
+
+    def _note_drops(self, dropped: int) -> None:
+        """Post-lock bookkeeping for evicted spans: bump the global drop
+        counter and, on the FIRST drop this tracer sees, record a degraded
+        note so operators learn the trace is now a ring, not a log."""
+        if dropped <= 0:
+            return
+        REGISTRY.counter("telemetry.spans_dropped_total").inc(dropped)
+        with self._lock:
+            first = not self._drop_noted
+            self._drop_noted = True
+        if first:
+            try:
+                # lazy import — telemetry must stay import-light here
+                from .resilience import record_failure
+                record_failure(
+                    "telemetry", "degraded", "span ring buffer full",
+                    point="tracer.max_spans", run_name=self.run_name,
+                    max_spans=self.max_spans)
+            except Exception:  # noqa: BLE001 — never fail a span close
+                pass
 
     # -- parenting ---------------------------------------------------------
     def _parent(self, tid: int) -> Optional[Span]:
@@ -127,7 +283,13 @@ class Tracer:
 
     # -- recording ---------------------------------------------------------
     @contextlib.contextmanager
-    def span(self, name: str, **attrs):
+    def span(self, name: str, *, ctx: Optional[TraceContext] = None,
+             links: Optional[List[TraceContext]] = None, **attrs):
+        """Record one span.  ``ctx`` pins the span to an explicit W3C trace
+        position (request-scoped tracing across processes); ``links`` record
+        causally-related-but-not-parent contexts (a batch span links every
+        request it coalesced).  Without ``ctx`` the span rides the tracer's
+        own trace id with a fresh 64-bit position."""
         tid = threading.get_ident()
         now = time.monotonic() - self.t0_mono
         with self._lock:
@@ -135,7 +297,11 @@ class Tracer:
             sp = Span(name=name, span_id=f"s{next(self._ids)}",
                       parent_id=parent.span_id if parent else None,
                       start_s=now, attrs=dict(attrs), thread=tid,
-                      start_wall_s=time.time())
+                      start_wall_s=time.time(),
+                      trace_id=ctx.trace_id if ctx else self.trace_id,
+                      w3c_id=ctx.span_id if ctx else os.urandom(8).hex(),
+                      links=[{"traceId": l.trace_id, "spanId": l.span_id}
+                             for l in (links or [])])
             self._stacks.setdefault(tid, []).append(sp)
         try:
             yield sp
@@ -151,9 +317,11 @@ class Tracer:
                     if stack[i] is sp:      # robust to interleaved exits
                         del stack[i]
                         break
-                self._spans.append(sp)
+                dropped = self._record_locked(sp)
+            self._note_drops(dropped)
 
-    def event(self, name: str, **attrs) -> Span:
+    def event(self, name: str, *, ctx: Optional[TraceContext] = None,
+              **attrs) -> Span:
         """A zero-duration marker span (e.g. a racing prune decision)."""
         now = time.monotonic() - self.t0_mono
         tid = threading.get_ident()
@@ -162,9 +330,12 @@ class Tracer:
             sp = Span(name=name, span_id=f"s{next(self._ids)}",
                       parent_id=parent.span_id if parent else None,
                       start_s=now, end_s=now, attrs=dict(attrs), thread=tid,
-                      start_wall_s=time.time())
-            self._spans.append(sp)
-            return sp
+                      start_wall_s=time.time(),
+                      trace_id=ctx.trace_id if ctx else self.trace_id,
+                      w3c_id=ctx.span_id if ctx else os.urandom(8).hex())
+            dropped = self._record_locked(sp)
+        self._note_drops(dropped)
+        return sp
 
     @property
     def spans(self) -> List[Span]:
@@ -179,25 +350,51 @@ class Tracer:
     # -- export ------------------------------------------------------------
     def to_json(self) -> Dict[str, Any]:
         return {"runName": self.run_name, "t0WallS": round(self.t0_wall, 3),
+                "traceId": self.trace_id, "pid": os.getpid(),
+                "workerId": self.worker_id,
+                "spansDropped": self.spans_dropped,
                 "spans": [s.to_json() for s in self.spans]}
 
     def export_chrome_trace(self, path: str) -> str:
         """Write the trace in Chrome trace-event JSON ("X" complete events,
         microsecond timestamps) — loadable in Perfetto / chrome://tracing.
         Span ids and parent ids ride in ``args`` so the span tree survives
-        the round trip (``load_trace`` reads them back)."""
-        events = []
+        the round trip (``load_trace`` reads them back).  Alongside the span
+        events the export carries ``process_name`` metadata and a
+        ``clock_sync`` event anchored at ``t0_wall`` — two independently
+        exported traces align on a shared wall-clock timeline in Perfetto
+        even without ``merge_traces``."""
+        pid = os.getpid()
+        proc_label = self.run_name if self.worker_id is None \
+            else f"{self.run_name} [worker {self.worker_id}]"
+        events: List[Dict[str, Any]] = [
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": proc_label}},
+            # wall-clock anchor: issue_ts is the absolute wall time (µs) at
+            # the tracer epoch (ts=0), so cross-process merges re-align by
+            # shifting each file's events onto one wall timeline
+            {"name": "clock_sync", "ph": "c", "pid": pid, "tid": 0,
+             "ts": 0.0,
+             "args": {"sync_id": self.trace_id,
+                      "issue_ts": round(self.t0_wall * 1e6, 1)}},
+        ]
         for s in self.spans:
+            args = {"spanId": s.span_id, "parentId": s.parent_id,
+                    "status": s.status, "traceId": s.trace_id,
+                    "w3cSpanId": s.w3c_id, **s.attrs}
+            if s.links:
+                args["links"] = [dict(l) for l in s.links]
             events.append({
                 "name": s.name, "cat": s.name.split(".", 1)[0], "ph": "X",
                 "ts": round(s.start_s * 1e6, 1),
                 "dur": round(max(s.duration_s, 0.0) * 1e6, 1),
-                "pid": 0, "tid": s.thread,
-                "args": {"spanId": s.span_id, "parentId": s.parent_id,
-                         "status": s.status, **s.attrs}})
+                "pid": pid, "tid": s.thread, "args": args})
         doc = {"traceEvents": events, "displayTimeUnit": "ms",
                "otherData": {"runName": self.run_name,
-                             "t0WallS": round(self.t0_wall, 3)}}
+                             "t0WallS": round(self.t0_wall, 3),
+                             "traceId": self.trace_id, "pid": pid,
+                             "workerId": self.worker_id,
+                             "spansDropped": self.spans_dropped}}
         with open(path, "w") as fh:
             json.dump(doc, fh, default=str)
         return path
@@ -242,23 +439,25 @@ def use_tracer(tracer: Tracer):
 
 
 @contextlib.contextmanager
-def span(name: str, **attrs):
+def span(name: str, *, ctx: Optional[TraceContext] = None,
+         links: Optional[List[TraceContext]] = None, **attrs):
     """Record a span on the ambient tracer; a no-op (one attribute check)
     when tracing is off — instrumentation sites pay nothing by default."""
     tracer = active_tracer()
     if tracer is None:
         yield None
         return
-    with tracer.span(name, **attrs) as sp:
+    with tracer.span(name, ctx=ctx, links=links, **attrs) as sp:
         yield sp
 
 
-def event(name: str, **attrs) -> Optional[Span]:
+def event(name: str, *, ctx: Optional[TraceContext] = None,
+          **attrs) -> Optional[Span]:
     """Record a zero-duration marker on the ambient tracer (None when off)."""
     tracer = active_tracer()
     if tracer is None:
         return None
-    return tracer.event(name, **attrs)
+    return tracer.event(name, ctx=ctx, **attrs)
 
 
 def current_span_id() -> Optional[str]:
@@ -270,23 +469,47 @@ def current_span_id() -> Optional[str]:
     return tracer.current_span_id()
 
 
+def current_trace_context() -> Optional[TraceContext]:
+    """The W3C position to propagate to a callee or child process right
+    now: the innermost open span's context on the ambient tracer (falling
+    back to the tracer root), else the context a parent process exported
+    via ``TRANSMOGRIFAI_TRACEPARENT``, else None."""
+    tracer = active_tracer()
+    if tracer is not None:
+        sp = tracer.current_span()
+        if sp is not None and sp.trace_id and sp.w3c_id:
+            return sp.context()
+        return tracer.root_context()
+    return TraceContext.from_env()
+
+
 # --------------------------------------------------------------------------
 # metrics registry
 # --------------------------------------------------------------------------
 
 class Counter:
-    """Monotonic thread-safe counter."""
+    """Monotonic thread-safe counter.  ``inc(trace_id=...)`` remembers the
+    last incrementing trace as an OpenMetrics exemplar (shed counters link
+    a 429 spike straight to a concrete request trace)."""
 
-    __slots__ = ("name", "_value", "_lock")
+    __slots__ = ("name", "_value", "_lock", "_exemplar")
 
     def __init__(self, name: str):
         self.name = name
         self._value = 0
         self._lock = threading.Lock()
+        self._exemplar: Optional[Dict[str, Any]] = None
 
-    def inc(self, n: Union[int, float] = 1) -> None:
+    def inc(self, n: Union[int, float] = 1,
+            trace_id: Optional[str] = None) -> None:
         with self._lock:
             self._value += n
+            if trace_id:
+                self._exemplar = {"traceId": trace_id, "value": n}
+
+    def exemplar(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return dict(self._exemplar) if self._exemplar else None
 
     @property
     def value(self) -> Union[int, float]:
@@ -517,10 +740,106 @@ def load_trace(path: str) -> List[Dict[str, Any]]:
                       "startS": float(ev.get("ts", 0.0)) / 1e6,
                       "durationS": float(ev.get("dur", 0.0)) / 1e6,
                       "status": args.get("status", "ok"),
+                      "traceId": args.get("traceId", ""),
+                      "w3cSpanId": args.get("w3cSpanId", ""),
+                      "links": args.get("links") or [],
                       "attrs": {k: v for k, v in args.items()
-                                if k not in ("spanId", "parentId",
-                                             "status")}})
+                                if k not in ("spanId", "parentId", "status",
+                                             "traceId", "w3cSpanId",
+                                             "links")}})
     return spans
+
+
+# --------------------------------------------------------------------------
+# cross-process trace assembly
+# --------------------------------------------------------------------------
+
+def merge_traces(paths: Iterable[str],
+                 out_path: Optional[str] = None) -> Dict[str, Any]:
+    """Align per-process trace exports onto one wall-clock-anchored Perfetto
+    timeline.  Accepts both export formats (chrome trace-event JSON with an
+    ``otherData.t0WallS`` anchor, and ``Tracer.to_json()`` native files).
+    The earliest ``t0WallS`` across files becomes the merged epoch; each
+    file's events are shifted by its anchor delta and its pid remapped to a
+    stable per-file index so Perfetto renders one process lane per worker
+    (labelled via ``process_name`` metadata with the worker id)."""
+    docs: List[Dict[str, Any]] = []
+    for p in paths:
+        with open(p) as fh:
+            doc = json.load(fh)
+        if not isinstance(doc, dict):
+            continue
+        if "spans" in doc:          # native Tracer.to_json() format
+            t0 = float(doc.get("t0WallS", 0.0))
+            events = []
+            for s in doc["spans"]:
+                args = {"spanId": s.get("spanId"),
+                        "parentId": s.get("parentId"),
+                        "status": s.get("status", "ok"),
+                        "traceId": s.get("traceId", ""),
+                        "w3cSpanId": s.get("w3cSpanId", ""),
+                        **(s.get("attrs") or {})}
+                if s.get("links"):
+                    args["links"] = s["links"]
+                events.append({
+                    "name": s.get("name", "?"),
+                    "cat": str(s.get("name", "?")).split(".", 1)[0],
+                    "ph": "X",
+                    "ts": round(float(s.get("startS", 0.0)) * 1e6, 1),
+                    "dur": round(
+                        max(float(s.get("durationS", 0.0)), 0.0) * 1e6, 1),
+                    "pid": int(doc.get("pid", 0)),
+                    "tid": s.get("thread", 0), "args": args})
+            other = {"runName": doc.get("runName", "run"), "t0WallS": t0,
+                     "traceId": doc.get("traceId", ""),
+                     "pid": doc.get("pid", 0),
+                     "workerId": doc.get("workerId")}
+        else:
+            events = [e for e in doc.get("traceEvents", [])
+                      if e.get("ph") == "X"]
+            other = dict(doc.get("otherData") or {})
+        docs.append({"path": p, "events": events, "other": other,
+                     "t0": float(other.get("t0WallS", 0.0) or 0.0)})
+    if not docs:
+        merged: Dict[str, Any] = {"traceEvents": [],
+                                  "displayTimeUnit": "ms",
+                                  "otherData": {"merged": True, "files": []}}
+        if out_path:
+            with open(out_path, "w") as fh:
+                json.dump(merged, fh, default=str)
+        return merged
+
+    anchor = min(d["t0"] for d in docs)
+    events: List[Dict[str, Any]] = []
+    files_meta = []
+    for idx, d in enumerate(docs):
+        shift_us = (d["t0"] - anchor) * 1e6
+        worker_id = d["other"].get("workerId")
+        run_name = d["other"].get("runName", "run")
+        label = run_name if worker_id is None \
+            else f"{run_name} [worker {worker_id}]"
+        events.append({"name": "process_name", "ph": "M", "pid": idx,
+                       "tid": 0, "args": {"name": label}})
+        events.append({"name": "clock_sync", "ph": "c", "pid": idx,
+                       "tid": 0, "ts": round(shift_us, 1),
+                       "args": {"sync_id": d["other"].get("traceId", ""),
+                                "issue_ts": round(d["t0"] * 1e6, 1)}})
+        for ev in d["events"]:
+            ev = dict(ev)
+            ev["ts"] = round(float(ev.get("ts", 0.0)) + shift_us, 1)
+            ev["pid"] = idx
+            events.append(ev)
+        files_meta.append({"path": d["path"], "runName": run_name,
+                           "workerId": worker_id,
+                           "originalPid": d["other"].get("pid"),
+                           "t0WallS": d["t0"]})
+    merged = {"traceEvents": events, "displayTimeUnit": "ms",
+              "otherData": {"merged": True, "t0WallS": anchor,
+                            "files": files_meta}}
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(merged, fh, default=str)
+    return merged
 
 
 def render_trace_summary(path: str, top_n: int = 10) -> str:

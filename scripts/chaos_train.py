@@ -292,6 +292,42 @@ def run_chaos_train(*, seed=0, probe_timeout_s=2.0, grace_s=3.0, rows=560,
     return summary
 
 
+def run_chaos_hostgroup(*, out_dir, seed=0, rows=560):
+    """Lost-host drill (ISSUE 14): drive the ci_hostgroup_smoke harness —
+    2-process group vs single-process control, SIGKILL rank 1 mid-sweep,
+    relaunch at world 1, checkpoint resume, identical winner — and fold its
+    checks into the chaos summary contract."""
+    import subprocess
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "ci_hostgroup_smoke.py")
+    env = dict(os.environ,
+               HOSTGROUP_SMOKE_ROWS=str(rows),
+               HOSTGROUP_SMOKE_SEED=str(seed))
+    os.makedirs(out_dir, exist_ok=True)
+    checks = {}
+    for phase in ("run", "validate"):
+        r = subprocess.run([sys.executable, script, phase, out_dir],
+                           env=env, capture_output=True, text=True,
+                           timeout=1200)
+        checks[f"hostgroup_{phase}_rc0"] = r.returncode == 0
+        if r.returncode != 0:
+            print(r.stdout[-4000:], file=sys.stderr)
+            print(r.stderr[-4000:], file=sys.stderr)
+            break
+    smoke_path = os.path.join(out_dir, "hostgroup_smoke.json")
+    checks["hostgroup_outage_artifact"] = False
+    if os.path.exists(smoke_path):
+        with open(smoke_path) as fh:
+            smoke = json.load(fh)
+        rec = (smoke.get("chaos") or {}).get("outageRecord")
+        checks["hostgroup_outage_artifact"] = isinstance(rec, dict)
+    summary = {"passed": all(checks.values()), "checks": checks,
+               "seed": seed, "rows": rows, "mode": "hostgroup"}
+    with open(os.path.join(out_dir, "summary.json"), "w") as fh:
+        json.dump(summary, fh, indent=2)
+    return summary
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--out-dir", required=True)
@@ -301,10 +337,18 @@ def main(argv=None):
     ap.add_argument("--rows", type=int, default=560,
                     help="sweep rows; must divide by 8 AND 7 so the mesh "
                          "forms before and after the injected device loss")
+    ap.add_argument("--mode", choices=("full", "hostgroup"), default="full",
+                    help="'full' runs the in-process supervisor drills; "
+                         "'hostgroup' runs the multi-process lost-host "
+                         "drill (real ranks, SIGKILL, relaunch, resume)")
     args = ap.parse_args(argv)
-    summary = run_chaos_train(
-        seed=args.seed, probe_timeout_s=args.probe_timeout_s,
-        grace_s=args.grace_s, rows=args.rows, out_dir=args.out_dir)
+    if args.mode == "hostgroup":
+        summary = run_chaos_hostgroup(out_dir=args.out_dir, seed=args.seed,
+                                      rows=args.rows)
+    else:
+        summary = run_chaos_train(
+            seed=args.seed, probe_timeout_s=args.probe_timeout_s,
+            grace_s=args.grace_s, rows=args.rows, out_dir=args.out_dir)
     print(json.dumps(summary, indent=2))
     if not summary["passed"]:
         failing = [k for k, ok in summary["checks"].items() if not ok]

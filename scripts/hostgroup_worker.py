@@ -1,0 +1,108 @@
+"""One rank of a host-group training smoke (launched by
+``hostgroup.launch_hosts`` — see ``ci_hostgroup_smoke.py``).
+
+Joins the host group (heartbeat, optional ``jax.distributed`` init over the
+gloo CPU collectives, init barrier), runs the deterministic two-family CV
+sweep from ``chaos_train._two_family_sweep`` with a per-rank
+``SweepCheckpoint``, and posts the winner in its done file.  A W3C
+traceparent exported by the launcher seeds this rank's tracer, so every
+rank's export shares ONE trace id and ``trace-merge`` stitches them into a
+rank-labelled timeline.
+
+Chaos knob (the lost-host drill): ``HOSTGROUP_WORKER_DIE_RANK`` makes that
+rank SIGKILL itself right after the first candidate family checkpoints
+(flushed first, so the relaunch has something to resume from) in generation
+``HOSTGROUP_WORKER_DIE_GEN`` (default 0).  Survivors abort through the done
+barrier / preemption guard and exit ``EXIT_HOST_LOST`` so the launcher
+relaunches the group at the shrunken world size; the resumed sweep replays
+the checkpointed family and must select the identical winner.
+"""
+
+import json
+import os
+import signal
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--rows", type=int, default=560)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ckpt-base", default=None,
+                    help="checkpoint root; this rank uses "
+                         "<ckpt-base>/ckpt-rank<rank> (persists across "
+                         "relaunch generations); default: <run_dir>/ckpt "
+                         "under the launcher's run dir")
+    args = ap.parse_args(argv)
+    if args.ckpt_base is None:
+        run_dir = os.environ.get("TRANSMOGRIFAI_HOSTGROUP_RUN_DIR")
+        if not run_dir:
+            ap.error("--ckpt-base is required outside a train-hosts launch "
+                     "(no TRANSMOGRIFAI_HOSTGROUP_RUN_DIR in the env)")
+        args.ckpt_base = os.path.join(run_dir, "ckpt")
+
+    # the container's sitecustomize registers an accelerator plugin; the env
+    # var alone does not stop jax picking it up — re-pin via the config knob
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from transmogrifai_tpu.checkpoint import TrainingPreempted
+    from transmogrifai_tpu.parallel import hostgroup
+    from transmogrifai_tpu.telemetry import TraceContext, Tracer, use_tracer
+
+    hg = hostgroup.maybe_init_hostgroup()
+    if hg is None:
+        raise SystemExit("hostgroup_worker must run under launch_hosts "
+                         "(TRANSMOGRIFAI_HOSTGROUP_* env missing)")
+    rank, gen = hg.rank, hg.generation
+
+    die_rank = int(os.environ.get("HOSTGROUP_WORKER_DIE_RANK", "-1"))
+    die_gen = int(os.environ.get("HOSTGROUP_WORKER_DIE_GEN", "0"))
+    if rank == die_rank and gen == die_gen:
+        from transmogrifai_tpu.checkpoint import SweepCheckpoint
+        orig = SweepCheckpoint.record_candidate
+
+        def record_then_die(self, *a, **kw):
+            orig(self, *a, **kw)
+            self.flush()   # durable: the relaunch resumes from this family
+            # no cleanup on purpose — a lost host writes no goodbye
+            os.kill(os.getpid(), signal.SIGKILL)
+
+        SweepCheckpoint.record_candidate = record_then_die
+
+    tracer = Tracer(run_name="hostgroup-sweep",
+                    parent=TraceContext.from_env(), rank=rank)
+    ckpt = os.path.join(args.ckpt_base, f"ckpt-rank{rank}")
+    try:
+        with use_tracer(tracer):
+            from chaos_train import _two_family_sweep
+            winner, params, _ = _two_family_sweep(
+                args.rows, args.seed, resume_from=ckpt)
+        # all ranks finish the sweep before any posts a result: a lost host
+        # discovered here aborts every survivor in one relaunchable group
+        hg.barrier("done")
+        hg.mark_done({"winner": winner, "params": params,
+                      "traceId": tracer.trace_id})
+        hg.close()
+    except (TrainingPreempted, hostgroup.HostLostError) as e:
+        hg.close(state="aborted")
+        print(f"rank {rank} gen {gen} aborted on peer loss: "
+              f"{type(e).__name__}", file=sys.stderr)
+        raise SystemExit(hostgroup.EXIT_HOST_LOST)
+    finally:
+        tracer.export_chrome_trace(os.path.join(
+            hg.run_dir, f"trace-rank{rank}-gen{gen}.json"))
+    print(json.dumps({"rank": rank, "generation": gen, "winner": winner}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -290,7 +290,9 @@ class TestInitDistributedFailures:
 
     def test_cluster_env_failure_degrades_to_single_host(self, monkeypatch):
         from transmogrifai_tpu.parallel import multihost
-        monkeypatch.setenv("SLURM_JOB_ID", "1234")
+        # a world-size-bearing variable (> 1) is what arms auto-detect now;
+        # a bare job id (SLURM_JOB_ID) no longer counts as cluster evidence
+        monkeypatch.setenv("SLURM_NTASKS", "2")
 
         def boom(**kw):
             raise RuntimeError("no coordinator found")
@@ -306,7 +308,7 @@ class TestInitDistributedFailures:
 
     def test_injected_init_fault_degrades(self, monkeypatch):
         from transmogrifai_tpu.parallel import multihost
-        monkeypatch.setenv("SLURM_JOB_ID", "1234")
+        monkeypatch.setenv("SLURM_NTASKS", "2")
         monkeypatch.setattr(jax.distributed, "initialize",
                             lambda **kw: pytest.fail("must inject first"))
         log = FailureLog()

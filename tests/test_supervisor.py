@@ -316,7 +316,9 @@ class TestWatchdogAccounting:
 class TestMultihostTelemetry:
     def test_init_span_and_gauges_on_degrade(self, monkeypatch):
         from transmogrifai_tpu.parallel.multihost import init_distributed
-        monkeypatch.setenv("SLURM_JOB_ID", "424242")   # cluster env present
+        # a world-size-bearing var > 1: a bare job id no longer counts as
+        # cluster evidence (PR 14 auto-detect change)
+        monkeypatch.setenv("SLURM_NTASKS", "2")
         tracer = Tracer(run_name="t")
         log = FailureLog()
         with use_tracer(tracer), use_failure_log(log), inject_faults(

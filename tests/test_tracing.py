@@ -197,6 +197,29 @@ class TestExportAndMerge:
                  if e["ph"] == "X"}
         assert names == {"serving.request", "serving.batch"}
 
+    def test_rank_labels_exports_and_merge(self, tmp_path):
+        # host-group ranks: the rank rides the export and merge_traces
+        # labels one lane per host with it
+        parent = TraceContext.new()
+        paths = []
+        for rank in (0, 1):
+            tr = Tracer("sweep", parent=parent.child(), rank=rank)
+            with tr.span("selector.sweep"):
+                pass
+            assert tr.to_json()["rank"] == rank
+            paths.append(tr.export_chrome_trace(
+                str(tmp_path / f"trace-rank{rank}.json")))
+        merged = merge_traces(paths)
+        labels = [e["args"]["name"] for e in merged["traceEvents"]
+                  if e.get("name") == "process_name"]
+        assert any("[rank 0]" in l for l in labels)
+        assert any("[rank 1]" in l for l in labels)
+        assert [f["rank"] for f in merged["otherData"]["files"]] == [0, 1]
+        # one trace id across every rank's spans (launcher propagation)
+        ids = {e["args"]["traceId"]
+               for e in merged["traceEvents"] if e["ph"] == "X"}
+        assert ids == {parent.trace_id}
+
 
 # --------------------------------------------------------------------------
 # run_supervised: child-env propagation (satellite: supervised children)

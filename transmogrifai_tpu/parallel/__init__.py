@@ -11,11 +11,15 @@ reference's parallelism mechanisms (SURVEY.md §2.6):
 from .mesh import (candidate_mesh_for, candidate_sharding, data_axis_size,
                    data_sharding, make_mesh, maybe_data_mesh,
                    model_axis_size, model_axis_width, pad_rows_for,
-                   replicated_sharding)
+                   process_row_range, replicated_sharding)
 from .dist_fit import (fit_logreg_grid_sharded, sharded_col_stats,
                        sharded_forest_fit, sharded_gbt_round,
                        sharded_train_step)
-from .multihost import init_distributed, is_multihost
+from .hostgroup import (EXIT_HOST_LOST, HostGroup, HostGroupResult,
+                        HostLiveness, HostLostError, barrier_sync,
+                        hostgroup_env_present, launch_hosts,
+                        maybe_init_hostgroup)
+from .multihost import ensure_cpu_collectives, init_distributed, is_multihost
 from .streaming import (device_chunk_bytes, stream_to_device,
                         streaming_stats)
 from .supervisor import (DeviceLostError, Heartbeat, ProbeVerdict,
@@ -29,9 +33,13 @@ __all__ = [
     "make_mesh", "maybe_data_mesh", "data_sharding", "candidate_sharding",
     "candidate_mesh_for", "replicated_sharding", "data_axis_size",
     "model_axis_size", "model_axis_width", "pad_rows_for",
+    "process_row_range",
     "fit_logreg_grid_sharded", "sharded_col_stats", "sharded_forest_fit",
     "sharded_gbt_round", "sharded_train_step", "init_distributed",
-    "is_multihost",
+    "is_multihost", "ensure_cpu_collectives",
+    "EXIT_HOST_LOST", "HostGroup", "HostGroupResult", "HostLiveness",
+    "HostLostError", "barrier_sync", "hostgroup_env_present",
+    "launch_hosts", "maybe_init_hostgroup",
     "stream_to_device", "streaming_stats", "device_chunk_bytes",
     "DeviceLostError", "Heartbeat", "ProbeVerdict", "SupervisedResult",
     "TransferStallError", "effective_device_count", "is_device_loss",

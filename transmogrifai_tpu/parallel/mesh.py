@@ -134,6 +134,30 @@ def data_sharding(mesh: Mesh, ndim: int = 2, row_axis: int = 0) -> NamedSharding
     return NamedSharding(mesh, P(*spec))
 
 
+def process_row_range(mesh: Mesh, n_rows: int, ndim: int = 2,
+                      row_axis: int = 0,
+                      pad_to: Optional[int] = None) -> "tuple[int, int]":
+    """Global row extent ``[lo, hi)`` covered by THIS process's addressable
+    devices under ``data_sharding(mesh)`` — i.e. the slice of the global
+    row space this host must materialize from its reader (its per-host
+    shard).  Single-process meshes cover everything: ``(0, n_rows)``.
+    ``pad_to`` must match the ``stream_to_device`` call so the shard
+    boundaries of the padded shape are used; the returned extent is still
+    clipped to the ``n_rows`` real rows (pad rows are synthesized
+    on-device, never read)."""
+    total = n_rows if pad_to is None else max(pad_to, n_rows)
+    shape = [1] * ndim
+    shape[row_axis] = total
+    sh = data_sharding(mesh, ndim=ndim, row_axis=row_axis)
+    dev_map = sh.addressable_devices_indices_map(tuple(shape))
+    lo, hi = total, 0
+    for idx in dev_map.values():
+        rsl = idx[row_axis]
+        lo = min(lo, 0 if rsl.start is None else rsl.start)
+        hi = max(hi, total if rsl.stop is None else rsl.stop)
+    return min(int(lo), int(n_rows)), min(int(hi), int(n_rows))
+
+
 def candidate_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     """Shard axis 0 (grid candidates) over 'model'."""
     spec = P(MODEL_AXIS, *([None] * (ndim - 1)))

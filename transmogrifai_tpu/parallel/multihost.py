@@ -26,16 +26,49 @@ import jax
 from ..resilience import maybe_inject, record_failure, run_with_deadline
 
 
-_CLUSTER_ENV_VARS = (
+#: Env vars that name a coordinator / TPU-pod topology outright: their
+#: presence alone is enough to attempt auto-init.
+_COORDINATOR_ENV_VARS = (
     "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
     "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
-    "CLOUD_TPU_TASK_ID", "SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE",
+    "CLOUD_TPU_TASK_ID",
 )
+
+#: Env vars that carry the scheduler's world size.  A bare job id
+#: (SLURM_JOB_ID) is NOT here on purpose: a single-node SLURM job used to
+#: trip auto-init on it and "degrade" to single-host every run — only a
+#: world size > 1 means there are actually peers to rendezvous with.
+_WORLD_SIZE_ENV_VARS = (
+    "SLURM_NTASKS", "SLURM_NPROCS", "OMPI_COMM_WORLD_SIZE", "PMI_SIZE",
+)
+
+# kept for back-compat introspection (tests/dashboards list it)
+_CLUSTER_ENV_VARS = _COORDINATOR_ENV_VARS + _WORLD_SIZE_ENV_VARS
+
+
+def _world_size_env() -> int:
+    """Largest world size any scheduler env var claims (0 when none do)."""
+    import os
+    n = 0
+    for v in _WORLD_SIZE_ENV_VARS:
+        raw = os.environ.get(v)
+        if not raw:
+            continue
+        try:
+            n = max(n, int(raw))
+        except ValueError:
+            continue
+    return n
 
 
 def _cluster_env_present() -> bool:
+    """Only auto-detect when the environment names a coordinator or claims
+    a world size > 1 — a lone SLURM_JOB_ID (single-node job) must not
+    trigger an observably-failing distributed init attempt."""
     import os
-    return any(os.environ.get(v) for v in _CLUSTER_ENV_VARS)
+    if any(os.environ.get(v) for v in _COORDINATOR_ENV_VARS):
+        return True
+    return _world_size_env() > 1
 
 
 def init_distributed(coordinator_address: Optional[str] = None,
@@ -89,6 +122,9 @@ def init_distributed(coordinator_address: Optional[str] = None,
                 description="jax.distributed.initialize")
     except Exception as e:  # noqa: BLE001
         REGISTRY.gauge("multihost.initialized").set(0)
+        # known truth on EVERY exit path: init failed, this process is
+        # single — a stale >1 from a prior run must not survive the raise
+        REGISTRY.gauge("multihost.process_count").set(1)
         if coordinator_address is not None:
             # an EXPLICIT multi-host request that fails must not silently
             # degrade to single-host (every host would train divergently)
@@ -97,11 +133,34 @@ def init_distributed(coordinator_address: Optional[str] = None,
         # observably — exactly the demotion the round-5 probes did by hand
         record_failure("multihost.init_distributed", "degraded", e,
                        point="multihost.init", fallback="single-host")
-        REGISTRY.gauge("multihost.process_count").set(1)
         return False
     REGISTRY.gauge("multihost.initialized").set(1)
     REGISTRY.gauge("multihost.process_count").set(jax.process_count())
     return jax.process_count() > 1
+
+
+def ensure_cpu_collectives(implementation: str = "gloo") -> bool:
+    """Select a cross-process collectives backend for the CPU client.
+
+    jax's default CPU client has none: a multi-process CPU group can
+    ``init_distributed`` fine and then fail every computation over a
+    cross-process array with "Multiprocess computations aren't implemented
+    on the CPU backend".  Selecting gloo *before the backend first
+    initializes* makes the 2-process CI host group run real cross-process
+    collectives.  Best-effort: harmless (and a recorded no-op) on builds
+    without the option or after the backend is already live."""
+    from ..telemetry import REGISTRY
+    try:
+        jax.config.update("jax_cpu_collectives_implementation",
+                          implementation)
+    except Exception as e:  # noqa: BLE001 — option absent / backend live
+        record_failure("multihost.cpu_collectives", "swallowed", e,
+                       point="multihost.cpu_collectives",
+                       implementation=implementation)
+        REGISTRY.gauge("multihost.cpu_collectives").set(0)
+        return False
+    REGISTRY.gauge("multihost.cpu_collectives").set(1)
+    return True
 
 
 def is_multihost() -> bool:

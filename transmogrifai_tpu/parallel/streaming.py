@@ -117,13 +117,25 @@ def stream_to_device(arr,
                      row_axis: int = 0,
                      chunk_bytes: Optional[int] = None,
                      pad_to: Optional[int] = None,
-                     dtype=jnp.float32) -> jax.Array:
+                     dtype=jnp.float32,
+                     row_offset: int = 0,
+                     global_rows: Optional[int] = None) -> jax.Array:
     """Build a data-sharded device array from ``arr`` through bounded host
     chunks, optionally padding ``row_axis`` up to ``pad_to`` with zero rows.
 
     Returns the same logical array as
     ``jax.device_put(jnp.asarray(arr_padded, dtype), data_sharding(...))``
     with peak host staging bounded by ~2×``chunk_bytes``.
+
+    Multi-process (host group): ``arr`` may be just this rank's row shard —
+    its reader slice — positioned in the global row space by ``row_offset``
+    with ``global_rows`` the full logical row count (``mesh.process_row_range``
+    computes the slice to materialize).  Each process ``device_put``s only
+    its own addressable shards from its own slice; the shards assemble via
+    ``make_array_from_single_device_arrays`` into the same global array,
+    bitwise-equal to the single-process path on the real rows, with the
+    staging bound unchanged.  A slice that does not cover this process's
+    shard extent raises ``ValueError`` (typed, never silent misalignment).
     """
     from ..profiling import add_host_link_bytes
     from ..telemetry import REGISTRY, event, span
@@ -131,7 +143,15 @@ def stream_to_device(arr,
     host = np.asarray(arr)
     if ndim is None:
         ndim = host.ndim
-    n_rows = host.shape[row_axis]
+    n_local = host.shape[row_axis]
+    row_offset = int(row_offset)
+    n_rows = int(global_rows) if global_rows is not None \
+        else row_offset + n_local
+    if row_offset < 0 or row_offset + n_local > n_rows:
+        raise ValueError(
+            f"stream_to_device: local slice [{row_offset}, "
+            f"{row_offset + n_local}) exceeds the global row space "
+            f"[0, {n_rows})")
     total_rows = n_rows if pad_to is None else max(pad_to, n_rows)
     target_shape = list(host.shape)
     target_shape[row_axis] = total_rows
@@ -154,6 +174,7 @@ def stream_to_device(arr,
     shards = []
     inflight = []  # (device_array, host_buffer, staged_bytes) double buffer
     with span("mesh.stream_to_device", rows=int(n_rows),
+              local_rows=int(n_local), row_offset=int(row_offset),
               pad_rows=int(total_rows - n_rows),
               devices=len(dev_map), chunk_rows=int(chunk_rows)):
         for dev, idx in dev_map.items():
@@ -161,11 +182,20 @@ def stream_to_device(arr,
             start = 0 if rsl.start is None else rsl.start
             stop = total_rows if rsl.stop is None else rsl.stop
             real_stop = min(stop, n_rows)
+            if start < real_stop and (start < row_offset
+                                      or real_stop > row_offset + n_local):
+                raise ValueError(
+                    f"stream_to_device: this process's shard on {dev} "
+                    f"needs global rows [{start}, {real_stop}) but the "
+                    f"local slice only covers [{row_offset}, "
+                    f"{row_offset + n_local}) — pass the slice from "
+                    f"mesh.process_row_range")
             pieces = []
             pos = start
             while pos < real_stop:
                 end = min(pos + chunk_rows, real_stop)
-                view = host[_row_slice(host.shape, row_axis, pos, end)]
+                view = host[_row_slice(host.shape, row_axis,
+                                       pos - row_offset, end - row_offset)]
                 buf = np.ascontiguousarray(view, dtype=np_dtype)
                 nbytes = buf.nbytes
                 _stage(nbytes)

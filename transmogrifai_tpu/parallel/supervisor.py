@@ -186,8 +186,11 @@ def is_device_loss(e: BaseException) -> bool:
     mesh would not help and retrying the sweep would not converge."""
     if isinstance(e, (DeviceLostError, TransferStallError)):
         return True
+    if type(e).__name__ == "HostLostError":
+        return True   # hostgroup peer loss (name-matched: no circular import)
     s = str(e)
-    if "supervisor.device_loss" in s or "supervisor.chunk_stall" in s:
+    if "supervisor.device_loss" in s or "supervisor.chunk_stall" in s \
+            or "hostgroup.host_lost" in s:
         return True   # injected chaos markers (InjectedFault carries point)
     return ("UNAVAILABLE" in s or "DEVICE_LOST" in s
             or "device lost" in s.lower())

@@ -75,6 +75,15 @@ class OpParams:
     # (TRANSMOGRIFAI_SWEEP_RECOVERIES), outageDir
     # (TRANSMOGRIFAI_OUTAGE_DIR), heartbeatS (TRANSMOGRIFAI_HEARTBEAT_S)
     supervisor: Dict[str, Any] = field(default_factory=dict)
+    # host-group (multi-process training) knobs (parallel/hostgroup.py env
+    # equivalents): hosts (--hosts N launcher fan-out), beatIntervalS
+    # (TRANSMOGRIFAI_HOSTGROUP_BEAT_S), livenessTimeoutS
+    # (TRANSMOGRIFAI_HOSTGROUP_LIVENESS_S), barrierTimeoutS
+    # (TRANSMOGRIFAI_HOSTGROUP_BARRIER_S), initTimeoutS
+    # (TRANSMOGRIFAI_HOSTGROUP_INIT_S), distributed
+    # (TRANSMOGRIFAI_HOSTGROUP_DISTRIBUTED — jax.distributed per rank),
+    # maxRelaunches, bootTimeoutS, graceS, runDir (launcher-side)
+    hostgroup: Dict[str, Any] = field(default_factory=dict)
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "OpParams":
@@ -99,7 +108,8 @@ class OpParams:
             lifecycle=d.get("lifecycleParams") or {},
             aot=d.get("aotParams") or {},
             mesh=d.get("meshParams") or {},
-            supervisor=d.get("supervisorParams") or {})
+            supervisor=d.get("supervisorParams") or {},
+            hostgroup=d.get("hostgroupParams") or {})
 
     @staticmethod
     def load(path: str) -> "OpParams":
@@ -127,6 +137,7 @@ class OpParams:
             "aotParams": self.aot,
             "meshParams": self.mesh,
             "supervisorParams": self.supervisor,
+            "hostgroupParams": self.hostgroup,
         }
 
     def apply_stage_params(self, stages) -> None:

@@ -127,7 +127,11 @@ class FailureLog:
                "breaker_half_open",  # breaker probing for recovery
                "breaker_closed",     # breaker recovered: calls flow again
                "outage",       # device runtime declared down (supervisor)
-               "recovered")    # device runtime back after outage/degrade
+               "recovered",    # device runtime back after outage/degrade
+               "host_lost",      # host-group rank dead / heartbeat silent
+               "host_recovered",  # host-group rank heartbeat resumed
+               "relaunched",   # host group rebooted at shrunken world size
+               "escalated")    # SIGTERM ignored; SIGKILL reclaimed it
 
     def __init__(self):
         self._events: List[FailureEvent] = []

@@ -145,6 +145,25 @@ class OpWorkflowRunner:
             os.environ["TRANSMOGRIFAI_OUTAGE_DIR"] = str(sup["outageDir"])
         if sup.get("heartbeatS") is not None:
             os.environ["TRANSMOGRIFAI_HEARTBEAT_S"] = str(sup["heartbeatS"])
+        # hostgroupParams: cross-host liveness knobs ride the env the same
+        # way (hostgroup.py reads them per call, so launcher-exported values
+        # and per-run overrides compose)
+        hg_params = params.hostgroup or {}
+        if hg_params.get("beatIntervalS") is not None:
+            os.environ["TRANSMOGRIFAI_HOSTGROUP_BEAT_S"] = \
+                str(hg_params["beatIntervalS"])
+        if hg_params.get("livenessTimeoutS") is not None:
+            os.environ["TRANSMOGRIFAI_HOSTGROUP_LIVENESS_S"] = \
+                str(hg_params["livenessTimeoutS"])
+        if hg_params.get("barrierTimeoutS") is not None:
+            os.environ["TRANSMOGRIFAI_HOSTGROUP_BARRIER_S"] = \
+                str(hg_params["barrierTimeoutS"])
+        if hg_params.get("initTimeoutS") is not None:
+            os.environ["TRANSMOGRIFAI_HOSTGROUP_INIT_S"] = \
+                str(hg_params["initTimeoutS"])
+        if hg_params.get("distributed") is not None:
+            os.environ["TRANSMOGRIFAI_HOSTGROUP_DISTRIBUTED"] = \
+                "1" if hg_params["distributed"] else "0"
         tele = params.telemetry or {}
         trace_dir = tele.get("traceDir")
         enabled = bool(tele.get("enabled", trace_dir is not None))
@@ -157,8 +176,13 @@ class OpWorkflowRunner:
             tp = tele.get("traceparent")
             parent = (TraceContext.parse(str(tp)) if tp
                       else TraceContext.from_env())
-        tracer = Tracer(run_name=f"run:{run_type}",
-                        parent=parent) if enabled else None
+        # inside a host-group rank the tracer carries the rank so per-rank
+        # exports merge into one labelled multi-host timeline (trace-merge)
+        from .parallel import hostgroup as _hostgroup
+        hg_rank = _hostgroup.current_rank() \
+            if _hostgroup.hostgroup_env_present() else None
+        tracer = Tracer(run_name=f"run:{run_type}", parent=parent,
+                        rank=hg_rank) if enabled else None
         ctx = use_tracer(tracer) if tracer is not None \
             else contextlib.nullcontext()
         # opt-in heartbeat supervision for the whole run: background
@@ -174,16 +198,26 @@ class OpWorkflowRunner:
             from .parallel.supervisor import Heartbeat, supervisor_enabled
             if supervisor_enabled():
                 hb = Heartbeat(interval_s=hb_interval).start()
+        hg = None
         try:
             with ctx:
+                # inside a launch_hosts rank: join the host group (start the
+                # heartbeat, optionally init jax.distributed, pass the init
+                # barrier) before dispatch; post this rank's done file after
+                hg = _hostgroup.maybe_init_hostgroup()
                 result = self._run_dispatch(run_type, params)
+                if hg is not None:
+                    hg.mark_done({"runType": run_type, "ok": True})
         finally:
+            if hg is not None:
+                hg.close()
             if hb is not None:
                 hb.stop()
         if tracer is not None:
             result.tracer = tracer
             if trace_dir:
-                self._export_telemetry(tracer, trace_dir, run_type, result)
+                self._export_telemetry(tracer, trace_dir, run_type, result,
+                                       rank=hg_rank)
         return result
 
     def _run_dispatch(self, run_type: str,
@@ -215,17 +249,22 @@ class OpWorkflowRunner:
 
     @staticmethod
     def _export_telemetry(tracer, trace_dir: str, run_type: str,
-                          result: OpWorkflowRunnerResult) -> None:
+                          result: OpWorkflowRunnerResult,
+                          rank: "Optional[int]" = None) -> None:
         """Write <trace_dir>/trace-<run_type>.json (Chrome trace events,
-        Perfetto-loadable) and telemetry.json (summary).  Best-effort: a
-        full disk must not fail a finished run."""
+        Perfetto-loadable) and telemetry.json (summary).  Inside a
+        host-group rank the filenames carry the rank so N ranks sharing one
+        trace_dir never clobber each other (``trace-merge`` stitches them).
+        Best-effort: a full disk must not fail a finished run."""
         from .telemetry import write_telemetry_summary
+        suffix = "" if rank is None else f"-rank{rank}"
         try:
             os.makedirs(trace_dir, exist_ok=True)
-            trace_path = os.path.join(trace_dir, f"trace-{run_type}.json")
+            trace_path = os.path.join(
+                trace_dir, f"trace-{run_type}{suffix}.json")
             tracer.export_chrome_trace(trace_path)
             write_telemetry_summary(
-                os.path.join(trace_dir, "telemetry.json"), tracer)
+                os.path.join(trace_dir, f"telemetry{suffix}.json"), tracer)
             if isinstance(result.metrics, dict):
                 result.metrics["traceFile"] = trace_path
         except Exception as e:  # noqa: BLE001 — diagnostics only
@@ -617,6 +656,14 @@ class OpApp:
                        help="disable device-runtime supervision: no "
                             "degrade-to-surviving-mesh sweep recovery, no "
                             "heartbeat; device errors propagate unchanged")
+        p.add_argument("--hosts", type=int, default=1,
+                       help="launch this command across N supervised local "
+                            "processes (ranked host group with heartbeats, "
+                            "jax.distributed init, lost-host relaunch); "
+                            "1 = run in-process")
+        p.add_argument("--hosts-run-dir",
+                       help="host-group run directory (heartbeats, logs, "
+                            "outage records); default: a temp dir")
         return p.parse_args(argv)
 
     def main(self, argv: Optional[List[str]] = None) -> OpWorkflowRunnerResult:
@@ -655,5 +702,36 @@ class OpApp:
             params.mesh["chunkBytes"] = args.mesh_chunk_bytes
         if args.no_supervisor:
             params.supervisor["enabled"] = False
+        from .parallel import hostgroup
+        hosts = max(1, int(args.hosts or params.hostgroup.get("hosts", 1)))
+        if hosts > 1 and not hostgroup.hostgroup_env_present():
+            # launcher role: fan this same command out as N ranked worker
+            # processes and supervise them (each rank re-enters main() with
+            # the host-group env set and takes the in-process branch)
+            import sys
+            child = list(sys.argv) if argv is None else [sys.argv[0]] + \
+                list(argv)
+            hg_params = params.hostgroup or {}
+            res = hostgroup.launch_hosts(
+                [sys.executable] + child, hosts,
+                run_dir=args.hosts_run_dir or hg_params.get("runDir"),
+                boot_timeout=float(hg_params.get("bootTimeoutS", 240.0)),
+                grace_s=float(hg_params.get("graceS", 15.0)),
+                max_relaunches=int(hg_params.get("maxRelaunches", 1)),
+                liveness_timeout=hg_params.get("livenessTimeoutS"),
+                beat_interval=hg_params.get("beatIntervalS"),
+                distributed=bool(hg_params.get("distributed", True)))
+            out = OpWorkflowRunnerResult(
+                run_type=args.run_type, metrics={"hostgroup": res.to_json()})
+            if not res.ok:
+                raise SystemExit(1)
+            return out
         runner = self.make_runner()
-        return runner.run(args.run_type, params)
+        try:
+            return runner.run(args.run_type, params)
+        except hostgroup.HostLostError:
+            if hostgroup.hostgroup_env_present():
+                # survivor abort: exit with the benign host-lost code so the
+                # launcher relaunches the group instead of counting a failure
+                raise SystemExit(hostgroup.EXIT_HOST_LOST)
+            raise

@@ -175,6 +175,17 @@ class Span:
         return out
 
 
+def _proc_label(run_name: str, worker_id, rank) -> str:
+    """Perfetto process-lane label: run name plus whichever identities
+    apply — serving-pool worker id and/or host-group rank."""
+    label = run_name
+    if worker_id is not None:
+        label += f" [worker {worker_id}]"
+    if rank is not None:
+        label += f" [rank {rank}]"
+    return label
+
+
 class Tracer:
     """Thread-safe span collector.  See module docstring for the parenting
     rule; all mutation happens under one lock, so concurrent serving/
@@ -187,7 +198,8 @@ class Tracer:
     def __init__(self, run_name: str = "run", *,
                  max_spans: Optional[int] = None,
                  parent: Optional[TraceContext] = None,
-                 worker_id: Optional[str] = None):
+                 worker_id: Optional[str] = None,
+                 rank: Optional[int] = None):
         self.run_name = run_name
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
@@ -208,6 +220,9 @@ class Tracer:
         self._drop_noted = False
         self.parent_ctx = parent
         self.worker_id = worker_id
+        # host-group rank (multi-process training); like worker_id it rides
+        # the exports so merge_traces can label one lane per host
+        self.rank = rank
         # every span this tracer records shares one trace id unless an
         # explicit per-request ctx overrides it
         self.trace_id = parent.trace_id if parent else os.urandom(16).hex()
@@ -351,7 +366,7 @@ class Tracer:
     def to_json(self) -> Dict[str, Any]:
         return {"runName": self.run_name, "t0WallS": round(self.t0_wall, 3),
                 "traceId": self.trace_id, "pid": os.getpid(),
-                "workerId": self.worker_id,
+                "workerId": self.worker_id, "rank": self.rank,
                 "spansDropped": self.spans_dropped,
                 "spans": [s.to_json() for s in self.spans]}
 
@@ -365,8 +380,7 @@ class Tracer:
         exported traces align on a shared wall-clock timeline in Perfetto
         even without ``merge_traces``."""
         pid = os.getpid()
-        proc_label = self.run_name if self.worker_id is None \
-            else f"{self.run_name} [worker {self.worker_id}]"
+        proc_label = _proc_label(self.run_name, self.worker_id, self.rank)
         events: List[Dict[str, Any]] = [
             {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
              "args": {"name": proc_label}},
@@ -393,7 +407,7 @@ class Tracer:
                "otherData": {"runName": self.run_name,
                              "t0WallS": round(self.t0_wall, 3),
                              "traceId": self.trace_id, "pid": pid,
-                             "workerId": self.worker_id,
+                             "workerId": self.worker_id, "rank": self.rank,
                              "spansDropped": self.spans_dropped}}
         with open(path, "w") as fh:
             json.dump(doc, fh, default=str)
@@ -793,7 +807,8 @@ def merge_traces(paths: Iterable[str],
             other = {"runName": doc.get("runName", "run"), "t0WallS": t0,
                      "traceId": doc.get("traceId", ""),
                      "pid": doc.get("pid", 0),
-                     "workerId": doc.get("workerId")}
+                     "workerId": doc.get("workerId"),
+                     "rank": doc.get("rank")}
         else:
             events = [e for e in doc.get("traceEvents", [])
                       if e.get("ph") == "X"]
@@ -815,9 +830,9 @@ def merge_traces(paths: Iterable[str],
     for idx, d in enumerate(docs):
         shift_us = (d["t0"] - anchor) * 1e6
         worker_id = d["other"].get("workerId")
+        rank = d["other"].get("rank")
         run_name = d["other"].get("runName", "run")
-        label = run_name if worker_id is None \
-            else f"{run_name} [worker {worker_id}]"
+        label = _proc_label(run_name, worker_id, rank)
         events.append({"name": "process_name", "ph": "M", "pid": idx,
                        "tid": 0, "args": {"name": label}})
         events.append({"name": "clock_sync", "ph": "c", "pid": idx,
@@ -830,7 +845,7 @@ def merge_traces(paths: Iterable[str],
             ev["pid"] = idx
             events.append(ev)
         files_meta.append({"path": d["path"], "runName": run_name,
-                           "workerId": worker_id,
+                           "workerId": worker_id, "rank": rank,
                            "originalPid": d["other"].get("pid"),
                            "t0WallS": d["t0"]})
     merged = {"traceEvents": events, "displayTimeUnit": "ms",

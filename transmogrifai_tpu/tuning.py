@@ -717,8 +717,15 @@ class OpValidator:
         families instead of refitting them.  Bounded by
         TRANSMOGRIFAI_SWEEP_RECOVERIES (0 with ``--no-supervisor``: the
         error propagates unchanged)."""
+        from .parallel import hostgroup as _hostgroup
         from .parallel import supervisor as _supervisor
         from .telemetry import span
+        # inside a multi-process host group the sweep span carries the rank
+        # so merged traces attribute each sweep lane to its host
+        _hg_attrs = {}
+        if _hostgroup.hostgroup_env_present():
+            _hg_attrs = {"hostgroup_rank": _hostgroup.current_rank(),
+                         "hostgroup_world": _hostgroup.group_world_size()}
         attempt = 0
         while True:
             self._sweep_attempt = attempt
@@ -726,7 +733,7 @@ class OpValidator:
                 with span("selector.sweep", candidates=len(candidates),
                           validation_type=self.validation_type,
                           grid_points=sum(len(c.grid) for c in candidates),
-                          attempt=attempt):
+                          attempt=attempt, **_hg_attrs):
                     return self._validate_impl(candidates, batch, label,
                                                features,
                                                in_fold_dag=in_fold_dag,

@@ -201,6 +201,15 @@ def _telemetry_aux(tracer, top_n: int = 8):
     return out
 
 
+def _memory_aux():
+    """Memory-governor block for the bench aux (ISSUE 15 satellite): the
+    preflight plan, any shrink-ladder activity and the host peak RSS, so
+    OOM-pressure regressions (and the plan that avoided them) live in
+    every BENCH_*.json."""
+    from transmogrifai_tpu.parallel.memory import memory_aux
+    return dict(memory_aux(), peak_rss_mb=_peak_rss_mb())
+
+
 # nominal dense peak of one TPU v5e chip (bf16 MXU); override with
 # TRANSMOGRIFAI_PEAK_FLOPS for other parts.  Used only to place the bench
 # programs on a roofline — achieved numbers are the measurement.
@@ -365,6 +374,7 @@ def run_dense(N: int, on_accel: bool, platform: str):
             **phases,
             "roofline": _roofline_aux(phases.get("selector_s"), on_accel),
             "telemetry": _telemetry_aux(tracer),
+            "memory": _memory_aux(),
         },
     }
 
@@ -438,6 +448,7 @@ def run_transmog(N: int, on_accel: bool, platform: str):
             **phases,
             "roofline": _roofline_aux(phases.get("selector_s"), on_accel),
             "telemetry": _telemetry_aux(tracer),
+            "memory": _memory_aux(),
         },
     }
 

@@ -328,6 +328,44 @@ def run_chaos_hostgroup(*, out_dir, seed=0, rows=560):
     return summary
 
 
+def run_chaos_oom(*, out_dir, seed=0, rows=560):
+    """Device-memory-pressure drill (ISSUE 15): drive the ci_memory_smoke
+    harness — tiny-budget preflight plan, OOM-vs-device-loss classifier
+    disjointness, injected mid-sweep OOM walking the shrink-and-retry
+    ladder to the identical winner with zero worker deaths — and fold its
+    checks into the chaos summary contract."""
+    import subprocess
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "ci_memory_smoke.py")
+    env = dict(os.environ,
+               MEMORY_SMOKE_ROWS=str(rows),
+               MEMORY_SMOKE_SEED=str(seed))
+    os.makedirs(out_dir, exist_ok=True)
+    checks = {}
+    for phase in ("run", "validate"):
+        r = subprocess.run([sys.executable, script, phase, out_dir],
+                           env=env, capture_output=True, text=True,
+                           timeout=1200)
+        checks[f"oom_{phase}_rc0"] = r.returncode == 0
+        if r.returncode != 0:
+            print(r.stdout[-4000:], file=sys.stderr)
+            print(r.stderr[-4000:], file=sys.stderr)
+            break
+    smoke_path = os.path.join(out_dir, "memory-smoke.json")
+    checks["oom_drill_converged"] = False
+    if os.path.exists(smoke_path):
+        with open(smoke_path) as fh:
+            smoke = json.load(fh)
+        drill = smoke.get("drill") or {}
+        checks["oom_drill_converged"] = bool(
+            drill.get("same_winner") and drill.get("device_cap") is None)
+    summary = {"passed": all(checks.values()), "checks": checks,
+               "seed": seed, "rows": rows, "mode": "oom"}
+    with open(os.path.join(out_dir, "summary.json"), "w") as fh:
+        json.dump(summary, fh, indent=2)
+    return summary
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--out-dir", required=True)
@@ -337,14 +375,20 @@ def main(argv=None):
     ap.add_argument("--rows", type=int, default=560,
                     help="sweep rows; must divide by 8 AND 7 so the mesh "
                          "forms before and after the injected device loss")
-    ap.add_argument("--mode", choices=("full", "hostgroup"), default="full",
+    ap.add_argument("--mode", choices=("full", "hostgroup", "oom"),
+                    default="full",
                     help="'full' runs the in-process supervisor drills; "
                          "'hostgroup' runs the multi-process lost-host "
-                         "drill (real ranks, SIGKILL, relaunch, resume)")
+                         "drill (real ranks, SIGKILL, relaunch, resume); "
+                         "'oom' runs the memory-governor pressure drill "
+                         "(injected device OOM, shrink ladder, same winner)")
     args = ap.parse_args(argv)
     if args.mode == "hostgroup":
         summary = run_chaos_hostgroup(out_dir=args.out_dir, seed=args.seed,
                                       rows=args.rows)
+    elif args.mode == "oom":
+        summary = run_chaos_oom(out_dir=args.out_dir, seed=args.seed,
+                                rows=args.rows)
     else:
         summary = run_chaos_train(
             seed=args.seed, probe_timeout_s=args.probe_timeout_s,

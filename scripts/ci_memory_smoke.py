@@ -1,0 +1,174 @@
+"""CI smoke for the memory governor (ISSUE 15): prove, in one process,
+that device-memory pressure degrades instead of killing the sweep —
+
+* a tiny forced device budget makes the preflight planner emit SMALLER
+  transfer chunks than the 256 MB default (the plan reacts to the budget,
+  it is not a constant);
+* device OOM classifies as MEMORY EXHAUSTION and NOT device loss, and
+  DEVICE_LOST/UNAVAILABLE classify as device loss and NOT memory
+  exhaustion — the two recovery paths stay disjoint;
+* an injected ``memory.device_oom`` mid-sweep walks the shrink-and-retry
+  ladder and CONVERGES: the resumed sweep selects the IDENTICAL winner
+  (name + params) as the unpressured control, replaying checkpointed
+  families instead of refitting them;
+* ZERO worker deaths: the mesh never shrinks (``device_cap`` stays None)
+  — OOM recovery is a work-shape change, not a topology change;
+* every shrink lands in the failure log (``degraded`` at
+  ``memory.device_oom``) and telemetry (``memory.shrinks_total``), and a
+  ladder that runs dry surfaces as a typed ``MemoryExhaustedError`` with
+  the attempted plan attached.
+
+Usage:
+    python scripts/ci_memory_smoke.py run OUT_DIR       # drill + record
+    python scripts/ci_memory_smoke.py validate OUT_DIR  # parse + assert
+"""
+
+import json
+import os
+import sys
+
+# the sweep needs the virtual 8-device CPU topology; must be set before
+# jax initializes (mirrors tests/conftest.py)
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# runnable as `python scripts/ci_memory_smoke.py` from the repo root; the
+# scripts dir itself is added so the sweep fixture is shared with the chaos
+# harness instead of forked
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, _HERE)
+
+ROWS = int(os.environ.get("MEMORY_SMOKE_ROWS", "560"))
+SEED = int(os.environ.get("MEMORY_SMOKE_SEED", "0"))
+
+
+def run(out_dir):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from chaos_train import _two_family_sweep
+    from transmogrifai_tpu.parallel import memory as mem
+    from transmogrifai_tpu.parallel import supervisor as sup
+    from transmogrifai_tpu.parallel.streaming import device_chunk_bytes
+    from transmogrifai_tpu.resilience import FaultInjector, inject_faults
+    from transmogrifai_tpu.telemetry import REGISTRY
+
+    os.makedirs(out_dir, exist_ok=True)
+
+    # 1. tiny forced budget → the preflight plan shrinks its chunks
+    default_chunk = device_chunk_bytes()
+    os.environ["TRANSMOGRIFAI_DEVICE_MEM_BYTES"] = str(32 << 20)
+    try:
+        plan = mem.plan_sweep_memory(rows=1_000_000, cols=32, folds=3,
+                                     grid_width=8, devices=8)
+        planner = {"budget_bytes": plan.device_budget,
+                   "default_chunk_bytes": default_chunk,
+                   "plan": plan.to_json()}
+    finally:
+        os.environ.pop("TRANSMOGRIFAI_DEVICE_MEM_BYTES", None)
+
+    # 2. classifier disjointness on the real allocator message shapes
+    oom = RuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying to "
+                       "allocate 68719476736 bytes.")
+    lost = RuntimeError("DEVICE_LOST: device lost: TPU worker disappeared")
+    typed = mem.as_memory_exhausted(oom)
+    classify = {
+        "oom_is_memory_exhaustion": mem.is_memory_exhaustion(oom),
+        "oom_is_device_loss": sup.is_device_loss(oom),
+        "device_lost_is_memory_exhaustion": mem.is_memory_exhaustion(lost),
+        "device_lost_is_device_loss": sup.is_device_loss(lost),
+        "typed_error": type(typed).__name__,
+        "typed_has_plan": typed.plan is not None,
+    }
+
+    # 3. injected device OOM mid-sweep → shrink ladder + checkpoint resume
+    #    converge on the control winner; the mesh never shrinks
+    os.environ["TRANSMOGRIFAI_TPU_MESH"] = "1"
+    sweep_dir = os.path.join(out_dir, "sweep")
+    try:
+        sup.reset_surviving_devices()
+        mem.reset_memory_degrade()
+        w0, p0, _ = _two_family_sweep(ROWS, SEED)
+        shrinks_before = REGISTRY.counter("memory.shrinks_total").value
+        with inject_faults(FaultInjector(
+                fail_keys={"memory.device_oom": ["LR_B:score:o0"]},
+                seed=SEED)) as inj:
+            w1, p1, sweep_log = _two_family_sweep(ROWS, SEED,
+                                                  resume_from=sweep_dir)
+        sweep_actions = [(e.action, e.point) for e in sweep_log]
+        drill = {
+            "control_winner": w0, "control_params": p0,
+            "pressured_winner": w1, "pressured_params": p1,
+            "same_winner": bool(w1 == w0 and p1 == p0),
+            "oom_fired": ("memory.device_oom", "LR_B:score:o0") in inj.fired,
+            "shrink_recorded": ("degraded",
+                                "memory.device_oom") in sweep_actions,
+            "resumed_from_checkpoint": any(
+                a == "resumed" for a, _ in sweep_actions),
+            "shrinks_total_delta": REGISTRY.counter(
+                "memory.shrinks_total").value - shrinks_before,
+            "oom_attempt_budget": mem.max_oom_recoveries(),
+            "final_shrink_level": mem.shrink_level(),
+            "device_cap": sup.device_cap(),
+        }
+    finally:
+        sup.reset_surviving_devices()
+        mem.reset_memory_degrade()
+        os.environ.pop("TRANSMOGRIFAI_TPU_MESH", None)
+
+    record = {"rows": ROWS, "seed": SEED, "planner": planner,
+              "classify": classify, "drill": drill}
+    path = os.path.join(out_dir, "memory-smoke.json")
+    with open(path, "w") as fh:
+        fh.write(json.dumps(record) + "\n")
+    print(f"wrote {path}: plan chunk {plan.chunk_bytes} bytes under a "
+          f"{32 << 20}-byte budget (default {default_chunk}), injected OOM "
+          f"-> winner {w1} (control {w0}), shrinks "
+          f"{drill['shrinks_total_delta']}, device_cap {sup.device_cap()}")
+    return 0
+
+
+def validate(out_dir):
+    with open(os.path.join(out_dir, "memory-smoke.json")) as fh:
+        record = json.loads(fh.readline())
+
+    # the plan reacted to the tiny budget: strictly smaller chunks
+    pl = record["planner"]
+    assert pl["budget_bytes"] == 32 << 20, pl
+    assert pl["plan"]["chunkBytes"] < pl["default_chunk_bytes"], pl
+    assert pl["plan"]["estDeviceBytes"] > 0, pl
+
+    # classification is typed and the two recovery routes are disjoint
+    cl = record["classify"]
+    assert cl["oom_is_memory_exhaustion"] and not cl["oom_is_device_loss"], cl
+    assert (cl["device_lost_is_device_loss"]
+            and not cl["device_lost_is_memory_exhaustion"]), cl
+    assert cl["typed_error"] == "MemoryExhaustedError", cl
+    assert cl["typed_has_plan"], cl
+
+    # the drill converged: same winner, within the attempt budget, every
+    # shrink recorded, zero worker deaths (mesh untouched)
+    dr = record["drill"]
+    assert dr["oom_fired"], dr
+    assert dr["same_winner"], dr
+    assert dr["shrink_recorded"], dr
+    assert dr["resumed_from_checkpoint"], dr
+    assert dr["shrinks_total_delta"] >= 1, dr
+    assert 1 <= dr["final_shrink_level"] <= dr["oom_attempt_budget"], dr
+    assert dr["device_cap"] is None, dr
+
+    print(f"OK: tiny budget -> {pl['plan']['chunkBytes']}-byte chunks "
+          f"(default {pl['default_chunk_bytes']}), OOM typed + disjoint "
+          f"from device loss, injected OOM converged to the control winner "
+          f"{dr['control_winner']} after {dr['shrinks_total_delta']} "
+          f"shrink(s) with the mesh untouched")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "run":
+        sys.exit(run(sys.argv[2]))
+    if len(sys.argv) == 3 and sys.argv[1] == "validate":
+        sys.exit(validate(sys.argv[2]))
+    sys.exit(f"usage: {sys.argv[0]} run OUT_DIR | validate OUT_DIR")

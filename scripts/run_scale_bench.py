@@ -33,7 +33,11 @@ sys.path.insert(0, ROOT)
 from bench import last_json_line  # noqa: E402
 
 # retry ladder for a crashed family run: progressively tighter HBM budgets
-# (device-transfer cache cap, tree-histogram budget)
+# (device-transfer cache cap, tree-histogram budget).  NOTE (ISSUE 15): the
+# default mesh path now degrades IN-PROCESS via the memory governor's
+# shrink-and-retry ladder (parallel/memory.py) — this env ladder survives
+# only for the --subprocess-ladder fallback, where each step costs a fresh
+# process and a re-paid feature-engineering pass.
 _LADDER = [
     {"TRANSMOGRIFAI_DEVICE_CACHE_BYTES": str(256 << 20),
      "TRANSMOGRIFAI_TREE_BUDGET_GB": "4"},
@@ -63,6 +67,12 @@ def _run_bench(n, extra_env, timeout_s=3600):
     line = last_json_line(r.stdout)
     if line:
         rec["result"] = json.loads(line)
+        # hoist the memory-governor block (plan, shrink level, peak RSS) so
+        # scanning a scale artifact for OOM pressure doesn't require digging
+        # through each run's full aux
+        mem = (rec["result"].get("aux") or {}).get("memory")
+        if mem:
+            rec["memory"] = mem
     if r.rc != 0:
         rec["stderr_tail"] = ("timeout" if r.timed_out
                               else (r.stderr or ""))[-2000:]

@@ -1,0 +1,343 @@
+"""Memory governor (ISSUE 15): OOM classification disjoint from device
+loss, deterministic preflight planning, the shrink-and-retry ladder, the
+host RSS watchdog state machine, and the selector's non-finite-metric
+audit trail.
+
+Everything here is fast: the planner and ladder are pure functions of
+shapes + process state, and the watchdog runs on injected RSS readers and
+shedders (zero threads, zero sleeps).  The end-to-end injected-OOM sweep
+drill lives in scripts/ci_memory_smoke.py.
+"""
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.parallel import memory as mem
+from transmogrifai_tpu.parallel import supervisor as sup
+from transmogrifai_tpu.resilience import (FailureLog, FaultInjector,
+                                          inject_faults, use_failure_log)
+from transmogrifai_tpu.telemetry import REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def _clean_ladder():
+    mem.reset_memory_degrade()
+    yield
+    mem.reset_memory_degrade()
+    mem.install_watchdog(None)
+
+
+# --------------------------------------------------------------------------
+# classification: memory exhaustion vs device loss stay disjoint
+# --------------------------------------------------------------------------
+
+class TestClassification:
+    OOM_SHAPES = [
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "68719476736 bytes.",
+        "Resource exhausted: Failed to allocate request for 2.0GiB",
+        "XLA:TPU compile permanent error: OOM when allocating tensor",
+        "allocation failure: hbm exhausted",
+        "requested bytes exceeds the memory available",
+    ]
+    LOSS_SHAPES = [
+        "DEVICE_LOST: device lost: TPU worker disappeared",
+        "UNAVAILABLE: socket closed",
+    ]
+
+    def test_oom_shapes_classify(self):
+        for msg in self.OOM_SHAPES:
+            e = RuntimeError(msg)
+            assert mem.is_memory_exhaustion(e), msg
+            assert not sup.is_device_loss(e), msg
+
+    def test_typed_forms_classify(self):
+        assert mem.is_memory_exhaustion(MemoryError("host heap"))
+        assert mem.is_memory_exhaustion(mem.MemoryExhaustedError("hbm"))
+
+    def test_device_loss_is_not_memory_exhaustion(self):
+        for msg in self.LOSS_SHAPES:
+            e = RuntimeError(msg)
+            assert sup.is_device_loss(e), msg
+            assert not mem.is_memory_exhaustion(e), msg
+
+    def test_resource_exhausted_is_not_device_loss(self):
+        # the PR-11 contract, re-pinned from the memory side: OOM must
+        # route to the shrink ladder, never to a mesh shrink
+        assert not sup.is_device_loss(RuntimeError("RESOURCE_EXHAUSTED"))
+
+    def test_ordinary_failures_do_not_classify(self):
+        for e in (ValueError("bad hyper-parameter"),
+                  RuntimeError("jaxlib error: invalid argument"),
+                  KeyError("metric")):
+            assert not mem.is_memory_exhaustion(e), e
+
+    def test_wrap_attaches_last_plan_and_is_idempotent(self):
+        plan = mem.plan_sweep_memory(rows=1000, cols=8, folds=3,
+                                     grid_width=4, devices=8,
+                                     budget=64 << 20)
+        typed = mem.as_memory_exhausted(RuntimeError("RESOURCE_EXHAUSTED"))
+        assert isinstance(typed, mem.MemoryExhaustedError)
+        assert typed.plan is plan
+        assert mem.as_memory_exhausted(typed) is typed
+
+
+# --------------------------------------------------------------------------
+# preflight planner
+# --------------------------------------------------------------------------
+
+class TestPlanner:
+    KW = dict(rows=1_000_000, cols=32, folds=3, grid_width=8, devices=8)
+
+    def test_deterministic(self):
+        a = mem.plan_sweep_memory(budget=32 << 20, **self.KW)
+        b = mem.plan_sweep_memory(budget=32 << 20, **self.KW)
+        assert a.to_json() == b.to_json()
+
+    def test_no_budget_keeps_default_chunk(self):
+        plan = mem.plan_sweep_memory(budget=None, chunk_bytes=256 << 20,
+                                     **self.KW)
+        assert plan.chunk_bytes == 256 << 20
+        assert plan.grid_parts == 1 and plan.shrinks == []
+
+    def test_tiny_budget_shrinks_chunks(self):
+        plan = mem.plan_sweep_memory(budget=32 << 20,
+                                     chunk_bytes=256 << 20, **self.KW)
+        assert plan.chunk_bytes < 256 << 20
+        # staging (double-buffered) stays within a quarter of the budget
+        assert 2 * plan.chunk_bytes <= (32 << 20) // 4
+        assert "halve_chunk_bytes" in plan.shrinks
+
+    def test_very_tiny_budget_partitions_grid(self):
+        plan = mem.plan_sweep_memory(budget=4 << 20, chunk_bytes=256 << 20,
+                                     **self.KW)
+        assert plan.grid_parts > 1
+        assert "partition_grid" in plan.shrinks
+        assert plan.chunk_bytes >= 1 << 20    # floor holds
+
+    def test_env_budget_discovery(self, monkeypatch):
+        monkeypatch.setenv("TRANSMOGRIFAI_DEVICE_MEM_BYTES",
+                           str(48 << 20))
+        assert mem.device_memory_budget() == 48 << 20
+        plan = mem.plan_sweep_memory(chunk_bytes=256 << 20, **self.KW)
+        assert plan.device_budget == 48 << 20
+        assert mem.last_plan() is plan
+
+    def test_batch_estimate_scales_with_rows_and_width(self):
+        one = mem.estimate_batch_bytes(1, 10)
+        assert mem.estimate_batch_bytes(100, 10) == 100 * one
+        assert mem.estimate_batch_bytes(1, 20) == 2 * one
+
+
+# --------------------------------------------------------------------------
+# the shrink-and-retry ladder
+# --------------------------------------------------------------------------
+
+class TestShrinkLadder:
+    def test_ladder_walk_is_deterministic_and_recorded(self):
+        flog = FailureLog()
+        before = REGISTRY.counter("memory.shrinks_total").value
+        base = 256 << 20
+        with use_failure_log(flog):
+            assert (mem.effective_chunk_bytes(base), mem.grid_partitions(),
+                    mem.model_axis_collapsed(),
+                    mem.per_candidate_fallback()) == (base, 1, False, False)
+            oom = RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+            # rung 1: halve chunks only
+            assert mem.note_sweep_memory_exhaustion(oom, attempt=0) == 1
+            assert mem.effective_chunk_bytes(base) == base >> 1
+            assert mem.grid_partitions() == 1
+            # rung 2: partition the candidate grid
+            assert mem.note_sweep_memory_exhaustion(oom, attempt=1) == 2
+            assert mem.effective_chunk_bytes(base) == base >> 2
+            assert mem.grid_partitions() == 2
+            assert not mem.model_axis_collapsed()
+            # rung 3: collapse the model axis
+            assert mem.note_sweep_memory_exhaustion(oom, attempt=2) == 3
+            assert mem.model_axis_collapsed()
+            assert not mem.per_candidate_fallback()
+            # rung 4: per-candidate fallback (last resort)
+            assert mem.note_sweep_memory_exhaustion(oom, attempt=3) == 4
+            assert mem.per_candidate_fallback()
+        events = [e for e in flog if e.point == "memory.device_oom"]
+        assert [e.action for e in events] == ["degraded"] * 4
+        assert [e.detail["fallback"] for e in events] == [
+            f"memory ladder: {s}" for s in mem.LADDER_STEPS]
+        assert REGISTRY.counter("memory.shrinks_total").value - before == 4
+        mem.reset_memory_degrade()
+        assert mem.shrink_level() == 0
+        assert mem.effective_chunk_bytes(base) == base
+
+    def test_chunk_floor(self):
+        for _ in range(12):
+            mem.note_sweep_memory_exhaustion(RuntimeError("oom"))
+        assert mem.effective_chunk_bytes(256 << 20) == 1 << 20
+
+    def test_planner_folds_in_ladder_state(self):
+        mem.note_sweep_memory_exhaustion(RuntimeError("oom"))
+        mem.note_sweep_memory_exhaustion(RuntimeError("oom"))
+        plan = mem.plan_sweep_memory(rows=1000, cols=8, folds=3,
+                                     grid_width=8, devices=8, budget=None,
+                                     chunk_bytes=256 << 20)
+        # a post-OOM replan starts from the degraded state, not scratch
+        assert plan.chunk_bytes == (256 << 20) >> 2
+        assert plan.grid_parts == 2
+
+    def test_governor_off_means_zero_recoveries(self, monkeypatch):
+        monkeypatch.setenv("TRANSMOGRIFAI_MEMORY_GOVERNOR", "0")
+        assert not mem.memory_governor_enabled()
+        assert mem.max_oom_recoveries() == 0
+        monkeypatch.setenv("TRANSMOGRIFAI_MEMORY_GOVERNOR", "1")
+        monkeypatch.setenv("TRANSMOGRIFAI_OOM_RECOVERIES", "7")
+        assert mem.max_oom_recoveries() == 7
+
+
+# --------------------------------------------------------------------------
+# host RSS watchdog
+# --------------------------------------------------------------------------
+
+class TestRssWatchdog:
+    def _wd(self, readings, shed_log=None):
+        it = iter(readings)
+        shedders = () if shed_log is None else (
+            lambda: shed_log.append("pretrace") or 11,
+            lambda: shed_log.append("cache") or 22)
+        return mem.RssWatchdog(soft_bytes=100, hard_bytes=200,
+                               rss_reader=lambda: next(it),
+                               clock=lambda: 0.0, shedders=shedders)
+
+    def test_transitions_shed_trip_and_recover(self):
+        flog = FailureLog()
+        shed_log = []
+        wd = self._wd([50, 150, 150, 250, 250, 40], shed_log)
+        with use_failure_log(flog):
+            assert wd.tick() == "ok"
+            assert wd.tick() == "soft"         # crossed soft: sheds once
+            assert shed_log == ["pretrace", "cache"]
+            assert wd.tick() == "soft"         # still soft: no re-shed
+            assert shed_log == ["pretrace", "cache"]
+            assert wd.tick() == "hard"         # crossed hard: trips
+            assert wd.tripped
+            with pytest.raises(mem.HostMemoryPressure):
+                wd.check()
+            assert wd.tick() == "hard"
+            assert wd.tick() == "ok"           # recovered: untrips
+            assert not wd.tripped
+            wd.check()                          # no longer raises
+        actions = [e.action for e in flog
+                   if e.point == "memory.host_pressure"]
+        assert actions == ["shed", "degraded", "recovered"]
+        shed_ev = next(e for e in flog if e.action == "shed")
+        assert shed_ev.detail["shed_bytes"] == 33
+
+    def test_ambient_check_host_pressure(self):
+        wd = self._wd([250])
+        with use_failure_log(FailureLog()):
+            wd.tick()
+        mem.install_watchdog(wd)
+        with pytest.raises(mem.HostMemoryPressure):
+            mem.check_host_pressure()
+        mem.install_watchdog(None)
+        mem.check_host_pressure()   # no ambient watchdog -> no-op
+
+    def test_injected_host_pressure_reads_as_hard(self):
+        flog = FailureLog()
+        wd = self._wd([50, 50])
+        with use_failure_log(flog), inject_faults(FaultInjector(
+                rates={"memory.host_pressure": 1.0}, seed=0)):
+            assert wd.tick() == "hard"
+        assert wd.tripped
+        assert [e.action for e in flog
+                if e.point == "memory.host_pressure"] == ["degraded"]
+
+    def test_rss_gauge_tracks_reading(self):
+        wd = self._wd([123])
+        with use_failure_log(FailureLog()):
+            wd.tick()
+        assert wd.last_rss == 123
+        snap = REGISTRY.snapshot()["gauges"]
+        assert snap.get("memory.host_rss_bytes") == 123
+
+    def test_default_shedders_drop_real_state(self):
+        # the production shed targets actually release: the device-transfer
+        # cache reports freed bytes and the pretrace queue drains
+        from transmogrifai_tpu import aot, columns
+        released = columns.shed_device_cache()
+        assert released >= 0 and not columns._DEVICE_CACHE
+        assert aot.pretrace_shed() >= 0
+
+
+# --------------------------------------------------------------------------
+# serving admission: the memory signal
+# --------------------------------------------------------------------------
+
+class TestServingMemoryAdmission:
+    def _ctl(self, **params):
+        from transmogrifai_tpu.serving.overload import (OverloadConfig,
+                                                        OverloadController)
+        return OverloadController(OverloadConfig.from_params(params),
+                                  queue_bound=64, max_batch=8)
+
+    def test_over_budget_sheds_with_memory_kind(self):
+        ctl = self._ctl(batchBytesBudget=1000)
+        d = ctl.admit(queue_depth=0, est_bytes=5000)
+        assert d is not None and d.kind == "memory"
+        assert d.retry_after_s >= 1.0
+        assert "batchBytesBudget" in d.message
+
+    def test_under_budget_and_default_off_admit(self):
+        ctl = self._ctl(batchBytesBudget=1000)
+        assert ctl.admit(queue_depth=0, est_bytes=500) is None
+        # budget unset (the default): the signal is entirely off
+        off = self._ctl()
+        assert off.config.batch_bytes_budget is None
+        assert off.admit(queue_depth=0, est_bytes=10 ** 12) is None
+
+
+# --------------------------------------------------------------------------
+# selector: non-finite metrics leave an audit trail (ISSUE 15 satellite)
+# --------------------------------------------------------------------------
+
+class TestSelectorNonFiniteAudit:
+    class _R:
+        def __init__(self, name, value):
+            self.model_name = name
+            self.metric_values = {"auPR": value}
+
+    class _M:
+        def __init__(self, results):
+            class S:
+                evaluation_metric = "auPR"
+            self.summary = S()
+            self.summary.validation_results = results
+
+    def test_nonfinite_filtered_with_degraded_note(self):
+        from transmogrifai_tpu.selector import _combiner_best_metric
+        flog = FailureLog()
+        m = self._M([self._R("LR_good", 0.8), self._R("LR_nan", np.nan),
+                     self._R("LR_inf", np.inf)])
+        with use_failure_log(flog):
+            assert _combiner_best_metric(m, True) == 0.8
+        notes = [e for e in flog if e.point == "selector.nonfinite_metric"]
+        assert [e.action for e in notes] == ["degraded"] * 2
+        assert {e.detail["model"] for e in notes} == {"LR_nan", "LR_inf"}
+        assert all(e.detail["metric"] == "auPR" for e in notes)
+
+    def test_all_finite_records_nothing(self):
+        from transmogrifai_tpu.selector import _combiner_best_metric
+        flog = FailureLog()
+        m = self._M([self._R("A", 0.2), self._R("B", 0.9)])
+        with use_failure_log(flog):
+            assert _combiner_best_metric(m, True) == 0.9
+            assert _combiner_best_metric(m, False) == 0.2
+        assert not [e for e in flog
+                    if e.point == "selector.nonfinite_metric"]
+
+    def test_all_nonfinite_falls_back(self):
+        from transmogrifai_tpu.selector import _combiner_best_metric
+        flog = FailureLog()
+        m = self._M([self._R("A", np.nan)])
+        with use_failure_log(flog):
+            assert _combiner_best_metric(m, True) == 0.5
+        assert len([e for e in flog
+                    if e.point == "selector.nonfinite_metric"]) == 1

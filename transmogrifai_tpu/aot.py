@@ -389,3 +389,30 @@ def pretrace_submit(label: str, fn) -> None:
 def pretrace_drain(timeout: Optional[float] = None) -> None:
     """Block until submitted pre-traces finish (tests / shutdown hygiene)."""
     _IDLE.wait(timeout)
+
+
+def pretrace_shed() -> int:
+    """Drop every QUEUED (not-yet-started) pre-trace — the RSS watchdog's
+    soft-watermark shedder.  Pre-traces are strictly advisory (a dropped one
+    only costs the foreground compile it would have hidden), so under host
+    memory pressure they are the first load to go.  Returns the number of
+    entries dropped (the watchdog logs it; exact bytes are unknowable before
+    the compile runs)."""
+    import queue
+
+    with _POOL_LOCK:
+        if _QUEUE is None:
+            return 0
+        dropped = 0
+        while True:
+            try:
+                _QUEUE.get_nowait()
+            except queue.Empty:
+                break
+            _QUEUE.task_done()
+            dropped += 1
+        if _QUEUE.unfinished_tasks == 0:
+            _IDLE.set()
+    if dropped:
+        _count("aot.pretrace_shed", dropped)
+    return dropped

@@ -35,6 +35,18 @@ _DEVICE_CACHE_CAP = int(__import__("os").environ.get(
 _MATRIX_BF16_ELEMS = 1 << 26       # 64M elements = 256 MB in f32
 
 
+def shed_device_cache() -> int:
+    """Release every cached host→device transfer — the RSS watchdog's
+    soft-watermark shedder.  The cache only saves re-transfers (columns are
+    immutable; a dropped entry re-ships over the link on next use), so
+    under host memory pressure its device bytes AND the host references
+    pinning the source arrays go first.  Returns the bytes released."""
+    released = _DEVICE_CACHE_BYTES[0]
+    _DEVICE_CACHE.clear()
+    _DEVICE_CACHE_BYTES[0] = 0
+    return max(0, int(released))
+
+
 def device_matrix(values):
     """Feature matrix for device compute: device-resident f32/bf16 arrays
     pass through untouched (bf16 is STORAGE — every consumer accumulates in

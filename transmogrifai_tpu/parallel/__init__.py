@@ -19,6 +19,10 @@ from .hostgroup import (EXIT_HOST_LOST, HostGroup, HostGroupResult,
                         HostLiveness, HostLostError, barrier_sync,
                         hostgroup_env_present, launch_hosts,
                         maybe_init_hostgroup)
+from .memory import (HostMemoryPressure, MemoryExhaustedError, MemoryPlan,
+                     RssWatchdog, check_host_pressure, device_memory_budget,
+                     is_memory_exhaustion, memory_governor_enabled,
+                     plan_sweep_memory, reset_memory_degrade, shrink_level)
 from .multihost import ensure_cpu_collectives, init_distributed, is_multihost
 from .streaming import (device_chunk_bytes, stream_to_device,
                         streaming_stats)
@@ -41,6 +45,10 @@ __all__ = [
     "HostLostError", "barrier_sync", "hostgroup_env_present",
     "launch_hosts", "maybe_init_hostgroup",
     "stream_to_device", "streaming_stats", "device_chunk_bytes",
+    "HostMemoryPressure", "MemoryExhaustedError", "MemoryPlan",
+    "RssWatchdog", "check_host_pressure", "device_memory_budget",
+    "is_memory_exhaustion", "memory_governor_enabled", "plan_sweep_memory",
+    "reset_memory_degrade", "shrink_level",
     "DeviceLostError", "Heartbeat", "ProbeVerdict", "SupervisedResult",
     "TransferStallError", "effective_device_count", "is_device_loss",
     "mark_device_loss", "probe_devices", "probe_with_backoff",

@@ -54,6 +54,11 @@ def model_axis_width() -> int:
         return 1
     if w < 1 or len(jax.devices()) % w:
         return 1
+    # memory degrade ladder rung 3: give the model axis's devices back to
+    # the data axis so each candidate lane spans more aggregate HBM
+    from .memory import model_axis_collapsed
+    if model_axis_collapsed():
+        return 1
     return w
 
 

@@ -162,7 +162,12 @@ def stream_to_device(arr,
     row_bytes = np_dtype.itemsize * max(
         1, int(np.prod([s for a, s in enumerate(target_shape)
                         if a != row_axis])))
-    budget = chunk_bytes if chunk_bytes is not None else device_chunk_bytes()
+    # the memory-governor degrade ladder halves the chunk budget per rung:
+    # applies to explicit planner-chosen budgets too, so a post-OOM retry
+    # streams smaller even when the caller pinned chunk_bytes
+    from .memory import effective_chunk_bytes
+    budget = effective_chunk_bytes(
+        chunk_bytes if chunk_bytes is not None else device_chunk_bytes())
     chunk_rows = max(1, budget // row_bytes)
 
     REGISTRY.gauge("mesh.chunk_bytes").set(budget)

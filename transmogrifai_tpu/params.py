@@ -84,6 +84,14 @@ class OpParams:
     # (TRANSMOGRIFAI_HOSTGROUP_DISTRIBUTED — jax.distributed per rank),
     # maxRelaunches, bootTimeoutS, graceS, runDir (launcher-side)
     hostgroup: Dict[str, Any] = field(default_factory=dict)
+    # memory-governance knobs (parallel/memory.py env equivalents): enabled
+    # (TRANSMOGRIFAI_MEMORY_GOVERNOR; --no-memory-governor), deviceMemBytes
+    # (TRANSMOGRIFAI_DEVICE_MEM_BYTES per-device budget override), headroom
+    # (TRANSMOGRIFAI_MEMORY_HEADROOM XLA-temp factor), oomRecoveries
+    # (TRANSMOGRIFAI_OOM_RECOVERIES shrink-ladder budget), hostSoftBytes /
+    # hostHardBytes (TRANSMOGRIFAI_HOST_MEM_SOFT_BYTES / _HARD_BYTES RSS
+    # watchdog watermarks), watchdogIntervalS (TRANSMOGRIFAI_RSS_WATCHDOG_S)
+    memory: Dict[str, Any] = field(default_factory=dict)
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "OpParams":
@@ -109,7 +117,8 @@ class OpParams:
             aot=d.get("aotParams") or {},
             mesh=d.get("meshParams") or {},
             supervisor=d.get("supervisorParams") or {},
-            hostgroup=d.get("hostgroupParams") or {})
+            hostgroup=d.get("hostgroupParams") or {},
+            memory=d.get("memoryParams") or {})
 
     @staticmethod
     def load(path: str) -> "OpParams":
@@ -138,6 +147,7 @@ class OpParams:
             "meshParams": self.mesh,
             "supervisorParams": self.supervisor,
             "hostgroupParams": self.hostgroup,
+            "memoryParams": self.memory,
         }
 
     def apply_stage_params(self, stages) -> None:

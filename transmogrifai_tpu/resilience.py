@@ -809,4 +809,9 @@ INJECTION_POINTS = {
                               "(fires as a stalled/hung link)",
     "supervisor.device_loss": "a device dropping out of the active mesh "
                               "mid-sweep (fit or scoring)",
+    "memory.device_oom": "a device allocator exhausting HBM mid-sweep "
+                         "(fires as RESOURCE_EXHAUSTED; routes to the "
+                         "shrink-and-retry ladder, never the mesh shrink)",
+    "memory.host_pressure": "one host RSS watchdog tick (fires as a "
+                            "hard-watermark reading)",
 }

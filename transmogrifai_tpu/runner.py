@@ -164,6 +164,31 @@ class OpWorkflowRunner:
         if hg_params.get("distributed") is not None:
             os.environ["TRANSMOGRIFAI_HOSTGROUP_DISTRIBUTED"] = \
                 "1" if hg_params["distributed"] else "0"
+        # memoryParams: the governor reads the env per call (preflight plan
+        # per fold group, ladder per retry), so run-scoped knobs ride the
+        # env knobs exactly like the supervisor's
+        memp = params.memory or {}
+        if memp.get("enabled") is not None:
+            os.environ["TRANSMOGRIFAI_MEMORY_GOVERNOR"] = \
+                "1" if memp["enabled"] else "0"
+        if memp.get("deviceMemBytes") is not None:
+            os.environ["TRANSMOGRIFAI_DEVICE_MEM_BYTES"] = \
+                str(memp["deviceMemBytes"])
+        if memp.get("headroom") is not None:
+            os.environ["TRANSMOGRIFAI_MEMORY_HEADROOM"] = \
+                str(memp["headroom"])
+        if memp.get("oomRecoveries") is not None:
+            os.environ["TRANSMOGRIFAI_OOM_RECOVERIES"] = \
+                str(memp["oomRecoveries"])
+        if memp.get("hostSoftBytes") is not None:
+            os.environ["TRANSMOGRIFAI_HOST_MEM_SOFT_BYTES"] = \
+                str(memp["hostSoftBytes"])
+        if memp.get("hostHardBytes") is not None:
+            os.environ["TRANSMOGRIFAI_HOST_MEM_HARD_BYTES"] = \
+                str(memp["hostHardBytes"])
+        if memp.get("watchdogIntervalS") is not None:
+            os.environ["TRANSMOGRIFAI_RSS_WATCHDOG_S"] = \
+                str(memp["watchdogIntervalS"])
         tele = params.telemetry or {}
         trace_dir = tele.get("traceDir")
         enabled = bool(tele.get("enabled", trace_dir is not None))
@@ -198,6 +223,18 @@ class OpWorkflowRunner:
             from .parallel.supervisor import Heartbeat, supervisor_enabled
             if supervisor_enabled():
                 hb = Heartbeat(interval_s=hb_interval).start()
+        # host-side RSS watchdog (ISSUE 15): runs whenever the governor is
+        # on, a watermark is configured, and a cadence is set — sheds
+        # pretrace queues/transfer caches at the soft watermark, trips the
+        # typed HostMemoryPressure flag at the hard one
+        wd = None
+        from .parallel import memory as _memory
+        wd_interval = _memory.watchdog_interval_s()
+        if (wd_interval > 0 and _memory.memory_governor_enabled()
+                and (os.environ.get("TRANSMOGRIFAI_HOST_MEM_SOFT_BYTES")
+                     or os.environ.get("TRANSMOGRIFAI_HOST_MEM_HARD_BYTES"))):
+            wd = _memory.RssWatchdog(interval_s=wd_interval).start()
+            _memory.install_watchdog(wd)
         hg = None
         try:
             with ctx:
@@ -213,6 +250,9 @@ class OpWorkflowRunner:
                 hg.close()
             if hb is not None:
                 hb.stop()
+            if wd is not None:
+                _memory.install_watchdog(None)
+                wd.stop()
         if tracer is not None:
             result.tracer = tracer
             if trace_dir:
@@ -656,6 +696,15 @@ class OpApp:
                        help="disable device-runtime supervision: no "
                             "degrade-to-surviving-mesh sweep recovery, no "
                             "heartbeat; device errors propagate unchanged")
+        p.add_argument("--no-memory-governor", action="store_true",
+                       help="disable memory governance: no preflight "
+                            "device-memory planning, no OOM shrink-and-"
+                            "retry ladder, no RSS watchdog; allocator "
+                            "errors propagate unchanged")
+        p.add_argument("--device-mem-bytes", type=int,
+                       help="per-device memory budget the preflight "
+                            "planner plans against (overrides "
+                            "device.memory_stats() discovery)")
         p.add_argument("--hosts", type=int, default=1,
                        help="launch this command across N supervised local "
                             "processes (ranked host group with heartbeats, "
@@ -702,6 +751,10 @@ class OpApp:
             params.mesh["chunkBytes"] = args.mesh_chunk_bytes
         if args.no_supervisor:
             params.supervisor["enabled"] = False
+        if args.no_memory_governor:
+            params.memory["enabled"] = False
+        if args.device_mem_bytes is not None:
+            params.memory["deviceMemBytes"] = args.device_mem_bytes
         from .parallel import hostgroup
         hosts = max(1, int(args.hosts or params.hostgroup.get("hosts", 1)))
         if hosts > 1 and not hostgroup.hostgroup_env_present():

@@ -637,6 +637,31 @@ class RegressionModelSelector(ModelSelector):
         return _compact_models(OpLinearRegression, OpRandomForestRegressor)
 
 
+def _combiner_best_metric(m, larger_better: bool) -> float:
+    """Best validation metric of one selector's summary, for ensemble
+    weighting.  Non-finite values (NaN/inf fold metrics of failed or
+    diverged candidates) are excluded from the ranking — but never
+    silently: each drop records a ``degraded`` FailureLog note naming the
+    candidate and metric, so a candidate that NaN-ed its way out of the
+    weighting is visible in the log instead of vanishing."""
+    metric = m.summary.evaluation_metric
+    vals = []
+    for r in m.summary.validation_results:
+        v = r.metric_values.get(metric, np.nan)
+        if np.isfinite(v):
+            vals.append(v)
+        else:
+            record_failure("combiner", "degraded",
+                           f"non-finite {metric}={v} for candidate "
+                           f"{r.model_name}; excluded from ensemble "
+                           "weighting",
+                           point="selector.nonfinite_metric",
+                           model=r.model_name, metric=metric)
+    if not vals:
+        return 0.5
+    return max(vals) if larger_better else min(vals)
+
+
 class SelectedModelCombiner(Estimator):
     """≙ SelectedModelCombiner: weighted-average ensemble of two selectors'
     winners, weights ∝ validation metric."""
@@ -660,15 +685,8 @@ class SelectedModelCombiner(Estimator):
 
         # weight by each selector's best validation metric; for
         # smaller-is-better metrics (RMSE, Error) weight inversely
-        def _best_metric(m):
-            vals = [r.metric_values.get(m.summary.evaluation_metric, np.nan)
-                    for r in m.summary.validation_results]
-            vals = [v for v in vals if np.isfinite(v)]
-            if not vals:
-                return 0.5
-            return max(vals) if larger_better else min(vals)
-
-        b1, b2 = _best_metric(m1), _best_metric(m2)
+        b1 = _combiner_best_metric(m1, larger_better)
+        b2 = _combiner_best_metric(m2, larger_better)
         if larger_better:
             w1, w2 = abs(b1), abs(b2)
         else:

@@ -519,8 +519,20 @@ class ScoringEngine:
                          ctx: Optional[TraceContext] = None) -> None:
         if self._closed or self._draining:
             raise EngineClosed("engine is shutting down")
+        est_bytes = None
+        if self.overload.config.batch_bytes_budget is not None:
+            # device-memory admission (ISSUE 15): estimate what the queue
+            # would occupy on device with this request admitted.  The entry
+            # is read without the swap lock — a stale width during a reload
+            # race only skews an estimate, never correctness.
+            from ..parallel.memory import estimate_batch_bytes
+            width = len(getattr(self._entry.model, "raw_features",
+                                ()) or ()) or 1
+            est_bytes = estimate_batch_bytes(self._queued_rows + extra,
+                                             width)
         decision = self.overload.admit(self._queued_rows, extra,
-                                       deadline_s=deadline_s)
+                                       deadline_s=deadline_s,
+                                       est_bytes=est_bytes)
         if decision is not None:
             trace_id = ctx.trace_id if ctx else None
             self.metrics.counter("shed_total").inc(trace_id=trace_id)

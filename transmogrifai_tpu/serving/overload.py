@@ -70,6 +70,11 @@ class OverloadConfig:
     half_open_probes: int = 1           # probes that must succeed to close
     reload_breaker_failures: int = 3    # reload failures that open its breaker
     reload_breaker_reset_s: float = 10.0
+    # device-memory admission budget (ISSUE 15): estimated bytes the queued
+    # rows would occupy on device (rows × feature width × dtype × headroom,
+    # parallel/memory.estimate_batch_bytes); None = memory admission off —
+    # the default, so depth/deadline-tuned deployments are unchanged
+    batch_bytes_budget: Optional[int] = None
 
     _PARAM_KEYS = {
         "latencyTargetMs": "latency_target_ms",
@@ -86,6 +91,7 @@ class OverloadConfig:
         "halfOpenProbes": "half_open_probes",
         "reloadBreakerFailures": "reload_breaker_failures",
         "reloadBreakerResetS": "reload_breaker_reset_s",
+        "batchBytesBudget": "batch_bytes_budget",
     }
 
     @classmethod
@@ -104,8 +110,9 @@ class OverloadConfig:
 class ShedDecision:
     """Why admission refused a request, and when to come back."""
 
-    kind: str            # "limit" (queue past the adaptive limit) or
-    #                      "deadline" (queue wait would blow the deadline)
+    kind: str            # "limit" (queue past the adaptive limit),
+    #                      "deadline" (queue wait would blow the deadline),
+    #                      or "memory" (queued rows past the byte budget)
     message: str
     retry_after_s: float
 
@@ -240,11 +247,28 @@ class OverloadController:
         return batches_ahead * ewma
 
     def admit(self, queue_depth: int, extra: int = 1,
-              deadline_s: Optional[float] = None
+              deadline_s: Optional[float] = None,
+              est_bytes: Optional[int] = None
               ) -> Optional[ShedDecision]:
         """Decide whether ``extra`` records may join a queue currently
         ``queue_depth`` deep.  None = admitted; a ``ShedDecision``
-        otherwise (the engine translates it into ``OverloadedError``)."""
+        otherwise (the engine translates it into ``OverloadedError``).
+
+        ``est_bytes`` — the engine's device-memory estimate for the queue
+        WITH this request admitted — is checked against
+        ``batch_bytes_budget`` when both are set: a batch that would blow
+        the device budget sheds honestly at the door instead of OOM-ing
+        the scoring program mid-flight."""
+        budget_bytes = self.config.batch_bytes_budget
+        if (budget_bytes is not None and est_bytes is not None
+                and est_bytes > budget_bytes):
+            wait = self.estimate_wait_s(queue_depth)
+            return ShedDecision(
+                kind="memory",
+                message=(f"estimated queued-batch footprint {est_bytes} "
+                         f"bytes exceeds the {budget_bytes}-byte device "
+                         "memory budget (batchBytesBudget)"),
+                retry_after_s=max(1.0, wait))
         limit = self.admission_limit()
         if queue_depth + extra > limit:
             wait = self.estimate_wait_s(queue_depth)

@@ -219,6 +219,10 @@ def render_metrics(engine: ScoringEngine) -> str:
             "deadline",
             exemplar=engine.metrics.counter(
                 "shed_deadline_total").exemplar())
+    counter("shed_memory_total", c.get("shed_memory_total", 0),
+            "Requests shed because the estimated queued-batch footprint "
+            "exceeded the device memory budget (batchBytesBudget)",
+            exemplar=engine.metrics.counter("shed_memory_total").exemplar())
     counter("brownout_sheds_total", c.get("brownout_sheds_total", 0),
             "Batch-observer runs skipped while in BROWNOUT")
     counter("health_transitions_total", c.get("health_transitions_total", 0),

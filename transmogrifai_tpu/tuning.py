@@ -716,8 +716,18 @@ class OpValidator:
         ``SweepCheckpoint`` candidate boundary, replaying already-scored
         families instead of refitting them.  Bounded by
         TRANSMOGRIFAI_SWEEP_RECOVERIES (0 with ``--no-supervisor``: the
-        error propagates unchanged)."""
+        error propagates unchanged).
+
+        Classified device-memory exhaustion (``is_memory_exhaustion``:
+        RESOURCE_EXHAUSTED / allocator messages — deliberately disjoint
+        from device loss) takes the OTHER recovery: the deterministic
+        shrink ladder (halve streaming chunks → partition the candidate
+        grid → collapse the model axis → per-candidate fallback), one rung
+        per retry, resuming from the same checkpoint.  Bounded by
+        TRANSMOGRIFAI_OOM_RECOVERIES; an exhausted ladder raises typed
+        ``MemoryExhaustedError`` with the attempted plan attached."""
         from .parallel import hostgroup as _hostgroup
+        from .parallel import memory as _memory
         from .parallel import supervisor as _supervisor
         from .telemetry import span
         # inside a multi-process host group the sweep span carries the rank
@@ -727,24 +737,42 @@ class OpValidator:
             _hg_attrs = {"hostgroup_rank": _hostgroup.current_rank(),
                          "hostgroup_world": _hostgroup.group_world_size()}
         attempt = 0
+        oom_attempt = 0
         while True:
             self._sweep_attempt = attempt
+            self._oom_attempt = oom_attempt
+            # the RSS watchdog's hard watermark surfaces HERE, on the
+            # governed thread, where a typed error can be handled — not as
+            # a kernel OOM-kill of an arbitrary victim
+            _memory.check_host_pressure()
             try:
                 with span("selector.sweep", candidates=len(candidates),
                           validation_type=self.validation_type,
                           grid_points=sum(len(c.grid) for c in candidates),
-                          attempt=attempt, **_hg_attrs):
+                          attempt=attempt, oom_attempt=oom_attempt,
+                          **_hg_attrs):
                     return self._validate_impl(candidates, batch, label,
                                                features,
                                                in_fold_dag=in_fold_dag,
                                                splitter=splitter)
             except Exception as e:  # noqa: BLE001 — classify, maybe recover
-                if (attempt >= _supervisor.max_sweep_recoveries()
-                        or not _supervisor.is_device_loss(e)):
-                    raise
-                _supervisor.note_sweep_device_loss(e, attempt=attempt,
-                                                   stage="validator")
-                attempt += 1
+                if _supervisor.is_device_loss(e):
+                    if attempt >= _supervisor.max_sweep_recoveries():
+                        raise
+                    _supervisor.note_sweep_device_loss(e, attempt=attempt,
+                                                       stage="validator")
+                    attempt += 1
+                    continue
+                if _memory.is_memory_exhaustion(e):
+                    if not _memory.memory_governor_enabled():
+                        raise   # --no-memory-governor: propagate unchanged
+                    if oom_attempt >= _memory.max_oom_recoveries():
+                        raise _memory.as_memory_exhausted(e) from e
+                    _memory.note_sweep_memory_exhaustion(
+                        e, attempt=oom_attempt, stage="validator")
+                    oom_attempt += 1
+                    continue
+                raise
 
     def _validate_impl(self, candidates: Sequence[ModelCandidate],
                        batch: ColumnBatch, label: str, features: str,
@@ -883,8 +911,9 @@ class OpValidator:
                 pred = model.predict_arrays(X_va)
                 return self.evaluator.evaluate(y_va, pred)
             except Exception as e:  # noqa: BLE001 — candidate robustness
+                from .parallel.memory import is_memory_exhaustion
                 from .parallel.supervisor import is_device_loss
-                if is_device_loss(e):
+                if is_device_loss(e) or is_memory_exhaustion(e):
                     raise   # sweep-level recovery, not a NaN score
                 record_failure(cand.model_name, "skipped", e,
                                point="selector.candidate_metric",
@@ -1006,6 +1035,8 @@ class OpValidator:
             self.last_mesh = mesh
             from .parallel import (data_axis_size, data_sharding,
                                    pad_rows_for, stream_to_device)
+            from .parallel import memory as _mem
+            _plan_chunk = None   # preflight-chosen streaming chunk bytes
             N_fit = N
             if mesh is not None:
                 # multi-device: row-shard the matrix over the mesh 'data' axis
@@ -1022,6 +1053,22 @@ class OpValidator:
                     N_fit = max(N_fit, -(-rung // extent) * extent)
                 if N_fit > N and not pad_exact_all:
                     N_fit = N   # divisible N, mixed families: no ladder pad
+                if _mem.memory_governor_enabled():
+                    # preflight (ISSUE 15): estimate the padded-rung ×
+                    # dtype × grid-width × fold-panel footprint against the
+                    # per-device budget and choose chunk bytes (and grid
+                    # partitioning, read back by the fit bodies) BEFORE the
+                    # first transfer — the 11M-row regime stops discovering
+                    # OOM by dying in batched_device_put
+                    plan = _mem.plan_sweep_memory(
+                        rows=N_fit,
+                        cols=(int(X.shape[1])
+                              if getattr(X, "ndim", 1) == 2 else 1),
+                        folds=len(fsplits),
+                        grid_width=max((len(c.grid) for c in candidates),
+                                       default=1),
+                        devices=int(mesh.devices.size))
+                    _plan_chunk = plan.chunk_bytes
                 if isinstance(X, jax.Array):
                     # already device-resident (upstream DAG output): pad on
                     # device, then lay out over the mesh in one shot
@@ -1037,7 +1084,8 @@ class OpValidator:
                     # the one-shot device_put staged the whole matrix
                     # (BENCH_11M_ATTEMPTS_r4 hard faults)
                     X = stream_to_device(np.asarray(X, dtype=np.float32),
-                                         mesh, pad_to=N_fit)
+                                         mesh, pad_to=N_fit,
+                                         chunk_bytes=_plan_chunk)
                 if N_fit > N:
                     # tree families quantile-bin over the true rows only —
                     # keeps padded split points identical to unpadded ones
@@ -1052,7 +1100,8 @@ class OpValidator:
             if is_dev:
                 # exact wire (bf16 only when verified lossless), shared with
                 # every other consumer of the same label buffer
-                y_dev = (stream_to_device(y32, mesh, pad_to=N_fit)
+                y_dev = (stream_to_device(y32, mesh, pad_to=N_fit,
+                                          chunk_bytes=_plan_chunk)
                          if mesh is not None else
                          to_device_f32(y32, exact=True))
             X_host = None if is_dev else X   # lazy d2h only if a fallback needs it
@@ -1099,12 +1148,14 @@ class OpValidator:
                         vm[va_idx] = 1.0
                         if mesh is not None:
                             # pad tail streams in as zeros — never validated
-                            vmj = stream_to_device(vm, mesh, pad_to=N_fit)
+                            vmj = stream_to_device(vm, mesh, pad_to=N_fit,
+                                                   chunk_bytes=_plan_chunk)
                         else:
                             vmj = to_device_f32(vm)  # 0/1 mask: bf16 exact
                         va_masks_dev.append(vmj)
                 if mesh is not None:
-                    W = stream_to_device(W, mesh, row_axis=1, pad_to=N_fit)
+                    W = stream_to_device(W, mesh, row_axis=1, pad_to=N_fit,
+                                         chunk_bytes=_plan_chunk)
                 else:
                     # one shared transfer; family fits see a no-op conversion.
                     # exact=True: bf16 wire only when verified lossless (0/1
@@ -1191,6 +1242,7 @@ class OpValidator:
                     return _fit_candidate_body(cand, Wblk, grid)
 
             def _fit_candidate_body(cand, Wblk, grid):
+                from .parallel import memory as _memq
                 from .telemetry import span as _span
                 use_pad = bool(pad_rows) and getattr(
                     cand.estimator, "weighted_pad_exact", False)
@@ -1207,7 +1259,34 @@ class OpValidator:
                         "supervisor.device_loss",
                         key=f"{cand.model_name}:fit:"
                             f"a{getattr(self, '_sweep_attempt', 0)}")
-                    out = cand.estimator.fit_arrays_grid(Xf, yf, Wf, grid)
+                    # chaos seam for a mid-sweep allocator OOM; keyed by the
+                    # memory-ladder attempt for the same reason — the
+                    # shrunken retry must not be re-killed
+                    maybe_inject(
+                        "memory.device_oom",
+                        key=f"{cand.model_name}:fit:"
+                            f"o{getattr(self, '_oom_attempt', 0)}")
+                    if _memq.per_candidate_fallback():
+                        # memory ladder's last rung: no batched grid program
+                        # at all — the per-(fold, point) working set is the
+                        # smallest the sweep can make
+                        raise MemoryError(
+                            "memory ladder: per-candidate fallback")
+                    parts = _memq.grid_partitions()
+                    if parts > 1 and len(grid) > 1:
+                        # memory ladder rung 2+ (or the preflight plan):
+                        # split the batched (fold × grid) program into grid
+                        # sub-batches so each program's lane working set
+                        # shrinks with the partition count
+                        sub = -(-len(grid) // min(parts, len(grid)))
+                        outs = [cand.estimator.fit_arrays_grid(
+                                    Xf, yf, Wf, grid[i:i + sub])
+                                for i in range(0, len(grid), sub)]
+                        out = [[fit for o in outs for fit in o[f]]
+                               for f in range(len(outs[0]))]
+                    else:
+                        out = cand.estimator.fit_arrays_grid(Xf, yf, Wf,
+                                                             grid)
                     self.family_fit_meta[cand.model_name] = {
                         "folds": len(out), "rows": int(Xf.shape[0]),
                         "real_rows": int(N), "lanes": len(grid),
@@ -1220,6 +1299,12 @@ class OpValidator:
                     # sweep-level recovery rebuild the surviving mesh instead
                     from .parallel.supervisor import is_device_loss
                     if is_device_loss(e):
+                        raise
+                    # allocator exhaustion is not a bad candidate either —
+                    # unless the ladder already reached its last rung, where
+                    # per-point refits ARE the recovery
+                    if (_memq.is_memory_exhaustion(e)
+                            and not _memq.per_candidate_fallback()):
                         raise
                     # batched fit failed as a block — retry per point so one
                     # bad candidate can't take down the family (≙ Try-wrapped
@@ -1344,6 +1429,10 @@ class OpValidator:
                     "supervisor.device_loss",
                     key=f"{cand.model_name}:score:"
                         f"a{getattr(self, '_sweep_attempt', 0)}")
+                maybe_inject(
+                    "memory.device_oom",
+                    key=f"{cand.model_name}:score:"
+                        f"o{getattr(self, '_oom_attempt', 0)}")
                 masks = va_masks_dev[fold_offset:fold_offset + n_folds]
                 if (is_dev and self._record_grid_metrics_batched(
                         cand, ci, fitted_grid, X, y_dev, masks, rec)):

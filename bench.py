@@ -41,10 +41,17 @@ accelerator; reduced CPU smoke runs report 1.0.
    forced onto the JIT path — `new_compiles_at_serve` must be 0 on the AOT
    run.
 
+7. **multi_tenant** (ISSUE 16 tentpole): one TenantRegistry over six
+   per-tenant bundles with ``max_active=3`` and a deterministic skewed
+   popularity sequence — aggregate rows/s with LRU activation/eviction
+   churn in the measured wall, plus cold-tenant first-score latency and
+   activation/eviction counts in the aux.
+
 Env knobs: BENCH_ROWS (dense rows), BENCH_TRANSMOG_ROWS, BENCH_SCORE_ROWS,
 BENCH_SPARSE_ROWS, BENCH_SPARSE_HASHES, BENCH_COLD_START_ROWS,
-BENCH_WORKLOAD (dense|transmog|score|text_sparse|selector_smoke|
-serving_chaos|serve_cold_start|all, default all).
+BENCH_TENANT_REQUESTS, BENCH_WORKLOAD (dense|transmog|score|text_sparse|
+selector_smoke|serving_chaos|serve_cold_start|serve_scaleout|multi_tenant|
+all, default all).
 """
 
 import json
@@ -768,6 +775,117 @@ def run_serve_cold_start(on_accel: bool, platform: str):
     }
 
 
+def run_multi_tenant(on_accel: bool, platform: str):
+    """Multi-tenant serving (ISSUE 16 tentpole): one TenantRegistry over a
+    model root of per-tenant bundles, driven by a deterministic skewed
+    popularity sequence with ``max_active`` below the tenant count — so the
+    LRU activation/eviction churn is part of the measured wall, exactly as
+    a consolidation deployment would pay it.  Headline: aggregate rows/s
+    across all tenants.  Aux: cold-tenant first-score latency (activation +
+    first batch), activation/eviction counts, per-tenant request mix."""
+    import shutil
+    import tempfile
+
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                            ModelCandidate, grid)
+    from transmogrifai_tpu.serving.tenants import TenantRegistry
+    from transmogrifai_tpu.workflow import Workflow
+
+    n_train = int(os.environ.get("BENCH_TENANT_TRAIN_ROWS", "1000"))
+    requests = int(os.environ.get(
+        "BENCH_TENANT_REQUESTS", "600" if on_accel else "240"))
+    rows_per_request = 8
+    rng = np.random.default_rng(9)
+    cities = ("ny", "sf", "la", "chi")
+    records = []
+    for i in range(n_train):
+        age = float(rng.normal(40, 10))
+        income = float(rng.normal(5000, 1000))
+        records.append({
+            "label": float(age / 40.0 + rng.normal() > 1.0),
+            "age": age, "income": income,
+            "city": cities[int(rng.integers(0, len(cities)))]})
+    label = FeatureBuilder.RealNN("label").as_response()
+    preds = [FeatureBuilder.Real("age").as_predictor(),
+             FeatureBuilder.Real("income").as_predictor(),
+             FeatureBuilder.PickList("city").as_predictor()]
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(),
+                       grid(reg_param=[0.01], max_iter=[30]),
+                       "OpLogisticRegression")])
+    sel.set_input(label, transmogrify(preds))
+    model = (Workflow().set_input_records(records)
+             .set_result_features(sel.get_output()).train())
+
+    tenants = [f"tenant-{i}" for i in range(6)]
+    # skewed popularity, worst-case for a 3-slot LRU: the tail tenants
+    # almost always re-activate from disk
+    weights = [0.40, 0.25, 0.15, 0.10, 0.06, 0.04]
+    max_active = 3
+    root = tempfile.mkdtemp(prefix="bench-tenants-")
+    try:
+        control = os.path.join(root, ".control")  # dotted: not a tenant
+        model.save(control)
+        for t in tenants:
+            shutil.copytree(control, os.path.join(root, t))
+        seq = np.random.default_rng(7).choice(
+            len(tenants), size=requests, p=weights)
+        batch = [{"age": 30.0 + i, "income": 4000.0 + 100.0 * i,
+                  "city": cities[i % len(cities)]}
+                 for i in range(rows_per_request)]
+        registry = TenantRegistry(root, max_batch=32, queue_bound=256,
+                                  max_active=max_active,
+                                  memory_budget_bytes=1 << 30)
+        try:
+            t0 = time.perf_counter()
+            registry.engine_for(tenants[0]).score_record(
+                batch[0], timeout_s=300.0)
+            cold_first_score_s = time.perf_counter() - t0
+
+            mix = dict.fromkeys(tenants, 0)
+            t0 = time.perf_counter()
+            for idx in seq:
+                registry.engine_for(tenants[idx]).score_records(
+                    batch, timeout_s=300.0)
+                mix[tenants[idx]] += 1
+            storm_wall = time.perf_counter() - t0
+            status = registry.status()
+        finally:
+            registry.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    rows_scored = requests * rows_per_request
+    activations = sum(info["activations"]
+                      for info in status["tenants"].values())
+    evictions = sum(info["evictions"]
+                    for info in status["tenants"].values())
+    return {
+        "metric": f"multi-tenant serving: aggregate throughput, "
+                  f"{len(tenants)} tenants / max_active={max_active}, "
+                  f"skewed popularity ({platform})",
+        "value": round(rows_scored / max(storm_wall, 1e-9), 1),
+        "unit": "rows/s",
+        "vs_baseline": 1.0,
+        "aux": {
+            "platform": platform,
+            "tenants": len(tenants),
+            "max_active": max_active,
+            "popularity": weights,
+            "requests": requests,
+            "rows_per_request": rows_per_request,
+            "storm_wall_s": round(storm_wall, 3),
+            "cold_tenant_first_score_s": round(cold_first_score_s, 3),
+            "activations": activations,
+            "evictions": evictions,
+            "request_mix": mix,
+            "tenants_active_at_end": status["tenantsActive"],
+        },
+    }
+
+
 def run_serve_scaleout(on_accel: bool, platform: str):
     """Serving scale-out (ISSUE 12 tentpole): closed-loop load against the
     SO_REUSEPORT worker pool on the columnar wire format, swept over client
@@ -1260,6 +1378,7 @@ def main():
         ("serve_cold_start", lambda: run_serve_cold_start(on_accel,
                                                           platform)),
         ("serve_scaleout", lambda: run_serve_scaleout(on_accel, platform)),
+        ("multi_tenant", lambda: run_multi_tenant(on_accel, platform)),
     ]
     can_retry = (os.environ.get("BENCH_NO_RETRY") != "1" and on_accel)
     broken = False

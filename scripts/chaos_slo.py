@@ -27,6 +27,15 @@ SO_REUSEPORT pool, SIGKILL one worker mid-storm, and require zero 5xx
 from the survivors, a supervisor restart, and parseable aggregated
 metrics (artifacts: ``outcomes-pool.jsonl``, ``metrics-pool.txt``,
 ``summary-pool.json``).
+
+``--mode tenants`` runs the noisy-neighbor drill instead (ISSUE 16):
+boot the registry server on a multi-tenant model root, storm one hot
+tenant past its admission budget while quarantining a toxic tenant
+mid-storm, and require that the victims only ever see 2xx / 429 /
+503-with-Retry-After — zero 5xx, zero hangs, zero sheds — with scores
+bitwise-equal to a single-tenant control (artifacts:
+``outcomes-tenants.jsonl``, ``metrics-tenants.txt``,
+``summary-tenants.json``).
 """
 
 import argparse
@@ -473,6 +482,298 @@ def run_pool_chaos_slo(*, workers=2, clients=16, requests_per_client=25,
     return summary
 
 
+def run_tenant_chaos_slo(*, hot_clients=12, victim_clients=3,
+                         requests_per_client=25, seed=0,
+                         request_deadline_s=15.0, out_dir=None):
+    """Noisy-neighbor chaos for bulkheaded multi-tenant serving (ISSUE 16).
+
+    Boots the registry server on a model root with a ``hot`` tenant, two
+    victims and a ``toxic`` tenant, drives a hot-tenant storm past its
+    admission budget while quarantining ``toxic`` mid-storm (a poison
+    candidate stream trips its reload breaker), and asserts:
+
+    * victims see ONLY 2xx / 429 / 503-with-Retry-After — zero 5xx, zero
+      hangs — and keep accepting requests throughout the storm;
+    * the hot tenant sheds 429s against ITS budget; the victims' shed
+      counters stay at zero (the bulkhead held);
+    * ``toxic`` ends QUARANTINED with 503 + honest Retry-After while its
+      neighbors never notice;
+    * a victim's post-storm scores are BITWISE equal to a fresh
+      single-tenant control engine on the same bundle.
+    """
+    import shutil
+    import tempfile
+
+    from transmogrifai_tpu.checkpoint import next_version_dir
+    from transmogrifai_tpu.resilience import (FailureLog, FaultInjector,
+                                              inject_faults,
+                                              use_failure_log)
+    from transmogrifai_tpu.serving.engine import ScoringEngine
+    from transmogrifai_tpu.serving.overload import OverloadConfig
+    from transmogrifai_tpu.serving.server import start_server
+
+    root = tempfile.mkdtemp(prefix="chaos-tenants-")
+    model = _train_model(seed)
+    control_bundle = os.path.join(root, ".control")  # dotted: not a tenant
+    model.save(control_bundle)
+    for tenant in ("hot", "victim-a", "victim-b"):
+        shutil.copytree(control_bundle, os.path.join(root, tenant))
+    toxic_dir = os.path.join(root, "toxic")
+    model.save(next_version_dir(toxic_dir))  # checkpoint root: reloadable
+
+    overload = OverloadConfig(
+        latency_target_ms=250.0, reload_breaker_failures=2,
+        reload_breaker_reset_s=5.0)
+    flog = FailureLog()
+    outcomes = []
+    outcomes_lock = threading.Lock()
+
+    def post_tenant(port, tenant, payload, timeout):
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/score/{tenant}", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            r.read()
+            return r.status, dict(r.headers)
+
+    with use_failure_log(flog):
+        server, thread = start_server(
+            model_root=root, port=0, max_batch=8, queue_bound=8,
+            request_deadline_s=request_deadline_s, overload=overload,
+            tenant_memory_budget_bytes=1 << 30)  # pin: no eviction churn
+        port = server.port
+        registry = server.registry
+        try:
+            # warm every tenant (cold activation is part of the contract,
+            # but the storm measures steady-state isolation)
+            for tenant in ("hot", "victim-a", "victim-b", "toxic"):
+                status, _ = post_tenant(port, tenant, {"x": 0.5},
+                                        timeout=request_deadline_s + 15.0)
+                assert status == 200, f"warmup failed for {tenant}"
+
+            poison_at = threading.Event()
+            poisoned = threading.Event()
+
+            def client(cid, tenant, pace_s, n):
+                for i in range(n):
+                    t0 = time.perf_counter()
+                    err, retry_after = "", None
+                    x = float((cid * 37 + i) % 11) / 5
+                    # hot clients post multi-row batches: each request
+                    # claims several queue slots, so the storm reliably
+                    # overruns the hot tenant's admission budget
+                    payload = ([{"x": x + j / 10} for j in range(6)]
+                               if tenant == "hot" else {"x": x})
+                    try:
+                        status, headers = post_tenant(
+                            port, tenant, payload,
+                            timeout=request_deadline_s + 15.0)
+                    except urllib.error.HTTPError as e:
+                        status = e.code
+                        retry_after = e.headers.get("Retry-After")
+                        e.read()
+                    except Exception as e:  # noqa: BLE001 — timeout or
+                        #       dropped connection: a contract hang
+                        status = -1
+                        err = f"{type(e).__name__}: {e}"
+                    dt = time.perf_counter() - t0
+                    klass = "hang" if status == -1 else _classify(status)
+                    row = {"client": cid, "tenant": tenant, "i": i,
+                           "status": status, "latencyS": round(dt, 4),
+                           "class": klass}
+                    if retry_after is not None:
+                        row["retryAfter"] = retry_after
+                    if err:
+                        row["error"] = err
+                    with outcomes_lock:
+                        outcomes.append(row)
+                    if tenant == "hot" and cid == 0 and i == max(2, n // 5):
+                        poison_at.set()
+                    if pace_s:
+                        time.sleep(pace_s)
+
+            threads = []
+            cid = 0
+            for _ in range(hot_clients):
+                threads.append(threading.Thread(
+                    target=client,
+                    args=(cid, "hot", 0.0, requests_per_client),
+                    daemon=True))
+                cid += 1
+            for tenant in ("victim-a", "victim-b"):
+                for _ in range(victim_clients):
+                    threads.append(threading.Thread(
+                        target=client,
+                        args=(cid, tenant, 0.01, requests_per_client),
+                        daemon=True))
+                    cid += 1
+            for _ in range(2):
+                threads.append(threading.Thread(
+                    target=client,
+                    args=(cid, "toxic", 0.05, requests_per_client),
+                    daemon=True))
+                cid += 1
+
+            def poison():
+                # mid-storm: publish a newer valid version for ``toxic``
+                # and fail every reload attempt — a poison candidate
+                # stream.  The reload breaker opens, and the NEXT routed
+                # request parks the tenant in quarantine.  Then corrupt
+                # the on-disk versions so the backoff re-probes keep
+                # failing: the tenant must STAY quarantined for the rest
+                # of the storm (a valid bundle would honestly reactivate).
+                poison_at.wait(timeout=60.0)
+                model.save(next_version_dir(toxic_dir))
+                engine = registry.peek_engine("toxic")
+                if engine is None:
+                    return
+                injector = FaultInjector(
+                    rates={"serving.reload": 1.0}, seed=seed)
+                with inject_faults(injector):
+                    for _ in range(4):
+                        try:
+                            engine.reload_now()
+                        except Exception:  # noqa: BLE001 — chaos
+                            pass
+                for dirpath, _dirs, files in os.walk(toxic_dir):
+                    for fname in files:
+                        if fname == "MANIFEST.json":
+                            continue
+                        fpath = os.path.join(dirpath, fname)
+                        with open(fpath, "r+b") as fh:
+                            first = fh.read(1)
+                            if first:
+                                fh.seek(0)
+                                fh.write(bytes([first[0] ^ 0xFF]))
+                poisoned.set()
+
+            poisoner = threading.Thread(target=poison, daemon=True)
+            t_start = time.perf_counter()
+            for t in threads:
+                t.start()
+            poisoner.start()
+            for t in threads:
+                t.join(timeout=request_deadline_s + 120.0)
+            hung_threads = sum(1 for t in threads if t.is_alive())
+            storm_s = time.perf_counter() - t_start
+            poisoner.join(timeout=30.0)
+
+            # -- post-storm: isolation evidence ----------------------------
+            victim_sheds = {}
+            for tenant in ("victim-a", "victim-b"):
+                eng = registry.peek_engine(tenant)
+                victim_sheds[tenant] = (
+                    eng.stats()["counters"].get("shed_total", 0)
+                    if eng else None)
+            hot_engine = registry.peek_engine("hot")
+            hot_shed_total = (hot_engine.stats()["counters"]
+                              .get("shed_total", 0) if hot_engine else 0)
+
+            # a victim's scores stay bitwise-equal to a fresh
+            # single-tenant control engine on the same bundle
+            probe = [{"x": 0.3}, {"x": 1.7}, {"x": -0.9}]
+            victim_engine = registry.engine_for("victim-a")
+            got = [r for r, _ in victim_engine.score_records(
+                probe, timeout_s=60.0)]
+            control = ScoringEngine(os.path.join(root, "victim-a"),
+                                    max_batch=8, queue_bound=8)
+            try:
+                want = [r for r, _ in control.score_records(
+                    probe, timeout_s=60.0)]
+            finally:
+                control.close()
+            pred_name = next(iter(want[0]))
+            parity = True
+            for field in ("prediction", "probability_0", "probability_1"):
+                gv = np.array([r[pred_name][field] for r in got],
+                              dtype=np.float64)
+                wv = np.array([r[pred_name][field] for r in want],
+                              dtype=np.float64)
+                parity &= bool(np.array_equal(gv.view(np.uint64),
+                                              wv.view(np.uint64)))
+
+            _, metrics_text = _get(port, "/metrics")
+            _, healthz = _get(port, "/healthz")
+            final_states = {t: info["state"] for t, info in
+                            json.loads(healthz)["tenants"].items()}
+        finally:
+            server.drain_and_close()
+            thread.join(timeout=10.0)
+
+    classes = {}
+    victim_classes = {}
+    toxic_503 = []
+    for row in outcomes:
+        classes[row["class"]] = classes.get(row["class"], 0) + 1
+        if row["tenant"].startswith("victim"):
+            victim_classes[row["class"]] = \
+                victim_classes.get(row["class"], 0) + 1
+        if row["tenant"] == "toxic" and row["class"] == "503":
+            toxic_503.append(row)
+    accepted = [r["latencyS"] for r in outcomes if r["class"] == "2xx"]
+    p99 = _percentile(accepted, 0.99)
+    total = (hot_clients + 2 * victim_clients + 2) * requests_per_client
+    five_xx = sum(v for k, v in classes.items()
+                  if k.startswith("unclassified_5"))
+    hot_429 = sum(1 for r in outcomes
+                  if r["tenant"] == "hot" and r["class"] == "429")
+    bad_victim = {k: v for k, v in victim_classes.items()
+                  if k not in ("2xx", "429", "503")}
+    checks = {
+        "all_requests_terminated": len(outcomes) == total
+        and hung_threads == 0,
+        "zero_5xx": five_xx == 0,
+        "victims_only_contract_outcomes": not bad_victim,
+        "victims_kept_serving": victim_classes.get("2xx", 0) > 0,
+        "victims_never_shed": all(v == 0
+                                  for v in victim_sheds.values()),
+        "hot_tenant_shed_its_own_budget": hot_429 > 0
+        and hot_shed_total > 0,
+        "toxic_quarantined_mid_storm": poisoned.is_set()
+        and final_states.get("toxic") == "QUARANTINED"
+        and any(r.get("retryAfter") for r in toxic_503),
+        "victims_bitwise_equal_to_control": parity,
+        "accepted_p99_within_deadline": p99 <= request_deadline_s,
+        "tenant_metrics_present": 'tenant="victim-a"' in metrics_text
+        and "tenant_quarantines_total" in metrics_text,
+    }
+    summary = {
+        "passed": all(checks.values()),
+        "mode": "tenants",
+        "checks": checks,
+        "hotClients": hot_clients,
+        "victimClients": victim_clients,
+        "requestsPerClient": requests_per_client,
+        "totalRequests": total,
+        "outcomes": classes,
+        "victimOutcomes": victim_classes,
+        "hot429": hot_429,
+        "hotShedTotal": hot_shed_total,
+        "victimSheds": victim_sheds,
+        "toxic503WithRetryAfter": sum(
+            1 for r in toxic_503 if r.get("retryAfter")),
+        "hungClientThreads": hung_threads,
+        "stormSeconds": round(storm_s, 2),
+        "acceptedP99S": round(p99, 4),
+        "requestDeadlineS": request_deadline_s,
+        "finalTenantStates": final_states,
+        "failureSummary": flog.summary(),
+    }
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "outcomes-tenants.jsonl"),
+                  "w") as fh:
+            for row in outcomes:
+                fh.write(json.dumps(row) + "\n")
+        with open(os.path.join(out_dir, "metrics-tenants.txt"), "w") as fh:
+            fh.write(metrics_text)
+        with open(os.path.join(out_dir, "summary-tenants.json"),
+                  "w") as fh:
+            json.dump(summary, fh, indent=2)
+    return summary
+
+
 def _metric_value(metrics_text, name):
     """Last plain-sample value of ``transmogrifai_serving_<name>``."""
     full = f"transmogrifai_serving_{name}"
@@ -489,9 +790,11 @@ def _metric_value(metrics_text, name):
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--out-dir", required=True)
-    ap.add_argument("--mode", choices=("engine", "pool"), default="engine",
+    ap.add_argument("--mode", choices=("engine", "pool", "tenants"),
+                    default="engine",
                     help="engine: in-process fault injection; pool: "
-                    "SIGKILL one SO_REUSEPORT worker mid-storm")
+                    "SIGKILL one SO_REUSEPORT worker mid-storm; tenants: "
+                    "noisy-neighbor storm + mid-storm quarantine")
     ap.add_argument("--workers", type=int, default=2,
                     help="pool mode: worker processes")
     ap.add_argument("--clients", type=int, default=32)
@@ -513,6 +816,18 @@ def main(argv=None):
             print(f"pool chaos SLO FAILED: {failing}", file=sys.stderr)
             return 1
         print("pool chaos SLO passed", file=sys.stderr)
+        return 0
+    if args.mode == "tenants":
+        summary = run_tenant_chaos_slo(
+            hot_clients=args.clients, requests_per_client=args.requests,
+            seed=args.seed, request_deadline_s=args.request_deadline_s,
+            out_dir=args.out_dir)
+        print(json.dumps(summary, indent=2))
+        if not summary["passed"]:
+            failing = [k for k, ok in summary["checks"].items() if not ok]
+            print(f"tenant chaos SLO FAILED: {failing}", file=sys.stderr)
+            return 1
+        print("tenant chaos SLO passed", file=sys.stderr)
         return 0
     if args.batch_fault_rate < 0.05 or args.reload_fault_rate < 0.05:
         print("warning: fault rates below the 5% acceptance floor",

@@ -1,0 +1,300 @@
+"""CI smoke for bulkheaded multi-tenant serving (ISSUE 16): train a tiny
+model, lay out a model root with three tenants — one deliberately
+corrupted — boot a 1-worker pool on it, and require
+
+  * the corrupt tenant is QUARANTINED: 503 with an honest ``Retry-After``
+    on every request, never a 5xx crash or a hang,
+  * the other two tenants serve 200s whose floats are BITWISE equal to a
+    single-tenant control engine scoring the same bundle (isolation does
+    not perturb results),
+  * zero XLA backend compiles and zero online traces in the worker after
+    warm traffic (cold tenant activation is AOT: shipped executables
+    absorb every first score),
+  * the worker's /metrics carries ``tenant``-labelled shed/quarantine/
+    state families and the parent's merge keeps them.
+
+Usage:
+    python scripts/ci_multitenant_smoke.py run OUT_DIR
+    python scripts/ci_multitenant_smoke.py validate OUT_DIR
+
+``run`` writes OUT_DIR/multitenant-smoke.json; ``validate`` asserts it so
+the CI failure mode is a readable diff of the summary.
+"""
+
+import json
+import os
+import shutil
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+# runnable as `python scripts/ci_multitenant_smoke.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SUMMARY_NAME = "multitenant-smoke.json"
+
+RECORDS = [{"x1": -0.25, "x2": 1.0, "cat": "a"},
+           {"x1": 0.1, "x2": 9.5, "cat": "b"},
+           {"x1": 2.0, "x2": 0.0, "cat": "c"},
+           {"x1": None, "x2": 4.2, "cat": "a"}]
+
+
+def _make_records(n, seed=7):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        x1 = float(rng.normal())
+        x2 = float(rng.uniform(0, 10))
+        recs.append({
+            "y": 1.0 if (x1 + 0.2 * x2 + rng.normal() * 0.3) > 1.0 else 0.0,
+            "x1": x1, "x2": x2, "cat": ["a", "b", "c"][i % 3],
+        })
+    return recs
+
+
+def _post(port, path, body, content_type, headers=None, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body,
+        headers={"Content-Type": content_type, **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _get(port, path, timeout=30):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _metric(text, name, default=None):
+    """The value of the UNLABELED sample of family ``name``."""
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        head, _, value = line.rpartition(" ")
+        if head.rstrip() == name:
+            return float(value)
+    if default is None:
+        raise AssertionError(f"metric {name} missing")
+    return default
+
+
+def _alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def _corrupt(bundle_dir):
+    """Flip one byte in the first digest-covered bundle file."""
+    for name in sorted(os.listdir(bundle_dir)):
+        path = os.path.join(bundle_dir, name)
+        if os.path.isfile(path) and name != "MANIFEST.json":
+            with open(path, "rb") as fh:
+                data = fh.read()
+            with open(path, "wb") as fh:
+                fh.write(bytes([data[0] ^ 0xFF]) + data[1:])
+            return name
+    raise AssertionError(f"nothing to corrupt under {bundle_dir}")
+
+
+def run(out_dir):
+    from transmogrifai_tpu import types as T
+    from transmogrifai_tpu.features import features_from_schema
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, ModelCandidate, grid)
+    from transmogrifai_tpu.serving import wire
+    from transmogrifai_tpu.serving.engine import ScoringEngine
+    from transmogrifai_tpu.serving.pool import ServingPool
+
+    from transmogrifai_tpu.workflow import Workflow
+
+    os.makedirs(out_dir, exist_ok=True)
+    schema = {"y": T.RealNN, "x1": T.Real, "x2": T.Real, "cat": T.PickList}
+    y, predictors = features_from_schema(schema, response="y")
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]),
+                       "OpLogisticRegression")])
+    sel.set_input(y, transmogrify(predictors))
+    model = (Workflow().set_input_records(_make_records(200))
+             .set_result_features(sel.get_output()).train())
+
+    control = os.path.join(out_dir, "control-model")
+    os.environ["TRANSMOGRIFAI_AOT_LADDER_MAX"] = "16"
+    model.save(control)
+    root = os.path.join(out_dir, "model-root")
+    os.makedirs(root, exist_ok=True)
+    for tenant in ("tenant-a", "tenant-b", "tenant-c"):
+        shutil.copytree(control, os.path.join(root, tenant))
+    corrupted_file = _corrupt(os.path.join(root, "tenant-c"))
+
+    pool = ServingPool(None, model_root=root, workers=1, max_batch=16,
+                       queue_bound=256,
+                       run_dir=os.path.join(out_dir, "pool-run"))
+    summary = {"modelRoot": root, "port": pool.port,
+               "corruptedFile": corrupted_file}
+    pids = []
+    try:
+        t0 = time.time()
+        pool.start()
+        summary["bootWallS"] = round(time.time() - t0, 2)
+        body = json.dumps(RECORDS).encode()
+
+        # -- the corrupt tenant is parked, honestly --------------------------
+        quarantine = []
+        for _ in range(2):
+            status, _, headers = _post(pool.port, "/v1/score/tenant-c",
+                                       body, "application/json")
+            quarantine.append({"status": status,
+                               "retryAfter": headers.get("Retry-After")})
+        summary["quarantine"] = quarantine
+
+        # -- healthy tenants serve, bitwise equal to the control -------------
+        oracle = ScoringEngine(control, max_batch=16, queue_bound=256)
+        try:
+            want = [r for r, _ in oracle.score_records(RECORDS,
+                                                       timeout_s=120)]
+        finally:
+            oracle.close()
+        pred_name = next(iter(want[0]))
+        tenants = {}
+        for tenant, route in (("tenant-a", "path"), ("tenant-b", "header")):
+            if route == "path":
+                status, raw, _ = _post(pool.port, f"/v1/score/{tenant}",
+                                       body, "application/json")
+            else:
+                status, raw, _ = _post(pool.port, "/v1/score", body,
+                                       "application/json",
+                                       headers={"X-Model-Id": tenant})
+            info = {"route": route, "status": status, "bitwiseParity": False}
+            if status == 200:
+                got = json.loads(raw)["results"]
+                parity = True
+                for field in ("prediction", "probability_0",
+                              "probability_1"):
+                    gvals = np.array([r[pred_name][field] for r in got],
+                                     dtype=np.float64)
+                    wvals = np.array([r[pred_name][field] for r in want],
+                                     dtype=np.float64)
+                    parity &= bool(np.array_equal(gvals.view(np.uint64),
+                                                  wvals.view(np.uint64)))
+                info["bitwiseParity"] = parity
+            tenants[tenant] = info
+        summary["tenants"] = tenants
+
+        # warm traffic (JSON + columnar) so "zero compiles" means something
+        statuses = []
+        for i in range(10):
+            s1, _, _ = _post(pool.port, "/v1/score/tenant-a", body,
+                             "application/json")
+            s2, _, _ = _post(pool.port, "/v1/score/tenant-b",
+                             wire.encode_records(RECORDS),
+                             wire.CONTENT_TYPE)
+            statuses.extend([s1, s2])
+        summary["warmTrafficStatuses"] = sorted(set(statuses))
+
+        # -- worker metrics: AOT activation, tenant labels -------------------
+        slot = pool.slots[0]
+        admin = slot.ready["adminPort"]
+        text = _get(admin, "/metrics")
+        summary["worker"] = {
+            "backendCompiles": _metric(
+                text, "transmogrifai_serving_backend_compiles_total", 0.0),
+            "aotExecutablesLoaded": _metric(
+                text,
+                "transmogrifai_serving_aot_executables_loaded_total"),
+            "onlineTraces": _metric(
+                text, "transmogrifai_serving_online_traces_total", 0.0),
+            "tenantQuarantines": _metric(
+                text, "transmogrifai_serving_tenant_quarantines_total"),
+            "pid": slot.ready["pid"],
+        }
+        summary["workerMetricsTenantLabels"] = {
+            t: f'tenant="{t}"' in text
+            for t in ("tenant-a", "tenant-b", "tenant-c")}
+        state_c = None
+        for line in text.splitlines():
+            if line.startswith(
+                    'transmogrifai_serving_tenant_state{tenant="tenant-c"}'):
+                state_c = float(line.rpartition(" ")[2])
+        summary["tenantCStateCode"] = state_c
+
+        hz = json.loads(_get(admin, "/healthz"))
+        summary["healthz"] = {
+            t: info["state"] for t, info in hz["tenants"].items()}
+
+        # -- parent merge keeps the tenant labels ----------------------------
+        merged = pool.metrics()
+        summary["mergedMetricsKeepTenantLabels"] = (
+            'tenant="tenant-a"' in merged and 'tenant="tenant-c"' in merged)
+        summary["poolTenantStates"] = pool.status().get("tenants")
+
+        pids = [summary["worker"]["pid"]]
+    finally:
+        t0 = time.time()
+        pool.stop(grace_s=60.0)
+        summary["stopWallS"] = round(time.time() - t0, 2)
+    time.sleep(0.5)
+    summary["orphanPids"] = [p for p in pids if _alive(p)]
+
+    with open(os.path.join(out_dir, SUMMARY_NAME), "w") as fh:
+        json.dump(summary, fh, indent=2)
+    print(json.dumps(summary, indent=2))
+    return 0
+
+
+def validate(out_dir):
+    with open(os.path.join(out_dir, SUMMARY_NAME)) as fh:
+        s = json.load(fh)
+    for q in s["quarantine"]:
+        assert q["status"] == 503, \
+            f"corrupt tenant must 503, got {q['status']}"
+        assert q["retryAfter"] and int(q["retryAfter"]) >= 1, \
+            f"503 without an honest Retry-After: {q}"
+    for tenant, info in s["tenants"].items():
+        assert info["status"] == 200, f"{tenant} failed: {info}"
+        assert info["bitwiseParity"], \
+            f"{tenant} scores drifted from the single-tenant control"
+    assert s["warmTrafficStatuses"] == [200], \
+        f"healthy-tenant traffic saw non-200s: {s['warmTrafficStatuses']}"
+    w = s["worker"]
+    assert w["backendCompiles"] == 0, \
+        f"worker compiled {w['backendCompiles']} programs"
+    assert w["onlineTraces"] == 0, \
+        f"{w['onlineTraces']} online traces after warm"
+    assert w["aotExecutablesLoaded"] > 0, "no AOT executables loaded"
+    assert w["tenantQuarantines"] >= 1, "quarantine was never counted"
+    assert all(s["workerMetricsTenantLabels"].values()), \
+        f"missing tenant labels: {s['workerMetricsTenantLabels']}"
+    assert s["tenantCStateCode"] == 2, \
+        f"tenant-c state gauge {s['tenantCStateCode']} != 2 (QUARANTINED)"
+    assert s["healthz"]["tenant-c"] == "QUARANTINED"
+    assert s["healthz"]["tenant-a"] == "ACTIVE"
+    assert s["mergedMetricsKeepTenantLabels"], \
+        "pool merge dropped tenant labels"
+    assert s["orphanPids"] == [], f"orphan workers: {s['orphanPids']}"
+    print(f"OK: corrupt tenant quarantined with Retry-After="
+          f"{s['quarantine'][0]['retryAfter']}s, "
+          f"{len(s['tenants'])} healthy tenants bitwise-equal to the "
+          f"control, 0 compiles / 0 online traces after warm, tenant "
+          f"labels end-to-end, clean stop in {s['stopWallS']}s")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "run":
+        sys.exit(run(sys.argv[2]))
+    if len(sys.argv) == 3 and sys.argv[1] == "validate":
+        sys.exit(validate(sys.argv[2]))
+    sys.exit(f"usage: {sys.argv[0]} run OUT_DIR | validate OUT_DIR")

@@ -155,3 +155,83 @@ class TestWorkerLabel:
         assert _with_worker_label("", "3") == '{worker_id="3"}'
         assert _with_worker_label('{a="b"}', "0") == \
             '{worker_id="0",a="b"}'
+
+
+class TestStopRestartRace:
+    """Satellite regression: stop() racing a supervisor _restart must never
+    orphan the freshly-spawned worker, and stop() stays idempotent."""
+
+    @staticmethod
+    def _pool(workers=1):
+        from transmogrifai_tpu.serving.pool import ServingPool
+        return ServingPool("unused-model", workers=workers, port=0,
+                           max_restarts=100)
+
+    @staticmethod
+    def _fake_proc():
+        import subprocess
+        import sys
+        return subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(60)"])
+
+    def test_stop_mid_restart_reaps_fresh_worker(self):
+        """stop() completing between _restart's budget check and its spawn
+        is exactly the orphan window: the restart must notice and reap the
+        process stop() never saw."""
+        pool = self._pool()
+        slot = pool.slots[0]
+        spawned = []
+
+        def racing_spawn(s):
+            pool.stop(grace_s=2.0)          # stop wins the race mid-restart
+            s.proc = self._fake_proc()
+            spawned.append(s.proc)
+
+        pool._spawn = racing_spawn
+        pool._restart(slot, "test race")
+        assert spawned, "the restart never reached its spawn"
+        assert spawned[0].poll() is not None, \
+            "fresh worker orphaned by the stop/restart race"
+        pool.stop(grace_s=1.0)              # idempotent after the race
+        pool.stop(grace_s=1.0)
+
+    def test_concurrent_stop_and_restarts_reap_everything(self):
+        import threading
+
+        pool = self._pool(workers=2)
+        procs = []
+        plock = threading.Lock()
+
+        def fake_spawn(s):
+            p = self._fake_proc()
+            with plock:
+                s.proc = p
+                procs.append(p)
+
+        pool._spawn = fake_spawn
+        pool._wait_ready = lambda slot, deadline: None
+        for slot in pool.slots:
+            fake_spawn(slot)
+
+        barrier = threading.Barrier(4)
+
+        def restart(slot):
+            barrier.wait()
+            pool._restart(slot, "chaos")
+
+        def stop():
+            barrier.wait()
+            pool.stop(grace_s=2.0)
+
+        threads = [threading.Thread(target=restart, args=(pool.slots[0],)),
+                   threading.Thread(target=restart, args=(pool.slots[1],)),
+                   threading.Thread(target=stop),
+                   threading.Thread(target=stop)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "stop/restart hung"
+        pool.stop(grace_s=1.0)              # final stop is still safe
+        for p in procs:
+            assert p.poll() is not None, "a worker process was orphaned"

@@ -703,3 +703,128 @@ def test_sigterm_drains_cli_server_under_load(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=30)
+
+
+class TestFailureAccounting:
+    """Satellite regressions: the JSON and columnar fallback paths account
+    dead letters identically (counter + FailureLog action + trace id), and
+    an unreadable manifest degrades loudly instead of silently."""
+
+    def test_json_dead_letter_records_action_and_trace_id(self, bundle):
+        from transmogrifai_tpu.resilience import FailureLog, use_failure_log
+        from transmogrifai_tpu.telemetry import TraceContext
+        path, _, _ = bundle
+        eng = ScoringEngine(path, max_batch=4, queue_bound=64, warm=False)
+        try:
+            eng._compiled_ok = False               # force the row fallback
+            orig = eng._entry.local_fn
+
+            def poisoned(rec):
+                if rec.get("x") == 666.0:
+                    raise RuntimeError("poisoned row")
+                return orig(rec)
+
+            eng._entry.local_fn = poisoned
+            ctx = TraceContext.new()
+            log = FailureLog()
+            with use_failure_log(log):
+                with pytest.raises(RuntimeError, match="poisoned row"):
+                    eng.score_record({"x": 666.0}, timeout_s=60, ctx=ctx)
+                # a healthy record on the same engine still serves
+                eng.score_record({"x": 0.5}, timeout_s=60)
+            ev = log.by_action("dead_letter")
+            assert len(ev) == 1
+            assert ev[0].point == "serving.batch"
+            assert ev[0].detail["trace_id"] == ctx.trace_id
+            assert eng.stats()["counters"]["dead_letter_total"] == 1
+        finally:
+            eng.close()
+
+    def test_columnar_dead_letter_matches_json_accounting(self, bundle):
+        from transmogrifai_tpu.resilience import FailureLog, use_failure_log
+        from transmogrifai_tpu.telemetry import TraceContext
+        path, _, _ = bundle
+        eng = ScoringEngine(path, max_batch=4, queue_bound=64, warm=False)
+        try:
+            eng._compiled_ok = False
+
+            def always_poisoned(rec):
+                raise RuntimeError("poisoned row")
+
+            eng._entry.local_fn = always_poisoned
+            batch = wire.decode_batch(wire.encode_records([{"x": 1.0}]),
+                                      eng.raw_features)
+            ctx = TraceContext.new()
+            log = FailureLog()
+            with use_failure_log(log):
+                with pytest.raises(RuntimeError, match="poisoned row"):
+                    eng.score_columns(batch, timeout_s=60, ctx=ctx)
+            ev = log.by_action("dead_letter")
+            assert len(ev) == 1
+            assert ev[0].point == "serving.batch"
+            assert ev[0].detail["trace_id"] == ctx.trace_id
+            assert ev[0].detail["row"] == 0
+            assert eng.stats()["counters"]["dead_letter_total"] == 1
+        finally:
+            eng.close()
+
+    def test_unreadable_manifest_records_degraded_note(self, bundle,
+                                                       monkeypatch):
+        from transmogrifai_tpu.resilience import FailureLog, use_failure_log
+        from transmogrifai_tpu.serving import engine as engine_mod
+        path, _, _ = bundle
+
+        def unreadable(bundle_path):
+            raise RuntimeError("manifest exists but cannot be parsed")
+
+        monkeypatch.setattr(engine_mod, "read_manifest", unreadable)
+        log = FailureLog()
+        with use_failure_log(log):
+            eng = ScoringEngine(path, max_batch=2, warm=False)
+            eng.close()
+        ev = [e for e in log.by_action("degraded")
+              if e.point == "serving.manifest"]
+        assert ev, "unreadable manifest must leave a degraded note"
+        assert "manifest unreadable" in ev[0].detail["detail"]
+
+
+class TestReloadCloseRace:
+    def test_reload_now_racing_close(self, tmp_path):
+        """reload_now() and close() interleaved from two threads: no
+        deadlock, no exception besides the documented ones, and the engine
+        ends closed with close() still idempotent."""
+        model, _ = _train()
+        root = str(tmp_path / "root")
+        model.save(next_version_dir(root))
+        for _ in range(3):
+            eng = ScoringEngine(root, max_batch=2, warm=False)
+            barrier = threading.Barrier(2)
+            errs = []
+
+            def reloader():
+                barrier.wait()
+                try:
+                    for _ in range(5):
+                        eng.reload_now()
+                except EngineClosed:
+                    pass               # documented: lookups after close
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            def closer():
+                barrier.wait()
+                try:
+                    eng.close(drain=True, timeout_s=30)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            t1 = threading.Thread(target=reloader)
+            t2 = threading.Thread(target=closer)
+            t1.start()
+            t2.start()
+            t1.join(timeout=60)
+            t2.join(timeout=60)
+            assert not t1.is_alive() and not t2.is_alive(), "race deadlocked"
+            assert not errs, errs
+            eng.close()                # idempotent after the race
+            assert eng.reload_now() in (True, False)  # never hangs/raises

@@ -1,0 +1,457 @@
+"""Multi-tenant serving: TenantRegistry bulkheads, quarantine, LRU budget.
+
+Covers the bulkheaded multi-tenant acceptance criteria: per-tenant routing
+(URL path / X-Model-Id header / modelId field), 404-unknown vs
+503-quarantined semantics with an honest Retry-After, deterministic
+backoff re-probes that reactivate a repaired bundle, LRU activation under
+the count cap and device-memory budget with ``tenant.evicted`` FailureLog
+actions, per-tenant overload bulkheads (a flooded tenant sheds; its
+neighbors score bitwise-identically to a single-tenant control), and the
+tenant-labelled /metrics families."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from transmogrifai_tpu.local import score_function
+from transmogrifai_tpu.resilience import (FailureLog, RetryPolicy,
+                                          use_failure_log)
+from transmogrifai_tpu.serving import (TENANT_ACTIVE, TENANT_INACTIVE,
+                                       TENANT_QUARANTINED, OverloadedError,
+                                       TenantQuarantinedError, TenantRegistry,
+                                       UnknownTenantError)
+from transmogrifai_tpu.serving.server import start_server
+
+from test_serving import _train
+
+
+def _corrupt_bundle(root):
+    """Flip a byte in the first digest-covered bundle file; returns an undo
+    callback that restores the original bytes."""
+    for name in sorted(os.listdir(root)):
+        path = os.path.join(root, name)
+        if os.path.isfile(path) and name != "MANIFEST.json":
+            with open(path, "rb") as fh:
+                original = fh.read()
+            with open(path, "wb") as fh:
+                fh.write(bytes([original[0] ^ 0xFF]) + original[1:])
+
+            def undo(path=path, original=original):
+                with open(path, "wb") as fh:
+                    fh.write(original)
+            return undo
+    raise AssertionError(f"no digest-covered file under {root}")
+
+
+@pytest.fixture(scope="module")
+def tenant_root(tmp_path_factory):
+    """A model root with three healthy tenants (same trained model, so
+    any tenant's scores can be compared against one local oracle)."""
+    model, pred_name = _train()
+    root = tmp_path_factory.mktemp("tenants")
+    for tenant in ("alpha", "beta", "gamma"):
+        model.save(str(root / tenant))
+    return str(root), pred_name, score_function(model)
+
+
+def _registry(root, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("retry_policy",
+                  RetryPolicy(max_attempts=10 ** 6, base_delay_s=0.05,
+                              max_delay_s=0.2, jitter=0.0))
+    return TenantRegistry(root, **kw)
+
+
+class TestRegistry:
+    def test_scan_lists_tenants_and_skips_dotfiles(self, tenant_root,
+                                                   tmp_path):
+        root, _, _ = tenant_root
+        reg = _registry(root)
+        try:
+            assert reg.tenants() == ["alpha", "beta", "gamma"]
+            for t in reg.tenants():
+                assert reg._slots[t].state == TENANT_INACTIVE
+        finally:
+            reg.close()
+        os.makedirs(tmp_path / ".staging" / "x")
+        os.makedirs(tmp_path / "solo")
+        reg2 = _registry(str(tmp_path))
+        try:
+            assert reg2.tenants() == ["solo"]
+        finally:
+            reg2.close()
+
+    def test_activation_scores_match_local(self, tenant_root):
+        root, pred_name, local_fn = tenant_root
+        reg = _registry(root)
+        try:
+            eng = reg.engine_for("alpha")
+            rec = {"x": 1.25}
+            out, version = eng.score_record(rec, timeout_s=60)
+            assert out[pred_name]["probability_1"] == pytest.approx(
+                local_fn(rec)[pred_name]["probability_1"], abs=1e-6)
+            st = reg.status()
+            assert st["tenants"]["alpha"]["state"] == TENANT_ACTIVE
+            assert st["tenants"]["alpha"]["modelVersion"] == version
+            assert st["tenants"]["beta"]["state"] == TENANT_INACTIVE
+            assert st["tenantsActive"] == 1
+        finally:
+            reg.close()
+
+    def test_unknown_tenant_raises_and_new_dir_is_picked_up(self,
+                                                            tenant_root):
+        root, _, _ = tenant_root
+        reg = _registry(root)
+        try:
+            with pytest.raises(UnknownTenantError) as ei:
+                reg.engine_for("nope")
+            assert ei.value.tenant == "nope"
+            assert "alpha" in ei.value.known
+            # a tenant directory created after startup is found by the
+            # lookup-time rescan — no restart needed
+            model, _ = _train()
+            model.save(os.path.join(root, "delta"))
+            try:
+                assert reg.engine_for("delta") is not None
+            finally:
+                reg.close()
+        finally:
+            import shutil
+            shutil.rmtree(os.path.join(root, "delta"), ignore_errors=True)
+
+    def test_corrupt_bundle_quarantines_then_reactivates(self, tenant_root):
+        root, pred_name, local_fn = tenant_root
+        undo = _corrupt_bundle(os.path.join(root, "gamma"))
+        log = FailureLog()
+        reg = _registry(root)
+        try:
+            with use_failure_log(log):
+                with pytest.raises(TenantQuarantinedError) as ei:
+                    reg.engine_for("gamma")
+            assert ei.value.tenant == "gamma"
+            assert ei.value.retry_after_s >= 1.0
+            slot = reg._slots["gamma"]
+            assert slot.state == TENANT_QUARANTINED
+            assert log.by_action("tenant.quarantined")
+            # within the backoff window requests are refused WITHOUT
+            # re-probing (the bulkhead against repeated poison loads)
+            probes_before = slot.probes
+            with pytest.raises(TenantQuarantinedError):
+                reg.engine_for("gamma")
+            assert slot.probes == probes_before
+            # healthy neighbors never noticed
+            assert reg.engine_for("alpha").score_record(
+                {"x": 0.5}, timeout_s=60)[0][pred_name]["probability_1"] \
+                == pytest.approx(
+                    local_fn({"x": 0.5})[pred_name]["probability_1"], abs=1e-6)
+            # repair the bundle, wait out the deterministic backoff: the
+            # next request IS the probe and serves normally
+            undo()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if time.monotonic() >= slot.next_probe_at:
+                    break
+                time.sleep(0.01)
+            with use_failure_log(log):
+                eng = reg.engine_for("gamma")
+            assert slot.state == TENANT_ACTIVE
+            assert slot.reactivations == 1
+            assert log.by_action("tenant.reactivated")
+            out, _ = eng.score_record({"x": -0.5}, timeout_s=60)
+            assert out[pred_name]["probability_1"] == pytest.approx(
+                local_fn({"x": -0.5})[pred_name]["probability_1"], abs=1e-6)
+        finally:
+            undo()
+            reg.close()
+
+    def test_failed_probe_backs_off_deterministically(self, tenant_root):
+        root, _, _ = tenant_root
+        undo = _corrupt_bundle(os.path.join(root, "beta"))
+        reg = _registry(root)
+        try:
+            with pytest.raises(TenantQuarantinedError):
+                reg.engine_for("beta")
+            slot = reg._slots["beta"]
+            assert slot.probe_attempt == 1
+            # the schedule is the RetryPolicy's, keyed by tenant: honest
+            # Retry-After and reproducible across hosts
+            expected = reg.retry_policy.delay_for(1, key="beta")
+            assert slot.next_probe_at - time.monotonic() \
+                == pytest.approx(expected, abs=0.05)
+            while time.monotonic() < slot.next_probe_at:
+                time.sleep(0.01)
+            probes = slot.probes
+            with pytest.raises(TenantQuarantinedError):
+                reg.engine_for("beta")       # probe runs, bundle still bad
+            assert slot.probes == probes + 1
+            assert slot.probe_attempt == 2
+        finally:
+            undo()
+            reg.close()
+
+    def test_reload_breaker_open_quarantines(self, tenant_root):
+        root, _, _ = tenant_root
+        reg = _registry(root)
+        try:
+            eng = reg.engine_for("alpha")
+            brk = eng.overload.reload_breaker
+            # scoped breaker: this tenant's failures are charged to its own
+            # bulkhead, never a shared one
+            assert brk.name.endswith("@alpha")
+            for _ in range(10):
+                brk.record_failure(RuntimeError("poison candidate"))
+            with pytest.raises(TenantQuarantinedError):
+                reg.engine_for("alpha")
+            assert reg._slots["alpha"].state == TENANT_QUARANTINED
+            assert "reload breaker" in reg._slots["alpha"].quarantine_reason
+            # the neighbor's breaker is untouched: it still serves
+            assert reg.engine_for("beta") is not None
+        finally:
+            reg.close()
+
+    def test_lru_eviction_under_count_cap(self, tenant_root):
+        root, _, _ = tenant_root
+        log = FailureLog()
+        reg = _registry(root, max_active=2)
+        try:
+            with use_failure_log(log):
+                reg.engine_for("alpha")
+                time.sleep(0.02)
+                reg.engine_for("beta")
+                time.sleep(0.02)
+                # alpha is now the coldest entry; gamma's activation must
+                # evict it and leave beta alone
+                reg.engine_for("gamma")
+            assert reg._slots["alpha"].state == TENANT_INACTIVE
+            assert reg._slots["beta"].state == TENANT_ACTIVE
+            assert reg._slots["gamma"].state == TENANT_ACTIVE
+            ev = log.by_action("tenant.evicted")
+            assert len(ev) == 1 and ev[0].detail["tenant"] == "alpha"
+            # a re-request transparently reactivates (and evicts beta,
+            # now coldest)
+            with use_failure_log(log):
+                assert reg.engine_for("alpha") is not None
+            assert reg._slots["beta"].state == TENANT_INACTIVE
+            assert reg._slots["alpha"].activations == 2
+        finally:
+            reg.close()
+
+    def test_memory_budget_eviction(self, tenant_root):
+        root, _, _ = tenant_root
+        log = FailureLog()
+        # a 1-byte budget: every entry is over budget, but the just-
+        # activated entry is protected (keep=) so exactly one stays loaded
+        reg = _registry(root, memory_budget_bytes=1)
+        try:
+            with use_failure_log(log):
+                reg.engine_for("alpha")
+                assert reg._slots["alpha"].entry_bytes > 1
+                reg.engine_for("beta")
+            assert reg._slots["alpha"].state == TENANT_INACTIVE
+            assert reg._slots["beta"].state == TENANT_ACTIVE
+            ev = log.by_action("tenant.evicted")
+            assert ev and ev[0].detail["reason"] == "memory budget"
+        finally:
+            reg.close()
+
+    def test_bulkhead_hot_tenant_sheds_victim_serves(self, tenant_root):
+        """The isolation proof at the registry level: a tenant driven past
+        its admission budget sheds 429s while a quiet neighbor's scores
+        stay bitwise-equal to the single-tenant oracle."""
+        root, pred_name, local_fn = tenant_root
+        reg = _registry(root, queue_bound=2)
+        try:
+            hot = reg.engine_for("alpha")
+            shed = threading.Event()
+
+            def flood():
+                for i in range(200):
+                    if shed.is_set():
+                        return
+                    try:
+                        hot.score_record({"x": float(i)}, timeout_s=30)
+                    except OverloadedError:
+                        shed.set()
+                        return
+
+            threads = [threading.Thread(target=flood) for _ in range(8)]
+            for t in threads:
+                t.start()
+            try:
+                victim = reg.engine_for("beta")
+                out, _ = victim.score_record({"x": 2.5}, timeout_s=60)
+            finally:
+                shed.set()
+                for t in threads:
+                    t.join(timeout=30)
+            assert out[pred_name]["probability_1"] == pytest.approx(
+                local_fn({"x": 2.5})[pred_name]["probability_1"], abs=1e-6)
+            assert shed.is_set(), "the flood never tripped admission"
+            # the shed budget is the hot tenant's own
+            assert hot.stats()["counters"].get("shed_total", 0) > 0
+            assert victim.stats()["counters"].get("shed_total", 0) == 0
+        finally:
+            reg.close()
+
+    def test_metrics_text_tenant_families(self, tenant_root):
+        root, _, _ = tenant_root
+        undo = _corrupt_bundle(os.path.join(root, "gamma"))
+        reg = _registry(root)
+        try:
+            reg.engine_for("alpha").score_record({"x": 0.1}, timeout_s=60)
+            with pytest.raises(TenantQuarantinedError):
+                reg.engine_for("gamma")
+            text = reg.metrics_text()
+            p = "transmogrifai_serving"
+            # engine families are merged with a tenant label…
+            assert f'{p}_requests_total{{tenant="alpha"}}' in text
+            # …and the registry families cover cold/quarantined tenants too
+            assert f'{p}_tenant_state{{tenant="alpha"}} 1' in text
+            assert f'{p}_tenant_state{{tenant="beta"}} 0' in text
+            assert f'{p}_tenant_state{{tenant="gamma"}} 2' in text
+            assert f'{p}_tenant_quarantines_total{{tenant="gamma"}} 1' \
+                in text
+            assert f"{p}_tenants 3" in text
+            assert f"{p}_tenants_active 1" in text
+            assert f"{p}_tenants_quarantined 1" in text
+            for fam in ("tenant_requests_total", "tenant_activations_total",
+                        "tenant_evictions_total", "tenant_probes_total",
+                        "tenant_active_bytes"):
+                assert f"# TYPE {p}_{fam} " in text
+        finally:
+            undo()
+            reg.close()
+
+    def test_close_is_idempotent_and_refuses_lookups(self, tenant_root):
+        from transmogrifai_tpu.serving import EngineClosed
+        root, _, _ = tenant_root
+        reg = _registry(root)
+        reg.engine_for("alpha")
+        reg.close()
+        reg.close()
+        with pytest.raises(EngineClosed):
+            reg.engine_for("alpha")
+
+
+def _post(port, path, payload, headers=None, timeout=60):
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+@pytest.fixture(scope="module")
+def tenant_server(tenant_root):
+    root, pred_name, local_fn = tenant_root
+    undo = _corrupt_bundle(os.path.join(root, "gamma"))
+    server, thread = start_server(model_root=root, port=0, max_batch=4)
+    yield server, pred_name, local_fn
+    server.drain_and_close()
+    thread.join(timeout=30)
+    undo()
+
+
+class TestTenantHTTP:
+    def test_routing_path_header_and_field_agree(self, tenant_server):
+        server, pred_name, local_fn = tenant_server
+        rec = {"x": 0.75}
+        want = local_fn(rec)[pred_name]["probability_1"]
+        by_path = _post(server.port, "/v1/score/alpha", rec)
+        by_header = _post(server.port, "/v1/score", rec,
+                          {"X-Model-Id": "alpha"})
+        by_field = _post(server.port, "/v1/score",
+                         {**rec, "modelId": "alpha"})
+        for status, body, _ in (by_path, by_header, by_field):
+            assert status == 200
+            assert body["result"][pred_name]["probability_1"] \
+                == pytest.approx(want, abs=1e-6)
+        # the modelId routing field is stripped before scoring: identical
+        # result payloads prove it never reached the feature row
+        assert by_field[1]["result"] == by_path[1]["result"]
+
+    def test_unrouted_and_unknown_get_404(self, tenant_server):
+        server, _, _ = tenant_server
+        status, body, _ = _post(server.port, "/v1/score", {"x": 0.5})
+        assert status == 404
+        assert "alpha" in json.dumps(body)     # the error names the tenants
+        assert _post(server.port, "/v1/score/nope", {"x": 0.5})[0] == 404
+
+    def test_mixed_model_ids_get_400(self, tenant_server):
+        server, _, _ = tenant_server
+        status, body, _ = _post(
+            server.port, "/v1/score",
+            [{"x": 0.1, "modelId": "alpha"}, {"x": 0.2, "modelId": "beta"}])
+        assert status == 400
+        assert "modelId" in body["error"]
+        # a homogeneous batch routes fine
+        status, body, _ = _post(
+            server.port, "/v1/score",
+            [{"x": 0.1, "modelId": "alpha"}, {"x": 0.2, "modelId": "alpha"}])
+        assert status == 200 and len(body["results"]) == 2
+
+    def test_quarantined_tenant_gets_503_with_retry_after(self,
+                                                          tenant_server):
+        server, _, _ = tenant_server
+        status, body, headers = _post(server.port, "/v1/score/gamma",
+                                      {"x": 0.5})
+        assert status == 503
+        assert body["state"] == "QUARANTINED"
+        assert int(headers["Retry-After"]) >= 1
+        # and it stays parked on the next request, same honest semantics
+        status2, _, headers2 = _post(server.port, "/v1/score/gamma",
+                                     {"x": 0.5})
+        assert status2 == 503 and "Retry-After" in headers2
+
+    def test_healthz_readyz_and_metrics_surfaces(self, tenant_server):
+        server, _, _ = tenant_server
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz", timeout=30) as r:
+            hz = json.loads(r.read())
+        assert hz["tenants"]["gamma"]["state"] == TENANT_QUARANTINED
+        assert hz["tenantsTotal"] == 3
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/readyz", timeout=30) as r:
+            assert r.status == 200
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert 'tenant="gamma"' in text
+        assert "transmogrifai_serving_tenant_state" in text
+
+
+class TestRetrainRanking:
+    def test_traffic_weighted_drift_ranking(self, tenant_root):
+        from transmogrifai_tpu.lifecycle import rank_tenants_for_retrain
+        root, _, _ = tenant_root
+        reg = _registry(root, drift=True)
+        try:
+            # identical scoring windows → identical drift; the ranking
+            # difference must come from traffic share alone
+            for i in range(10):
+                reg.engine_for("alpha").score_record(
+                    {"x": float(i) / 5.0}, timeout_s=60)
+                reg.engine_for("beta").score_record(
+                    {"x": float(i) / 5.0}, timeout_s=60)
+            for _ in range(20):
+                reg.engine_for("alpha")    # routed-but-unscored traffic
+            ranked = rank_tenants_for_retrain(reg, min_rows=1)
+            names = [r["tenant"] for r in ranked]
+            assert names.index("alpha") < names.index("beta")
+            top = ranked[0]
+            assert top["trafficShare"] > 0.5
+            assert {"tenant", "breached", "trafficShare", "driftPsi",
+                    "rows", "priority", "reasons"} <= set(top)
+            # gamma never served: no monitor rows, so it is not ranked
+            assert "gamma" not in names
+        finally:
+            reg.close()

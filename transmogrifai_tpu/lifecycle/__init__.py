@@ -12,6 +12,6 @@ from .baselines import (BASELINES_JSON, ModelBaselines,  # noqa: F401
 from .controller import (DriftThresholdPolicy,  # noqa: F401
                          LifecycleController, LifecycleOutcome,
                          LifecycleState, ManualPolicy, RetrainPolicy,
-                         ScheduledIntervalPolicy)
+                         ScheduledIntervalPolicy, rank_tenants_for_retrain)
 from .drift import DriftMonitor, DriftReport, psi  # noqa: F401
 from .service import drift_check_main, lifecycle_main  # noqa: F401

@@ -388,3 +388,51 @@ class LifecycleController:
                                 metric_name=metric_name,
                                 candidate_metric=cand_m,
                                 incumbent_metric=inc_m, candidate_path=path)
+
+
+# --------------------------------------------------------------------------
+# multi-tenant retrain prioritisation
+# --------------------------------------------------------------------------
+
+def rank_tenants_for_retrain(registry, *, min_rows: int = 1
+                             ) -> List[Dict[str, Any]]:
+    """Order a ``serving.tenants.TenantRegistry``'s tenants by
+    traffic-weighted drift severity: the tenant whose drift hurts the most
+    *users* retrains first.
+
+    Per ACTIVE tenant with an attached drift monitor (registry built with
+    ``drift=True``) that has observed at least ``min_rows`` rows, the
+    score is ``traffic_share * (1 + drift_psi)`` where ``drift_psi`` is
+    the worst of the score PSI and any per-feature PSI.  Tenants whose
+    window actually *breached* sort above all non-breached tenants
+    regardless of score — a breach is a retrain trigger, the weight only
+    orders the queue.  Cold, quarantined and monitor-less tenants are
+    excluded (nothing to compare; quarantine is a bundle problem, not a
+    drift problem)."""
+    weights = registry.traffic_weights()
+    total = sum(max(0, w) for w in weights.values()) or 1
+    ranked: List[Dict[str, Any]] = []
+    for tenant in registry.tenants():
+        engine = registry.peek_engine(tenant)
+        monitor = getattr(engine, "drift_monitor", None) if engine else None
+        if monitor is None or monitor.rows_observed < min_rows:
+            continue
+        try:
+            report = monitor.evaluate()
+        except Exception as e:  # noqa: BLE001 — one tenant's broken
+            #                     monitor must not stop the ranking
+            record_failure("lifecycle", "swallowed", e,
+                           point="lifecycle.tenants", tenant=tenant)
+            continue
+        psi = max([report.score_psi]
+                  + [f.psi for f in report.features if f.psi == f.psi])
+        share = max(0, weights.get(tenant, 0)) / total
+        ranked.append({"tenant": tenant, "breached": report.breached,
+                       "trafficShare": round(share, 6),
+                       "driftPsi": round(float(psi), 6),
+                       "rows": report.rows,
+                       "priority": round(share * (1.0 + float(psi)), 6),
+                       "reasons": list(report.reasons)})
+    ranked.sort(key=lambda r: (not r["breached"], -r["priority"],
+                               r["tenant"]))
+    return ranked

@@ -42,7 +42,13 @@ class OpParams:
     # latencyTargetMs, adaptiveLimit, minLimit, queueDeadlineMs,
     # brownoutHigh, brownoutLow, breakerWindow, breakerFailures,
     # breakerRate, breakerMinCalls, breakerResetS, halfOpenProbes,
-    # reloadBreakerFailures, reloadBreakerResetS
+    # reloadBreakerFailures, reloadBreakerResetS.
+    # Multi-tenant serving: modelRoot (a directory of per-tenant bundles;
+    # replaces --model-location and routes /v1/score/<tenant> /
+    # X-Model-Id / modelId through per-tenant bulkheaded engines),
+    # tenantMaxActive (LRU cap on loaded tenant engines),
+    # tenantMemoryBudgetBytes (device-memory budget the active tenant
+    # set is charged against; default device_memory_budget())
     serving: Dict[str, Any] = field(default_factory=dict)
     # sweep-racing knobs applied to every ModelSelector validator: enabled,
     # eta, minSurvivors (see DefaultSelectorParams.RACING*)

@@ -131,7 +131,12 @@ class FailureLog:
                "host_lost",      # host-group rank dead / heartbeat silent
                "host_recovered",  # host-group rank heartbeat resumed
                "relaunched",   # host group rebooted at shrunken world size
-               "escalated")    # SIGTERM ignored; SIGKILL reclaimed it
+               "escalated",    # SIGTERM ignored; SIGKILL reclaimed it
+               "tenant.activated",    # multi-tenant: bundle loaded on demand
+               "tenant.evicted",      # multi-tenant: LRU/budget unload
+               "tenant.quarantined",  # multi-tenant: bundle parked as toxic
+               "tenant.reactivated",  # multi-tenant: quarantine probe passed
+               "tenant.removed")      # multi-tenant: bundle dir disappeared
 
     def __init__(self):
         self._events: List[FailureEvent] = []

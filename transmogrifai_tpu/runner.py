@@ -563,11 +563,14 @@ class OpWorkflowRunner:
         """Online scoring: block inside the HTTP serve loop until
         SIGTERM/SIGINT, then drain and return.  Serving knobs ride in
         ``params.serving`` (see ``OpParams``)."""
-        if not params.model_location:
-            raise ValueError("run-type 'serve' needs --model-location")
         from .serving.overload import OverloadConfig
         from .serving.server import serve_main
         sv = params.serving or {}
+        model_root = sv.get("modelRoot")
+        if bool(params.model_location) == bool(model_root):
+            raise ValueError("run-type 'serve' needs exactly one of "
+                             "--model-location (single bundle) or "
+                             "servingParams.modelRoot (multi-tenant)")
         workers = int(sv.get("workers", 1))
         with timer.phase("serve"):
             if workers > 1:
@@ -585,7 +588,11 @@ class OpWorkflowRunner:
                     reload_poll_s=float(sv.get("reloadPollS", 10.0)),
                     overload=dataclasses.asdict(
                         OverloadConfig.from_params(sv)),
-                    wire_format=sv.get("wireFormat", "auto"))
+                    wire_format=sv.get("wireFormat", "auto"),
+                    model_root=model_root,
+                    tenant_max_active=sv.get("tenantMaxActive"),
+                    tenant_memory_budget_bytes=sv.get(
+                        "tenantMemoryBudgetBytes"))
             else:
                 serve_main(params.model_location,
                            host=sv.get("host", "127.0.0.1"),
@@ -597,7 +604,11 @@ class OpWorkflowRunner:
                                                      30.0),
                            reload_poll_s=float(sv.get("reloadPollS", 10.0)),
                            overload=OverloadConfig.from_params(sv),
-                           wire_format=sv.get("wireFormat", "auto"))
+                           wire_format=sv.get("wireFormat", "auto"),
+                           model_root=model_root,
+                           tenant_max_active=sv.get("tenantMaxActive"),
+                           tenant_memory_budget_bytes=sv.get(
+                               "tenantMemoryBudgetBytes"))
         return OpWorkflowRunnerResult(RunType.SERVE)
 
     def _lifecycle(self, params: OpParams, timer: PhaseTimer

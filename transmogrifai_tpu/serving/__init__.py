@@ -12,3 +12,6 @@ from .overload import (BROWNOUT, DEGRADED, DRAINING,  # noqa: F401
                        HEALTH_STATES, SERVING, HealthStateMachine,
                        OverloadConfig, OverloadController)
 from .server import ScoringHTTPServer, serve_main  # noqa: F401
+from .tenants import (TENANT_ACTIVE, TENANT_INACTIVE,  # noqa: F401
+                      TENANT_QUARANTINED, TenantQuarantinedError,
+                      TenantRegistry, UnknownTenantError)

@@ -145,8 +145,17 @@ class _ModelEntry:
         created = None
         try:
             created = (read_manifest(bundle_path) or {}).get("createdAt")
-        except Exception:  # noqa: BLE001 — legacy bundle, no manifest
-            pass
+        except Exception as e:  # noqa: BLE001 — a legacy bundle has no
+            #                     manifest (read_manifest → None, no raise);
+            #                     reaching here means the manifest exists but
+            #                     is unreadable.  Serve anyway — staleness
+            #                     falls back to process load time — but say
+            #                     so (PR-1 convention: silent excepts report)
+            record_failure(
+                "serving", "degraded", e, point="serving.manifest",
+                bundle=bundle_path,
+                detail="manifest unreadable; model_staleness_seconds falls "
+                       "back to process load time")
         self.created_at: Optional[float] = (
             float(created) if isinstance(created, (int, float)) else None)
         self.loaded_at: float = time.time()
@@ -186,10 +195,15 @@ class ScoringEngine:
                  batch_deadline_s: Optional[float] = 30.0,
                  reload_poll_s: float = 0.0, warm: bool = True,
                  warm_record: Optional[Dict[str, Any]] = None,
-                 overload: Optional[OverloadConfig] = None):
+                 overload: Optional[OverloadConfig] = None,
+                 tenant: Optional[str] = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.model_location = model_location
+        # multi-tenant serving (TenantRegistry): the tenant this engine is
+        # a bulkhead for.  Scopes the breaker names, tags batch spans and
+        # shed events — None (single-bundle) leaves every name unchanged.
+        self.tenant = tenant
         self.max_batch = int(max_batch)
         # linger_ms is deprecated and ignored: the continuous batcher
         # dispatches as soon as the device frees, coalescing whatever is
@@ -228,7 +242,8 @@ class ScoringEngine:
         # shares this engine's registry so /metrics sees everything.
         self.overload = OverloadController(
             overload, queue_bound=lambda: self.queue_bound,
-            max_batch=self.max_batch, registry=self.metrics)
+            max_batch=self.max_batch, registry=self.metrics,
+            scope=tenant)
 
         # lifecycle hooks: batch observers see every successfully-scored
         # (records, results) pair; the drift monitor is one such observer
@@ -538,8 +553,11 @@ class ScoringEngine:
             self.metrics.counter("shed_total").inc(trace_id=trace_id)
             self.metrics.counter(f"shed_{decision.kind}_total").inc(
                 trace_id=trace_id)
+            detail: Dict[str, Any] = {"kind": decision.kind}
+            if self.tenant:
+                detail["tenant"] = self.tenant
             record_failure("serving", "shed", decision.message,
-                           point="serving.admit", kind=decision.kind)
+                           point="serving.admit", **detail)
             self.overload.refresh_health(
                 queue_depth=self._queued_rows, draining=False,
                 compiled_ok=self._compiled_ok)
@@ -604,7 +622,9 @@ class ScoringEngine:
         # requests, all correlated
         links = [r.ctx for r in batch if r.ctx is not None]
         bctx = links[0].child() if links else None
-        with span("serving.batch", ctx=bctx, links=links, rows=len(batch)):
+        attrs = {"tenant": self.tenant} if self.tenant else {}
+        with span("serving.batch", ctx=bctx, links=links, rows=len(batch),
+                  **attrs):
             self._process_inner(batch, links=links)
 
     def _process_inner(self, batch: List[_Request],
@@ -665,13 +685,17 @@ class ScoringEngine:
         if results is None:
             self.metrics.counter("fallback_batches_total").inc()
             results = []
-            for rec in records:
+            for req, rec in zip(batch, records):
                 try:
                     results.append(entry.local_fn(rec))
                 except Exception as e:  # noqa: BLE001 — isolate bad records
                     # even the row-at-a-time fallback failed: this record is
                     # unservable by either path — a serving dead letter
-                    self.metrics.counter("dead_letter_total").inc()
+                    trace_id = req.ctx.trace_id if req.ctx else None
+                    self.metrics.counter("dead_letter_total").inc(
+                        trace_id=trace_id)
+                    record_failure("serving", "dead_letter", e,
+                                   point="serving.batch", trace_id=trace_id)
                     results.append(e)
         self.metrics.counter("batches_total").inc()
         self.metrics.counter("batch_rows_total").inc(len(batch))
@@ -761,7 +785,8 @@ class ScoringEngine:
         scored = entry.model.score(batch=self._pad_columns(chunk, size))
         return result_arrays(scored, entry.result_names, n)
 
-    def _local_fallback_columns(self, entry: _ModelEntry, chunk: ColumnBatch
+    def _local_fallback_columns(self, entry: _ModelEntry, chunk: ColumnBatch,
+                                ctx: Optional[TraceContext] = None
                                 ) -> Dict[str, Any]:
         """Row-at-a-time local scoring for a columnar chunk the compiled
         path could not handle.  A row that fails even here is a dead
@@ -772,8 +797,15 @@ class ScoringEngine:
             rec = {name: ft.value for name, ft in chunk.row(i).items()}
             try:
                 row = entry.local_fn(rec)
-            except Exception:
-                self.metrics.counter("dead_letter_total").inc()
+            except Exception as e:  # same dead-letter accounting as the
+                #                     JSON path: counter + FailureLog action,
+                #                     both carrying the request's trace id
+                trace_id = ctx.trace_id if ctx else None
+                self.metrics.counter("dead_letter_total").inc(
+                    trace_id=trace_id)
+                record_failure("serving", "dead_letter", e,
+                               point="serving.batch", row=i,
+                               trace_id=trace_id)
                 raise
             flat: Dict[str, Any] = {}
             for name, v in row.items():
@@ -802,8 +834,9 @@ class ScoringEngine:
 
     def _process_columnar(self, req: _ColumnarRequest) -> None:
         links = [req.ctx] if req.ctx is not None else []
+        attrs = {"tenant": self.tenant} if self.tenant else {}
         with span("serving.batch", ctx=links[0].child() if links else None,
-                  links=links, rows=req.rows, columnar=True):
+                  links=links, rows=req.rows, columnar=True, **attrs):
             try:
                 self._process_columnar_inner(req)
             except BaseException as e:  # noqa: BLE001 — fail the request,
@@ -873,7 +906,8 @@ class ScoringEngine:
                     arrays = None
             if arrays is None:
                 self.metrics.counter("fallback_batches_total").inc()
-                arrays = self._local_fallback_columns(entry, chunk)
+                arrays = self._local_fallback_columns(entry, chunk,
+                                                      ctx=req.ctx)
             self.metrics.counter("batches_total").inc()
             self.metrics.counter("batch_rows_total").inc(hi - lo)
             batch_s = time.perf_counter() - t0
@@ -902,6 +936,7 @@ class ScoringEngine:
             aot_execs = getattr(self._entry.model, "aot_executables", 0)
         return {"counters": self.metrics.counters(),
                 "queue_depth": self.queue_depth,
+                "tenant": self.tenant,
                 "model_version": version,
                 "aot_executables": aot_execs,
                 "compiled_path_active": self._compiled_ok,

@@ -178,8 +178,15 @@ class OverloadController:
 
     def __init__(self, config: Optional[OverloadConfig] = None, *,
                  queue_bound: Any, max_batch: int,
-                 registry: Optional[Any] = None):
+                 registry: Optional[Any] = None,
+                 scope: Optional[str] = None):
         self.config = config or OverloadConfig()
+        # multi-tenant bulkheads: a scoped controller suffixes its breaker
+        # names with ``@<scope>`` so per-tenant breaker state/counters stay
+        # distinguishable after the tenant-labeled metrics merge.  None
+        # (the single-bundle default) keeps the PR-8 names bit-for-bit.
+        self.scope = scope
+        suffix = f"@{scope}" if scope else ""
         # int for a fixed ceiling, or a callable for a live one (the engine
         # passes ``lambda: self.queue_bound`` so runtime retuning is seen)
         if callable(queue_bound):
@@ -194,14 +201,14 @@ class OverloadController:
                 target_latency_s=cfg.latency_target_ms / 1000.0,
                 max_limit=self.queue_bound, min_limit=cfg.min_limit)
         self.compiled_breaker = CircuitBreaker(
-            "serving.batch", window=cfg.breaker_window,
+            f"serving.batch{suffix}", window=cfg.breaker_window,
             failure_threshold=cfg.breaker_failures,
             failure_rate=cfg.breaker_rate,
             min_calls=cfg.breaker_min_calls,
             reset_timeout_s=cfg.breaker_reset_s,
             half_open_probes=cfg.half_open_probes, registry=registry)
         self.reload_breaker = CircuitBreaker(
-            "serving.reload",
+            f"serving.reload{suffix}",
             failure_threshold=cfg.reload_breaker_failures,
             # reload attempts are sparse (one per watcher poll): consecutive
             # failures are the only meaningful trip wire
@@ -342,6 +349,7 @@ class OverloadController:
 
     def snapshot(self) -> Dict[str, Any]:
         return {"health": self.health.snapshot(),
+                "scope": self.scope,
                 "admission_limit": self.admission_limit(),
                 "queue_bound": self.queue_bound,
                 "adaptive": (self.limit.snapshot()

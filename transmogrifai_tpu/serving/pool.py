@@ -66,9 +66,11 @@ def _escape_label_value(v: str) -> str:
             .replace("\n", "\\n"))
 
 
-def _with_worker_label(labels: str, worker_id: str) -> str:
-    """``{a="b"}`` or ``""`` → same labels plus ``worker_id``."""
-    tag = f'worker_id="{_escape_label_value(worker_id)}"'
+def _with_worker_label(labels: str, worker_id: str,
+                       label: str = "worker_id") -> str:
+    """``{a="b"}`` or ``""`` → same labels plus ``label`` (default
+    ``worker_id``; the tenant registry merges with ``tenant``)."""
+    tag = f'{label}="{_escape_label_value(worker_id)}"'
     if not labels:
         return "{" + tag + "}"
     inner = labels[1:-1].strip()
@@ -162,11 +164,15 @@ def _parse_exposition(text: str):
     return families, order
 
 
-def merge_worker_metrics(worker_texts: List[Tuple[str, str]]) -> str:
+def merge_worker_metrics(worker_texts: List[Tuple[str, str]],
+                         label: str = "worker_id") -> str:
     """Merge per-worker ``/metrics`` payloads into one exposition.
 
-    ``worker_texts`` is ``[(worker_id, exposition_text), ...]``.  Per
-    family (names unchanged, so existing dashboards keep working):
+    ``worker_texts`` is ``[(worker_id, exposition_text), ...]``; ``label``
+    names the per-source label (``worker_id`` for pool workers, ``tenant``
+    for the tenant registry — pool-level aggregation preserves inner
+    labels, so worker-level ``tenant`` labels survive a second merge).
+    Per family (names unchanged, so existing dashboards keep working):
 
     * **counters**: one aggregate sample per label-set (sum across
       workers) plus one sample per worker with a ``worker_id`` label,
@@ -214,7 +220,8 @@ def merge_worker_metrics(worker_texts: List[Tuple[str, str]]) -> str:
                     or sample_name.endswith("_count"))
                 ex_suffix = f" {exemplar}" if exemplar else ""
                 per_worker.append(
-                    f"{sample_name}{_with_worker_label(labels, wid)} "
+                    f"{sample_name}"
+                    f"{_with_worker_label(labels, wid, label=label)} "
                     f"{_fmt(value)}{ex_suffix}")
                 if is_quantile:
                     continue  # no cross-worker quantile merge
@@ -280,20 +287,40 @@ def worker_main(config_path: str) -> int:
     with preemption_guard("serve-worker"), \
             (use_tracer(tracer) if tracer is not None
              else contextlib.nullcontext()):
-        engine = ScoringEngine(
-            cfg["modelLocation"],
-            max_batch=int(cfg.get("maxBatch", 64)),
-            queue_bound=int(cfg.get("queueBound", 256)),
-            reload_poll_s=float(cfg.get("reloadPollS", 0.0)),
-            overload=overload)
+        engine = None
+        registry = None
+        if cfg.get("modelRoot"):
+            # multi-tenant worker: every worker loads the full registry —
+            # tenants activate lazily per worker, so a worker only pays
+            # for the tenants the kernel actually routes to it
+            from .tenants import TenantRegistry
+            registry = TenantRegistry(
+                cfg["modelRoot"],
+                max_batch=int(cfg.get("maxBatch", 64)),
+                queue_bound=int(cfg.get("queueBound", 256)),
+                reload_poll_s=float(cfg.get("reloadPollS", 0.0)),
+                overload=overload,
+                max_active=cfg.get("tenantMaxActive"),
+                memory_budget_bytes=cfg.get("tenantMemoryBudgetBytes"))
+            served = f"{len(registry.tenants())} tenants"
+        else:
+            engine = ScoringEngine(
+                cfg["modelLocation"],
+                max_batch=int(cfg.get("maxBatch", 64)),
+                queue_bound=int(cfg.get("queueBound", 256)),
+                reload_poll_s=float(cfg.get("reloadPollS", 0.0)),
+                overload=overload)
+            served = engine.model_version
         traffic = ScoringHTTPServer(
             engine, host=cfg["host"], port=int(cfg["port"]),
             request_deadline_s=cfg.get("requestDeadlineS", 30.0),
-            reuse_port=True, wire_format=cfg.get("wireFormat", "auto"))
+            reuse_port=True, wire_format=cfg.get("wireFormat", "auto"),
+            registry=registry)
         admin = ScoringHTTPServer(
             engine, host=cfg["host"], port=0,
             request_deadline_s=cfg.get("requestDeadlineS", 30.0),
-            wire_format=cfg.get("wireFormat", "auto"))
+            wire_format=cfg.get("wireFormat", "auto"),
+            registry=registry)
         for srv, tag in ((traffic, "traffic"), (admin, "admin")):
             threading.Thread(target=srv.serve_forever,
                              name=f"worker-{worker_id}-{tag}",
@@ -302,7 +329,7 @@ def worker_main(config_path: str) -> int:
             os.path.join(cfg["runDir"], f"worker-{worker_id}.ready.json"),
             {"workerId": worker_id, "pid": os.getpid(),
              "port": traffic.port, "adminPort": admin.port})
-        print(f"worker {worker_id} serving {engine.model_version} on "
+        print(f"worker {worker_id} serving {served} on "
               f":{traffic.port} (admin :{admin.port})", flush=True)
         try:
             while not shutdown_requested("serve-worker"):
@@ -310,7 +337,10 @@ def worker_main(config_path: str) -> int:
         finally:
             traffic.draining = True
             admin.draining = True
-            engine.close(drain=True, timeout_s=30.0)
+            if registry is not None:
+                registry.close(timeout_s=30.0)
+            else:
+                engine.close(drain=True, timeout_s=30.0)
             traffic.shutdown()
             traffic.server_close()
             admin.shutdown()
@@ -353,7 +383,7 @@ class ServingPool:
     fail ``health_probes_fatal`` consecutive admin ``/healthz`` probes,
     and exposes pool status + merged metrics."""
 
-    def __init__(self, model_location: str, *, workers: int = 2,
+    def __init__(self, model_location: Optional[str], *, workers: int = 2,
                  host: str = "127.0.0.1", port: int = 0,
                  max_batch: int = 64, queue_bound: int = 256,
                  request_deadline_s: Optional[float] = 30.0,
@@ -365,10 +395,18 @@ class ServingPool:
                  health_probes_fatal: int = 3,
                  worker_boot_timeout_s: float = 180.0,
                  max_restarts: int = 20,
-                 trace_dir: Optional[str] = None):
+                 trace_dir: Optional[str] = None,
+                 model_root: Optional[str] = None,
+                 tenant_max_active: Optional[int] = None,
+                 tenant_memory_budget_bytes: Optional[int] = None):
         if workers < 1:
             raise ValueError("workers must be >= 1")
+        if bool(model_location) == bool(model_root):
+            raise ValueError("exactly one of model_location (single "
+                             "bundle) or model_root (multi-tenant) is "
+                             "required")
         self.model_location = model_location
+        self.model_root = model_root
         self.workers = int(workers)
         self.host = host
         # all workers share ONE concrete port: resolve the ephemeral
@@ -395,7 +433,10 @@ class ServingPool:
             "reloadPollS": float(reload_poll_s),
             "overload": dict(overload) if overload else None,
             "wireFormat": wire_format, "runDir": self.run_dir,
-            "traceDir": self.trace_dir}
+            "traceDir": self.trace_dir,
+            "modelRoot": model_root,
+            "tenantMaxActive": tenant_max_active,
+            "tenantMemoryBudgetBytes": tenant_memory_budget_bytes}
         self.slots = [self._make_slot(i) for i in range(self.workers)]
         self._supervisor: Optional[threading.Thread] = None
 
@@ -526,6 +567,23 @@ class ServingPool:
             except subprocess.TimeoutExpired:
                 pass
         self._spawn(slot)
+        with self._lock:
+            aborted = self._stopping
+        if aborted:
+            # stop() ran between the budget check and the spawn: the new
+            # worker is ours to reap — terminate it now rather than orphan
+            # a process stop() never saw
+            if slot.proc is not None:
+                try:
+                    slot.proc.terminate()
+                    slot.proc.wait(timeout=10.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    try:
+                        slot.proc.kill()
+                        slot.proc.wait(timeout=5.0)
+                    except (OSError, subprocess.TimeoutExpired):
+                        pass
+            return
         try:
             self._wait_ready(
                 slot, time.monotonic() + self.worker_boot_timeout_s)
@@ -556,15 +614,45 @@ class ServingPool:
 
     # -- status / metrics --------------------------------------------------
     def status(self) -> Dict[str, Any]:
-        return {"port": self.port, "workers": self.workers,
-                "alive": sum(1 for s in self.slots if s.alive),
-                "restartsTotal": self._restarts_total,
-                "runDir": self.run_dir,
-                "workerList": [
-                    {"workerId": s.worker_id, "alive": s.alive,
-                     "pid": (s.ready or {}).get("pid"),
-                     "adminPort": (s.ready or {}).get("adminPort"),
-                     "restarts": s.restarts} for s in self.slots]}
+        st = {"port": self.port, "workers": self.workers,
+              "alive": sum(1 for s in self.slots if s.alive),
+              "restartsTotal": self._restarts_total,
+              "runDir": self.run_dir,
+              "workerList": [
+                  {"workerId": s.worker_id, "alive": s.alive,
+                   "pid": (s.ready or {}).get("pid"),
+                   "adminPort": (s.ready or {}).get("adminPort"),
+                   "restarts": s.restarts} for s in self.slots]}
+        if self.model_root:
+            st["modelRoot"] = self.model_root
+            st["tenants"] = self.tenant_states()
+        return st
+
+    def tenant_states(self) -> Dict[str, Any]:
+        """Per-tenant state across the pool, scraped (best effort) from
+        each worker's admin ``/healthz``.  A tenant's pool-level state is
+        the worst any worker reports (QUARANTINED > ACTIVE > INACTIVE):
+        activation is lazy per worker, so a tenant can be cold on one
+        worker and quarantined on another — the operator wants the bad
+        news."""
+        rank = {"INACTIVE": 0, "ACTIVE": 1, "QUARANTINED": 2}
+        merged: Dict[str, Any] = {}
+        for slot in self.slots:
+            if not (slot.alive and slot.ready):
+                continue
+            url = (f"http://{self.host}:{slot.ready['adminPort']}/healthz")
+            try:
+                with urllib.request.urlopen(url, timeout=2.0) as resp:
+                    payload = json.loads(resp.read().decode())
+            except (urllib.error.URLError, OSError, TimeoutError,
+                    ValueError):
+                continue
+            for tenant, info in (payload.get("tenants") or {}).items():
+                seen = merged.get(tenant)
+                if seen is None or (rank.get(info.get("state"), 0)
+                                    > rank.get(seen.get("state"), 0)):
+                    merged[tenant] = info
+        return merged
 
     def scrape_worker(self, slot: _WorkerSlot) -> Optional[str]:
         if not (slot.alive and slot.ready):
@@ -700,7 +788,7 @@ def _make_admin_server(pool: ServingPool, host: str, port: int):
     return _AdminServer((host, port), _AdminHandler)
 
 
-def pool_serve_main(model_location: str, *, workers: int,
+def pool_serve_main(model_location: Optional[str], *, workers: int,
                     host: str = "127.0.0.1", port: int = 8180,
                     admin_port: int = 0, max_batch: int = 64,
                     queue_bound: int = 256,
@@ -708,7 +796,11 @@ def pool_serve_main(model_location: str, *, workers: int,
                     reload_poll_s: float = 10.0,
                     overload: Optional[Dict[str, Any]] = None,
                     wire_format: str = "auto",
-                    trace_dir: Optional[str] = None) -> int:
+                    trace_dir: Optional[str] = None,
+                    model_root: Optional[str] = None,
+                    tenant_max_active: Optional[int] = None,
+                    tenant_memory_budget_bytes: Optional[int] = None
+                    ) -> int:
     """Blocking entry point for ``serve --workers N``: run the pool until
     SIGTERM/SIGINT, then drain every worker and exit 0."""
     from ..checkpoint import preemption_guard, shutdown_requested
@@ -718,7 +810,9 @@ def pool_serve_main(model_location: str, *, workers: int,
             max_batch=max_batch, queue_bound=queue_bound,
             request_deadline_s=request_deadline_s,
             reload_poll_s=reload_poll_s, overload=overload,
-            wire_format=wire_format, trace_dir=trace_dir).start()
+            wire_format=wire_format, trace_dir=trace_dir,
+            model_root=model_root, tenant_max_active=tenant_max_active,
+            tenant_memory_budget_bytes=tenant_memory_budget_bytes).start()
         admin = _make_admin_server(pool, host, admin_port)
         threading.Thread(target=admin.serve_forever, name="pool-admin",
                          daemon=True).start()

@@ -326,6 +326,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
         self._request_context()
+        if self.server.registry is not None:
+            self._do_get_registry()
+            return
         engine = self.server.engine
         if self.path == "/healthz":
             # liveness, not readiness: a draining process is still alive
@@ -370,12 +373,93 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
+    def _do_get_registry(self) -> None:
+        """Multi-tenant GET surfaces: ``/healthz`` lists per-tenant state,
+        ``/readyz`` is ready while ANY tenant is servable, ``/metrics`` is
+        the tenant-labeled merge."""
+        registry = self.server.registry
+        if self.path == "/healthz":
+            st = registry.status()
+            st["status"] = "draining" if self.server.draining else "ok"
+            self._reply(200, st)
+        elif self.path == "/readyz":
+            st = registry.status()
+            servable = st["tenantsTotal"] - st["tenantsQuarantined"]
+            if self.server.draining:
+                self._reply(503, {"ready": False, "reasons": ["draining"]},
+                            extra_headers={"Retry-After": "30"})
+            elif servable < 1:
+                self._reply(503, {"ready": False,
+                                  "reasons": ["no servable tenants"],
+                                  "tenantsQuarantined":
+                                      st["tenantsQuarantined"]},
+                            extra_headers={"Retry-After": "30"})
+            else:
+                self._reply(200, {"ready": True,
+                                  "tenantsTotal": st["tenantsTotal"],
+                                  "tenantsActive": st["tenantsActive"],
+                                  "tenantsQuarantined":
+                                      st["tenantsQuarantined"]})
+        elif self.path == "/metrics":
+            self._reply(200, registry.metrics_text().encode(),
+                        content_type="text/plain; version=0.0.4")
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def _tenant_from_path(self) -> Tuple[bool, Optional[str]]:
+        """``(path_ok, tenant)``: ``/v1/score`` → (True, None);
+        ``/v1/score/<tenant>`` → (True, tenant); anything else
+        → (False, None)."""
+        if self.path == "/v1/score":
+            return True, None
+        prefix = "/v1/score/"
+        if self.path.startswith(prefix):
+            from urllib.parse import unquote
+            tenant = unquote(self.path[len(prefix):])
+            if tenant and "/" not in tenant:
+                return True, tenant
+        return False, None
+
+    def _resolve_engine(self, tenant: Optional[str]
+                        ) -> Optional[ScoringEngine]:
+        """Registry-mode tenant → engine, replying 404 (unknown) or 503 +
+        ``Retry-After`` (quarantined) and returning None on failure.
+        Single-engine mode ignores ``tenant`` and returns the engine —
+        the path check in ``do_POST`` already enforced ``/v1/score``."""
+        from .tenants import TenantQuarantinedError, UnknownTenantError
+        registry = self.server.registry
+        if registry is None:
+            return self.server.engine
+        if not tenant:
+            self._reply(404, {
+                "error": "multi-tenant server: name the model via "
+                         "/v1/score/<tenant>, an X-Model-Id header, or a "
+                         "modelId field", "tenants": registry.tenants()})
+            return None
+        try:
+            return registry.engine_for(tenant)
+        except UnknownTenantError as e:
+            self._reply(404, {"error": str(e), "tenant": tenant})
+            return None
+        except TenantQuarantinedError as e:
+            self._reply(503, {"error": str(e), "tenant": tenant,
+                              "state": "QUARANTINED"},
+                        extra_headers={"Retry-After": _retry_after(
+                            e.retry_after_s)})
+            return None
+        except EngineClosed as e:
+            self._reply(503, {"error": str(e)},
+                        extra_headers={"Retry-After": "30"})
+            return None
+
     def do_POST(self) -> None:  # noqa: N802
         ctx = self._request_context()
-        if self.path != "/v1/score":
+        path_ok, path_tenant = self._tenant_from_path()
+        registry_mode = self.server.registry is not None
+        if not path_ok or (path_tenant is not None and not registry_mode):
             self._reply(404, {"error": f"unknown path {self.path}"})
             return
-        engine = self.server.engine
+        tenant = path_tenant or self.headers.get("X-Model-Id")
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length)
         ctype = (self.headers.get("Content-Type") or
@@ -385,13 +469,56 @@ class _Handler(BaseHTTPRequestHandler):
         # the request span is pinned to the request's W3C position (ctx),
         # so the engine's batch span — which links back to ctx — and any
         # supervised child this request triggers share its trace id
+        attrs = {"tenant": tenant} if (registry_mode and tenant) else {}
         with span("serving.request", ctx=ctx,
-                  wire="columnar" if columnar else "json") as req_sp:
+                  wire="columnar" if columnar else "json",
+                  **attrs) as req_sp:
             self._req_span = req_sp
             if columnar:
+                engine = self._resolve_engine(tenant)
+                if engine is None:
+                    return
                 self._post_columnar(engine, body, timeout_s, ctx)
+            elif registry_mode:
+                self._post_json_registry(tenant, body, timeout_s, ctx)
             else:
-                self._post_json(engine, body, timeout_s, ctx)
+                self._post_json(self.server.engine, body, timeout_s, ctx)
+
+    def _post_json_registry(self, tenant: Optional[str], body: bytes,
+                            timeout_s: Optional[float],
+                            ctx: TraceContext) -> None:
+        """JSON scoring with tenant resolution: the path / ``X-Model-Id``
+        header wins; otherwise a ``modelId`` field in the record (or in
+        every record of a list — mixed ids are a 400, one request routes
+        to one bulkhead).  The field is stripped before scoring."""
+        try:
+            payload = json.loads(body or b"null")
+        except (ValueError, TypeError) as e:
+            self._reply(400, {"error": f"invalid JSON body: {e}"})
+            return
+        if tenant is None:
+            if isinstance(payload, dict):
+                tenant = payload.pop("modelId", None)
+            elif isinstance(payload, list):
+                ids = {r.pop("modelId", None) for r in payload
+                       if isinstance(r, dict)}
+                if len(ids) > 1:
+                    self._reply(400, {
+                        "error": "mixed modelId values in one list "
+                                 "request; a request routes to exactly "
+                                 "one tenant"})
+                    return
+                tenant = next(iter(ids), None)
+        if tenant is not None and not isinstance(tenant, str):
+            self._reply(400, {"error": "modelId must be a string"})
+            return
+        sp = getattr(self, "_req_span", None)
+        if sp is not None and tenant:
+            sp.attrs.setdefault("tenant", tenant)
+        engine = self._resolve_engine(tenant)
+        if engine is None:
+            return
+        self._score_json(engine, payload, timeout_s, ctx)
 
     def _post_columnar(self, engine: ScoringEngine, body: bytes,
                        timeout_s: Optional[float],
@@ -432,6 +559,10 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, TypeError) as e:
             self._reply(400, {"error": f"invalid JSON body: {e}"})
             return
+        self._score_json(engine, payload, timeout_s, ctx)
+
+    def _score_json(self, engine: ScoringEngine, payload: Any,
+                    timeout_s: Optional[float], ctx: TraceContext) -> None:
         try:
             if isinstance(payload, dict):
                 result, version = engine.score_record(payload, timeout_s,
@@ -477,10 +608,14 @@ class ScoringHTTPServer(ThreadingHTTPServer):
     # concurrent-client burst; serving is exactly that workload
     request_queue_size = 128
 
-    def __init__(self, engine: ScoringEngine, host: str = "127.0.0.1",
-                 port: int = 8180,
+    def __init__(self, engine: Optional[ScoringEngine],
+                 host: str = "127.0.0.1", port: int = 8180,
                  request_deadline_s: Optional[float] = 30.0,
-                 reuse_port: bool = False, wire_format: str = "auto"):
+                 reuse_port: bool = False, wire_format: str = "auto",
+                 registry: Optional[Any] = None):
+        if engine is None and registry is None:
+            raise ValueError("either an engine (single bundle) or a "
+                             "TenantRegistry is required")
         # bind manually so SO_REUSEPORT is set BEFORE bind: N pool workers
         # each bind the same (host, port) and the kernel load-balances
         # accepted connections across them
@@ -495,6 +630,10 @@ class ScoringHTTPServer(ThreadingHTTPServer):
             self.server_close()
             raise
         self.engine = engine
+        # multi-tenant mode: a TenantRegistry routes /v1/score/<tenant>,
+        # X-Model-Id and modelId-field requests to per-tenant engines; the
+        # single-engine path above stays byte-for-byte when registry=None
+        self.registry = registry
         self.request_deadline_s = request_deadline_s
         self.reuse_port = reuse_port
         self.wire_format = wire_format  # "auto" | "json" (columnar → 415)
@@ -507,42 +646,69 @@ class ScoringHTTPServer(ThreadingHTTPServer):
     def drain_and_close(self, timeout_s: Optional[float] = 30.0) -> None:
         """Stop accepting, finish queued work, release the socket."""
         self.draining = True
-        self.engine.close(drain=True, timeout_s=timeout_s)
+        if self.registry is not None:
+            self.registry.close(timeout_s=timeout_s)
+        if self.engine is not None:
+            self.engine.close(drain=True, timeout_s=timeout_s)
         self.shutdown()
         self.server_close()
 
 
-def start_server(model_location: str, *, host: str = "127.0.0.1",
+def start_server(model_location: Optional[str] = None, *,
+                 host: str = "127.0.0.1",
                  port: int = 0, max_batch: int = 64, linger_ms: float = 2.0,
                  queue_bound: int = 256,
                  request_deadline_s: Optional[float] = 30.0,
                  reload_poll_s: float = 0.0, warm: bool = True,
                  overload: Optional[OverloadConfig] = None,
-                 reuse_port: bool = False, wire_format: str = "auto"
+                 reuse_port: bool = False, wire_format: str = "auto",
+                 model_root: Optional[str] = None,
+                 tenant_max_active: Optional[int] = None,
+                 tenant_memory_budget_bytes: Optional[int] = None
                  ) -> Tuple[ScoringHTTPServer, threading.Thread]:
     """Build engine + server and start the accept loop in a daemon thread.
-    ``port=0`` binds an ephemeral port (see ``server.port``)."""
-    engine = ScoringEngine(model_location, max_batch=max_batch,
-                           linger_ms=linger_ms, queue_bound=queue_bound,
-                           reload_poll_s=reload_poll_s, warm=warm,
-                           overload=overload)
+    ``port=0`` binds an ephemeral port (see ``server.port``).  Exactly one
+    of ``model_location`` (single bundle, the unchanged default path) or
+    ``model_root`` (a directory of per-tenant bundles → multi-tenant
+    routing) is required."""
+    if bool(model_location) == bool(model_root):
+        raise ValueError("exactly one of model_location (single bundle) "
+                         "or model_root (multi-tenant) is required")
+    engine = None
+    registry = None
+    if model_root:
+        from .tenants import TenantRegistry
+        registry = TenantRegistry(
+            model_root, max_batch=max_batch, queue_bound=queue_bound,
+            reload_poll_s=reload_poll_s, warm=warm, overload=overload,
+            max_active=tenant_max_active,
+            memory_budget_bytes=tenant_memory_budget_bytes)
+    else:
+        engine = ScoringEngine(model_location, max_batch=max_batch,
+                               linger_ms=linger_ms, queue_bound=queue_bound,
+                               reload_poll_s=reload_poll_s, warm=warm,
+                               overload=overload)
     server = ScoringHTTPServer(engine, host=host, port=port,
                                request_deadline_s=request_deadline_s,
                                reuse_port=reuse_port,
-                               wire_format=wire_format)
+                               wire_format=wire_format, registry=registry)
     thread = threading.Thread(target=server.serve_forever,
                               name="scoring-http", daemon=True)
     thread.start()
     return server, thread
 
 
-def serve_main(model_location: str, *, host: str = "127.0.0.1",
+def serve_main(model_location: Optional[str] = None, *,
+               host: str = "127.0.0.1",
                port: int = 8180, max_batch: int = 64, linger_ms: float = 2.0,
                queue_bound: int = 256,
                request_deadline_s: Optional[float] = 30.0,
                reload_poll_s: float = 10.0,
                overload: Optional[OverloadConfig] = None,
-               wire_format: str = "auto") -> int:
+               wire_format: str = "auto",
+               model_root: Optional[str] = None,
+               tenant_max_active: Optional[int] = None,
+               tenant_memory_budget_bytes: Optional[int] = None) -> int:
     """Blocking entry point for the ``serve`` CLI subcommand: serve until
     SIGTERM/SIGINT, then drain in-flight batches and exit 0."""
     with preemption_guard("serve"):
@@ -551,8 +717,13 @@ def serve_main(model_location: str, *, host: str = "127.0.0.1",
             linger_ms=linger_ms, queue_bound=queue_bound,
             request_deadline_s=request_deadline_s,
             reload_poll_s=reload_poll_s, overload=overload,
-            wire_format=wire_format)
-        print(f"serving {server.engine.model_version} on "
+            wire_format=wire_format, model_root=model_root,
+            tenant_max_active=tenant_max_active,
+            tenant_memory_budget_bytes=tenant_memory_budget_bytes)
+        served = (f"{len(server.registry.tenants())} tenants from "
+                  f"{model_root}" if server.registry is not None
+                  else server.engine.model_version)
+        print(f"serving {served} on "
               f"http://{host}:{server.port} (max_batch={max_batch}, "
               f"linger_ms={linger_ms})", flush=True)
         try:

@@ -1,0 +1,539 @@
+"""TenantRegistry — one serving process, many bundles, bulkheaded.
+
+The reference's ``local`` module was designed to run many serialized
+workflow models side by side in one process; this is that layer for the
+TPU serving plane, built as a robustness feature (ROADMAP item 4): the
+hundredth model must not be able to take down the first.
+
+* **Layout.** ``--model-root`` is a directory whose immediate
+  subdirectories are tenants; each tenant directory is a single verified
+  bundle or a checkpoint root of ``ckpt-NNNNNN`` versions (exactly the
+  ``--model-location`` contract, once per tenant — newest valid version
+  serves, digest-checked via ``checkpoint.find_latest_valid``).
+* **Bulkheads.** Every active tenant owns a full ``ScoringEngine``:
+  its own queue, continuous batcher, adaptive admission limit, shed
+  budget, and compiled-path + reload ``CircuitBreaker``s (scoped
+  ``serving.batch@<tenant>`` / ``serving.reload@<tenant>``).  A hot
+  tenant exhausts *its* admission budget and gets 429s; nothing it does
+  moves another tenant's limits or breakers.
+* **Quarantine.** A tenant whose bundle fails digest/ABI verification at
+  activation — or whose reload breaker is OPEN (a poison candidate
+  stream) — is parked ``QUARANTINED``: requests get a typed
+  ``TenantQuarantinedError`` (HTTP 503 + honest ``Retry-After``), and
+  re-probes follow the deterministic backoff of a
+  ``resilience.RetryPolicy`` (attempt-indexed, keyed by tenant).  A
+  probe that loads a now-valid bundle reactivates the tenant; other
+  tenants never notice either way.
+* **LRU activation under the device-memory budget (PR 15).**  Cold
+  tenants activate on first request (AOT bundles deserialize shipped
+  executables → zero-compile first score).  Each active entry is charged
+  an ``estimate_batch_bytes(max_batch, feature_width)`` footprint
+  against ``device_memory_budget()`` (or an explicit byte budget /
+  ``max_active`` count cap); admitting a new tenant past the budget
+  evicts the coldest active entry first, with a ``tenant.evicted``
+  FailureLog action.
+
+State machine per tenant::
+
+    INACTIVE --activate ok--> ACTIVE --reload breaker OPEN--+
+        ^  ^                     |                          |
+        |  +----- evicted (LRU) -+                          v
+        +-- probe ok ------------------------------- QUARANTINED
+                                                  (backoff re-probe)
+
+Thread safety: one registry lock guards the tenant table and every state
+transition (activation, probe, eviction, quarantine).  Steady-state
+lookups are a dict hit + timestamp; a cold activation briefly serializes
+lookups, which is the price of never deadlocking across per-slot locks.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..resilience import (CircuitBreaker, RetryPolicy, maybe_inject,
+                          record_failure)
+from ..telemetry import MetricsRegistry, span
+from .engine import ScoringEngine
+from .overload import OverloadConfig
+
+# -- tenant states (mirrors the serving health ladder style) ----------------
+TENANT_INACTIVE = "INACTIVE"        # known, not loaded (cold)
+TENANT_ACTIVE = "ACTIVE"            # engine loaded and serving
+TENANT_QUARANTINED = "QUARANTINED"  # bundle failed verification / reloads
+
+TENANT_STATES = (TENANT_INACTIVE, TENANT_ACTIVE, TENANT_QUARANTINED)
+TENANT_STATE_CODES = {TENANT_INACTIVE: 0, TENANT_ACTIVE: 1,
+                      TENANT_QUARANTINED: 2}
+
+
+class UnknownTenantError(KeyError):
+    """No such tenant under the model root (HTTP 404 — a client naming a
+    tenant that does not exist is a client error, not a server state)."""
+
+    def __init__(self, tenant: str, known: List[str]):
+        super().__init__(tenant)
+        self.tenant = tenant
+        self.known = list(known)
+
+    def __str__(self) -> str:
+        return (f"unknown tenant {self.tenant!r} "
+                f"({len(self.known)} tenants registered)")
+
+
+class TenantQuarantinedError(RuntimeError):
+    """The tenant exists but is parked in QUARANTINED (HTTP 503 + honest
+    ``Retry-After``): its bundle failed verification or its reload breaker
+    tripped.  ``retry_after_s`` is when the next re-probe is due."""
+
+    def __init__(self, tenant: str, reason: str, retry_after_s: float):
+        super().__init__(f"tenant {tenant!r} is quarantined: {reason}")
+        self.tenant = tenant
+        self.reason = reason
+        self.retry_after_s = max(1.0, float(retry_after_s))
+
+
+class _TenantSlot:
+    """Registry-internal record for one tenant (guarded by the registry
+    lock — never hand one out)."""
+
+    __slots__ = ("tenant", "root", "state", "engine", "entry_bytes",
+                 "last_used", "requests_total", "activations", "evictions",
+                 "quarantines", "probes", "reactivations",
+                 "quarantine_reason", "probe_attempt", "next_probe_at")
+
+    def __init__(self, tenant: str, root: str):
+        self.tenant = tenant
+        self.root = root
+        self.state = TENANT_INACTIVE
+        self.engine: Optional[ScoringEngine] = None
+        self.entry_bytes = 0
+        self.last_used = 0.0          # monotonic; 0 = never used
+        self.requests_total = 0
+        self.activations = 0
+        self.evictions = 0
+        self.quarantines = 0
+        self.probes = 0
+        self.reactivations = 0
+        self.quarantine_reason = ""
+        self.probe_attempt = 0        # backoff index while quarantined
+        self.next_probe_at = 0.0      # monotonic deadline for the re-probe
+
+
+class TenantRegistry:
+    """See module docstring.  ``engine_for(tenant)`` is the whole hot-path
+    API; everything else is lifecycle, status and metrics."""
+
+    def __init__(self, model_root: str, *, max_batch: int = 64,
+                 queue_bound: int = 256,
+                 batch_deadline_s: Optional[float] = 30.0,
+                 reload_poll_s: float = 0.0, warm: bool = True,
+                 overload: Optional[OverloadConfig] = None,
+                 max_active: Optional[int] = None,
+                 memory_budget_bytes: Optional[int] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 drift: bool = False,
+                 engine_factory: Optional[Callable[..., ScoringEngine]]
+                 = None):
+        if not os.path.isdir(model_root):
+            raise FileNotFoundError(f"model root {model_root!r} is not a "
+                                    "directory")
+        self.model_root = model_root
+        self.max_batch = int(max_batch)
+        self.queue_bound = int(queue_bound)
+        self.batch_deadline_s = batch_deadline_s
+        self.reload_poll_s = float(reload_poll_s)
+        self.warm = warm
+        self.overload = overload          # shared template; controllers are
+        #                                   per-engine, so budgets are not
+        self.max_active = (int(max_active) if max_active else None)
+        if memory_budget_bytes is not None:
+            self.memory_budget: Optional[int] = int(memory_budget_bytes)
+        else:
+            from ..parallel.memory import device_memory_budget
+            self.memory_budget = device_memory_budget()
+        # quarantine re-probe backoff: deterministic in (seed, tenant,
+        # attempt) — the same corrupt tenant re-probes on the same honest
+        # schedule on every host, and tests can predict Retry-After
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=10 ** 9, base_delay_s=2.0, max_delay_s=300.0,
+            multiplier=2.0, jitter=0.1)
+        self.drift = drift
+        self._engine_factory = engine_factory or self._default_factory
+        self.metrics = MetricsRegistry()
+        self._lock = threading.RLock()
+        self._slots: Dict[str, _TenantSlot] = {}
+        self._closed = False
+        self.scan()
+
+    # -- discovery ---------------------------------------------------------
+    def scan(self) -> List[str]:
+        """Sync the tenant table with the model root's subdirectories:
+        new directories appear as INACTIVE tenants, removed ones drop
+        (closing their engine).  Returns the sorted tenant names."""
+        try:
+            names = sorted(
+                d for d in os.listdir(self.model_root)
+                if not d.startswith(".")
+                and os.path.isdir(os.path.join(self.model_root, d)))
+        except OSError as e:
+            record_failure("serving", "skipped", e, point="serving.tenants",
+                           detail="model root unreadable during scan")
+            with self._lock:
+                return sorted(self._slots)
+        with self._lock:
+            for name in names:
+                if name not in self._slots:
+                    self._slots[name] = _TenantSlot(
+                        name, os.path.join(self.model_root, name))
+            for name in list(self._slots):
+                if name not in names:
+                    slot = self._slots.pop(name)
+                    if slot.engine is not None:
+                        self._close_engine(slot)
+                    record_failure("serving", "tenant.removed", None,
+                                   point="serving.tenants", tenant=name)
+            return sorted(self._slots)
+
+    def tenants(self) -> List[str]:
+        with self._lock:
+            return sorted(self._slots)
+
+    # -- the hot path ------------------------------------------------------
+    def engine_for(self, tenant: str) -> ScoringEngine:
+        """The tenant's engine, activating (or re-probing) as needed.
+
+        Raises ``UnknownTenantError`` (404) for a tenant the root does not
+        contain, ``TenantQuarantinedError`` (503 + Retry-After) for one
+        parked in quarantine."""
+        with self._lock:
+            if self._closed:
+                from .engine import EngineClosed
+                raise EngineClosed("tenant registry is closed")
+            slot = self._slots.get(tenant)
+            if slot is None:
+                # a tenant directory created after startup is one cheap
+                # rescan away — no restart needed to add a tenant
+                self.scan()
+                slot = self._slots.get(tenant)
+            if slot is None:
+                raise UnknownTenantError(tenant, sorted(self._slots))
+            now = time.monotonic()
+            if slot.state == TENANT_QUARANTINED:
+                if now < slot.next_probe_at:
+                    raise TenantQuarantinedError(
+                        tenant, slot.quarantine_reason,
+                        slot.next_probe_at - now)
+                self._probe(slot)          # raises on a failed probe
+            elif slot.state == TENANT_INACTIVE:
+                self._activate(slot)       # raises via quarantine on fail
+            else:
+                brk = slot.engine.overload.reload_breaker
+                if brk.current_state() == CircuitBreaker.OPEN:
+                    # a poison candidate stream opened the reload breaker:
+                    # park the tenant rather than serve an entry whose
+                    # refresh path is known-broken
+                    self._quarantine(
+                        slot, "reload breaker open "
+                        f"(next bundle probe was {brk.retry_after_s():.1f}s"
+                        " away)")
+                    raise TenantQuarantinedError(
+                        tenant, slot.quarantine_reason,
+                        slot.next_probe_at - time.monotonic())
+            slot.last_used = time.monotonic()
+            slot.requests_total += 1
+            assert slot.engine is not None
+            return slot.engine
+
+    def peek_engine(self, tenant: str) -> Optional[ScoringEngine]:
+        """The tenant's engine if (and only if) it is ACTIVE — never
+        activates, never raises.  For observers (drift ranking, metrics)
+        that must not perturb LRU state."""
+        with self._lock:
+            slot = self._slots.get(tenant)
+            if slot is None or slot.state != TENANT_ACTIVE:
+                return None
+            return slot.engine
+
+    # -- activation / eviction ---------------------------------------------
+    def _default_factory(self, slot: _TenantSlot) -> ScoringEngine:
+        return ScoringEngine(
+            slot.root, max_batch=self.max_batch,
+            queue_bound=self.queue_bound,
+            batch_deadline_s=self.batch_deadline_s,
+            reload_poll_s=self.reload_poll_s, warm=self.warm,
+            overload=self.overload, tenant=slot.tenant)
+
+    def _entry_bytes(self, engine: ScoringEngine) -> int:
+        from ..parallel.memory import estimate_batch_bytes
+        width = len(engine.raw_features or ()) or 1
+        return int(estimate_batch_bytes(self.max_batch, width))
+
+    def _activate(self, slot: _TenantSlot) -> None:
+        t0 = time.perf_counter()
+        try:
+            maybe_inject("tenant.activate", key=slot.tenant)
+            with span("serving.tenant_activate", tenant=slot.tenant):
+                engine = self._engine_factory(slot)
+        except Exception as e:  # noqa: BLE001 — corrupt bundle, missing
+            #                     versions, ABI mismatch: all quarantine
+            self._quarantine(slot, f"activation failed: {e}", cause=e)
+            raise TenantQuarantinedError(
+                slot.tenant, slot.quarantine_reason,
+                slot.next_probe_at - time.monotonic())
+        slot.engine = engine
+        slot.entry_bytes = self._entry_bytes(engine)
+        slot.state = TENANT_ACTIVE
+        slot.last_used = time.monotonic()
+        slot.activations += 1
+        slot.probe_attempt = 0
+        slot.quarantine_reason = ""
+        if self.drift:
+            try:
+                engine.attach_drift_monitor()
+            except Exception as e:  # noqa: BLE001 — monitoring must not
+                #                     fail an activation
+                record_failure("serving", "swallowed", e,
+                               point="serving.tenants", tenant=slot.tenant)
+        self.metrics.counter("tenant.activations_total").inc()
+        record_failure(
+            "serving", "tenant.activated", None, point="serving.tenants",
+            tenant=slot.tenant, version=engine.model_version,
+            activation_s=round(time.perf_counter() - t0, 3),
+            entry_bytes=slot.entry_bytes)
+        self._enforce_budget(keep=slot)
+
+    def _active_slots(self) -> List[_TenantSlot]:
+        return [s for s in self._slots.values()
+                if s.state == TENANT_ACTIVE]
+
+    def _enforce_budget(self, keep: _TenantSlot) -> None:
+        """Evict coldest-first until the active set fits both the count
+        cap and the byte budget.  ``keep`` (the entry just activated) is
+        never the victim — the request that paid for the activation gets
+        to use it."""
+        while True:
+            active = self._active_slots()
+            over_count = (self.max_active is not None
+                          and len(active) > self.max_active)
+            over_bytes = (self.memory_budget is not None
+                          and sum(s.entry_bytes for s in active)
+                          > self.memory_budget)
+            if not (over_count or over_bytes):
+                return
+            victims = [s for s in active if s is not keep]
+            if not victims:
+                return  # a single entry over budget still serves
+            self._evict(min(victims, key=lambda s: s.last_used),
+                        "count cap" if over_count else "memory budget")
+
+    def _evict(self, slot: _TenantSlot, why: str) -> None:
+        idle_s = (time.monotonic() - slot.last_used
+                  if slot.last_used else float("inf"))
+        self._close_engine(slot)
+        slot.state = TENANT_INACTIVE
+        slot.evictions += 1
+        self.metrics.counter("tenant.evictions_total").inc()
+        record_failure("serving", "tenant.evicted", None,
+                       point="serving.tenants", tenant=slot.tenant,
+                       reason=why, idle_s=round(idle_s, 3),
+                       entry_bytes=slot.entry_bytes)
+
+    def _close_engine(self, slot: _TenantSlot,
+                      timeout_s: float = 10.0) -> None:
+        engine, slot.engine = slot.engine, None
+        slot.entry_bytes = 0
+        if engine is None:
+            return
+        try:
+            engine.close(drain=True, timeout_s=timeout_s)
+        except Exception as e:  # noqa: BLE001 — a wedged engine must not
+            #                     wedge the registry
+            record_failure("serving", "swallowed", e,
+                           point="serving.tenants", tenant=slot.tenant)
+
+    # -- quarantine --------------------------------------------------------
+    def _quarantine(self, slot: _TenantSlot, reason: str,
+                    cause: Any = None) -> None:
+        self._close_engine(slot, timeout_s=5.0)
+        slot.state = TENANT_QUARANTINED
+        slot.quarantine_reason = reason
+        slot.probe_attempt += 1
+        delay = self.retry_policy.delay_for(slot.probe_attempt,
+                                            key=slot.tenant)
+        slot.next_probe_at = time.monotonic() + delay
+        slot.quarantines += 1
+        self.metrics.counter("tenant.quarantines_total").inc()
+        record_failure("serving", "tenant.quarantined", cause or reason,
+                       point="serving.tenants", tenant=slot.tenant,
+                       attempt=slot.probe_attempt,
+                       next_probe_s=round(delay, 3))
+
+    def _probe(self, slot: _TenantSlot) -> None:
+        """One quarantine re-probe: attempt a fresh verified activation.
+        Success reactivates the tenant (this request serves normally);
+        failure re-parks it one backoff step later."""
+        slot.probes += 1
+        self.metrics.counter("tenant.probes_total").inc()
+        attempt = slot.probe_attempt
+        try:
+            maybe_inject("tenant.probe", key=slot.tenant)
+            with span("serving.tenant_probe", tenant=slot.tenant,
+                      attempt=attempt):
+                engine = self._engine_factory(slot)
+        except Exception as e:  # noqa: BLE001 — still broken: back off
+            slot.probe_attempt = attempt + 1
+            delay = self.retry_policy.delay_for(slot.probe_attempt,
+                                                key=slot.tenant)
+            slot.next_probe_at = time.monotonic() + delay
+            slot.quarantine_reason = f"probe {attempt} failed: {e}"
+            record_failure("serving", "tenant.quarantined", e,
+                           point="serving.tenants", tenant=slot.tenant,
+                           attempt=slot.probe_attempt,
+                           next_probe_s=round(delay, 3))
+            raise TenantQuarantinedError(slot.tenant,
+                                         slot.quarantine_reason, delay)
+        slot.engine = engine
+        slot.entry_bytes = self._entry_bytes(engine)
+        slot.state = TENANT_ACTIVE
+        slot.last_used = time.monotonic()
+        slot.activations += 1
+        slot.reactivations += 1
+        slot.probe_attempt = 0
+        slot.quarantine_reason = ""
+        if self.drift:
+            try:
+                engine.attach_drift_monitor()
+            except Exception as e:  # noqa: BLE001
+                record_failure("serving", "swallowed", e,
+                               point="serving.tenants", tenant=slot.tenant)
+        self.metrics.counter("tenant.reactivations_total").inc()
+        record_failure("serving", "tenant.reactivated", None,
+                       point="serving.tenants", tenant=slot.tenant,
+                       version=engine.model_version, after_probes=attempt)
+        self._enforce_budget(keep=slot)
+
+    # -- status / metrics --------------------------------------------------
+    def status(self) -> Dict[str, Any]:
+        """Per-tenant state for ``/healthz`` and admin surfaces."""
+        with self._lock:
+            now = time.monotonic()
+            tenants: Dict[str, Any] = {}
+            for name in sorted(self._slots):
+                s = self._slots[name]
+                info: Dict[str, Any] = {
+                    "state": s.state,
+                    "requestsTotal": s.requests_total,
+                    "activations": s.activations,
+                    "evictions": s.evictions,
+                    "entryBytes": s.entry_bytes,
+                }
+                if s.engine is not None:
+                    info["modelVersion"] = s.engine.model_version
+                    info["queueDepth"] = s.engine.queue_depth
+                    info["health"] = \
+                        s.engine.overload.health.snapshot()["state"]
+                if s.state == TENANT_QUARANTINED:
+                    info["quarantine"] = {
+                        "reason": s.quarantine_reason,
+                        "attempt": s.probe_attempt,
+                        "nextProbeInS": round(
+                            max(0.0, s.next_probe_at - now), 3),
+                    }
+                tenants[name] = info
+            active = self._active_slots()
+            return {"modelRoot": self.model_root,
+                    "tenants": tenants,
+                    "tenantsTotal": len(self._slots),
+                    "tenantsActive": len(active),
+                    "tenantsQuarantined": sum(
+                        1 for s in self._slots.values()
+                        if s.state == TENANT_QUARANTINED),
+                    "activeBytes": sum(s.entry_bytes for s in active),
+                    "memoryBudgetBytes": self.memory_budget,
+                    "maxActive": self.max_active}
+
+    def traffic_weights(self) -> Dict[str, int]:
+        """Requests routed per tenant since startup — the weight the
+        lifecycle retrain ranking uses."""
+        with self._lock:
+            return {name: s.requests_total
+                    for name, s in self._slots.items()}
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition: every active tenant's full engine
+        families merged with a ``tenant`` label (aggregate + per-tenant
+        samples, exactly the pool's ``worker_id`` merge semantics), plus
+        registry-level tenant state/activation/eviction/quarantine
+        families covering ALL tenants — quarantined and cold tenants are
+        visible even though they have no engine to scrape."""
+        from .pool import _METRIC_PREFIX, merge_worker_metrics
+        from .server import render_metrics
+        with self._lock:
+            texts = [(s.tenant, render_metrics(s.engine))
+                     for s in self._active_slots()]
+            slots = [(name, self._slots[name])
+                     for name in sorted(self._slots)]
+            st = self.status()
+        merged = merge_worker_metrics(texts, label="tenant") if texts else ""
+        p = _METRIC_PREFIX
+        lines = [
+            f"# HELP {p}_tenant_state Tenant state: 0 INACTIVE / 1 ACTIVE "
+            "/ 2 QUARANTINED",
+            f"# TYPE {p}_tenant_state gauge"]
+        from .pool import _escape_label_value as esc
+        for name, s in slots:
+            lines.append(f'{p}_tenant_state{{tenant="{esc(name)}"}} '
+                         f'{TENANT_STATE_CODES[s.state]}')
+        for fam, attr, help_ in (
+                ("tenant_requests_total", "requests_total",
+                 "Requests routed to this tenant"),
+                ("tenant_activations_total", "activations",
+                 "Cold/quarantine activations of this tenant's engine"),
+                ("tenant_evictions_total", "evictions",
+                 "LRU evictions of this tenant under the memory budget"),
+                ("tenant_quarantines_total", "quarantines",
+                 "Times this tenant entered quarantine"),
+                ("tenant_probes_total", "probes",
+                 "Quarantine re-probes attempted for this tenant")):
+            lines.append(f"# HELP {p}_{fam} {help_}")
+            lines.append(f"# TYPE {p}_{fam} counter")
+            lines.append(f"{p}_{fam} "
+                         f"{sum(getattr(s, attr) for _, s in slots)}")
+            lines.extend(
+                f'{p}_{fam}{{tenant="{esc(name)}"}} {getattr(s, attr)}'
+                for name, s in slots)
+        for fam, key, help_ in (
+                ("tenants", "tenantsTotal", "Tenants under the model root"),
+                ("tenants_active", "tenantsActive",
+                 "Tenants with a loaded engine"),
+                ("tenants_quarantined", "tenantsQuarantined",
+                 "Tenants parked in quarantine"),
+                ("tenant_active_bytes", "activeBytes",
+                 "Estimated device bytes charged by active entries")):
+            lines.append(f"# HELP {p}_{fam} {help_}")
+            lines.append(f"# TYPE {p}_{fam} gauge")
+            lines.append(f"{p}_{fam} {st[key]}")
+        if self.memory_budget is not None:
+            lines.append(f"# HELP {p}_tenant_memory_budget_bytes Device "
+                         "memory budget the active set is charged against")
+            lines.append(f"# TYPE {p}_tenant_memory_budget_bytes gauge")
+            lines.append(f"{p}_tenant_memory_budget_bytes "
+                         f"{self.memory_budget}")
+        return merged + "\n".join(lines) + "\n"
+
+    # -- shutdown ----------------------------------------------------------
+    def close(self, timeout_s: float = 30.0) -> None:
+        """Drain and close every active engine; the registry refuses new
+        lookups afterwards.  Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            for slot in self._slots.values():
+                self._close_engine(slot, timeout_s=timeout_s)
+                if slot.state == TENANT_ACTIVE:
+                    slot.state = TENANT_INACTIVE

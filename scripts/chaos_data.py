@@ -1,0 +1,366 @@
+"""Closed-loop data-fuzz chaos drill for the poison-data firewall.
+
+Fuzzes records against BOTH halves of the lifecycle:
+
+* **train** — 120 clean rows plus fatally-poisoned rows (garbage
+  strings, ±inf/NaN, nested maps, huge strings, hostile encodings) at
+  pinned indices must quarantine EXACTLY the poison rows and fit a
+  winner bitwise-identical to a control trained on the clean subset
+  directly; a poison storm past ``maxQuarantineFraction`` must abort
+  with the typed ``DataQualityError``;
+* **serve** — N concurrent closed-loop clients storm a LIVE
+  ``SO_REUSEPORT`` pool with a seeded mix of clean records and fuzzed
+  records (missing fields, unknown extras, wrong types, ±inf/NaN
+  storms, huge strings, mixed encodings) plus byte-corrupted columnar
+  bodies, and every outcome must be classified:
+
+  - zero 5xx, zero hangs, zero connection drops;
+  - fuzz rejections are TYPED ONLY: 422 with a violation list drawn
+    from the taxonomy (or 400 for structurally corrupt columnar
+    bodies), never a bare error;
+  - tolerated fuzz (missing/extra fields under ``coerce``) scores 200;
+  - clean columnar requests stay bitwise-equal to a pre-storm control;
+  - quarantine accounting closes: the pool's merged
+    ``quality_quarantined_records_total`` delta equals the number of
+    records the clients saw rejected.
+
+Artifacts written to ``--out-dir``: ``outcomes-data.jsonl`` (one line
+per request), ``metrics-data.txt`` (final merged ``/metrics``), and
+``summary-data.json`` (the verdict, also printed).  Exit 0 on a clean
+pass, 1 on any contract violation.
+
+Usage:
+    python scripts/chaos_data.py --out-dir /tmp/chaos_data \
+        [--clients 12] [--requests 25] [--seed 0]
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+# runnable as `python scripts/chaos_data.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+POISON_IDX = (5, 25, 45, 65, 85, 105)
+
+# fuzz categories → (mutator, statuses the firewall may answer with)
+FUZZ = {
+    "missing_field": (lambda rec, rng: _drop(rec, "x1"), {200}),
+    "extra_field": (lambda rec, rng: {**rec, "zzz_unknown": "?"}, {200}),
+    "coercible_type": (lambda rec, rng: {**rec, "x1": str(rec["x1"])},
+                       {200}),
+    "wrong_type": (lambda rec, rng: {**rec, "x1": "garbage"}, {422}),
+    "nested_map": (lambda rec, rng: {**rec, "x1": {"a": {"b": 1}}}, {422}),
+    "nan": (lambda rec, rng: {**rec, "x1": float("nan")}, {422}),
+    "pos_inf": (lambda rec, rng: {**rec, "x1": float("inf")}, {422}),
+    "neg_inf": (lambda rec, rng: {**rec, "x2": -float("inf")}, {422}),
+    "overflow_literal": (lambda rec, rng: {**rec, "x1": "1e400"}, {422}),
+    "huge_string": (lambda rec, rng: {**rec, "x1": "A" * 100_000}, {422}),
+    "mixed_encoding": (lambda rec, rng: {**rec, "x1": "Ünïcödé-€-\x00\x7f"},
+                       {422}),
+}
+
+
+def _drop(rec, key):
+    out = dict(rec)
+    out.pop(key, None)
+    return out
+
+
+def _make_records(n=120, seed=11):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        x1 = float(rng.normal())
+        x2 = float(rng.uniform(0, 10))
+        recs.append({
+            "y": 1.0 if (x1 + 0.2 * x2 + rng.normal() * 0.3) > 1.0 else 0.0,
+            "x1": x1, "x2": x2,
+        })
+    return recs
+
+
+def _train(records):
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, ModelCandidate, grid)
+    from transmogrifai_tpu.workflow import Workflow
+    y = FeatureBuilder.RealNN("y").as_response()
+    x1 = FeatureBuilder.Real("x1").as_predictor()
+    x2 = FeatureBuilder.Real("x2").as_predictor()
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]),
+                       "LR")])
+    sel.set_input(y, transmogrify([x1, x2]))
+    pred = sel.get_output()
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred).train())
+    return model, pred.name
+
+
+def _post(port, body, content_type, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/score", data=body,
+        headers={"Content-Type": content_type})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _metric(text, name, default=0.0):
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        line = line.split(" # ")[0]        # drop any exemplar suffix
+        head, _, value = line.rpartition(" ")
+        if head.rstrip() == name:
+            return float(value)
+    return default
+
+
+def _fuzz_train(summary):
+    """Train under fatal poison; require exact quarantine + winner parity
+    and the typed abort past the fraction limit."""
+    from transmogrifai_tpu.local import score_function
+    from transmogrifai_tpu.quality import DataQualityError
+    from transmogrifai_tpu.telemetry import REGISTRY
+
+    clean = _make_records()
+    fatal = ["garbage", float("nan"), float("inf"), {"a": 1},
+             "B" * 100_000, "Ünïcödé-€-\x00"]
+    poisoned = list(clean)
+    for slot, idx in enumerate(POISON_IDX):
+        poisoned[idx] = {**clean[idx], "x1": fatal[slot % len(fatal)]}
+    control_recs = [r for i, r in enumerate(clean) if i not in POISON_IDX]
+
+    before = REGISTRY.counters().get("quality.rows_quarantined_total", 0)
+    m_poison, pred_p = _train(poisoned)
+    after = REGISTRY.counters().get("quality.rows_quarantined_total", 0)
+    summary["train"] = {"rowsQuarantined": after - before,
+                        "poisonInjected": len(POISON_IDX)}
+
+    m_control, pred_c = _train(control_recs)
+    fp, fc = score_function(m_poison), score_function(m_control)
+    parity = True
+    for v in (-2.0, -0.5, 0.0, 0.5, 2.0):
+        rec = {"x1": v, "x2": 10.0 - abs(v)}
+        a, b = fp(rec)[pred_p], fc(rec)[pred_c]
+        for field in ("prediction", "probability_0", "probability_1"):
+            parity &= bool(np.float64(a[field]).view(np.uint64)
+                           == np.float64(b[field]).view(np.uint64))
+    summary["train"]["winnerBitwiseParity"] = parity
+
+    storm = [({**r, "x1": "junk"} if i < 40 else r)
+             for i, r in enumerate(clean)]
+    try:
+        _train(storm)
+        summary["train"]["stormAbort"] = None
+    except DataQualityError as e:
+        summary["train"]["stormAbort"] = {"quarantined": e.quarantined,
+                                          "total": e.total}
+    return m_poison
+
+
+def _fuzz_serve(model, out_dir, clients, requests, seed, summary):
+    """Storm a live pool with fuzzed + clean + corrupt-columnar traffic."""
+    from transmogrifai_tpu.serving import wire
+    from transmogrifai_tpu.serving.pool import ServingPool
+
+    bundle = os.path.join(out_dir, "model")
+    model.save(bundle)
+    pool = ServingPool(bundle, workers=1, max_batch=8, queue_bound=256,
+                       run_dir=os.path.join(out_dir, "pool-run"))
+    outcomes = []
+    lock = threading.Lock()
+    clean_rec = {"x1": 0.4, "x2": 5.0}
+    try:
+        pool.start()
+        port = pool.port
+        clean_body = wire.encode_records([clean_rec])
+        status, control_bytes = _post(port, clean_body, wire.CONTENT_TYPE)
+        summary["serve"] = {"controlStatus": status}
+
+        categories = sorted(FUZZ)
+
+        def client(cid):
+            rng = np.random.default_rng(seed * 1000 + cid)
+            for i in range(requests):
+                roll = rng.random()
+                out = {"client": cid, "i": i}
+                try:
+                    if roll < 0.35:               # clean columnar
+                        out["kind"] = "clean"
+                        code, body = _post(port, clean_body,
+                                           wire.CONTENT_TYPE, timeout=90)
+                        out["status"] = code
+                        out["bitwise"] = (body == control_bytes)
+                    elif roll < 0.45:             # corrupt columnar bytes
+                        out["kind"] = "corrupt_columnar"
+                        mutated = bytearray(clean_body)
+                        for _ in range(int(rng.integers(1, 4))):
+                            pos = int(rng.integers(0, len(mutated)))
+                            mutated[pos] = int(rng.integers(0, 256))
+                        code, body = _post(port, bytes(mutated),
+                                           wire.CONTENT_TYPE, timeout=90)
+                        out["status"] = code
+                    else:                          # record fuzz, JSON path
+                        cat = categories[int(rng.integers(0,
+                                                          len(categories)))]
+                        mutator, allowed = FUZZ[cat]
+                        out["kind"] = cat
+                        rec = mutator(dict(clean_rec), rng)
+                        code, body = _post(
+                            port, json.dumps(rec).encode(),
+                            "application/json", timeout=90)
+                        out["status"] = code
+                        if code == 422:
+                            payload = json.loads(body)
+                            out["violationKinds"] = sorted(
+                                {v["kind"]
+                                 for v in payload.get("violations", [])})
+                except Exception as e:           # hang / drop / reset
+                    out["status"] = None
+                    out["error"] = f"{type(e).__name__}: {e}"
+                with lock:
+                    outcomes.append(out)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        summary["serve"]["expected"] = clients * requests
+        summary["serve"]["completed"] = len(outcomes)
+
+        merged = pool.metrics()
+        with open(os.path.join(out_dir, "metrics-data.txt"), "w") as fh:
+            fh.write(merged)
+        summary["serve"]["quarantinedMetric"] = _metric(
+            merged, "transmogrifai_serving_quality_quarantined_records_total")
+        summary["serve"]["violationsMetric"] = _metric(
+            merged, "transmogrifai_serving_quality_violations_total")
+    finally:
+        pool.stop(grace_s=60.0)
+
+    with open(os.path.join(out_dir, "outcomes-data.jsonl"), "w") as fh:
+        for out in outcomes:
+            fh.write(json.dumps(out) + "\n")
+    return outcomes
+
+
+def _verdict(outcomes, summary):
+    from transmogrifai_tpu.quality import VIOLATION_KINDS
+    violations = []
+    t = summary["train"]
+    if t["rowsQuarantined"] != t["poisonInjected"]:
+        violations.append(
+            f"train quarantined {t['rowsQuarantined']} rows, injected "
+            f"{t['poisonInjected']}")
+    if not t["winnerBitwiseParity"]:
+        violations.append(
+            "poisoned-train winner drifted from the clean-subset control")
+    if not t["stormAbort"] or t["stormAbort"]["quarantined"] != 40:
+        violations.append(
+            f"no typed DataQualityError past maxQuarantineFraction: "
+            f"{t['stormAbort']}")
+
+    s = summary["serve"]
+    if s["controlStatus"] != 200:
+        violations.append(f"pre-storm control scored {s['controlStatus']}")
+    if s["completed"] != s["expected"]:
+        violations.append(
+            f"{s['expected'] - s['completed']} requests never completed")
+    rejected_records = 0
+    by_kind = {}
+    for out in outcomes:
+        code = out["status"]
+        kind = out["kind"]
+        by_kind.setdefault(kind, {}).setdefault(str(code), 0)
+        by_kind[kind][str(code)] += 1
+        if code is None:
+            violations.append(f"hang/drop: {out}")
+        elif code >= 500:
+            violations.append(f"5xx: {out}")
+        elif kind == "clean":
+            if code != 200:
+                violations.append(f"clean request rejected: {out}")
+            elif not out.get("bitwise"):
+                violations.append(
+                    f"clean response drifted from pre-storm control: {out}")
+        elif kind == "corrupt_columnar":
+            if code not in (200, 400, 422):
+                violations.append(f"corrupt columnar unclassified: {out}")
+            if code in (400, 422):
+                rejected_records += 1
+        else:
+            _, allowed = FUZZ[kind]
+            if code not in allowed:
+                violations.append(
+                    f"fuzz {kind} answered {code}, allowed {allowed}")
+            if code == 422:
+                rejected_records += 1
+                kinds = out.get("violationKinds") or []
+                if not kinds or any(k not in VIOLATION_KINDS
+                                    for k in kinds):
+                    violations.append(
+                        f"422 without taxonomy violations: {out}")
+    summary["serve"]["outcomesByKind"] = by_kind
+    summary["serve"]["rejectedSeenByClients"] = rejected_records
+    # corrupt columnar bodies are rejected at decode (400) BEFORE the
+    # quarantine counter; only 422s count against it
+    fuzz_422 = sum(
+        1 for out in outcomes
+        if out["kind"] not in ("clean", "corrupt_columnar")
+        and out["status"] == 422)
+    if summary["serve"]["quarantinedMetric"] != fuzz_422:
+        violations.append(
+            f"quarantine accounting open: metric "
+            f"{summary['serve']['quarantinedMetric']} != {fuzz_422} "
+            f"client-observed 422s")
+    summary["violations"] = violations
+    summary["pass"] = not violations
+    return summary
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out-dir", required=True)
+    ap.add_argument("--clients", type=int, default=12)
+    ap.add_argument("--requests", type=int, default=25)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    summary = {"seed": args.seed, "clients": args.clients,
+               "requests": args.requests}
+    model = _fuzz_train(summary)
+    outcomes = _fuzz_serve(model, args.out_dir, args.clients,
+                           args.requests, args.seed, summary)
+    _verdict(outcomes, summary)
+
+    with open(os.path.join(args.out_dir, "summary-data.json"), "w") as fh:
+        json.dump(summary, fh, indent=2)
+    print(json.dumps(summary, indent=2))
+    if not summary["pass"]:
+        print(f"FAIL: {len(summary['violations'])} contract violations",
+              file=sys.stderr)
+        return 1
+    print("OK: poison-train parity + typed-rejection-only fuzz storm with "
+          "closed quarantine accounting")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

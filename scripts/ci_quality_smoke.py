@@ -1,0 +1,256 @@
+"""CI smoke for the poison-data firewall (ISSUE 17): train under 5%
+injected poison and serve a poison/clean mix, and require
+
+  * training quarantines EXACTLY the poison rows (counter delta == number
+    injected) and the fitted winner is bitwise-identical to a control
+    trained on the clean subset directly,
+  * training past ``maxQuarantineFraction`` aborts with the typed
+    ``DataQualityError`` — never a silent partial fit,
+  * at serving, a poison record coalesced among clean neighbors fails
+    ONLY itself: per-record 422 with a typed violation list while every
+    clean columnar request returns bytes bitwise-equal to the quiet
+    control — and zero 5xx anywhere,
+  * /metrics carries the ``quality_*`` families and /healthz reports the
+    policy and quarantine fraction.
+
+Usage:
+    python scripts/ci_quality_smoke.py run OUT_DIR
+    python scripts/ci_quality_smoke.py validate OUT_DIR
+
+``run`` writes OUT_DIR/quality-smoke.json; ``validate`` asserts it so the
+CI failure mode is a readable diff of the summary.
+"""
+
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+# runnable as `python scripts/ci_quality_smoke.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SUMMARY_NAME = "quality-smoke.json"
+POISON_IDX = (5, 25, 45, 65, 85, 105)          # 6/120 = 5%
+
+
+def _make_records(n=120, seed=11):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        x1 = float(rng.normal())
+        x2 = float(rng.uniform(0, 10))
+        recs.append({
+            "y": 1.0 if (x1 + 0.2 * x2 + rng.normal() * 0.3) > 1.0 else 0.0,
+            "x1": x1, "x2": x2,
+        })
+    return recs
+
+
+def _post_json(port, payload, timeout=60):
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/score", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _post_columnar(port, body, timeout=60):
+    from transmogrifai_tpu.serving import wire
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/score", data=body,
+        headers={"Content-Type": wire.CONTENT_TYPE})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _get(port, path, timeout=30):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _train(records):
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, ModelCandidate, grid)
+    from transmogrifai_tpu.workflow import Workflow
+
+    y = FeatureBuilder.RealNN("y").as_response()
+    x1 = FeatureBuilder.Real("x1").as_predictor()
+    x2 = FeatureBuilder.Real("x2").as_predictor()
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]),
+                       "OpLogisticRegression")])
+    sel.set_input(y, transmogrify([x1, x2]))
+    pred = sel.get_output()
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred).train())
+    return model, pred.name
+
+
+def run(out_dir):
+    from transmogrifai_tpu.local import score_function
+    from transmogrifai_tpu.quality import (DataQualityError, SCHEMA_JSON)
+    from transmogrifai_tpu.serving import wire
+    from transmogrifai_tpu.serving.server import start_server
+    from transmogrifai_tpu.telemetry import REGISTRY
+
+    os.makedirs(out_dir, exist_ok=True)
+    summary = {}
+
+    # -- training under 5% poison vs the clean-subset control ----------------
+    clean = _make_records()
+    control_recs = [r for i, r in enumerate(clean) if i not in POISON_IDX]
+    poisoned = [({"y": r["y"], "x1": "#!poison!#", "x2": r["x2"]}
+                 if i in POISON_IDX else r)
+                for i, r in enumerate(clean)]
+
+    before = REGISTRY.counters().get("quality.rows_quarantined_total", 0)
+    m_poison, pred_p = _train(poisoned)
+    after = REGISTRY.counters().get("quality.rows_quarantined_total", 0)
+    summary["rowsQuarantined"] = after - before
+    summary["poisonInjected"] = len(POISON_IDX)
+
+    m_control, pred_c = _train(control_recs)
+    probe = [{"x1": v, "x2": 10.0 - abs(v)}
+             for v in (-2.0, -0.5, 0.0, 0.5, 2.0)]
+    fp, fc = score_function(m_poison), score_function(m_control)
+    parity = True
+    for rec in probe:
+        a, b = fp(rec)[pred_p], fc(rec)[pred_c]
+        for field in ("prediction", "probability_0", "probability_1"):
+            av = np.float64(a[field]).view(np.uint64)
+            bv = np.float64(b[field]).view(np.uint64)
+            parity &= bool(av == bv)
+    summary["winnerBitwiseParity"] = parity
+
+    # -- past maxQuarantineFraction training must abort, typed ---------------
+    storm = [({"y": r["y"], "x1": "junk", "x2": r["x2"]} if i < 40 else r)
+             for i, r in enumerate(clean)]
+    try:
+        _train(storm)
+        summary["quarantineStormAbort"] = None
+    except DataQualityError as e:
+        summary["quarantineStormAbort"] = {
+            "quarantined": e.quarantined, "total": e.total}
+
+    bundle = os.path.join(out_dir, "model")
+    m_poison.save(bundle)
+    summary["bundleHasSchema"] = os.path.exists(
+        os.path.join(bundle, SCHEMA_JSON))
+
+    # -- serving: poison fails only itself, neighbors bitwise-equal ----------
+    server, thread = start_server(bundle, port=0, max_batch=4)
+    try:
+        port = server.port
+        clean_body = wire.encode_records(
+            [{"x1": 0.3 * i - 1.0, "x2": float(i)} for i in range(8)])
+        status, control_bytes = _post_columnar(port, clean_body)
+        summary["columnarControlStatus"] = status
+
+        results = {}
+
+        def worker(name, fn, arg):
+            results[name] = fn(port, arg)
+
+        threads = []
+        for i in range(6):
+            threads.append(threading.Thread(
+                target=worker,
+                args=(f"c{i}", _post_columnar, clean_body)))
+            threads.append(threading.Thread(
+                target=worker, args=(f"p{i}", _post_json,
+                                     {"x1": "poison-%d" % i, "x2": 1.0})))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+
+        statuses = sorted({code for code, _ in results.values()})
+        summary["mixedTrafficStatuses"] = statuses
+        summary["hung"] = len(results) != len(threads)
+        summary["cleanBitwiseEqual"] = all(
+            results[f"c{i}"] == (200, control_bytes) for i in range(6))
+        poison = [results[f"p{i}"] for i in range(6)]
+        summary["poisonStatuses"] = sorted({code for code, _ in poison})
+        body = json.loads(poison[0][1])
+        summary["poisonViolationKinds"] = sorted(
+            {v["kind"] for v in body.get("violations", [])})
+
+        metrics = _get(port, "/metrics")
+        summary["qualityMetricFamilies"] = {
+            f: f"transmogrifai_serving_{f}" in metrics
+            for f in ("quality_violations_total",
+                      "quality_quarantined_records_total",
+                      "quality_nonfinite_inputs_total",
+                      "quality_nonfinite_scores_total",
+                      "quality_quarantine_fraction")}
+        hz = json.loads(_get(port, "/healthz"))
+        summary["healthz"] = {
+            "qualityPolicy": hz.get("qualityPolicy"),
+            "qualityQuarantineFraction": hz.get("qualityQuarantineFraction")}
+    finally:
+        server.drain_and_close()
+
+    with open(os.path.join(out_dir, SUMMARY_NAME), "w") as fh:
+        json.dump(summary, fh, indent=2)
+    print(json.dumps(summary, indent=2))
+    return 0
+
+
+def validate(out_dir):
+    with open(os.path.join(out_dir, SUMMARY_NAME)) as fh:
+        s = json.load(fh)
+    assert s["rowsQuarantined"] == s["poisonInjected"], \
+        (f"quarantined {s['rowsQuarantined']} rows, injected "
+         f"{s['poisonInjected']} — the firewall must drop exactly the "
+         f"poison")
+    assert s["winnerBitwiseParity"], \
+        "poisoned-train winner drifted from the clean-subset control"
+    abort = s["quarantineStormAbort"]
+    assert abort and abort["quarantined"] == 40 and abort["total"] == 120, \
+        f"no typed DataQualityError past maxQuarantineFraction: {abort}"
+    assert s["bundleHasSchema"], "bundle is missing schema.json"
+    assert s["columnarControlStatus"] == 200
+    assert not s["hung"], "a request hung during the poison/clean mix"
+    assert all(code in (200, 422) for code in s["mixedTrafficStatuses"]), \
+        f"5xx or unexpected statuses in mixed traffic: " \
+        f"{s['mixedTrafficStatuses']}"
+    assert s["cleanBitwiseEqual"], \
+        "clean neighbors of poison records were not bitwise-equal to the " \
+        "quiet control"
+    assert s["poisonStatuses"] == [422], \
+        f"poison records must 422, got {s['poisonStatuses']}"
+    assert s["poisonViolationKinds"], "422 carried no typed violations"
+    missing = [f for f, ok in s["qualityMetricFamilies"].items() if not ok]
+    assert not missing, f"/metrics missing quality families: {missing}"
+    assert s["healthz"]["qualityPolicy"] == "coerce"
+    assert s["healthz"]["qualityQuarantineFraction"] > 0.0
+    print(f"OK: {s['rowsQuarantined']}/{s['poisonInjected']} poison rows "
+          f"quarantined with a bitwise-identical winner, storm aborted "
+          f"typed at {abort['quarantined']}/{abort['total']}, poison-only "
+          f"422s ({', '.join(s['poisonViolationKinds'])}) with clean "
+          f"neighbors bitwise-equal, quality metrics end-to-end")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "run":
+        sys.exit(run(sys.argv[2]))
+    if len(sys.argv) == 3 and sys.argv[1] == "validate":
+        sys.exit(validate(sys.argv[2]))
+    sys.exit(f"usage: {sys.argv[0]} run OUT_DIR | validate OUT_DIR")

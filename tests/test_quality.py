@@ -1,0 +1,765 @@
+"""Poison-data firewall (ISSUE 17): schema contracts, per-record
+quarantine, and non-finite guards across train + serve.
+
+Covers the acceptance criteria: RawSchema derivation/round-trip and
+``schema.json`` in every bundle; the typed violation taxonomy under the
+strict/coerce/quarantine policies; training under injected poison
+quarantining exactly the poison rows with a bitwise-identical winner vs
+the clean-subset control; the >maxQuarantineFraction abort; per-record
+HTTP 422s whose co-batched neighbors score 200 and bitwise-equal to a
+no-poison control (JSON and columnar); non-finite score interception; and
+property/fuzz sweeps over hostile values asserting typed errors — never
+crashes — with JSON-vs-columnar verdict parity."""
+
+import json
+import math
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import quality as Q
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.local import score_function
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                        ModelCandidate, grid)
+from transmogrifai_tpu.serving import ScoringEngine, wire
+from transmogrifai_tpu.serving.engine import records_to_batch
+from transmogrifai_tpu.serving.server import render_metrics, start_server
+from transmogrifai_tpu.telemetry import REGISTRY
+from transmogrifai_tpu.types import (Binary, Integral, Real, RealNN, Text,
+                                     RealMap)
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _records(n=120, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"y": float(i % 2), "x": float(rng.normal()) + (i % 2)}
+            for i in range(n)]
+
+
+def _train(records):
+    label = FeatureBuilder.RealNN("y").as_response()
+    x = FeatureBuilder.Real("x").as_predictor()
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]), "LR")])
+    sel.set_input(label, transmogrify([x]))
+    pred = sel.get_output()
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred).train())
+    return model, pred.name
+
+
+@pytest.fixture(scope="module")
+def bundle(tmp_path_factory):
+    model, pred_name = _train(_records())
+    path = str(tmp_path_factory.mktemp("quality") / "model")
+    model.save(path)
+    return path, pred_name, score_function(model)
+
+
+def _post(port, payload, timeout=60):
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/score", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _post_columnar(port, body, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/score", data=body,
+        headers={"Content-Type": wire.CONTENT_TYPE})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=30) as r:
+        return json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# the schema contract
+# ---------------------------------------------------------------------------
+
+def _demo_features():
+    return [FeatureBuilder.Real("age").as_predictor(),
+            FeatureBuilder.RealNN("score").as_predictor(),
+            FeatureBuilder.Binary("active").as_predictor(),
+            FeatureBuilder.Text("city").as_predictor(),
+            FeatureBuilder.RealMap("stats").as_predictor()]
+
+
+class TestRawSchema:
+    def test_derive_kinds_and_nullability(self):
+        sch = Q.RawSchema.derive(_demo_features())
+        assert sch.fields["age"].kind is Real
+        assert sch.fields["age"].nullable
+        assert sch.fields["score"].kind is RealNN
+        assert not sch.fields["score"].nullable
+        assert not sch.fields["age"].is_response
+
+    def test_json_round_trip(self):
+        sch = Q.RawSchema.derive(_demo_features())
+        sch.fields["age"].range = (0.0, 99.0)
+        back = Q.RawSchema.from_json(
+            json.loads(json.dumps(sch.to_json())))
+        assert set(back.fields) == set(sch.fields)
+        assert back.fields["age"].range == (0.0, 99.0)
+        assert back.fields["score"].nullable is False
+
+    def test_unknown_kind_is_skipped_not_fatal(self):
+        d = {"formatVersion": 1,
+             "fields": [{"name": "a", "kind": "Real"},
+                        {"name": "b", "kind": "KindFromTheFuture"}]}
+        back = Q.RawSchema.from_json(d)
+        assert "a" in back and "b" not in back
+
+    def test_bundle_carries_schema_json(self, bundle):
+        import os
+        path, _, _ = bundle
+        assert os.path.exists(os.path.join(path, Q.SCHEMA_JSON))
+        sch = Q.RawSchema.load(path)
+        assert sch is not None and "x" in sch and "y" in sch
+        # range hints derived from the retained train batch
+        assert sch.fields["x"].range is not None
+        assert sch.fields["y"].is_response
+
+    def test_schema_json_is_digest_covered(self, bundle):
+        """Tampering with schema.json must fail bundle verification like
+        any other bundle file (the contract is integrity-protected)."""
+        import os
+        import shutil
+        from transmogrifai_tpu.checkpoint import (CorruptModelError,
+                                                  verify_bundle)
+        path, _, _ = bundle
+        tampered = path + "-tampered"
+        shutil.copytree(path, tampered)
+        assert verify_bundle(tampered) is not None
+        with open(os.path.join(tampered, Q.SCHEMA_JSON), "a") as fh:
+            fh.write(" ")
+        with pytest.raises(CorruptModelError, match="schema.json"):
+            verify_bundle(tampered)
+        shutil.rmtree(tampered)
+
+
+class TestValidateRecord:
+    @pytest.fixture()
+    def sch(self):
+        return Q.RawSchema.derive(_demo_features())
+
+    def test_clean_record_is_same_object(self, sch):
+        rec = {"age": 33.0, "score": 1.0, "active": True, "city": "lisbon",
+               "stats": {"a": 1.0}}
+        out, violations = sch.validate_record(rec)
+        assert out is rec and violations == []
+
+    def test_explicit_null_in_non_nullable(self, sch):
+        _, v = sch.validate_record({"score": None})
+        assert [x.kind for x in v] == [Q.MISSING_REQUIRED_FIELD]
+        # ABSENT non-nullable keeps the legacy monoid-zero behavior
+        _, v = sch.validate_record({"age": 1.0})
+        assert v == []
+
+    def test_str_in_numeric_coerces_or_rejects(self, sch):
+        out, v = sch.validate_record({"age": "33.5"})
+        kinds = [x.kind for x in v]
+        assert kinds == [Q.TYPE_MISMATCH]
+        assert out["age"] == 33.5      # coerced copy ...
+        assert out is not None
+
+    def test_non_coercible_string(self, sch):
+        _, v = sch.validate_record({"age": "not-a-number"})
+        assert Q.NON_COERCIBLE_VALUE in [x.kind for x in v]
+
+    def test_nonfinite_value(self, sch):
+        _, v = sch.validate_record({"age": float("inf")})
+        assert [x.kind for x in v] == [Q.NON_FINITE_VALUE]
+        _, v = sch.validate_record({"age": "1e400"})
+        assert Q.NON_FINITE_VALUE in [x.kind for x in v]
+
+    def test_unknown_field(self, sch):
+        _, v = sch.validate_record({"age": 1.0, "zzz": 9})
+        assert [x.kind for x in v] == [Q.UNKNOWN_FIELD]
+        # "key" is the reader's row-identity channel, never unknown
+        _, v = sch.validate_record({"age": 1.0, "key": "r1"})
+        assert v == []
+
+    def test_binary_map_bools_are_clean(self):
+        feats = [FeatureBuilder.BinaryMap("flags").as_predictor()]
+        sch = Q.RawSchema.derive(feats)
+        rec = {"flags": {"k0": True, "k1": False}}
+        out, v = sch.validate_record(rec)
+        assert v == [] and out is rec
+
+    def test_map_value_screening(self, sch):
+        _, v = sch.validate_record({"stats": {"a": float("nan")}})
+        assert [x.kind for x in v] == [Q.NON_FINITE_VALUE]
+        _, v = sch.validate_record({"stats": {"a": "text"}})
+        assert [x.kind for x in v] == [Q.NON_COERCIBLE_VALUE]
+        _, v = sch.validate_record({"stats": [1, 2]})
+        assert [x.kind for x in v] == [Q.NON_COERCIBLE_VALUE]
+
+    def test_nested_map_in_scalar_field(self, sch):
+        _, v = sch.validate_record({"age": {"nested": 1}})
+        assert [x.kind for x in v] == [Q.NON_COERCIBLE_VALUE]
+
+    def test_binary_string_spellings(self, sch):
+        out, v = sch.validate_record({"active": "true"})
+        assert out["active"] is True
+        out, v = sch.validate_record({"active": "false"})
+        assert out["active"] is False
+        _, v = sch.validate_record({"active": "maybe"})
+        assert Q.NON_COERCIBLE_VALUE in [x.kind for x in v]
+
+
+class TestPolicyMatrix:
+    CASES = [
+        ([Q.Violation(Q.UNKNOWN_FIELD, "a", "")],
+         {"strict": True, "coerce": False, "quarantine": False}),
+        ([Q.Violation(Q.TYPE_MISMATCH, "a", "")],
+         {"strict": True, "coerce": False, "quarantine": True}),
+        ([Q.Violation(Q.MISSING_REQUIRED_FIELD, "a", "")],
+         {"strict": True, "coerce": False, "quarantine": True}),
+        ([Q.Violation(Q.NON_COERCIBLE_VALUE, "a", "")],
+         {"strict": True, "coerce": True, "quarantine": True}),
+        ([Q.Violation(Q.NON_FINITE_VALUE, "a", "")],
+         {"strict": True, "coerce": True, "quarantine": True}),
+    ]
+
+    def test_matrix(self):
+        for violations, expect in self.CASES:
+            for policy, want in expect.items():
+                assert Q.RawSchema.rejects(violations, policy) is want, \
+                    (violations[0].kind, policy)
+            assert Q.RawSchema.rejects(violations, "off") is False
+        assert Q.RawSchema.rejects([], "strict") is False
+
+    def test_config_resolution(self, monkeypatch):
+        monkeypatch.setenv("TRANSMOGRIFAI_QUALITY_POLICY", "strict")
+        monkeypatch.setenv("TRANSMOGRIFAI_MAX_QUARANTINE_FRACTION", "0.25")
+        cfg = Q.QualityConfig.resolve(None)
+        assert cfg.policy == "strict"
+        assert cfg.max_quarantine_fraction == 0.25
+        cfg = Q.QualityConfig.resolve({"policy": "quarantine",
+                                       "maxQuarantineFraction": 0.5})
+        assert cfg.policy == "quarantine"
+        assert cfg.max_quarantine_fraction == 0.5
+        monkeypatch.setenv("TRANSMOGRIFAI_QUALITY", "0")
+        assert not Q.QualityConfig.resolve(None).enabled
+        with pytest.raises(ValueError, match="unknown quality policy"):
+            Q.QualityConfig.resolve({"policy": "yolo"})
+
+
+# ---------------------------------------------------------------------------
+# training-side quarantine
+# ---------------------------------------------------------------------------
+
+POISON_IDX = (5, 25, 45, 65, 85, 105)
+
+
+class TestTrainingQuarantine:
+    def test_screen_records_keeps_order_and_counts(self):
+        feats = _demo_features()
+        recs = [{"age": float(i)} for i in range(10)]
+        recs[3] = {"age": "garbage"}
+        before = REGISTRY.counters().get(
+            "quality.rows_quarantined_total", 0)
+        kept = Q.screen_records(recs, feats,
+                                Q.QualityConfig(policy="coerce",
+                                                max_quarantine_fraction=0.5))
+        after = REGISTRY.counters().get("quality.rows_quarantined_total", 0)
+        assert after - before == 1
+        assert [r["age"] for r in kept] == [0.0, 1.0, 2.0, 4.0, 5.0, 6.0,
+                                            7.0, 8.0, 9.0]
+
+    def test_screen_records_abort_past_fraction(self):
+        feats = _demo_features()
+        recs = [{"age": "bad"} for _ in range(10)]
+        with pytest.raises(Q.DataQualityError) as ei:
+            Q.screen_records(recs, feats,
+                             Q.QualityConfig(policy="coerce",
+                                             max_quarantine_fraction=0.1))
+        assert ei.value.quarantined == 10 and ei.value.total == 10
+
+    def test_screen_batch_drops_nonfinite_rows(self):
+        feats = [FeatureBuilder.Real("x").as_predictor()]
+        recs = [{"x": 1.0}, {"x": float("nan")}, {"x": 3.0}]
+        batch = records_to_batch(feats, recs)
+        out = Q.screen_batch(batch, feats,
+                             Q.QualityConfig(max_quarantine_fraction=0.5))
+        assert len(out) == 2
+        np.testing.assert_array_equal(
+            np.asarray(out["x"].values, dtype=np.float64), [1.0, 3.0])
+
+    def test_poisoned_train_matches_clean_subset_control(self):
+        """5% injected poison: the quarantine excludes exactly the poison
+        rows, and the fitted winner is bitwise-identical to training on
+        the clean subset directly."""
+        clean = _records()
+        control = [r for i, r in enumerate(clean) if i not in POISON_IDX]
+        poisoned = [({"y": r["y"], "x": "#!poison!#"}
+                     if i in POISON_IDX else r)
+                    for i, r in enumerate(clean)]
+        before = REGISTRY.counters().get(
+            "quality.rows_quarantined_total", 0)
+        m_poison, pred_p = _train(poisoned)
+        after = REGISTRY.counters().get("quality.rows_quarantined_total", 0)
+        assert after - before == len(POISON_IDX)
+        m_control, pred_c = _train(control)
+        probe = [{"x": v} for v in (-2.0, -0.5, 0.0, 0.5, 2.0)]
+        fp = score_function(m_poison)
+        fc = score_function(m_control)
+        for rec in probe:
+            a, b = fp(rec)[pred_p], fc(rec)[pred_c]
+            assert a == b, (rec, a, b)
+
+    def test_training_aborts_past_max_quarantine_fraction(self):
+        clean = _records()
+        poisoned = [({"y": r["y"], "x": "junk"} if i < 40 else r)
+                    for i, r in enumerate(clean)]
+        label = FeatureBuilder.RealNN("y").as_response()
+        x = FeatureBuilder.Real("x").as_predictor()
+        sel = BinaryClassificationModelSelector(models=[
+            ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]),
+                           "LR")])
+        sel.set_input(label, transmogrify([x]))
+        wf = (Workflow().set_input_records(poisoned)
+              .set_result_features(sel.get_output()))
+        with pytest.raises(Q.DataQualityError, match="maxQuarantineFraction"):
+            wf.train()
+
+    def test_quality_disabled_restores_old_crash(self):
+        """`off` policy: the firewall steps aside and the poison fails the
+        run the way it always did (typed column error, not silent)."""
+        poisoned = [{"y": 0.0, "x": "junk"}] + _records(40)
+        label = FeatureBuilder.RealNN("y").as_response()
+        x = FeatureBuilder.Real("x").as_predictor()
+        sel = BinaryClassificationModelSelector(models=[
+            ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01]),
+                           "LR")])
+        sel.set_input(label, transmogrify([x]))
+        wf = (Workflow().set_input_records(poisoned)
+              .set_result_features(sel.get_output()))
+        wf.parameters["quality"] = {"policy": "off"}
+        with pytest.raises(Exception):
+            wf.train()
+
+
+# ---------------------------------------------------------------------------
+# serving: per-record 422s, neighbor isolation, non-finite guards
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def served(bundle):
+    path, pred_name, local_fn = bundle
+    server, thread = start_server(path, port=0, max_batch=4)
+    yield server.port, pred_name, local_fn, server
+    server.drain_and_close()
+
+
+class TestServingFirewall:
+    def test_clean_record_scores_200(self, served):
+        port, pred_name, local_fn, _ = served
+        code, body = _post(port, {"x": 0.5})
+        assert code == 200
+        want = local_fn({"x": 0.5})[pred_name]
+        assert body["result"][pred_name] == want
+
+    def test_poison_record_gets_422_with_violations(self, served):
+        port, _, _, _ = served
+        code, body = _post(port, {"x": "not-a-number"})
+        assert code == 422
+        assert body["policy"] == "coerce"
+        kinds = {v["kind"] for v in body["violations"]}
+        assert Q.NON_COERCIBLE_VALUE in kinds
+
+    def test_nan_and_inf_inputs_422(self, served):
+        port, _, _, _ = served
+        for bad in (float("nan"), float("inf"), -float("inf")):
+            code, body = _post(port, {"x": bad})
+            assert code == 422, bad
+            assert body["violations"][0]["kind"] == Q.NON_FINITE_VALUE
+
+    def test_huge_literal_is_nonfinite(self, served):
+        """1e400 overflows float64 to inf in the JSON parser — the seam
+        guard catches it as NonFiniteValue, not a 500."""
+        port, _, _, _ = served
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/score",
+            data=b'{"x": 1e400}',
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                code, body = r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            code, body = e.code, json.loads(e.read())
+        assert code == 422
+        assert body["violations"][0]["kind"] == Q.NON_FINITE_VALUE
+
+    def test_unknown_field_passes_under_coerce(self, served):
+        port, pred_name, local_fn, _ = served
+        code, body = _post(port, {"x": 0.5, "extra_field": "zzz"})
+        assert code == 200
+        assert body["result"][pred_name] == local_fn({"x": 0.5})[pred_name]
+
+    def test_list_poison_is_row_tagged_422(self, served):
+        port, _, _, _ = served
+        code, body = _post(port, [{"x": 0.1}, {"x": "bad"}, {"x": 0.2}])
+        assert code == 422
+        rows = {v.get("row") for v in body["violations"]}
+        assert rows == {1}
+
+    def test_neighbors_of_poison_score_200_and_bitwise_equal(self, served):
+        """The regression pin: clean requests coalesced around a poison
+        record must all return 200 with results bitwise-equal to the
+        no-poison control — the poison fails only itself."""
+        port, pred_name, _, _ = served
+        xs = [round(-1.0 + 0.17 * i, 3) for i in range(12)]
+        control = {}
+        for v in xs:
+            code, body = _post(port, {"x": v})
+            assert code == 200
+            control[v] = body["result"][pred_name]
+        results: dict = {}
+        errors: list = []
+
+        def clean_worker(v):
+            try:
+                results[v] = _post(port, {"x": v})
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def poison_worker(i):
+            try:
+                results[f"p{i}"] = _post(port, {"x": "poison-%d" % i})
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=clean_worker, args=(v,))
+                   for v in xs]
+        threads += [threading.Thread(target=poison_worker, args=(i,))
+                    for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors
+        for v in xs:
+            code, body = results[v]
+            assert code == 200, (v, body)
+            got = body["result"][pred_name]
+            # exact class decision; probabilities within float-reduction
+            # tolerance of the solo control (batch-shape padding changes
+            # summation order by a few ULPs — poison never enters the
+            # queue so it cannot shift results further than that)
+            assert got["prediction"] == control[v]["prediction"], v
+            for k in ("probability_0", "probability_1"):
+                assert got[k] == pytest.approx(control[v][k],
+                                               rel=1e-5, abs=1e-7), (v, k)
+        for i in range(6):
+            code, body = results[f"p{i}"]
+            assert code == 422, body
+
+    def test_columnar_nonfinite_rows_422(self, served):
+        port, _, _, _ = served
+        body = wire.encode_records([{"x": 0.5}, {"x": float("inf")},
+                                    {"x": 1.5}])
+        code, out = _post_columnar(port, body)
+        assert code == 422
+        payload = json.loads(out)
+        rows = {v.get("row") for v in payload["violations"]}
+        assert rows == {1}
+        assert payload["violations"][0]["kind"] == Q.NON_FINITE_VALUE
+
+    def test_columnar_clean_parity_during_poison(self, served):
+        """Clean columnar requests concurrent with poison columnar
+        requests return byte-identical bodies to the quiet control."""
+        port, _, _, _ = served
+        clean_body = wire.encode_records(
+            [{"x": 0.25 * i} for i in range(8)])
+        code, control = _post_columnar(port, clean_body)
+        assert code == 200
+        poison_body = wire.encode_records(
+            [{"x": float("nan")} for _ in range(4)])
+        results: dict = {}
+
+        def worker(name, body):
+            results[name] = _post_columnar(port, body)
+
+        threads = [threading.Thread(target=worker, args=(f"c{i}",
+                                                         clean_body))
+                   for i in range(4)]
+        threads += [threading.Thread(target=worker, args=(f"p{i}",
+                                                          poison_body))
+                    for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for i in range(4):
+            code, out = results[f"c{i}"]
+            assert code == 200 and out == control
+            code, _ = results[f"p{i}"]
+            assert code == 422
+
+    def test_metrics_and_healthz_surface_quality(self, served):
+        port, _, _, server = served
+        txt = render_metrics(server.engine)
+        for family in ("quality_violations_total",
+                       "quality_violations_by_kind_total",
+                       "quality_quarantined_records_total",
+                       "quality_nonfinite_inputs_total",
+                       "quality_nonfinite_scores_total",
+                       "quality_quarantine_fraction"):
+            assert f"transmogrifai_serving_{family}" in txt, family
+        assert 'kind="NonCoercibleValue"' in txt
+        # the violation counter carries a trace-id exemplar
+        line = [l for l in txt.splitlines()
+                if l.startswith("transmogrifai_serving_quality_violations"
+                                "_total ")][0]
+        assert "trace_id=" in line
+        h = _get_json(port, "/healthz")
+        assert h["qualityPolicy"] == "coerce"
+        assert 0.0 < h["qualityQuarantineFraction"] < 1.0
+
+
+class TestEngineFirewall:
+    def test_strict_rejects_unknown_field(self, bundle):
+        path, _, _ = bundle
+        eng = ScoringEngine(path, max_batch=2, warm=False,
+                            quality_policy="strict")
+        try:
+            with pytest.raises(Q.RecordQualityError) as ei:
+                eng.score_record({"x": 0.5, "surprise": 1}, timeout_s=30)
+            assert ei.value.violations[0].kind == Q.UNKNOWN_FIELD
+            # clean records still score
+            res, _ = eng.score_record({"x": 0.5}, timeout_s=30)
+            assert res
+        finally:
+            eng.close()
+
+    def test_off_disables_screening(self, bundle):
+        path, pred_name, local_fn = bundle
+        eng = ScoringEngine(path, max_batch=2, warm=False,
+                            quality_policy="off")
+        try:
+            res, _ = eng.score_record({"x": 0.5, "surprise": 1},
+                                      timeout_s=30)
+            assert res[pred_name] == local_fn({"x": 0.5})[pred_name]
+        finally:
+            eng.close()
+
+    def test_nonfinite_score_is_intercepted(self, bundle):
+        """A model that emits NaN dead-letters that row with a typed 422
+        error instead of returning NaN to the caller."""
+        path, pred_name, _ = bundle
+        eng = ScoringEngine(path, max_batch=2, warm=False)
+        try:
+            with eng._swap_lock:
+                entry = eng._entry
+            entry.local_fn = lambda rec: {pred_name: {
+                "prediction": float("nan"), "probability_1": 0.5}}
+            eng._compiled_ok = False      # route through the local path
+            with pytest.raises(Q.RecordQualityError) as ei:
+                eng.score_record({"x": 0.5}, timeout_s=30)
+            assert ei.value.violations[0].kind == Q.NON_FINITE_VALUE
+            assert eng.metrics.counters().get(
+                "quality.nonfinite_scores_total", 0) >= 1
+        finally:
+            eng.close()
+
+    def test_quarantine_fraction_property(self, bundle):
+        path, _, _ = bundle
+        eng = ScoringEngine(path, max_batch=2, warm=False)
+        try:
+            assert eng.quality_quarantine_fraction == 0.0
+            with pytest.raises(Q.RecordQualityError):
+                eng.score_record({"x": "zzz"}, timeout_s=30)
+            eng.score_record({"x": 1.0}, timeout_s=30)
+            assert 0.0 < eng.quality_quarantine_fraction < 1.0
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# hostile-value property/fuzz sweeps
+# ---------------------------------------------------------------------------
+
+HOSTILE_SCALARS = [
+    None, "", "   ", "NaN", "inf", "-inf", "1e400", "not-a-number",
+    float("nan"), float("inf"), -float("inf"), 1e400 if True else None,
+    {"nested": {"deeper": 1}}, [1, 2, 3], ["a", None], True, False,
+    "0" * 4096, "\x00\x01\x02", "ué¢€", b"bytes" if False else "bytes",
+    -0.0, 2 ** 80, "2" * 400,
+]
+
+
+class TestHostileFuzz:
+    def test_records_to_batch_never_crashes_untyped(self):
+        """Every hostile value either builds a batch or raises a TYPED
+        error (ValueError carrying a quality-taxonomy violation_kind, or
+        TypeError from the storage layer) — never a segfault/hang and
+        never an uncontrolled exception type."""
+        feats = [FeatureBuilder.Real("x").as_predictor(),
+                 FeatureBuilder.RealNN("z").as_predictor()]
+        for v in HOSTILE_SCALARS:
+            for field in ("x", "z"):
+                rec = {"x": 1.0, "z": 1.0}
+                rec[field] = v
+                try:
+                    batch = records_to_batch(feats, [rec])
+                    assert len(batch) == 1
+                except (ValueError, TypeError) as e:
+                    kind = getattr(e, "violation_kind", None)
+                    if isinstance(e, ValueError) and kind is not None:
+                        assert kind in Q.VIOLATION_KINDS
+
+    def test_screen_verdict_parity_json_vs_columnar_strict(self):
+        """Under strict policy the JSON screen and the columnar wire
+        decode agree on accept/reject for every encodable hostile scalar
+        — a record rejected on one path is rejected on the other."""
+        feats = [FeatureBuilder.Real("x").as_predictor(),
+                 FeatureBuilder.RealNN("z").as_predictor()]
+        sch = Q.RawSchema.derive(feats)
+        for v in HOSTILE_SCALARS:
+            rec = {"x": 1.0, "z": 1.0, "x2": None}
+            rec.pop("x2")
+            rec["x"] = v
+            _, violations, json_rejects = sch.screen_record(rec, "strict")
+            try:
+                body = wire.encode_records([rec])
+            except Exception:
+                continue    # not encodable on the wire at all
+            try:
+                batch = wire.decode_batch(body, feats)
+                col_rejects = bool(Q.batch_nonfinite_rows(batch, sch))
+            except wire.WireFormatError:
+                col_rejects = True
+            if col_rejects:
+                assert json_rejects, (v, "columnar rejects, JSON accepts")
+
+    def test_wire_decode_batch_hostile_values(self):
+        feats = [FeatureBuilder.Real("x").as_predictor(),
+                 FeatureBuilder.RealNN("z").as_predictor()]
+        cases = [
+            [{"x": None, "z": 1.0}],                      # null in nullable
+            [{"x": 1.0, "z": None}],                      # null in non-null
+            [{"x": "str", "z": 1.0}],                     # str in float
+            [{"x": float("nan"), "z": 1.0}],              # NaN
+            [{"x": 1.0, "z": float("inf")}],              # inf
+            [{"x": "", "z": 1.0}],                        # empty string
+        ]
+        for recs in cases:
+            try:
+                body = wire.encode_records(recs)
+            except Exception:
+                continue
+            try:
+                batch = wire.decode_batch(body, feats)
+                assert len(batch) == len(recs)
+            except wire.WireFormatError as e:
+                if e.violation_kind is not None:
+                    assert e.violation_kind in Q.VIOLATION_KINDS
+
+    def test_wire_decode_random_corruption_is_always_typed(self):
+        """Seeded byte-level fuzz over a valid columnar body: every
+        mutation decodes or raises WireFormatError — nothing else."""
+        feats = [FeatureBuilder.Real("x").as_predictor(),
+                 FeatureBuilder.Text("t").as_predictor()]
+        body = bytearray(wire.encode_records(
+            [{"x": 1.5, "t": "hello"}, {"x": None, "t": ""}]))
+        rng = np.random.default_rng(17)
+        for _ in range(300):
+            mutated = bytearray(body)
+            for _ in range(int(rng.integers(1, 4))):
+                pos = int(rng.integers(0, len(mutated)))
+                mutated[pos] = int(rng.integers(0, 256))
+            cut = mutated[:int(rng.integers(0, len(mutated) + 1))] \
+                if rng.random() < 0.3 else mutated
+            try:
+                wire.decode_batch(bytes(cut), feats)
+            except wire.WireFormatError:
+                pass
+
+    def test_nonnullable_empty_values_has_taxonomy_kind(self):
+        feats = [FeatureBuilder.RealNN("z").as_predictor()]
+        body = wire.encode_records([{"z": 1.0}, {"z": None}])
+        with pytest.raises(wire.WireFormatError,
+                           match="empty values") as ei:
+            wire.decode_batch(body, feats)
+        assert ei.value.violation_kind == Q.MISSING_REQUIRED_FIELD
+
+    def test_finite_row_mask_jit_compatible(self):
+        """The seam reduction must be traceable (jnp path, no python
+        branching on values)."""
+        import jax
+        import jax.numpy as jnp
+        arr = jnp.array([[1.0, 2.0], [jnp.inf, 0.0], [3.0, jnp.nan]])
+        mask = jax.jit(Q.finite_row_mask)(arr)
+        np.testing.assert_array_equal(np.asarray(mask),
+                                      [True, False, False])
+
+    def test_mask_nonfinite_result_arrays(self):
+        arrays = {"p": (np.array([0.2, np.nan, 0.4]), None),
+                  "q": (np.array([1.0, 1.0, np.inf]),
+                        np.array([True, True, True]))}
+        out, bad = Q.mask_nonfinite_result_arrays(arrays)
+        np.testing.assert_array_equal(bad, [False, True, True])
+        vals, mask = out["p"]
+        assert mask is not None and not mask[1] and mask[0]
+        assert np.isfinite(vals).all()
+
+
+# ---------------------------------------------------------------------------
+# reader-level malformed-row unification
+# ---------------------------------------------------------------------------
+
+class TestReaderUnification:
+    def test_avro_skips_corrupt_block(self, tmp_path):
+        from transmogrifai_tpu.readers import read_avro_records, write_avro
+        schema = {"type": "record", "name": "R",
+                  "fields": [{"name": "a", "type": "long"}]}
+        recs = [{"a": i} for i in range(10)]
+        path = str(tmp_path / "ok.avro")
+        write_avro(path, recs, schema, codec="deflate")
+        back, _ = read_avro_records(path)
+        assert [r["a"] for r in back] == list(range(10))
+        # corrupt a byte inside the block payload (past header+sync)
+        data = bytearray(open(path, "rb").read())
+        data[-10] ^= 0xFF
+        bad_path = str(tmp_path / "bad.avro")
+        open(bad_path, "wb").write(bytes(data))
+        before = REGISTRY.counters().get("quality.malformed_rows_total", 0)
+        got, _ = read_avro_records(bad_path, skip_malformed=True)
+        after = REGISTRY.counters().get("quality.malformed_rows_total", 0)
+        assert len(got) < len(recs)          # the bad block was dropped
+        assert after > before                 # ...and accounted
+        # strict mode still raises for callers that want fail-fast
+        with pytest.raises(Exception):
+            read_avro_records(bad_path, skip_malformed=False)
+
+    def test_streaming_reader_quarantines_per_record(self):
+        from transmogrifai_tpu.readers.streaming import StreamingReader
+        feats = [FeatureBuilder.Real("x").as_predictor()]
+        batches = [[{"x": 1.0}, {"x": "poison"}, {"x": 3.0}]]
+        reader = StreamingReader(batches=batches, raw_features=feats)
+        with Q.use_quality(Q.QualityConfig(policy="coerce",
+                                           max_quarantine_fraction=0.9)):
+            out = list(reader.stream())
+        assert len(out) == 1 and len(out[0]) == 2
+        np.testing.assert_array_equal(
+            np.asarray(out[0]["x"].values, dtype=np.float64), [1.0, 3.0])

@@ -269,19 +269,45 @@ def indicator_2d(flags: Iterable) -> np.ndarray:
 
 
 def numeric_column(kind: Type[FeatureType], values: Iterable, n: Optional[int] = None) -> Column:
-    """Build a numeric column from python values with Nones."""
+    """Build a numeric column from python values with Nones.
+
+    A value the kind cannot coerce raises a ``ValueError`` naming the kind,
+    the offending row and the value (with ``violation_kind`` set to the
+    quality.py taxonomy), so a poison record in a batch is attributable to
+    its row instead of surfacing as a bare ``float()`` traceback."""
     vals = list(values)
     n = len(vals) if n is None else n
     mask = np.array([v is not None for v in vals], dtype=bool)
     if issubclass(kind, (Date, DateTime)) or issubclass(kind, Integral):
-        arr = np.array([0 if v is None else int(v) for v in vals], dtype=np.int64)
+        cast, zero, dtype = int, 0, np.int64
     elif issubclass(kind, Binary):
-        arr = np.array([False if v is None else bool(v) for v in vals], dtype=bool)
+        cast, zero, dtype = bool, False, bool
     else:
-        arr = np.array([np.nan if v is None else float(v) for v in vals], dtype=np.float32)
+        cast, zero, dtype = float, np.nan, np.float32
+    try:
+        arr = np.array([zero if v is None else cast(v) for v in vals],
+                       dtype=dtype)
+    except (TypeError, ValueError) as e:
+        bad_row = None
+        for i, v in enumerate(vals):
+            if v is None:
+                continue
+            try:
+                cast(v)
+            except (TypeError, ValueError):
+                bad_row = i
+                break
+        err = ValueError(
+            f"{kind.__name__} column: non-coercible value at row "
+            f"{bad_row}: {str(vals[bad_row])[:60]!r}" if bad_row is not None
+            else f"{kind.__name__} column: non-coercible value ({e})")
+        err.violation_kind = "NonCoercibleValue"  # quality.py taxonomy
+        raise err from e
     if kind.non_nullable and not mask.all():
         bad = int((~mask).sum())
-        raise ValueError(f"{kind.__name__} column has {bad} empty values")
+        err = ValueError(f"{kind.__name__} column has {bad} empty values")
+        err.violation_kind = "MissingRequiredField"  # quality.py taxonomy
+        raise err
     return Column(kind, arr, mask=None if kind.non_nullable else mask)
 
 

@@ -98,6 +98,13 @@ class OpParams:
     # hostHardBytes (TRANSMOGRIFAI_HOST_MEM_SOFT_BYTES / _HARD_BYTES RSS
     # watchdog watermarks), watchdogIntervalS (TRANSMOGRIFAI_RSS_WATCHDOG_S)
     memory: Dict[str, Any] = field(default_factory=dict)
+    # data-quality firewall knobs (quality.py env equivalents): policy
+    # (TRANSMOGRIFAI_QUALITY_POLICY: strict | coerce | quarantine | off;
+    # --quality-policy), maxQuarantineFraction
+    # (TRANSMOGRIFAI_MAX_QUARANTINE_FRACTION — training aborts with
+    # DataQualityError past it), enabled (TRANSMOGRIFAI_QUALITY;
+    # --no-quality)
+    quality: Dict[str, Any] = field(default_factory=dict)
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "OpParams":
@@ -124,7 +131,8 @@ class OpParams:
             mesh=d.get("meshParams") or {},
             supervisor=d.get("supervisorParams") or {},
             hostgroup=d.get("hostgroupParams") or {},
-            memory=d.get("memoryParams") or {})
+            memory=d.get("memoryParams") or {},
+            quality=d.get("qualityParams") or {})
 
     @staticmethod
     def load(path: str) -> "OpParams":
@@ -154,6 +162,7 @@ class OpParams:
             "supervisorParams": self.supervisor,
             "hostgroupParams": self.hostgroup,
             "memoryParams": self.memory,
+            "qualityParams": self.quality,
         }
 
     def apply_stage_params(self, stages) -> None:

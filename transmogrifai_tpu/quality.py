@@ -1,0 +1,662 @@
+"""Poison-data firewall: schema contracts, per-record quarantine, and
+non-finite guards (reference: the data-quality half of SanityChecker /
+RawFeatureFilter — PAPER.md "auto-validates them" — applied to the HOT
+paths instead of the whole-batch filter pass).
+
+The hot paths used to trust their input: one hostile record in a coalesced
+serving micro-batch failed every co-batched neighbor (``records_to_batch``
+does bare coercion — ``float("junk")`` throws for the whole batch), readers
+raised mid-file on malformed rows, and nothing stopped NaN/Inf from flowing
+onto the device or back out as a silently-poisoned score.  This module is
+the firewall:
+
+* ``RawSchema`` — the per-bundle schema contract derived from the raw
+  features (name → kind, nullable, numeric range hints from the training
+  batch), serialized digest-covered as ``schema.json`` in every bundle and
+  enforced at train ingestion and serving assembly.
+* A typed violation taxonomy — ``MissingRequiredField`` / ``TypeMismatch``
+  / ``NonCoercibleValue`` / ``NonFiniteValue`` / ``UnknownField`` — under a
+  ``strict | coerce | quarantine`` policy (``qualityParams`` in OpParams).
+  The default ``coerce`` keeps historical behavior for inputs the old path
+  accepted (observable-but-unchanged) and quarantines only records the old
+  path would have crashed on.
+* Per-record quarantine: a rejected record carries its violations in a
+  ``RecordQualityError`` (HTTP 422 at the server) while neighbors score
+  normally; at training, quarantined rows are excluded with counters and a
+  ``maxQuarantineFraction`` guard that aborts with ``DataQualityError``
+  rather than silently training on a fraction of the data.
+* The non-finite firewall: finite-mask reductions (``jnp.isfinite`` on
+  device arrays, ``np.isfinite`` on host arrays — same reduction, jit-
+  compatible) at the host→device seam and on fused scoring outputs, with
+  ``quality.nonfinite_inputs_total`` / ``quality.nonfinite_scores_total``
+  accounting.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import (Any, Dict, Iterable, List, Optional, Sequence, Tuple,
+                    Type)
+
+import numpy as np
+
+from .types import (FeatureType, OPList, OPMap, OPVector,
+                    feature_type_from_name, is_map_kind, is_numeric_kind,
+                    is_text_kind, map_value_kind)
+
+SCHEMA_JSON = "schema.json"
+SCHEMA_FORMAT_VERSION = 1
+
+# -- the violation taxonomy -------------------------------------------------
+
+MISSING_REQUIRED_FIELD = "MissingRequiredField"
+TYPE_MISMATCH = "TypeMismatch"
+NON_COERCIBLE_VALUE = "NonCoercibleValue"
+NON_FINITE_VALUE = "NonFiniteValue"
+UNKNOWN_FIELD = "UnknownField"
+
+VIOLATION_KINDS = (MISSING_REQUIRED_FIELD, TYPE_MISMATCH,
+                   NON_COERCIBLE_VALUE, NON_FINITE_VALUE, UNKNOWN_FIELD)
+
+# violations the OLD ingestion path would have crashed on (or silently
+# poisoned a score with): these reject the record under EVERY policy —
+# "coerce keeps old behavior" means old *working* behavior, not old crashes
+FATAL_KINDS = frozenset({NON_COERCIBLE_VALUE, NON_FINITE_VALUE})
+
+POLICIES = ("strict", "coerce", "quarantine", "off")
+DEFAULT_POLICY = "coerce"
+
+
+@dataclass
+class Violation:
+    """One typed schema violation, attributable to a field (and, for
+    columnar/batch validation, a row)."""
+    kind: str
+    field: str
+    message: str
+    row: Optional[int] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"kind": self.kind, "field": self.field,
+                             "message": self.message}
+        if self.row is not None:
+            d["row"] = int(self.row)
+        return d
+
+
+class RecordQualityError(ValueError):
+    """A record (or identified rows of a columnar request) failed schema
+    validation.  The server maps this to a structured HTTP 422 carrying the
+    full violation list — the caller learns exactly what was wrong, and
+    co-batched neighbors are unaffected."""
+
+    def __init__(self, violations: Sequence[Violation],
+                 policy: str = DEFAULT_POLICY):
+        self.violations = list(violations)
+        self.policy = policy
+        head = self.violations[0] if self.violations else None
+        desc = (f"{head.kind} on field {head.field!r}: {head.message}"
+                if head else "schema validation failed")
+        more = len(self.violations) - 1
+        super().__init__(desc + (f" (+{more} more violation(s))"
+                                 if more > 0 else ""))
+
+    def to_json(self) -> List[Dict[str, Any]]:
+        return [v.to_json() for v in self.violations]
+
+
+class DataQualityError(RuntimeError):
+    """Training aborted because the quarantined fraction exceeded
+    ``maxQuarantineFraction`` — the data is too poisoned to silently train
+    on what remains."""
+
+    def __init__(self, quarantined: int, total: int, limit: float,
+                 sample: Optional[Sequence[Violation]] = None):
+        self.quarantined = int(quarantined)
+        self.total = int(total)
+        self.fraction = (float(quarantined) / total) if total else 1.0
+        self.limit = float(limit)
+        self.sample = list(sample or [])
+        detail = "; ".join(f"{v.kind}({v.field})" for v in self.sample[:5])
+        super().__init__(
+            f"{quarantined}/{total} rows ({self.fraction:.1%}) quarantined "
+            f"by the data-quality firewall — exceeds maxQuarantineFraction="
+            f"{limit:g}" + (f"; sample: {detail}" if detail else ""))
+
+
+# -- policy / run configuration ---------------------------------------------
+
+@dataclass
+class QualityConfig:
+    """Resolved quality knobs for one run (``qualityParams`` in OpParams,
+    ``TRANSMOGRIFAI_QUALITY*`` in the environment)."""
+    policy: str = DEFAULT_POLICY
+    max_quarantine_fraction: float = 0.1
+    enabled: bool = True
+
+    @staticmethod
+    def resolve(params: Optional[Dict[str, Any]] = None) -> "QualityConfig":
+        """Environment defaults overridden by an explicit params dict
+        (camelCase keys, the OpParams convention)."""
+        p = dict(params or {})
+        policy = p.get("policy",
+                       os.environ.get("TRANSMOGRIFAI_QUALITY_POLICY",
+                                      DEFAULT_POLICY))
+        if policy not in POLICIES:
+            raise ValueError(f"unknown quality policy {policy!r}; expected "
+                             f"one of {POLICIES}")
+        frac = p.get("maxQuarantineFraction")
+        if frac is None:
+            frac = float(os.environ.get(
+                "TRANSMOGRIFAI_MAX_QUARANTINE_FRACTION", "0.1"))
+        enabled = p.get("enabled")
+        if enabled is None:
+            enabled = os.environ.get("TRANSMOGRIFAI_QUALITY", "1") != "0"
+        if policy == "off":
+            enabled = False
+        return QualityConfig(policy=policy,
+                             max_quarantine_fraction=float(frac),
+                             enabled=bool(enabled))
+
+
+# ambient config for the dynamic extent of a train/stream run, so readers —
+# which have no params channel of their own — screen records with the run's
+# policy (the ``use_failure_log`` pattern)
+_CFG_STACK: List[QualityConfig] = []
+_CFG_LOCK = threading.Lock()
+
+
+def active_quality() -> Optional[QualityConfig]:
+    """The innermost installed config, or None (firewall dormant — readers
+    behave exactly as before)."""
+    with _CFG_LOCK:
+        return _CFG_STACK[-1] if _CFG_STACK else None
+
+
+@contextmanager
+def use_quality(cfg: QualityConfig):
+    """Install ``cfg`` as the ambient quality config for the extent."""
+    with _CFG_LOCK:
+        _CFG_STACK.append(cfg)
+    try:
+        yield cfg
+    finally:
+        with _CFG_LOCK:
+            for i in range(len(_CFG_STACK) - 1, -1, -1):
+                if _CFG_STACK[i] is cfg:
+                    del _CFG_STACK[i]
+                    break
+
+
+# -- the schema contract ----------------------------------------------------
+
+@dataclass
+class FieldSchema:
+    """One raw feature's contract: kind, nullability, response-ness and an
+    optional numeric (min, max) hint from the training sketches.  Range
+    hints are observability (drift/debug context in ``schema.json``), not a
+    rejection rule — serving-time distribution shift is drift's job."""
+    name: str
+    kind: Type[FeatureType]
+    nullable: bool = True
+    is_response: bool = False
+    range: Optional[Tuple[float, float]] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name, "kind": self.kind.__name__,
+                             "nullable": bool(self.nullable),
+                             "isResponse": bool(self.is_response)}
+        if self.range is not None:
+            d["range"] = [float(self.range[0]), float(self.range[1])]
+        return d
+
+
+def _is_number(v: Any) -> bool:
+    return (isinstance(v, (int, float, np.integer, np.floating))
+            and not isinstance(v, bool))
+
+
+def _finite(v: Any) -> bool:
+    try:
+        return math.isfinite(float(v))
+    except (TypeError, ValueError, OverflowError):
+        return False
+
+
+class RawSchema:
+    """The bundle's data contract: every raw feature's ``FieldSchema``.
+
+    Derived from the workflow's raw features at save time (with numeric
+    range hints from the retained train batch), serialized digest-covered
+    as ``schema.json``, re-derived from the rebuilt features for legacy
+    bundles that predate it."""
+
+    def __init__(self, fields: Dict[str, FieldSchema]):
+        self.fields = dict(fields)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.fields
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    # -- construction / persistence ----------------------------------------
+    @staticmethod
+    def derive(raw_features: Sequence, batch=None) -> "RawSchema":
+        """Features → contract; with the training ``batch``, numeric range
+        hints come from the same finite-only min/max the drift sketches use
+        (``filters.numeric_ranges``)."""
+        fields: Dict[str, FieldSchema] = {}
+        for f in raw_features:
+            rng = None
+            if batch is not None and is_numeric_kind(f.kind) \
+                    and f.name in batch:
+                try:
+                    from .filters import numeric_ranges
+                    rng = numeric_ranges(f, batch[f.name]).get(None)
+                except Exception:  # noqa: BLE001 — hints are optional
+                    rng = None
+            fields[f.name] = FieldSchema(
+                name=f.name, kind=f.kind,
+                nullable=not f.kind.non_nullable,
+                is_response=bool(getattr(f, "is_response", False)),
+                range=rng)
+        return RawSchema(fields)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"formatVersion": SCHEMA_FORMAT_VERSION,
+                "fields": [fs.to_json()
+                           for fs in self.fields.values()]}
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "RawSchema":
+        fields: Dict[str, FieldSchema] = {}
+        for fd in d.get("fields") or []:
+            try:
+                kind = feature_type_from_name(fd["kind"])
+            except (KeyError, ValueError):
+                continue    # a kind this build doesn't know: skip the field
+            rng = fd.get("range")
+            fields[fd["name"]] = FieldSchema(
+                name=fd["name"], kind=kind,
+                nullable=bool(fd.get("nullable", True)),
+                is_response=bool(fd.get("isResponse", False)),
+                range=tuple(rng) if rng else None)
+        return RawSchema(fields)
+
+    def save(self, bundle_dir: str) -> None:
+        with open(os.path.join(bundle_dir, SCHEMA_JSON), "w") as fh:
+            json.dump(self.to_json(), fh, indent=2)
+
+    @staticmethod
+    def load(bundle_dir: str) -> Optional["RawSchema"]:
+        path = os.path.join(bundle_dir, SCHEMA_JSON)
+        if not os.path.exists(path):
+            return None
+        with open(path) as fh:
+            return RawSchema.from_json(json.load(fh))
+
+    @staticmethod
+    def for_model(model, bundle_path: Optional[str] = None) -> "RawSchema":
+        """The schema a serving engine should enforce: the bundle's
+        ``schema.json`` when present and readable, else re-derived from the
+        model's raw features (legacy bundles — degrade, never fail)."""
+        if bundle_path:
+            try:
+                sch = RawSchema.load(bundle_path)
+                if sch is not None and len(sch):
+                    return sch
+            except Exception as e:  # noqa: BLE001 — corrupt schema.json
+                from .resilience import record_failure
+                record_failure("serving", "degraded", e,
+                               point="serving.quality", bundle=bundle_path,
+                               detail="unreadable schema.json; contract "
+                                      "re-derived from raw features")
+        return RawSchema.derive(model.raw_features)
+
+    # -- record validation ---------------------------------------------------
+    def validate_record(self, record: Dict[str, Any]
+                        ) -> Tuple[Dict[str, Any], List[Violation]]:
+        """Validate (and where possible coerce) one record against the
+        contract.  Returns ``(record, violations)`` — a NEW dict only when
+        a coercion changed something, so clean records pass through
+        untouched (bitwise parity with the unvalidated path).  Never
+        raises; policy decisions belong to ``rejects``."""
+        violations: List[Violation] = []
+        out = record
+        changed = False
+
+        def coerce(name: str, value: Any) -> None:
+            nonlocal out, changed
+            if not changed:
+                out = dict(record)
+                changed = True
+            out[name] = value
+
+        for name, fs in self.fields.items():
+            present = name in record
+            val = record.get(name)
+            if isinstance(val, FeatureType):
+                val = val.value
+            if val is None:
+                if not fs.nullable and not fs.is_response and present:
+                    # an EXPLICIT null in a non-nullable predictor; an
+                    # absent one is the normal unlabeled-scoring shape and
+                    # takes the monoid zero silently, as it always has
+                    violations.append(Violation(
+                        MISSING_REQUIRED_FIELD, name,
+                        f"null value for non-nullable {fs.kind.__name__}"))
+                continue
+            kind = fs.kind
+            if is_numeric_kind(kind):
+                self._check_numeric(name, kind, val, violations, coerce)
+            elif is_text_kind(kind):
+                if not isinstance(val, str):
+                    # str(v) is what the old path did; keep it, visibly
+                    violations.append(Violation(
+                        TYPE_MISMATCH, name,
+                        f"{type(val).__name__} where {kind.__name__} "
+                        "expects a string"))
+                    coerce(name, str(val))
+            elif is_map_kind(kind):
+                if not isinstance(val, dict):
+                    violations.append(Violation(
+                        NON_COERCIBLE_VALUE, name,
+                        f"{type(val).__name__} where {kind.__name__} "
+                        "expects an object"))
+                elif is_numeric_kind(map_value_kind(kind)):
+                    for k, mv in val.items():
+                        if mv is None:
+                            continue
+                        if isinstance(mv, bool):
+                            continue        # BinaryMap values
+                        if not _is_number(mv):
+                            violations.append(Violation(
+                                NON_COERCIBLE_VALUE, f"{name}.{k}",
+                                f"{type(mv).__name__} where "
+                                f"{kind.__name__} expects numeric values"))
+                        elif not _finite(mv):
+                            violations.append(Violation(
+                                NON_FINITE_VALUE, f"{name}.{k}",
+                                f"non-finite value {mv!r}"))
+            elif issubclass(kind, OPVector) or issubclass(kind, OPList):
+                if isinstance(val, (list, tuple, np.ndarray)):
+                    items = (val.tolist() if isinstance(val, np.ndarray)
+                             else val)
+                    if issubclass(kind, OPVector) and any(
+                            _is_number(x) and not _finite(x)
+                            for x in items):
+                        violations.append(Violation(
+                            NON_FINITE_VALUE, name,
+                            "non-finite element in vector"))
+                else:
+                    violations.append(Violation(
+                        NON_COERCIBLE_VALUE, name,
+                        f"{type(val).__name__} where {kind.__name__} "
+                        "expects a list"))
+            # remaining kinds (sets, geolocation variants ride OPList
+            # above) pass through — the old path stored them opaquely
+        for name in record:
+            if name not in self.fields and name != "key":
+                violations.append(Violation(
+                    UNKNOWN_FIELD, name,
+                    "field is not in the model's raw schema"))
+        return out, violations
+
+    @staticmethod
+    def _check_numeric(name, kind, val, violations, coerce) -> None:
+        from .types import Binary
+        if isinstance(val, bool) or _is_number(val):
+            if not _finite(val):
+                violations.append(Violation(
+                    NON_FINITE_VALUE, name, f"non-finite value {val!r}"))
+            return
+        if isinstance(val, str):
+            violations.append(Violation(
+                TYPE_MISMATCH, name,
+                f"str where {kind.__name__} expects a number"))
+            if issubclass(kind, Binary):
+                # the old path's bool(v) made ANY non-empty string True
+                # ("false" included) — only unambiguous spellings coerce
+                low = val.strip().lower()
+                if low in ("true", "1"):
+                    coerce(name, True)
+                elif low in ("false", "0", ""):
+                    coerce(name, False)
+                else:
+                    violations.append(Violation(
+                        NON_COERCIBLE_VALUE, name,
+                        f"{val[:40]!r} is not a boolean"))
+                return
+            try:
+                parsed = float(val)
+            except (TypeError, ValueError):
+                violations.append(Violation(
+                    NON_COERCIBLE_VALUE, name,
+                    f"{val[:40]!r} does not parse as a number"))
+                return
+            if not math.isfinite(parsed):
+                violations.append(Violation(
+                    NON_FINITE_VALUE, name,
+                    f"{val!r} parses to a non-finite number"))
+                return
+            coerce(name, parsed)
+            return
+        violations.append(Violation(
+            NON_COERCIBLE_VALUE, name,
+            f"{type(val).__name__} where {kind.__name__} expects a number"))
+
+    @staticmethod
+    def rejects(violations: Sequence[Violation], policy: str) -> bool:
+        """Does ``policy`` quarantine a record with these violations?
+        ``strict`` rejects any violation; ``quarantine`` tolerates only the
+        purely-observational ``UnknownField``; ``coerce`` (default) rejects
+        only what the old path crashed on (the FATAL kinds)."""
+        if not violations or policy == "off":
+            return False
+        if policy == "strict":
+            return True
+        if policy == "quarantine":
+            return any(v.kind != UNKNOWN_FIELD for v in violations)
+        return any(v.kind in FATAL_KINDS for v in violations)
+
+    def screen_record(self, record: Dict[str, Any], policy: str
+                      ) -> Tuple[Dict[str, Any], List[Violation], bool]:
+        """``(record, violations, rejected)`` in one call."""
+        out, violations = self.validate_record(record)
+        return out, violations, self.rejects(violations, policy)
+
+
+# -- the non-finite firewall (host→device seam + scoring outputs) ----------
+
+def finite_row_mask(values, mask=None):
+    """Per-row all-finite reduction over a float array, respecting an
+    optional presence mask (absent cells are vacuously fine — numeric
+    columns store NaN at masked-off positions by design).  Runs the same
+    ``isfinite``/``all`` reduction on device (``jnp``, jit-compatible) when
+    handed a jax array, on host (``np``) otherwise."""
+    if values.__class__.__module__.startswith("jax"):
+        import jax.numpy as jnp
+        ok = jnp.isfinite(values)
+        if mask is not None:
+            ok = ok | ~jnp.asarray(mask)
+        return ok if ok.ndim == 1 else jnp.all(
+            ok.reshape(ok.shape[0], -1), axis=1)
+    arr = np.asarray(values)
+    ok = np.isfinite(arr)
+    if mask is not None:
+        m = np.asarray(mask, dtype=bool)
+        ok = ok | ~m.reshape(m.shape + (1,) * (ok.ndim - 1))
+    return ok if ok.ndim == 1 else np.all(
+        ok.reshape(ok.shape[0], -1), axis=1)
+
+
+def batch_nonfinite_rows(batch, schema: Optional[RawSchema] = None
+                         ) -> Dict[int, List[Violation]]:
+    """Row → violations for non-finite values at PRESENT positions of the
+    float columns of an assembled ``ColumnBatch`` — the host→device seam
+    check (everything in these arrays is about to ship to the device)."""
+    out: Dict[int, List[Violation]] = {}
+    for name, col in batch.items():
+        vals = getattr(col, "values", None)
+        if not isinstance(vals, np.ndarray) or \
+                not np.issubdtype(vals.dtype, np.floating):
+            continue
+        if schema is not None and name in schema.fields and \
+                not is_numeric_kind(schema.fields[name].kind) and \
+                not issubclass(schema.fields[name].kind, OPVector):
+            continue
+        ok = finite_row_mask(vals, getattr(col, "mask", None))
+        for i in np.nonzero(~np.asarray(ok))[0]:
+            out.setdefault(int(i), []).append(Violation(
+                NON_FINITE_VALUE, name, "non-finite value in column",
+                row=int(i)))
+    return out
+
+
+def result_nonfinite_fields(result: Dict[str, Any]) -> List[str]:
+    """Field paths of non-finite floats in one scored result row (nested
+    prediction dicts included) — empty means the row is clean."""
+    bad: List[str] = []
+    for name, v in result.items():
+        if isinstance(v, dict):
+            for k, sub in v.items():
+                if _is_number(sub) and not _finite(sub):
+                    bad.append(f"{name}.{k}")
+        elif _is_number(v) and not _finite(v):
+            bad.append(name)
+    return bad
+
+
+def mask_nonfinite_result_arrays(arrays: Dict[str, Any]
+                                 ) -> Tuple[Dict[str, Any], np.ndarray]:
+    """Columnar-output firewall: mask non-finite score cells as ABSENT in
+    ``{name: (values, mask)}`` result arrays instead of shipping NaN to the
+    caller.  Returns ``(arrays, bad_row_mask)``; arrays are modified only
+    when something was non-finite."""
+    bad_rows: Optional[np.ndarray] = None
+    out = dict(arrays)
+    n = 0
+    for name, (vals, mask) in arrays.items():
+        arr = np.asarray(vals)
+        n = max(n, arr.shape[0] if arr.ndim else 0)
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        ok = np.asarray(finite_row_mask(arr, mask))
+        if ok.all():
+            continue
+        new_mask = (np.ones(arr.shape[0], dtype=bool) if mask is None
+                    else np.asarray(mask, dtype=bool).copy())
+        new_mask &= ok
+        out[name] = (np.where(np.isfinite(arr), arr, 0.0)
+                     if arr.ndim == 1 else arr, new_mask)
+        bad_rows = ~ok if bad_rows is None else (bad_rows | ~ok)
+    if bad_rows is None:
+        bad_rows = np.zeros(n, dtype=bool)
+    return out, bad_rows
+
+
+# -- training-side quarantine ----------------------------------------------
+
+def _quality_counters(stage: str, violations: Iterable[Violation],
+                      quarantined: int = 0,
+                      trace_id: Optional[str] = None,
+                      registry=None) -> None:
+    """Shared counter accounting: total + per-kind violation counters and
+    the quarantined-rows counter, in the given registry (an engine's) or
+    the process-wide one (training/readers)."""
+    if registry is None:
+        from .telemetry import REGISTRY
+        registry = REGISTRY
+    n = 0
+    for v in violations:
+        n += 1
+        registry.counter(
+            f"quality.violations_{v.kind}_total").inc(trace_id=trace_id)
+    if n:
+        registry.counter("quality.violations_total").inc(
+            n, trace_id=trace_id)
+    if quarantined:
+        registry.counter("quality.rows_quarantined_total").inc(quarantined)
+
+
+def screen_records(records: List[Dict[str, Any]], raw_features: Sequence,
+                   cfg: Optional[QualityConfig] = None, *,
+                   stage: str = "reader",
+                   schema: Optional[RawSchema] = None
+                   ) -> List[Dict[str, Any]]:
+    """Per-record quarantine for an ingestion record list: validate every
+    record against the contract, keep the survivors (coerced in place where
+    the policy allows), exclude the rest with full accounting, and abort
+    with ``DataQualityError`` past ``maxQuarantineFraction``.  With no
+    ambient/explicit config the input is returned untouched."""
+    cfg = cfg or active_quality()
+    if cfg is None or not cfg.enabled or not records:
+        return records
+    sch = schema or RawSchema.derive(raw_features)
+    kept: List[Dict[str, Any]] = []
+    sample: List[Violation] = []
+    quarantined = 0
+    for rec in records:
+        out, violations, rejected = sch.screen_record(rec, cfg.policy)
+        if violations:
+            _quality_counters(stage, violations)
+        if rejected:
+            quarantined += 1
+            if len(sample) < 8:
+                sample.extend(violations[:2])
+            from .resilience import record_failure
+            record_failure(stage, "quarantined",
+                           RecordQualityError(violations, cfg.policy),
+                           point=f"{stage}.quality",
+                           violations=[v.to_json() for v in violations[:4]])
+        else:
+            kept.append(out)
+    if quarantined:
+        _quality_counters(stage, (), quarantined=quarantined)
+        frac = quarantined / len(records)
+        if frac > cfg.max_quarantine_fraction:
+            raise DataQualityError(quarantined, len(records),
+                                   cfg.max_quarantine_fraction,
+                                   sample=sample)
+    return kept
+
+
+def screen_batch(batch, raw_features: Sequence,
+                 cfg: Optional[QualityConfig] = None, *,
+                 stage: str = "train",
+                 schema: Optional[RawSchema] = None):
+    """Non-finite firewall for an assembled training ``ColumnBatch``: drop
+    rows carrying NaN/Inf at present positions of raw numeric columns
+    before anything ships to the device, with the same accounting and
+    ``maxQuarantineFraction`` guard as ``screen_records``.  Returns the
+    (possibly row-filtered) batch."""
+    cfg = cfg or active_quality()
+    if cfg is None or not cfg.enabled or len(batch) == 0:
+        return batch
+    sch = schema or RawSchema.derive(raw_features)
+    by_row = batch_nonfinite_rows(batch, sch)
+    if not by_row:
+        return batch
+    from .resilience import record_failure
+    from .telemetry import REGISTRY
+    n = len(batch)
+    bad = sorted(by_row)
+    REGISTRY.counter("quality.nonfinite_inputs_total").inc(len(bad))
+    sample = [v for i in bad[:4] for v in by_row[i][:2]]
+    _quality_counters(stage, sample)
+    _quality_counters(stage, (), quarantined=len(bad))
+    record_failure(stage, "quarantined",
+                   f"{len(bad)} row(s) with non-finite values excluded "
+                   "before device transfer", point=f"{stage}.quality",
+                   rows=bad[:16])
+    if len(bad) / n > cfg.max_quarantine_fraction:
+        raise DataQualityError(len(bad), n, cfg.max_quarantine_fraction,
+                               sample=sample)
+    keep = np.setdiff1d(np.arange(n), np.asarray(bad, dtype=int))
+    return batch.take_rows(keep)

@@ -230,8 +230,31 @@ def _matches(schema, v) -> bool:
 # container file read / write
 # ---------------------------------------------------------------------------
 
-def read_avro_records(path: str) -> Tuple[List[Dict[str, Any]], Any]:
-    """→ (records, schema json) from an Avro Object Container File."""
+def _skip_malformed(path: str, what: str, cause) -> None:
+    """Dead-letter accounting for a corrupt Avro region: the typed
+    violation (``NonCoercibleValue`` — bytes that do not decode) lands in
+    the FailureLog and the quality counters, and reading continues — the
+    same skip-and-record contract the CSV reader has always had."""
+    from ..quality import NON_COERCIBLE_VALUE
+    from ..resilience import record_failure
+    from ..telemetry import REGISTRY
+    REGISTRY.counter("quality.malformed_rows_total").inc()
+    REGISTRY.counter(
+        f"quality.violations_{NON_COERCIBLE_VALUE}_total").inc()
+    REGISTRY.counter("quality.violations_total").inc()
+    record_failure("reader", "quarantined", cause, point="reader.quality",
+                   file=path, violation=NON_COERCIBLE_VALUE, detail=what)
+
+
+def read_avro_records(path: str, skip_malformed: bool = False
+                      ) -> Tuple[List[Dict[str, Any]], Any]:
+    """→ (records, schema json) from an Avro Object Container File.
+
+    With ``skip_malformed`` a block that fails to decompress or decode is
+    skipped with a recorded typed violation (decoding cannot resync inside
+    a block, so the block is the skip unit) and a bad sync marker stops the
+    read at the last good block — the malformed-row contract the CSV
+    reader has (``readers/csv.py``), instead of raising mid-file."""
     with open(path, "rb") as f:
         data = f.read()
     buf = io.BytesIO(data)
@@ -259,15 +282,29 @@ def read_avro_records(path: str) -> Tuple[List[Dict[str, Any]], Any]:
             break
         size = _read_long(buf)
         block = buf.read(size)
-        if codec == "deflate":
-            block = zlib.decompress(block, -15)
-        elif codec != "null":
-            raise ValueError(f"unsupported avro codec {codec!r}")
-        bbuf = io.BytesIO(block)
-        for _ in range(count):
-            records.append(_decode(schema, bbuf))
+        try:
+            if codec == "deflate":
+                block = zlib.decompress(block, -15)
+            elif codec != "null":
+                raise ValueError(f"unsupported avro codec {codec!r}")
+            bbuf = io.BytesIO(block)
+            decoded = [_decode(schema, bbuf) for _ in range(count)]
+        except Exception as e:  # noqa: BLE001 — corrupt block
+            if not skip_malformed:
+                raise
+            _skip_malformed(path, f"undecodable block of {count} "
+                                  "record(s) skipped", e)
+            decoded = []
         if buf.read(16) != sync:
-            raise ValueError(f"{path}: bad sync marker (corrupt file)")
+            if not skip_malformed:
+                raise ValueError(f"{path}: bad sync marker (corrupt file)")
+            # the framing itself is untrustworthy past this point: keep
+            # everything decoded so far, drop this block, stop reading
+            _skip_malformed(path, "bad sync marker; file truncated at the "
+                                  "last good block",
+                            ValueError("bad sync marker"))
+            break
+        records.extend(decoded)
     return records, schema
 
 
@@ -341,8 +378,13 @@ class AvroReader(DataReader):
 
     def __init__(self, path: str,
                  schema: Optional[Dict[str, Type[FeatureType]]] = None,
-                 key_field: Optional[str] = None):
-        records, avro_schema = read_avro_records(path)
+                 key_field: Optional[str] = None,
+                 skip_malformed: bool = True):
+        # skip_malformed unifies the malformed-row contract across readers
+        # (quality.py): corrupt blocks dead-letter with a typed violation
+        # instead of raising mid-file, as CSV has always done
+        records, avro_schema = read_avro_records(
+            path, skip_malformed=skip_malformed)
         self.avro_schema = avro_schema
         self.schema = (dict(schema) if schema
                        else infer_schema_from_avro(avro_schema))

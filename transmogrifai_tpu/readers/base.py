@@ -36,8 +36,21 @@ class Reader:
     def read(self) -> List[Dict[str, Any]]:
         raise NotImplementedError
 
+    @staticmethod
+    def _screen(records: List[Dict[str, Any]],
+                raw_features: Sequence[Feature]) -> List[Dict[str, Any]]:
+        """Per-record quarantine at ingestion when a quality config is
+        ambient (``quality.use_quality`` — workflow.train and the streaming
+        runner install one): malformed records are excluded with typed
+        violations instead of crashing column assembly mid-batch.  With no
+        ambient config this is the identity — historical behavior."""
+        from ..quality import active_quality, screen_records
+        if active_quality() is None:
+            return records
+        return screen_records(records, raw_features, stage="reader")
+
     def generate_batch(self, raw_features: Sequence[Feature]) -> ColumnBatch:
-        records = self.read()
+        records = self._screen(self.read(), raw_features)
         cols: Dict[str, Column] = {}
         for f in raw_features:
             cols[f.name] = _generator_of(f).extract_column(records)
@@ -103,7 +116,7 @@ class AggregateReader(DataReader):
     def generate_batch(self, raw_features: Sequence[Feature]) -> ColumnBatch:
         from ..aggregators import Event, split_events_at_cutoff
 
-        records = self.read()
+        records = self._screen(self.read(), raw_features)
         p = self.params
         grouped: Dict[Any, List[Dict]] = {}
         for r in records:

@@ -9,6 +9,12 @@ stray text after a closing quote (``a,"b"x,c``) is dropped by the native
 parser (→ ``b``) but appended by Python's csv module (→ ``bx``); neither
 path shifts later columns.
 
+This tolerate-and-continue contract for malformed rows is now UNIFORM
+across readers (quality.py): Avro skips undecodable blocks, Parquet nulls
+unconvertible timestamp cells, and streaming/record readers quarantine
+poison records per-row under an ambient ``QualityConfig`` — each recording
+a typed violation instead of raising mid-file, as this reader always has.
+
 * **native columnar** (default): the C++ parser (`native/fastcsv.cpp`) goes
   bytes → typed columns in one pass — no per-row Python objects — and
   ``generate_batch`` builds the ``ColumnBatch`` straight from the columnar

@@ -83,13 +83,33 @@ class ParquetReader(DataReader):
                  key_field: Optional[str] = None):
         records = read_parquet_records(path)
         self.schema = dict(schema) if schema else infer_schema_from_parquet(path)
-        # timestamps/dates → epoch millis (the Date/DateTime value convention)
+        # timestamps/dates → epoch millis (the Date/DateTime value
+        # convention).  A value that cannot convert nulls out with a typed
+        # violation instead of raising mid-file — the unified malformed-row
+        # contract (quality.py; CSV has always skipped-and-recorded)
         for name, kind in self.schema.items():
             if issubclass(kind, (Date, DateTime)):
                 for r in records:
                     v = r.get(name)
                     if v is not None and not isinstance(v, (int, float)):
-                        r[name] = _to_epoch_ms(v)
+                        try:
+                            r[name] = _to_epoch_ms(v)
+                        except Exception as e:  # noqa: BLE001 — bad cell
+                            from ..quality import TYPE_MISMATCH
+                            from ..resilience import record_failure
+                            from ..telemetry import REGISTRY
+                            r[name] = None
+                            REGISTRY.counter(
+                                "quality.malformed_rows_total").inc()
+                            REGISTRY.counter(
+                                f"quality.violations_{TYPE_MISMATCH}"
+                                "_total").inc()
+                            REGISTRY.counter(
+                                "quality.violations_total").inc()
+                            record_failure(
+                                "reader", "quarantined", e,
+                                point="reader.quality", file=path,
+                                field=name, violation=TYPE_MISMATCH)
         key_fn = ((lambda r: r.get(key_field)) if key_field
                   else (lambda r: id(r)))
         super().__init__(records=records, key_fn=key_fn)

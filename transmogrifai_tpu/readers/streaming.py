@@ -5,6 +5,12 @@ the DStream loop in OpWorkflowRunner.scala:225-263).
 ``stream()`` yields raw ``ColumnBatch``es; the runner feeds each to the
 compiled score function (SURVEY §2.6 P6: host loop + async device dispatch
 replaces DStream micro-batches).
+
+Malformed records share the unified skip-and-dead-letter contract
+(quality.py): each micro-batch assembles through ``Reader.generate_batch``,
+so when the streaming runner installs an ambient ``QualityConfig`` a poison
+record quarantines with a typed violation instead of dead-lettering its
+whole micro-batch.
 """
 
 from __future__ import annotations

@@ -123,6 +123,7 @@ class FailureLog:
                "promoted",     # lifecycle candidate won the holdout gate
                "rejected",     # lifecycle candidate lost; incumbent kept
                "shed",         # admission control rejected work up front
+               "quarantined",  # data-quality firewall excluded a record/row
                "breaker_open",       # circuit breaker tripped: calls skipped
                "breaker_half_open",  # breaker probing for recovery
                "breaker_closed",     # breaker recovered: calls flow again

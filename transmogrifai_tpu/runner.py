@@ -11,6 +11,7 @@ OpSparkListener: per-phase wall-clock + device memory stats collected into
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import time
@@ -189,6 +190,18 @@ class OpWorkflowRunner:
         if memp.get("watchdogIntervalS") is not None:
             os.environ["TRANSMOGRIFAI_RSS_WATCHDOG_S"] = \
                 str(memp["watchdogIntervalS"])
+        # qualityParams: the firewall resolves QualityConfig from the env
+        # at each ingestion point (workflow read, reader screen, serving
+        # engine), so run-scoped knobs ride the env like the blocks above
+        qp = params.quality or {}
+        if qp.get("policy") is not None:
+            os.environ["TRANSMOGRIFAI_QUALITY_POLICY"] = str(qp["policy"])
+        if qp.get("maxQuarantineFraction") is not None:
+            os.environ["TRANSMOGRIFAI_MAX_QUARANTINE_FRACTION"] = \
+                str(qp["maxQuarantineFraction"])
+        if qp.get("enabled") is not None:
+            os.environ["TRANSMOGRIFAI_QUALITY"] = \
+                "1" if qp["enabled"] else "0"
         tele = params.telemetry or {}
         trace_dir = tele.get("traceDir")
         enabled = bool(tele.get("enabled", trace_dir is not None))
@@ -485,8 +498,18 @@ class OpWorkflowRunner:
                     write_json_atomic(offsets_path, {"nextBatch": j + 1})
             pending = None
 
+        # ambient quality config: StreamingReader micro-batches assemble
+        # through Reader.generate_batch, which screens records against the
+        # run's policy — a poison record quarantines per-row (typed
+        # violation in the failure log) instead of dead-lettering its
+        # whole micro-batch after retries
+        from .quality import QualityConfig, use_quality
+        qcfg = QualityConfig.resolve(params.quality)
+        quality_scope = (use_quality(qcfg) if qcfg.enabled
+                         else contextlib.nullcontext())
         try:
-            with use_failure_log(flog), preemption_guard("streaming"):
+            with use_failure_log(flog), preemption_guard("streaming"), \
+                    quality_scope:
                 for i, batch in enumerate(self.score_reader.stream()):
                     if i < next_batch:
                         continue   # already scored by a previous run
@@ -593,6 +616,9 @@ class OpWorkflowRunner:
                     tenant_max_active=sv.get("tenantMaxActive"),
                     tenant_memory_budget_bytes=sv.get(
                         "tenantMemoryBudgetBytes"))
+                # pool workers resolve the firewall policy from the env set
+                # by run() (qualityParams.policy → TRANSMOGRIFAI_QUALITY_
+                # POLICY), so no kwarg threading is needed here
             else:
                 serve_main(params.model_location,
                            host=sv.get("host", "127.0.0.1"),
@@ -608,7 +634,9 @@ class OpWorkflowRunner:
                            model_root=model_root,
                            tenant_max_active=sv.get("tenantMaxActive"),
                            tenant_memory_budget_bytes=sv.get(
-                               "tenantMemoryBudgetBytes"))
+                               "tenantMemoryBudgetBytes"),
+                           quality_policy=(params.quality or {}).get(
+                               "policy"))
         return OpWorkflowRunnerResult(RunType.SERVE)
 
     def _lifecycle(self, params: OpParams, timer: PhaseTimer
@@ -724,6 +752,22 @@ class OpApp:
         p.add_argument("--hosts-run-dir",
                        help="host-group run directory (heartbeats, logs, "
                             "outage records); default: a temp dir")
+        p.add_argument("--quality-policy",
+                       choices=["strict", "coerce", "quarantine", "off"],
+                       help="data-quality firewall policy: strict rejects "
+                            "any schema violation, coerce (default) "
+                            "repairs what it can and rejects only "
+                            "non-coercible/non-finite values, quarantine "
+                            "tolerates only unknown fields, off disables "
+                            "the firewall")
+        p.add_argument("--max-quarantine-fraction", type=float,
+                       help="abort training with DataQualityError when "
+                            "more than this fraction of rows is "
+                            "quarantined (default 0.1)")
+        p.add_argument("--no-quality", action="store_true",
+                       help="disable the data-quality firewall entirely "
+                            "(schema screening, quarantine accounting and "
+                            "non-finite guards)")
         return p.parse_args(argv)
 
     def main(self, argv: Optional[List[str]] = None) -> OpWorkflowRunnerResult:
@@ -766,6 +810,13 @@ class OpApp:
             params.memory["enabled"] = False
         if args.device_mem_bytes is not None:
             params.memory["deviceMemBytes"] = args.device_mem_bytes
+        if args.quality_policy is not None:
+            params.quality["policy"] = args.quality_policy
+        if args.max_quarantine_fraction is not None:
+            params.quality["maxQuarantineFraction"] = \
+                args.max_quarantine_fraction
+        if args.no_quality:
+            params.quality["enabled"] = False
         from .parallel import hostgroup
         hosts = max(1, int(args.hosts or params.hostgroup.get("hosts", 1)))
         if hosts > 1 and not hostgroup.hostgroup_env_present():

@@ -42,6 +42,10 @@ from ..checkpoint import (bundle_version, find_latest_valid, is_bundle_dir,
                           read_manifest)
 from ..columns import Column, ColumnBatch, column_from_values
 from ..local import extract_raw_value, score_function
+from ..quality import (NON_FINITE_VALUE, QualityConfig, RawSchema,
+                       RecordQualityError, Violation, batch_nonfinite_rows,
+                       mask_nonfinite_result_arrays,
+                       result_nonfinite_fields)
 from ..resilience import (WatchdogTimeout, maybe_inject, record_failure,
                           run_with_deadline)
 from ..stages.generator import FeatureGeneratorStage
@@ -159,6 +163,12 @@ class _ModelEntry:
         self.created_at: Optional[float] = (
             float(created) if isinstance(created, (int, float)) else None)
         self.loaded_at: float = time.time()
+        # the data-quality firewall's schema contract: the bundle's
+        # digest-covered schema.json, or a re-derivation from the model's
+        # raw features for legacy bundles (WorkflowModel.load attaches it;
+        # for_model covers models handed in directly)
+        self.schema: RawSchema = (getattr(model, "raw_schema", None)
+                                  or RawSchema.for_model(model, bundle_path))
         # sparse-model detection: a SmartTextVectorizer that routed text to
         # the COO path stamps metadata["sparse"]=True on its fitted stage
         # (metadata round-trips through the bundle) — /metrics exposes this
@@ -196,7 +206,8 @@ class ScoringEngine:
                  reload_poll_s: float = 0.0, warm: bool = True,
                  warm_record: Optional[Dict[str, Any]] = None,
                  overload: Optional[OverloadConfig] = None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None,
+                 quality_policy: Optional[str] = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.model_location = model_location
@@ -215,6 +226,10 @@ class ScoringEngine:
         self.reload_poll_s = float(reload_poll_s)
         self.ladder = _padding_ladder(self.max_batch)
         self._warm_record = dict(warm_record or {})
+        # data-quality firewall policy (strict | coerce | quarantine | off);
+        # env default so `op serve` picks it up without plumbing
+        self.quality_policy = (quality_policy if quality_policy is not None
+                               else QualityConfig.resolve(None).policy)
 
         self._queue: "collections.deque" = collections.deque()
         self._queued_rows = 0  # rows, not entries: a columnar request
@@ -335,6 +350,16 @@ class ScoringEngine:
         with self._swap_lock:
             return self._entry.sparse
 
+    @property
+    def quality_quarantine_fraction(self) -> float:
+        """Fraction of offered records the firewall quarantined (rejected
+        records never reach ``requests_total``, so the denominator is
+        admitted + quarantined)."""
+        c = self.metrics.counters()
+        q = c.get("quality.quarantined_records_total", 0)
+        total = c.get("requests_total", 0) + q
+        return (q / total) if total else 0.0
+
     # -- lifecycle hooks ---------------------------------------------------
     def add_batch_observer(self, fn: Callable) -> None:
         """Register ``fn(records, results)`` to run after each micro-batch
@@ -428,6 +453,56 @@ class ScoringEngine:
                 record_failure("serving", "swallowed", e,
                                point="serving.reload")
 
+    # -- the data-quality firewall (pre-queue) -----------------------------
+    def _quarantine(self, violations: List[Violation],
+                    ctx: Optional[TraceContext],
+                    point: str = "serving.quality",
+                    rows: int = 1) -> RecordQualityError:
+        """Account ``rows`` quarantined records and build their typed
+        error.  Runs BEFORE submit, so poison never occupies a queue slot,
+        never counts against admission, and never trips the compiled-path
+        breaker — co-batched neighbors are structurally unaffected."""
+        trace_id = ctx.trace_id if ctx else None
+        err = RecordQualityError(violations, self.quality_policy)
+        self.metrics.counter("quality.quarantined_records_total").inc(
+            rows, trace_id=trace_id)
+        # dead-letter parity with the streaming DLQ: same counter, same
+        # FailureLog action, same trace-id correlation
+        self.metrics.counter("dead_letter_total").inc(rows,
+                                                      trace_id=trace_id)
+        record_failure("serving", "quarantined", err, point=point,
+                       trace_id=trace_id,
+                       violations=[v.to_json() for v in violations[:4]])
+        return err
+
+    def _screen(self, record: Dict[str, Any],
+                ctx: Optional[TraceContext]) -> Dict[str, Any]:
+        """Validate one record against the active bundle's schema contract.
+        Returns the (possibly coerced) record — the SAME dict object when
+        nothing needed coercion — or raises ``RecordQualityError``."""
+        policy = self.quality_policy
+        if policy == "off":
+            return record
+        with self._swap_lock:
+            entry = self._entry
+        out, violations, rejected = entry.schema.screen_record(record,
+                                                               policy)
+        if violations:
+            trace_id = ctx.trace_id if ctx else None
+            self.metrics.counter("quality.violations_total").inc(
+                len(violations), trace_id=trace_id)
+            for v in violations:
+                self.metrics.counter(
+                    f"quality.violations_{v.kind}_total").inc()
+            nonfinite = sum(1 for v in violations
+                            if v.kind == NON_FINITE_VALUE)
+            if nonfinite:
+                self.metrics.counter("quality.nonfinite_inputs_total").inc(
+                    nonfinite, trace_id=trace_id)
+        if rejected:
+            raise self._quarantine(violations, ctx)
+        return out
+
     # -- public scoring API ------------------------------------------------
     def score_record(self, record: Dict[str, Any],
                      timeout_s: Optional[float] = None,
@@ -438,7 +513,9 @@ class ScoringEngine:
         closes, or ``timeout_s`` elapses (→ ``DeadlineExceeded``).
         ``ctx`` is the request's trace position: the dispatching batch
         span links back to it and latency/shed exemplars carry its
-        trace id."""
+        trace id.  Raises ``RecordQualityError`` (→ HTTP 422) before the
+        record ever reaches the queue when it fails the schema contract."""
+        record = self._screen(record, ctx)
         req = self._submit(record, deadline_s=timeout_s, ctx=ctx)
         if not req.event.wait(timeout_s):
             raise DeadlineExceeded(
@@ -457,7 +534,23 @@ class ScoringEngine:
                       ctx: Optional[TraceContext] = None
                       ) -> List[Tuple[Dict[str, Any], str]]:
         """Score a client-provided list: every record rides the same queue
-        as single requests (admission control applies to the whole list)."""
+        as single requests (admission control applies to the whole list).
+        Any record failing the schema contract rejects the list up front
+        with a row-tagged violation list (``RecordQualityError``) — nothing
+        is partially enqueued."""
+        if self.quality_policy != "off":
+            screened: List[Dict[str, Any]] = []
+            bad: List[Violation] = []
+            for i, rec in enumerate(records):
+                try:
+                    screened.append(self._screen(rec, ctx))
+                except RecordQualityError as e:
+                    for v in e.violations:
+                        v.row = i
+                    bad.extend(e.violations)
+            if bad:
+                raise RecordQualityError(bad, self.quality_policy)
+            records = screened
         with self._cv:
             self._check_admission(extra=len(records), deadline_s=timeout_s,
                                   ctx=ctx)
@@ -497,6 +590,25 @@ class ScoringEngine:
         n = len(batch)
         if n < 1:
             raise ValueError("columnar batch must have at least one row")
+        if self.quality_policy != "off":
+            # host→device seam: the assembled float columns are exactly what
+            # ships to the device — reject rows carrying ±inf/NaN at present
+            # positions (fatal under every policy) with a per-row violation
+            # list instead of letting one poison row NaN the fused program
+            with self._swap_lock:
+                schema = self._entry.schema
+            by_row = batch_nonfinite_rows(batch, schema)
+            if by_row:
+                trace_id = ctx.trace_id if ctx else None
+                flat = [v for vs in by_row.values() for v in vs]
+                self.metrics.counter("quality.violations_total").inc(
+                    len(flat), trace_id=trace_id)
+                self.metrics.counter(
+                    f"quality.violations_{NON_FINITE_VALUE}_total").inc(
+                    len(flat))
+                self.metrics.counter("quality.nonfinite_inputs_total").inc(
+                    len(by_row), trace_id=trace_id)
+                raise self._quarantine(flat, ctx, rows=len(by_row))
         with self._cv:
             self._check_admission(extra=n, deadline_s=timeout_s, ctx=ctx)
             req = _ColumnarRequest(batch, ctx=ctx)
@@ -697,6 +809,37 @@ class ScoringEngine:
                     record_failure("serving", "dead_letter", e,
                                    point="serving.batch", trace_id=trace_id)
                     results.append(e)
+        if self.quality_policy != "off":
+            # output firewall: a NaN/inf score dead-letters ITS row (422 to
+            # that caller) instead of returning NaN; neighbors keep their
+            # finite results.  Runs before observers so drift/insight
+            # windows never ingest poison scores.
+            for idx, (req, res) in enumerate(zip(batch, results)):
+                if isinstance(res, BaseException):
+                    continue
+                bad = result_nonfinite_fields(res)
+                if not bad:
+                    continue
+                trace_id = req.ctx.trace_id if req.ctx else None
+                self.metrics.counter("quality.nonfinite_scores_total").inc(
+                    trace_id=trace_id)
+                self.metrics.counter("quality.violations_total").inc(
+                    len(bad), trace_id=trace_id)
+                self.metrics.counter(
+                    f"quality.violations_{NON_FINITE_VALUE}_total").inc(
+                    len(bad))
+                self.metrics.counter("quality.quarantined_records_total"
+                                     ).inc(trace_id=trace_id)
+                self.metrics.counter("dead_letter_total").inc(
+                    trace_id=trace_id)
+                err = RecordQualityError(
+                    [Violation(NON_FINITE_VALUE, f,
+                               "model produced a non-finite score")
+                     for f in bad], self.quality_policy)
+                record_failure("serving", "quarantined", err,
+                               point="serving.quality", trace_id=trace_id,
+                               fields=bad[:4])
+                results[idx] = err
         self.metrics.counter("batches_total").inc()
         self.metrics.counter("batch_rows_total").inc(len(batch))
         batch_s = time.perf_counter() - t0
@@ -908,6 +1051,26 @@ class ScoringEngine:
                 self.metrics.counter("fallback_batches_total").inc()
                 arrays = self._local_fallback_columns(entry, chunk,
                                                       ctx=req.ctx)
+            if self.quality_policy != "off":
+                # columnar output firewall: arrays cannot carry a per-row
+                # exception, so non-finite score cells are masked ABSENT
+                # (the wire's null convention) and counted — the caller
+                # sees null for the poisoned row, finite scores elsewhere
+                arrays, bad_rows = mask_nonfinite_result_arrays(arrays)
+                nbad = int(np.asarray(bad_rows).sum())
+                if nbad:
+                    trace_id = req.ctx.trace_id if req.ctx else None
+                    self.metrics.counter(
+                        "quality.nonfinite_scores_total").inc(
+                        nbad, trace_id=trace_id)
+                    self.metrics.counter("dead_letter_total").inc(
+                        nbad, trace_id=trace_id)
+                    record_failure(
+                        "serving", "quarantined",
+                        f"{nbad} non-finite score row(s) masked absent",
+                        point="serving.quality", trace_id=trace_id,
+                        rows=[int(i) + lo for i in
+                              np.nonzero(np.asarray(bad_rows))[0][:8]])
             self.metrics.counter("batches_total").inc()
             self.metrics.counter("batch_rows_total").inc(hi - lo)
             batch_s = time.perf_counter() - t0
@@ -937,6 +1100,9 @@ class ScoringEngine:
         return {"counters": self.metrics.counters(),
                 "queue_depth": self.queue_depth,
                 "tenant": self.tenant,
+                "quality_policy": self.quality_policy,
+                "quality_quarantine_fraction":
+                    self.quality_quarantine_fraction,
                 "model_version": version,
                 "aot_executables": aot_execs,
                 "compiled_path_active": self._compiled_ok,

@@ -42,6 +42,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..checkpoint import preemption_guard, shutdown_requested
+from ..quality import VIOLATION_KINDS, RecordQualityError
 from ..resilience import CircuitBreaker, WatchdogTimeout
 from ..telemetry import TraceContext, span
 from . import wire
@@ -113,6 +114,37 @@ def render_metrics(engine: ScoringEngine) -> str:
             c.get("columnar_observer_skips_total", 0),
             "Rows that bypassed batch observers on the columnar path "
             "(drift monitoring of columnar traffic is deferred)")
+    # data-quality firewall families (quality.py): violation volume by
+    # kind, quarantine volume and non-finite interceptions at both seams —
+    # the violation counter carries a trace-id exemplar so one bad record
+    # links straight to its request trace
+    counter("quality_violations_total", c.get("quality.violations_total", 0),
+            "Schema-contract violations observed across all records",
+            exemplar=engine.metrics.counter(
+                "quality.violations_total").exemplar())
+    kind_family = f"{_METRIC_PREFIX}_quality_violations_by_kind_total"
+    lines.append(f"# HELP {kind_family} Schema-contract violations by "
+                 "taxonomy kind")
+    lines.append(f"# TYPE {kind_family} counter")
+    for kind in VIOLATION_KINDS:
+        v = c.get(f"quality.violations_{kind}_total", 0)
+        lines.append(f'{kind_family}{{kind="{kind}"}} {v}')
+    counter("quality_quarantined_records_total",
+            c.get("quality.quarantined_records_total", 0),
+            "Records quarantined (HTTP 422) by the data-quality firewall",
+            exemplar=engine.metrics.counter(
+                "quality.quarantined_records_total").exemplar())
+    counter("quality_nonfinite_inputs_total",
+            c.get("quality.nonfinite_inputs_total", 0),
+            "Records/rows rejected at the host-to-device seam for "
+            "non-finite input values")
+    counter("quality_nonfinite_scores_total",
+            c.get("quality.nonfinite_scores_total", 0),
+            "Scored rows intercepted because the model produced a "
+            "non-finite score")
+    gauge("quality_quarantine_fraction",
+          round(engine.quality_quarantine_fraction, 6),
+          "Quarantined records over all offered records since start")
     gauge("queue_depth", s["queue_depth"],
           "Requests currently waiting for a micro-batch")
     gauge("compiled_path_active", int(s["compiled_path_active"]),
@@ -345,7 +377,10 @@ class _Handler(BaseHTTPRequestHandler):
                                   engine.active_bundle_path),
                               "modelStalenessS": round(
                                   engine.model_staleness_s, 3),
-                              "queueDepth": engine.queue_depth})
+                              "queueDepth": engine.queue_depth,
+                              "qualityPolicy": engine.quality_policy,
+                              "qualityQuarantineFraction": round(
+                                  engine.quality_quarantine_fraction, 6)})
         elif self.path == "/readyz":
             health = engine.overload.health.snapshot()
             breaker = engine.overload.compiled_breaker
@@ -537,9 +572,20 @@ class _Handler(BaseHTTPRequestHandler):
                         extra_headers={"X-Model-Version": version})
         except wire.WireFormatError as e:
             # malformed body = client bug, never a worker crash: a
-            # structured 400 names exactly what failed to parse
-            self._reply(400, {"error": "malformed columnar body",
-                              "detail": str(e)})
+            # structured 400 names exactly what failed to parse — with the
+            # quality-taxonomy kind when the decoder could classify it
+            detail: Dict[str, Any] = {"error": "malformed columnar body",
+                                      "detail": str(e)}
+            kind = getattr(e, "violation_kind", None)
+            if kind:
+                detail["violationKind"] = kind
+            self._reply(400, detail)
+        except RecordQualityError as e:
+            # rows identified by the firewall: 422 with the per-row
+            # violation list; other queued requests scored normally
+            self._reply(422, {
+                "error": "record failed data-quality validation",
+                "policy": e.policy, "violations": e.to_json()})
         except OverloadedError as e:
             self._reply(429, {"error": str(e)},
                         extra_headers={"Retry-After": _retry_after(
@@ -585,6 +631,13 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._reply(400, {"error": "body must be an object or a "
                                            "list of objects"})
+        except RecordQualityError as e:
+            # the poison record (or the offending rows of a list, tagged
+            # with their index) gets its own structured 422; co-batched
+            # neighbors in other requests score normally
+            self._reply(422, {
+                "error": "record failed data-quality validation",
+                "policy": e.policy, "violations": e.to_json()})
         except OverloadedError as e:
             self._reply(429, {"error": str(e)},
                         extra_headers={"Retry-After": _retry_after(
@@ -664,7 +717,8 @@ def start_server(model_location: Optional[str] = None, *,
                  reuse_port: bool = False, wire_format: str = "auto",
                  model_root: Optional[str] = None,
                  tenant_max_active: Optional[int] = None,
-                 tenant_memory_budget_bytes: Optional[int] = None
+                 tenant_memory_budget_bytes: Optional[int] = None,
+                 quality_policy: Optional[str] = None
                  ) -> Tuple[ScoringHTTPServer, threading.Thread]:
     """Build engine + server and start the accept loop in a daemon thread.
     ``port=0`` binds an ephemeral port (see ``server.port``).  Exactly one
@@ -684,10 +738,13 @@ def start_server(model_location: Optional[str] = None, *,
             max_active=tenant_max_active,
             memory_budget_bytes=tenant_memory_budget_bytes)
     else:
+        # tenant engines (registry mode) resolve the policy from the
+        # TRANSMOGRIFAI_QUALITY_POLICY env default on their own
         engine = ScoringEngine(model_location, max_batch=max_batch,
                                linger_ms=linger_ms, queue_bound=queue_bound,
                                reload_poll_s=reload_poll_s, warm=warm,
-                               overload=overload)
+                               overload=overload,
+                               quality_policy=quality_policy)
     server = ScoringHTTPServer(engine, host=host, port=port,
                                request_deadline_s=request_deadline_s,
                                reuse_port=reuse_port,
@@ -708,7 +765,8 @@ def serve_main(model_location: Optional[str] = None, *,
                wire_format: str = "auto",
                model_root: Optional[str] = None,
                tenant_max_active: Optional[int] = None,
-               tenant_memory_budget_bytes: Optional[int] = None) -> int:
+               tenant_memory_budget_bytes: Optional[int] = None,
+               quality_policy: Optional[str] = None) -> int:
     """Blocking entry point for the ``serve`` CLI subcommand: serve until
     SIGTERM/SIGINT, then drain in-flight batches and exit 0."""
     with preemption_guard("serve"):
@@ -719,7 +777,8 @@ def serve_main(model_location: Optional[str] = None, *,
             reload_poll_s=reload_poll_s, overload=overload,
             wire_format=wire_format, model_root=model_root,
             tenant_max_active=tenant_max_active,
-            tenant_memory_budget_bytes=tenant_memory_budget_bytes)
+            tenant_memory_budget_bytes=tenant_memory_budget_bytes,
+            quality_policy=quality_policy)
         served = (f"{len(server.registry.tenants())} tenants from "
                   f"{model_root}" if server.registry is not None
                   else server.engine.model_version)

@@ -70,7 +70,19 @@ _MAX_NAME = 4096
 
 
 class WireFormatError(ValueError):
-    """The columnar body is malformed or unsupported (HTTP 400)."""
+    """The columnar body is malformed or unsupported (HTTP 400).
+
+    ``violation_kind`` carries the data-quality taxonomy kind
+    (quality.py) when the decoder could classify the problem — structural
+    corruption (truncated body, bad magic) stays unclassified."""
+
+    violation_kind: Optional[str] = None
+
+
+def _typed_wire_error(message: str, kind: str) -> WireFormatError:
+    err = WireFormatError(message)
+    err.violation_kind = kind
+    return err
 
 
 def _align8(n: int) -> int:
@@ -304,15 +316,19 @@ def _numeric_cast(name, code, values, target: np.dtype, kind) -> np.ndarray:
     """Cast a wire array to the column storage dtype with exactly python's
     ``float()``/``int()``/``bool()`` coercion semantics (the JSON path)."""
     if code == UTF8:
-        raise WireFormatError(
+        raise _typed_wire_error(
             f"column {name!r} is utf8 but feature kind {kind.__name__} "
-            "is numeric")
+            "is numeric", "TypeMismatch")
     if code == BOOL and np.any(values > 1):
-        raise WireFormatError(
-            f"bool column {name!r} carries bytes outside {{0, 1}}")
+        raise _typed_wire_error(
+            f"bool column {name!r} carries bytes outside {{0, 1}}",
+            "NonCoercibleValue")
     if values.dtype == target:
         return values
-    return values.astype(target)
+    with np.errstate(over="ignore"):
+        # hostile i64 payloads may overflow the f64 cast to ±inf; the
+        # non-finite seam guard downstream owns that verdict, not a warning
+        return values.astype(target)
 
 
 def decode_batch(body: bytes, raw_features: Sequence) -> ColumnBatch:
@@ -340,9 +356,9 @@ def decode_batch(body: bytes, raw_features: Sequence) -> ColumnBatch:
         code, values, mask = wire
         if is_text_kind(kind):
             if code != UTF8:
-                raise WireFormatError(
+                raise _typed_wire_error(
                     f"column {f.name!r} is {_CODE_NAMES[code]} but feature "
-                    f"kind {kind.__name__} is text")
+                    f"kind {kind.__name__} is text", "TypeMismatch")
             vals = values
             if mask is not None and not mask.all():
                 vals = values.copy()
@@ -359,9 +375,10 @@ def decode_batch(body: bytes, raw_features: Sequence) -> ColumnBatch:
             absent_fill: Any = 0
         elif issubclass(kind, Binary):
             if code != BOOL:
-                raise WireFormatError(
+                raise _typed_wire_error(
                     f"column {f.name!r} is {_CODE_NAMES[code]} but "
-                    f"{kind.__name__} wants bool (code {BOOL})")
+                    f"{kind.__name__} wants bool (code {BOOL})",
+                    "TypeMismatch")
             arr = _numeric_cast(f.name, code, values, np.dtype(np.bool_),
                                 kind)
             absent_fill = False
@@ -372,9 +389,9 @@ def decode_batch(body: bytes, raw_features: Sequence) -> ColumnBatch:
         if kind.non_nullable:
             if mask is not None and not mask.all():
                 bad = int((~mask).sum())
-                raise WireFormatError(
+                raise _typed_wire_error(
                     f"{kind.__name__} column {f.name!r} has {bad} empty "
-                    "values")
+                    "values", "MissingRequiredField")
             out[f.name] = Column(kind, arr, mask=None)
             continue
         if mask is None:

@@ -268,8 +268,22 @@ class Workflow(_WorkflowCore):
         and sweep checkpoint already ambient."""
         from .sanitizer import (audit_dag_purity, audit_stage_serialization,
                                 nan_guard)
+        # the poison-data firewall (quality.py) brackets ingestion: the
+        # ambient config lets readers quarantine malformed records per-row
+        # (instead of raising mid-file), and the post-assembly screen drops
+        # NaN/Inf rows before anything ships to the device.  Past
+        # maxQuarantineFraction, training aborts with DataQualityError —
+        # never silently fits on a fraction of the data.
+        from .quality import QualityConfig, screen_batch, use_quality
+        qcfg = QualityConfig.resolve(self.parameters.get("quality"))
         with timer.phase("read"):
-            batch = self.generate_raw_data()
+            if qcfg.enabled:
+                with use_quality(qcfg):
+                    batch = self.generate_raw_data()
+                batch = screen_batch(batch, self.raw_features, qcfg,
+                                     stage="train")
+            else:
+                batch = self.generate_raw_data()
         with timer.phase("prefetch"):
             self._prefetch_text_profiles(batch)
         rff_results = None
@@ -762,6 +776,19 @@ class WorkflowModel(_WorkflowCore):
             from .resilience import record_failure
             record_failure("workflow.save", "swallowed", e,
                            point="checkpoint.save", detail="baselines.json")
+        # the data-quality schema contract (quality.py): raw feature kinds,
+        # nullability and training-range hints, digest-covered like every
+        # bundle file.  Serving enforces it at assembly; a failed write
+        # degrades serving to a re-derived contract, never fails the save.
+        try:
+            from .quality import RawSchema
+            RawSchema.derive(self.raw_features,
+                             batch=getattr(self, "train_batch",
+                                           None)).save(path)
+        except Exception as e:  # noqa: BLE001 — same rule as baselines
+            from .resilience import record_failure
+            record_failure("workflow.save", "swallowed", e,
+                           point="checkpoint.save", detail="schema.json")
         from .telemetry import active_tracer, write_telemetry_summary
         if active_tracer() is not None:
             # traced run: bundle the run's timeline summary next to the
@@ -896,6 +923,11 @@ class WorkflowModel(_WorkflowCore):
                            "bundle has no baselines.json (pre-lifecycle "
                            "build); drift monitoring disabled",
                            point="checkpoint.load", bundle=path)
+        # the schema contract rides along; bundles that predate it (or with
+        # an unreadable schema.json) get a contract re-derived from the
+        # rebuilt raw features — serving always has one to enforce
+        from .quality import RawSchema
+        model.raw_schema = RawSchema.for_model(model, path)
         # 5. AOT executables (formatVersion 2 bundles): deserialize straight
         # into the score program — mismatch/corruption degrades to JIT
         from .aot import install_bundle

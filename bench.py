@@ -217,6 +217,18 @@ def _memory_aux():
     return dict(memory_aux(), peak_rss_mb=_peak_rss_mb())
 
 
+def _registry_aux():
+    """Compiled-program-registry block (ISSUE 18): hit/miss/publish counts
+    and on-disk size, so every BENCH_*.json records how much of the run's
+    compile bill the fleet registry absorbed (read next to
+    new_compiles_during_train)."""
+    from transmogrifai_tpu.aot_registry import registry_stats
+    s = registry_stats()
+    return {k: s[k] for k in ("enabled", "root", "hits", "misses",
+                              "publishes", "evictions", "shared_hits",
+                              "bytes")}
+
+
 # nominal dense peak of one TPU v5e chip (bf16 MXU); override with
 # TRANSMOGRIFAI_PEAK_FLOPS for other parts.  Used only to place the bench
 # programs on a roofline — achieved numbers are the measurement.
@@ -382,6 +394,7 @@ def run_dense(N: int, on_accel: bool, platform: str):
             "roofline": _roofline_aux(phases.get("selector_s"), on_accel),
             "telemetry": _telemetry_aux(tracer),
             "memory": _memory_aux(),
+            "registry": _registry_aux(),
         },
     }
 
@@ -456,6 +469,7 @@ def run_transmog(N: int, on_accel: bool, platform: str):
             "roofline": _roofline_aux(phases.get("selector_s"), on_accel),
             "telemetry": _telemetry_aux(tracer),
             "memory": _memory_aux(),
+            "registry": _registry_aux(),
         },
     }
 

@@ -1,0 +1,304 @@
+"""CI smoke for the compiled-program registry (ISSUE 18): cold ≈ warm
+everywhere, not just at the serving edge.
+
+One train populates the registry (plus the managed compile cache under the
+same root).  Then:
+
+* a FRESH subprocess trains the same workflow and must report
+  ``new_compiles_during_train == 0`` — the whole train compile wall came
+  off the disk,
+* a registry-OFF control train (no registry, no compile cache) must reach
+  the SAME winner and bitwise-identical scores, proving the registry only
+  moves compiles, never numbers,
+* two "pool worker" subprocesses boot a ScoringEngine on an AOT-STRIPPED
+  copy of the bundle (no shipped executables — the registry is the only
+  warm source) and must compile at most ONE program between them,
+* one process activates the same stripped bundle as TWO tenants and must
+  share ONE installed executable (shared_hits > 0, zero loaded-table
+  growth on the second activation).
+
+Usage:
+    python scripts/ci_registry_smoke.py run OUT_DIR
+    python scripts/ci_registry_smoke.py validate OUT_DIR
+
+``run`` writes OUT_DIR/registry-smoke.json (the registry hit/miss summary
+CI uploads as an artifact).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+# runnable as `python scripts/ci_registry_smoke.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SUMMARY_NAME = "registry-smoke.json"
+
+# fresh-process train probe: listeners install before anything compiles so
+# every backend compile in this process is observed.  argv[1] = bundle out
+# dir or "-" to skip saving.
+_TRAIN_CHILD = r"""
+import hashlib, json, sys, time
+t0 = time.time()
+from transmogrifai_tpu.profiling import (install_compile_listeners,
+                                         new_compile_count)
+install_compile_listeners()
+import numpy as np
+from transmogrifai_tpu import types as T
+from transmogrifai_tpu.features import features_from_schema
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                        ModelCandidate, grid)
+from transmogrifai_tpu.serving.engine import records_to_batch
+from transmogrifai_tpu.workflow import Workflow
+
+def make_records(n, seed=7):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        x1 = float(rng.normal()); x2 = float(rng.uniform(0, 10))
+        recs.append({"y": 1.0 if (x1 + 0.2*x2 + rng.normal()*0.3) > 1.0
+                     else 0.0,
+                     "x1": x1, "x2": x2, "cat": ["a", "b", "c"][i % 3]})
+    return recs
+
+schema = {"y": T.RealNN, "x1": T.Real, "x2": T.Real, "cat": T.PickList}
+y, predictors = features_from_schema(schema, response="y")
+fv = transmogrify(predictors)
+checked = y.sanity_check(fv, remove_bad_features=True)
+sel = BinaryClassificationModelSelector(models=[
+    ModelCandidate(OpLogisticRegression(), grid(reg_param=[0.01, 0.1]),
+                   "OpLogisticRegression")])
+sel.set_input(y, checked)
+wf = (Workflow().set_input_records(make_records(200))
+      .set_result_features(sel.get_output()))
+model = wf.train()
+from transmogrifai_tpu.aot import pretrace_drain
+pretrace_drain()
+train_compiles = new_compile_count()
+
+# bitwise score fingerprint: same records, same order, hash of raw bytes
+pred = next(f.name for f in model.result_features)
+batch = records_to_batch(model.raw_features, make_records(32, seed=11))
+scored = model.score(batch=batch)
+h = hashlib.sha256()
+for k in sorted(scored[pred].values):
+    h.update(k.encode())
+    h.update(np.ascontiguousarray(np.asarray(
+        scored[pred].values[k], dtype=np.float64)).tobytes())
+
+if sys.argv[1] != "-":
+    model.save(sys.argv[1])
+
+from transmogrifai_tpu.aot_registry import registry_stats
+print(json.dumps({
+    "new_compiles_during_train": train_compiles,
+    "winner": model.selected_model.summary.best_model_name,
+    "score_sha256": h.hexdigest(),
+    "registry": registry_stats(),
+    "wall_s": round(time.time() - t0, 1),
+}))
+"""
+
+# fresh-process pool-worker probe: ScoringEngine on an AOT-less bundle —
+# the registry is the only possible source of warm executables
+_WORKER_CHILD = r"""
+import json, sys
+from transmogrifai_tpu.profiling import (install_compile_listeners,
+                                         new_compile_count)
+install_compile_listeners()
+from transmogrifai_tpu.serving.engine import ScoringEngine
+eng = ScoringEngine(sys.argv[1], max_batch=16, linger_ms=0.0)
+out, _ = eng.score_record({"x1": 0.4, "x2": 3.0, "cat": "a"})
+stats = eng.stats()
+eng.close()
+from transmogrifai_tpu.aot_registry import registry_stats
+print(json.dumps({
+    "new_compiles": new_compile_count(),
+    "result_keys": sorted(out),
+    "aot_executables": stats.get("aot_executables", 0),
+    "registry": registry_stats(),
+}))
+"""
+
+# one process, two byte-identical tenant bundles: the second activation
+# must reuse the first's installed executables (one copy in memory)
+_TENANT_CHILD = r"""
+import json, sys
+import numpy as np
+from transmogrifai_tpu.profiling import (install_compile_listeners,
+                                         new_compile_count)
+install_compile_listeners()
+from transmogrifai_tpu.serving.engine import ScoringEngine
+from transmogrifai_tpu.aot_registry import loaded_count, registry_stats
+rec = {"x1": 0.4, "x2": 3.0, "cat": "a"}
+eng_a = ScoringEngine(sys.argv[1], max_batch=16, linger_ms=0.0)
+out_a, _ = eng_a.score_record(rec)
+loaded_after_a = loaded_count()
+shared_before = registry_stats()["shared_hits"]
+eng_b = ScoringEngine(sys.argv[2], max_batch=16, linger_ms=0.0)
+out_b, _ = eng_b.score_record(rec)
+loaded_after_b = loaded_count()
+eng_a.close(); eng_b.close()
+equal = (sorted(out_a) == sorted(out_b) and
+         all(np.array_equal(np.asarray(out_a[k]), np.asarray(out_b[k]))
+             for k in out_a))
+print(json.dumps({
+    "loaded_after_a": loaded_after_a,
+    "loaded_after_b": loaded_after_b,
+    "shared_hits_gained": registry_stats()["shared_hits"] - shared_before,
+    "new_compiles": new_compile_count(),
+    "scores_equal": bool(equal),
+}))
+"""
+
+
+def _child(code, args, env):
+    p = subprocess.run([sys.executable, "-c", code, *args],
+                       capture_output=True, text=True, env=env, timeout=600)
+    line = next((ln for ln in reversed(p.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    if p.returncode != 0 or not line:
+        sys.stderr.write(p.stderr[-4000:])
+        raise SystemExit(f"child failed (rc={p.returncode})")
+    return json.loads(line)
+
+
+def _strip_aot(bundle, dest):
+    """Copy ``bundle`` with every aot-* platform dir removed and a
+    regenerated MANIFEST: a JIT-only bundle whose model content (and
+    therefore registry family digest) is unchanged."""
+    from transmogrifai_tpu.checkpoint import read_manifest, write_manifest
+    shutil.copytree(bundle, dest)
+    for name in list(os.listdir(dest)):
+        if name.startswith("aot-"):
+            shutil.rmtree(os.path.join(dest, name))
+    extra = {k: v for k, v in read_manifest(dest).items()
+             if k not in ("files", "createdAt", "formatVersion", "aot")}
+    write_manifest(dest, extra=extra)
+    return dest
+
+
+def run(out_dir):
+    os.makedirs(out_dir, exist_ok=True)
+    registry_root = os.path.join(out_dir, "registry")
+    bundle = os.path.join(out_dir, "model")
+
+    base = dict(os.environ)
+    for k in ("TRANSMOGRIFAI_AOT_REGISTRY", "TRANSMOGRIFAI_COMPILE_CACHE",
+              "TRANSMOGRIFAI_COMPILATION_CACHE", "TRANSMOGRIFAI_NO_AOT"):
+        base.pop(k, None)
+    base["TRANSMOGRIFAI_AOT_LADDER_MAX"] = "16"
+    reg_env = dict(base,
+                   TRANSMOGRIFAI_AOT_REGISTRY=registry_root,
+                   TRANSMOGRIFAI_COMPILE_CACHE=os.path.join(
+                       registry_root, "compile-cache"))
+
+    # 1. cold train populates registry + managed compile cache, saves the
+    #    bundle (export publishes the scoring executables)
+    cold = _child(_TRAIN_CHILD, [bundle], reg_env)
+    # 2. the headline: a fresh process against the warm registry root
+    warm = _child(_TRAIN_CHILD, ["-"], reg_env)
+    # 3. registry-off, cache-off control: same winner, bitwise-same scores.
+    # TRANSMOGRIFAI_COMPILATION_CACHE=0 also turns off the legacy default
+    # /tmp jax cache, which earlier runs on the same host may have warmed —
+    # the control really must compile from scratch.
+    control = _child(_TRAIN_CHILD, ["-"], dict(
+        base, TRANSMOGRIFAI_AOT_REGISTRY="0",
+        TRANSMOGRIFAI_COMPILATION_CACHE="0"))
+
+    # 4. two pool workers on an AOT-stripped bundle copy: with no shipped
+    #    executables, only the registry can absorb the boot compiles
+    stripped = _strip_aot(bundle, os.path.join(out_dir, "model-noaot"))
+    workers = [_child(_WORKER_CHILD, [stripped], reg_env)
+               for _ in range(2)]
+
+    # 5. two tenants of the same family x rung in one process share one
+    #    installed executable
+    tenant_a = _strip_aot(stripped, os.path.join(out_dir, "tenant-a"))
+    tenant_b = _strip_aot(stripped, os.path.join(out_dir, "tenant-b"))
+    tenants = _child(_TENANT_CHILD, [tenant_a, tenant_b], reg_env)
+
+    summary = {
+        "registryRoot": registry_root,
+        "cold": cold, "warm": warm, "control": control,
+        "workers": workers, "tenants": tenants,
+    }
+    with open(os.path.join(out_dir, SUMMARY_NAME), "w") as fh:
+        json.dump(summary, fh, indent=2)
+    print(json.dumps(summary, indent=2))
+    return 0
+
+
+def validate(out_dir):
+    with open(os.path.join(out_dir, SUMMARY_NAME)) as fh:
+        s = json.load(fh)
+    cold, warm, control = s["cold"], s["warm"], s["control"]
+    workers, tenants = s["workers"], s["tenants"]
+
+    # the first train must have fed the registry — or found it already
+    # fleet-warm (CI restores the directory via actions/cache, in which
+    # case cold == warm is exactly the point)
+    assert cold["registry"]["publishes"] > 0 or \
+        cold["registry"]["hits"] > 0, \
+        f"first train neither published nor hit: {cold['registry']}"
+    # vacuousness guard on the always-cold control: this workload really
+    # does demand compiles when nothing absorbs them
+    assert control["new_compiles_during_train"] > 0, \
+        "control train compiled nothing — the warm assert is vacuous"
+
+    # the acceptance bar: registry-warm, process-fresh train = ZERO compiles
+    assert warm["new_compiles_during_train"] == 0, \
+        f"warm fresh-process train compiled " \
+        f"{warm['new_compiles_during_train']} programs"
+    assert warm["registry"]["hits"] > 0, \
+        f"warm train never hit the registry: {warm['registry']}"
+
+    # the registry moves compiles, never numbers: winner + scores bitwise
+    assert cold["winner"] == warm["winner"] == control["winner"], \
+        f"winner drift: {cold['winner']}/{warm['winner']}/{control['winner']}"
+    assert cold["score_sha256"] == warm["score_sha256"] == \
+        control["score_sha256"], "score drift across registry/control runs"
+    assert control["registry"]["enabled"] is False, \
+        "control ran with the registry on — parity check is vacuous"
+
+    # N-worker pool boot on a bundle with NO shipped executables: <=1
+    # compile total, both workers fully served
+    pool_compiles = sum(w["new_compiles"] for w in workers)
+    assert pool_compiles <= 1, \
+        f"2-worker boot compiled {pool_compiles} programs " \
+        f"({[w['new_compiles'] for w in workers]})"
+    for w in workers:
+        assert w["aot_executables"] == 0, \
+            f"stripped bundle still shipped executables: {w}"
+        assert w["result_keys"], "worker returned no score fields"
+        assert w["registry"]["hits"] > 0, \
+            f"worker never hit the registry: {w['registry']}"
+
+    # tenant sharing: second activation reuses the first's executables
+    assert tenants["scores_equal"], "tenant copies scored differently"
+    assert tenants["shared_hits_gained"] > 0, \
+        f"second tenant installed its own executables: {tenants}"
+    assert tenants["loaded_after_b"] == tenants["loaded_after_a"], \
+        f"loaded-executable table grew on the second tenant: {tenants}"
+
+    hits = warm["registry"]["hits"] + sum(w["registry"]["hits"]
+                                          for w in workers)
+    print(f"OK: warm train {warm['new_compiles_during_train']} compiles "
+          f"(cold {cold['new_compiles_during_train']}), pool boot "
+          f"{pool_compiles} compiles, {hits} registry hits, "
+          f"{tenants['shared_hits_gained']} shared tenant installs, "
+          f"bitwise winner/score parity vs no-registry control")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "run":
+        sys.exit(run(sys.argv[2]))
+    if len(sys.argv) == 3 and sys.argv[1] == "validate":
+        sys.exit(validate(sys.argv[2]))
+    sys.exit(f"usage: {sys.argv[0]} run OUT_DIR | validate OUT_DIR")

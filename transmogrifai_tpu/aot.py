@@ -177,6 +177,13 @@ def _export_bundle_inner(model, bundle_dir: str) -> int:
         # its jitted fusion symbols missing ("Symbols not found" at
         # deserialize) — only a fresh backend compile round-trips
         pretrace_drain()
+        # registry publish rides the same export loop: every executable the
+        # bundle ships also lands in the fleet registry under its
+        # family x rung key, so pool workers / tenants / CI on OTHER
+        # bundles of the same content install instead of compiling
+        from . import aot_registry
+        family = (aot_registry.model_family_digest(bundle_dir)
+                  if aot_registry.registry_enabled() else None)
         prev_cache = jax.config.jax_enable_compilation_cache
         jax.config.update("jax_enable_compilation_cache", False)
         try:
@@ -184,6 +191,19 @@ def _export_bundle_inner(model, bundle_dir: str) -> int:
                                            key=lambda k: (k[2], k[0]))):
                 try:
                     rec = _serialize_key(program, key)
+                    if not aot_registry.payload_roundtrips(rec):
+                        # the executable came out of the persistent compile
+                        # cache (its payload deserializes to "Symbols not
+                        # found") — re-lower + re-compile once with every
+                        # cache layer suspended so the bundle ships an
+                        # installable build instead of silently skipping
+                        _count("aot_registry.recompiles_for_publish")
+                        with aot_registry.fresh_compile_env():
+                            rec = _serialize_key(program, key)
+                        if not aot_registry.payload_roundtrips(rec):
+                            raise RuntimeError(
+                                "payload does not deserialize even after a "
+                                "cache-suspended rebuild")
                 except Exception as e:  # noqa: BLE001 — per-key best effort
                     record_failure("workflow.save", "swallowed", e,
                                    point="checkpoint.aot",
@@ -194,8 +214,12 @@ def _export_bundle_inner(model, bundle_dir: str) -> int:
                     f.write(rec)
                 index.append({"file": fname, **_key_json(key)})
                 written += 1
+                if family:
+                    aot_registry.publish_score(family, key, program, rec)
         finally:
             jax.config.update("jax_enable_compilation_cache", prev_cache)
+        if family:
+            program.registry_family = family
         if not written:
             # nothing serialized — drop the empty dir so the bundle stays
             # byte-identical to a JIT-only save
@@ -275,16 +299,22 @@ def install_bundle(model, bundle_path: str) -> int:
     if reason is not None:
         return _fallback(f"ABI {reason}")
 
-    from jax.experimental.serialize_executable import deserialize_and_load
+    import hashlib
+
+    from .aot_registry import shared_load
     program = model.score_program()
     installed = 0
     for ent in meta.get("executables", []):
         fpath = os.path.join(aot_dir, ent.get("file", ""))
         try:
             with open(fpath, "rb") as f:
-                rec = pickle.load(f)
-            fn = deserialize_and_load(rec["payload"], rec["inTree"],
-                                      rec["outTree"])
+                raw = f.read()
+            rec = pickle.loads(raw)
+            # deserialize through the process-wide shared table keyed on
+            # content: two tenants loading byte-identical bundles (same
+            # family x rung) get ONE loaded executable and one copy of its
+            # device memory
+            fn = shared_load(hashlib.sha256(raw).hexdigest(), rec)
             program.install_executable(_key_tuple(rec["key"]), fn,
                                        rec["canonOut"], rec["metas"])
             installed += 1
@@ -331,11 +361,17 @@ _IDLE.set()
 def pretrace_enabled() -> bool:
     """Pre-tracing pays a background compile so the foreground fit becomes a
     persistent-cache hit — without the cache it would literally double the
-    compile bill, so it keys on the same env the fit-shape padding does."""
+    compile bill, so it keys on the same env the fit-shape padding does.
+    A configured executable registry also qualifies: its pre-trace pass can
+    skip the compile entirely (deserialize a published executable) and its
+    misses publish for the whole fleet."""
     if not aot_enabled():
         return False
     cache = os.environ.get("TRANSMOGRIFAI_COMPILE_CACHE", "")
-    return bool(cache) and cache != "0"
+    if bool(cache) and cache != "0":
+        return True
+    from .aot_registry import registry_enabled
+    return registry_enabled()
 
 
 def _pretrace_worker() -> None:

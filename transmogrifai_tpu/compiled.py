@@ -124,6 +124,9 @@ class ScoreProgram:
         # deserialized pre-compiled executable rather than a jit wrapper
         self._input_specs: Dict[Tuple, Any] = {}
         self._aot_installed: Set[Tuple] = set()
+        # model-content digest tying this program to the fleet registry
+        # (aot_registry.py); set by workflow load/save, None = no registry
+        self.registry_family: Optional[str] = None
 
     def install_executable(self, key: Tuple, fn: Any,
                            canon_out: Dict[str, str],
@@ -260,7 +263,8 @@ class ScoreProgram:
         metas_in = {n: batch[n].meta for n in frontier}
         n_rows_static = len(batch)
 
-        if key not in self._jitted:
+        fresh = key not in self._jitted
+        if fresh:
             metas_out: Dict[str, Any] = {}
             fns_at_trace = dict(staged_fns)
             inv_in = {c: n for n, c in canon_in.items()}
@@ -348,6 +352,12 @@ class ScoreProgram:
                 record_failure("compiled", "degraded", e,
                                point="compiled.shard",
                                fallback="unsharded program")
+        if fresh and mesh is None and key not in self._aot_installed:
+            # fleet-registry seam: a published executable for this exact
+            # (family, stages, rows, avals) installs over the untraced jit
+            # entry — the dispatch below then runs with zero compiles
+            from .aot_registry import try_install_score
+            try_install_score(self, key, arrays)
         jitted, canon_out_map = self._jitted[key]
         from .profiling import cost_analysis_enabled, record_program_cost
         if cost_analysis_enabled():

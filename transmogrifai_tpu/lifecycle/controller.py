@@ -283,6 +283,17 @@ class LifecycleController:
             # retrain replay THIS sweep's fits (candidate signatures don't
             # hash the training data) instead of fitting fresh data
             shutil.rmtree(sweep_dir, ignore_errors=True)
+        # a standing lifecycle host retrains indefinitely — each cycle
+        # publishes into the compiled-program registry and appends to the
+        # persistent compile cache, so each cycle also re-enforces both
+        # byte budgets (aot_registry GC: LRU-by-atime, stale-ABI first)
+        try:
+            from ..aot_registry import enforce_budget, gc_compile_cache
+            enforce_budget()
+            gc_compile_cache()
+        except Exception as e:  # noqa: BLE001 — GC must not fail a retrain
+            record_failure("lifecycle", "swallowed", e,
+                           point="lifecycle.registry_gc")
         return outcome
 
     def _failed(self, reason: str, policy: str, e: Exception,

@@ -75,60 +75,43 @@ def _grouped_grid_fit(est, X, y, fold_weights, grids, *, loss: str,
                 csh = candidate_sharding(cmesh)
                 l2s = _jax.device_put(l2s, csh)
                 l1s = _jax.device_put(l1s, csh)
+        # all grid dispatch goes through the registry seam (aot_registry):
+        # a registry hit runs an installed executable with zero traces and
+        # zero compiles; a miss runs the ordinary jit call and publishes a
+        # fresh build for the rest of the fleet
         from ..aot import pretrace_mode
+        from ..aot_registry import grid_call, grid_compile
+        if sparse:
+            label = "linear.sparse_grid_fit"
+            g_fn = sparse_linear_grid_fit
+            g_args = (Xj.values, Xj.indices, Xj.row_ids, yj, Wj, l2s, l1s)
+            g_statics = dict(n_rows=Xj.n_rows, n_cols=Xj.n_cols, loss=loss,
+                             fit_intercept=fit_intercept,
+                             standardization=standardization,
+                             max_iter=max_iter, tol=tol, n_classes=nc)
+        elif loss == "squared" and all(p[1] == 0.0 for p in pens):
+            label = "linear.ridge_grid_fit"
+            g_fn = ridge_grid_fit
+            g_args = (Xj, yj, Wj, l2s)
+            g_statics = dict(fit_intercept=fit_intercept,
+                             standardization=standardization)
+        else:
+            label = "linear.grid_fit"
+            g_fn = linear_grid_fit
+            g_args = (Xj, yj, Wj, l2s, l1s)
+            g_statics = dict(loss=loss, fit_intercept=fit_intercept,
+                             standardization=standardization,
+                             max_iter=max_iter, tol=tol, n_classes=nc)
         if pretrace_mode():
-            # background pre-trace: lower+compile each group's program (the
-            # compile lands in the persistent cache, so the real fit below
-            # becomes a disk hit) without executing anything
-            if sparse:
-                sparse_linear_grid_fit.lower(
-                    Xj.values, Xj.indices, Xj.row_ids, yj, Wj, l2s, l1s,
-                    n_rows=Xj.n_rows, n_cols=Xj.n_cols, loss=loss,
-                    fit_intercept=fit_intercept,
-                    standardization=standardization,
-                    max_iter=max_iter, tol=tol, n_classes=nc).compile()
-            elif loss == "squared" and all(p[1] == 0.0 for p in pens):
-                ridge_grid_fit.lower(
-                    Xj, yj, Wj, l2s, fit_intercept=fit_intercept,
-                    standardization=standardization).compile()
-            else:
-                linear_grid_fit.lower(
-                    Xj, yj, Wj, l2s, l1s, loss=loss,
-                    fit_intercept=fit_intercept,
-                    standardization=standardization,
-                    max_iter=max_iter, tol=tol, n_classes=nc).compile()
+            # background pre-trace: registry hit → deserialize the
+            # executable now (the real fit below dispatches it directly);
+            # miss → lower+compile into the persistent cache and publish
+            grid_compile(label, g_fn, g_args, static_kwargs=g_statics)
             continue
         from ..profiling import cost_analysis_enabled, record_program_cost
-        if sparse:
-            # flat-COO path: FISTA via take+segment_sum for every loss
-            # (the closed-form ridge would need an [D, D] Gram — at the
-            # 100k-column regime this path exists for, that is the dense
-            # blow-up the representation is here to avoid)
-            res = sparse_linear_grid_fit(
-                Xj.values, Xj.indices, Xj.row_ids, yj, Wj, l2s, l1s,
-                n_rows=Xj.n_rows, n_cols=Xj.n_cols, loss=loss,
-                fit_intercept=fit_intercept, standardization=standardization,
-                max_iter=max_iter, tol=tol, n_classes=nc)
-        elif loss == "squared" and all(p[1] == 0.0 for p in pens):
-            res = ridge_grid_fit(Xj, yj, Wj, l2s, fit_intercept=fit_intercept,
-                                 standardization=standardization)
-            if cost_analysis_enabled():
-                record_program_cost(
-                    "ridge_grid_fit", ridge_grid_fit, (Xj, yj, Wj, l2s),
-                    dict(fit_intercept=fit_intercept,
-                         standardization=standardization))
-        else:
-            res = linear_grid_fit(Xj, yj, Wj, l2s, l1s, loss=loss,
-                                  fit_intercept=fit_intercept,
-                                  standardization=standardization,
-                                  max_iter=max_iter, tol=tol, n_classes=nc)
-            if cost_analysis_enabled():
-                record_program_cost(
-                    "linear_grid_fit", linear_grid_fit,
-                    (Xj, yj, Wj, l2s, l1s),
-                    dict(loss=loss, fit_intercept=fit_intercept,
-                         standardization=standardization, max_iter=max_iter,
-                         tol=tol, n_classes=nc))
+        res = grid_call(label, g_fn, g_args, static_kwargs=g_statics)
+        if cost_analysis_enabled() and not sparse:
+            record_program_cost(label, g_fn, g_args, g_statics)
         coef = np.asarray(res.coef)
         inter = np.asarray(res.intercept)
         n_it = np.asarray(res.n_iter)

@@ -1201,13 +1201,20 @@ class _ForestEstimatorBase(PredictorEstimator):
             grid_args = (B, jnp.asarray(splits), base_stats, fold_w,
                          fold_ids, keys, mis, mgs, subs, masks,
                          jnp.float32(1.0))
+            from ..aot_registry import grid_call, grid_compile
+            f_statics = dict(impurity=impurity, maxDepth=max_depth,
+                             maxBins=max_bins, bootstrap=bootstrap,
+                             chunk=chunk, batchSize=batch_size, fpn=fpn)
             if pretrace:
-                # populate the persistent compile cache (and _SHARED_BINS,
-                # above) from the background thread; the sweep's real fit
-                # then traces into a disk hit instead of an XLA compile
-                fitter.lower(*grid_args).compile()
+                # registry hit → the executable deserializes now and the
+                # sweep's real fit dispatches it (zero compiles); miss →
+                # lower+compile into the persistent compile cache (and
+                # _SHARED_BINS, above) and publish the fresh build
+                grid_compile("trees.forest_grid_fit", fitter, grid_args,
+                             sig_statics=f_statics)
                 continue
-            trees = fitter(*grid_args)
+            trees = grid_call("trees.forest_grid_fit", fitter, grid_args,
+                              sig_statics=f_statics)
             from ..profiling import cost_analysis_enabled, record_program_cost
             if cost_analysis_enabled():
                 record_program_cost("forest_grid_fit", fitter, grid_args)
@@ -1357,10 +1364,16 @@ class _GBTEstimatorBase(PredictorEstimator):
                                             (mis, mgs, lams, etas))
             gbt_args = (B, jnp.asarray(splits), Xj, yj, margins, W, fmask,
                         mis_d, mgs_d, lams_d, etas_d)
+            from ..aot_registry import grid_call, grid_compile
+            g_statics = dict(task=self.task, maxDepth=max_depth,
+                             maxBins=max_bins, chunk=chunk,
+                             batchSize=batch_size, rounds=n_rounds)
             if pretrace:
-                fit_all.lower(*gbt_args).compile()
+                grid_compile("trees.gbt_grid_fit", fit_all, gbt_args,
+                             sig_statics=g_statics)
                 continue
-            margins, rounds = fit_all(*gbt_args)
+            margins, rounds = grid_call("trees.gbt_grid_fit", fit_all,
+                                        gbt_args, sig_statics=g_statics)
             from ..profiling import cost_analysis_enabled, record_program_cost
             if cost_analysis_enabled():
                 record_program_cost("gbt_grid_fit", fit_all, gbt_args)

@@ -697,6 +697,16 @@ def launch_hosts(cmd: Sequence[str], hosts: int, *,
     base_env["PYTHONPATH"] = _repo_root() + (
         os.pathsep + base_env["PYTHONPATH"]
         if base_env.get("PYTHONPATH") else "")
+    # every rank shares the launcher's compiled-program registry (and its
+    # managed compile cache): rank 0's publishes warm ranks 1..N-1, and a
+    # relaunch after a lost host resumes without re-paying compiles
+    from ..aot_registry import managed_compile_cache, registry_root
+    _reg = registry_root()
+    if _reg:
+        base_env.setdefault("TRANSMOGRIFAI_AOT_REGISTRY", _reg)
+    _cache = managed_compile_cache()
+    if _cache:
+        base_env.setdefault("TRANSMOGRIFAI_COMPILE_CACHE", _cache)
 
     world = hosts
     generation = 0

@@ -68,6 +68,15 @@ class OpParams:
     # pass --no-aot to save/load JIT-only bundles), ladderMax (largest
     # padded batch size exported at save time)
     aot: Dict[str, Any] = field(default_factory=dict)
+    # compiled-program registry knobs (aot_registry.py): enabled (default
+    # true — set false or pass --no-registry for pre-registry behavior),
+    # root (--registry-root / TRANSMOGRIFAI_AOT_REGISTRY; defaults to
+    # <checkpoint-location>/registry), capBytes
+    # (TRANSMOGRIFAI_AOT_REGISTRY_CAP_BYTES eviction budget), keepMin
+    # (TRANSMOGRIFAI_AOT_REGISTRY_KEEP_MIN entries never evicted),
+    # cacheCapBytes (TRANSMOGRIFAI_COMPILE_CACHE_CAP_BYTES budget for the
+    # persistent XLA compile cache)
+    registry: Dict[str, Any] = field(default_factory=dict)
     # mesh-sharded sweep knobs (parallel/mesh.py env equivalents): enabled
     # (TRANSMOGRIFAI_TPU_MESH), modelWidth (TRANSMOGRIFAI_TPU_MESH_MODEL),
     # chunkBytes (TRANSMOGRIFAI_DEVICE_CHUNK_BYTES), minRows
@@ -128,6 +137,7 @@ class OpParams:
             telemetry=d.get("telemetryParams") or {},
             lifecycle=d.get("lifecycleParams") or {},
             aot=d.get("aotParams") or {},
+            registry=d.get("registryParams") or {},
             mesh=d.get("meshParams") or {},
             supervisor=d.get("supervisorParams") or {},
             hostgroup=d.get("hostgroupParams") or {},
@@ -158,6 +168,7 @@ class OpParams:
             "telemetryParams": self.telemetry,
             "lifecycleParams": self.lifecycle,
             "aotParams": self.aot,
+            "registryParams": self.registry,
             "meshParams": self.mesh,
             "supervisorParams": self.supervisor,
             "hostgroupParams": self.hostgroup,

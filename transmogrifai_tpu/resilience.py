@@ -124,6 +124,7 @@ class FailureLog:
                "rejected",     # lifecycle candidate lost; incumbent kept
                "shed",         # admission control rejected work up front
                "quarantined",  # data-quality firewall excluded a record/row
+               "evicted",      # size-capped store dropped an entry (GC)
                "breaker_open",       # circuit breaker tripped: calls skipped
                "breaker_half_open",  # breaker probing for recovery
                "breaker_closed",     # breaker recovered: calls flow again

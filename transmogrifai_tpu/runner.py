@@ -108,6 +108,19 @@ class OpWorkflowRunner:
             set_aot_enabled(False)
         if ap.get("ladderMax") is not None:
             os.environ["TRANSMOGRIFAI_AOT_LADDER_MAX"] = str(ap["ladderMax"])
+        # registryParams: configure the compiled-program registry (root,
+        # byte budgets, kill switch).  When no root is pinned anywhere it
+        # defaults next to the sweep checkpoints (see the run-type blocks),
+        # so a standing host accumulates its own warm registry
+        rp = params.registry or {}
+        from .aot_registry import configure as configure_registry
+        configure_registry(
+            root=rp.get("root"),
+            enabled=(bool(rp["enabled"]) if rp.get("enabled") is not None
+                     else None),
+            cap_bytes=rp.get("capBytes"),
+            keep_min=rp.get("keepMin"),
+            cache_cap_bytes=rp.get("cacheCapBytes"))
         # meshParams: the mesh decision is made per-fit from the environment
         # (parallel/mesh.py), so the per-run knobs ride the env knobs
         mp = params.mesh or {}
@@ -345,10 +358,22 @@ class OpWorkflowRunner:
         if params.checkpoint_location:
             resume_from = os.path.join(params.checkpoint_location,
                                        "selector-sweep")
+            # default the compiled-program registry next to the sweep state:
+            # the checkpoint dir outlives /tmp, so every re-train (and every
+            # pool worker / lifecycle retrain pointed at the same location)
+            # installs executables instead of compiling.  configure() also
+            # parks the persistent XLA compile cache under the registry root
+            # (<registry>/compile-cache), so the pre-registry cache
+            # defaulting below only fires when the registry is disabled
+            from .aot_registry import configure as configure_registry
+            from .aot_registry import registry_allowed, registry_root
+            if registry_allowed() and registry_root() is None:
+                configure_registry(root=os.path.join(
+                    params.checkpoint_location, "registry"))
             if not os.environ.get("TRANSMOGRIFAI_COMPILE_CACHE"):
-                # the checkpoint dir outlives /tmp, so parking the XLA
-                # compile cache beside the sweep state makes every re-train
-                # of this app pay execution cost only
+                # registry off: keep the old behavior — park the XLA
+                # compile cache beside the sweep state so every re-train
+                # of this app pays execution cost only
                 from .profiling import set_compile_cache_dir
                 set_compile_cache_dir(os.path.join(
                     params.checkpoint_location, "compile-cache"))
@@ -720,6 +745,16 @@ class OpApp:
                        help="disable AOT-serialized executables: train "
                             "saves JIT-only bundles, load/serve recompiles "
                             "instead of installing shipped executables")
+        p.add_argument("--registry-root",
+                       help="compiled-program registry directory (default: "
+                            "<checkpoint-location>/registry, or "
+                            "$TRANSMOGRIFAI_AOT_REGISTRY); train publishes "
+                            "executables into it, every fresh train / "
+                            "worker / tenant installs from it")
+        p.add_argument("--no-registry", action="store_true",
+                       help="disable the compiled-program registry (no "
+                            "publish, no install; pre-registry compile "
+                            "behavior)")
         p.add_argument("--mesh", action="store_true",
                        help="force the mesh-sharded CV sweep on regardless "
                             "of the row-count heuristic")
@@ -798,6 +833,10 @@ class OpApp:
             params.telemetry["traceparent"] = args.traceparent
         if args.no_aot:
             params.aot["enabled"] = False
+        if args.registry_root:
+            params.registry["root"] = args.registry_root
+        if args.no_registry:
+            params.registry["enabled"] = False
         if args.mesh or args.no_mesh:
             params.mesh["enabled"] = bool(args.mesh and not args.no_mesh)
         if args.mesh_model_width is not None:

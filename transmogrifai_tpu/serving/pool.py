@@ -461,6 +461,16 @@ class ServingPool:
         root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        # every worker installs from the pool's compiled-program registry:
+        # N-worker boot then costs at most the ONE compile the first
+        # publisher paid, not N re-derivations (aot_registry.py)
+        from ..aot_registry import managed_compile_cache, registry_root
+        reg = registry_root()
+        if reg:
+            env.setdefault("TRANSMOGRIFAI_AOT_REGISTRY", reg)
+        cache = managed_compile_cache()
+        if cache:
+            env.setdefault("TRANSMOGRIFAI_COMPILE_CACHE", cache)
         # seed the worker's root span from the pool's ambient trace so
         # worker-side spans land on the same trace_id as the spawner
         from ..telemetry import TRACEPARENT_ENV, current_trace_context

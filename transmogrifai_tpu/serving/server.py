@@ -170,6 +170,28 @@ def render_metrics(engine: ScoringEngine) -> str:
             "AOT-serialized executables installed from model bundles")
     counter("aot_fallback_total", reg_counters.get("aot.fallback", 0),
             "Bundles or executables that fell back to the JIT path")
+    # compiled-program registry families (ISSUE 18): fleet-wide executable
+    # reuse — hits install published executables, misses compile + publish
+    counter("aot_registry_hits_total",
+            reg_counters.get("aot_registry.hits", 0),
+            "Registry lookups that found an installable executable")
+    counter("aot_registry_misses_total",
+            reg_counters.get("aot_registry.misses", 0),
+            "Registry lookups that fell through to the JIT path")
+    counter("aot_registry_publishes_total",
+            reg_counters.get("aot_registry.publishes", 0),
+            "Executables this process published into the registry")
+    counter("aot_registry_evictions_total",
+            reg_counters.get("aot_registry.evictions", 0),
+            "Registry entries evicted by the byte-budget GC")
+    counter("aot_registry_shared_hits_total",
+            reg_counters.get("aot_registry.shared_hits", 0),
+            "Installs served from the process-wide loaded-executable "
+            "table (tenants sharing one executable and its device memory)")
+    from ..aot_registry import registry_bytes, registry_enabled
+    if registry_enabled():
+        gauge("aot_registry_bytes", registry_bytes(),
+              "On-disk size of the compiled-program registry")
     gauge("racing_cv_fits_saved_total", reg.get("racing.cv_fits_saved", 0),
           "CV fold-fits skipped by selector grid racing")
     gauge("racing_points_pruned_total", reg.get("racing.points_pruned", 0),

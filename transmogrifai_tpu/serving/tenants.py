@@ -299,11 +299,16 @@ class TenantRegistry:
                 record_failure("serving", "swallowed", e,
                                point="serving.tenants", tenant=slot.tenant)
         self.metrics.counter("tenant.activations_total").inc()
+        # shared_executables: size of the process-wide loaded-executable
+        # table (aot_registry) — two tenants of the same family x rung
+        # converge on one entry, so this grows sub-linearly in tenants
+        from ..aot_registry import loaded_count
         record_failure(
             "serving", "tenant.activated", None, point="serving.tenants",
             tenant=slot.tenant, version=engine.model_version,
             activation_s=round(time.perf_counter() - t0, 3),
-            entry_bytes=slot.entry_bytes)
+            entry_bytes=slot.entry_bytes,
+            shared_executables=loaded_count())
         self._enforce_budget(keep=slot)
 
     def _active_slots(self) -> List[_TenantSlot]:

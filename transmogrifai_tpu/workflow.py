@@ -932,4 +932,12 @@ class WorkflowModel(_WorkflowCore):
         # into the score program — mismatch/corruption degrades to JIT
         from .aot import install_bundle
         model.aot_executables = install_bundle(model, path)
+        # 6. fleet registry: stamp the score program with its model-content
+        # family so shapes the bundle did not ship (or a bundle with no AOT
+        # artifacts at all — e.g. exported on another platform) still
+        # install published executables instead of compiling
+        from . import aot_registry
+        if aot_registry.registry_enabled():
+            model.score_program().registry_family = \
+                aot_registry.model_family_digest(path)
         return model

@@ -48,10 +48,11 @@ accelerator; reduced CPU smoke runs report 1.0.
    activation/eviction counts in the aux.
 
 Env knobs: BENCH_ROWS (dense rows), BENCH_TRANSMOG_ROWS, BENCH_SCORE_ROWS,
-BENCH_SPARSE_ROWS, BENCH_SPARSE_HASHES, BENCH_COLD_START_ROWS,
-BENCH_TENANT_REQUESTS, BENCH_WORKLOAD (dense|transmog|score|text_sparse|
-selector_smoke|serving_chaos|serve_cold_start|serve_scaleout|multi_tenant|
-all, default all).
+BENCH_SPARSE_ROWS, BENCH_SPARSE_HASHES, BENCH_SPARSE_MESH_ROWS,
+BENCH_COLD_START_ROWS, BENCH_TENANT_REQUESTS, BENCH_WORKLOAD
+(dense|transmog|score|text_sparse|text_sparse_mesh|selector_smoke|
+serving_chaos|serve_cold_start|serve_scaleout|multi_tenant|all,
+default all).
 """
 
 import json
@@ -197,6 +198,16 @@ def _telemetry_aux(tracer, top_n: int = 8):
            # host staging so HBM-pressure regressions show in artifacts
            "mesh": {k.split(".", 1)[1]: snap[k] for k in snap
                     if k.startswith("mesh.")},
+           # DeviceTable sparse shipments (ISSUE 19): rows/nnz over the
+           # link, ladder pad entries, shards — next to the dense mesh.*
+           # family they extend
+           "device_table": {k.split(".", 1)[1]: snap[k] for k in snap
+                            if k.startswith("device_table.")},
+           # honest degrade path: "sharded" when the sweep actually ran on
+           # a multi-device mesh this process, else "single_device" (the
+           # selector.mesh degraded FailureLog note says WHY, when forced)
+           "path": ("sharded" if snap.get("mesh.devices", 0)
+                    and snap.get("mesh.devices", 0) > 1 else "single_device"),
            "host_to_device_bytes_total": full["counters"].get(
                "host_to_device_bytes_total", 0)}
     if tracer is not None:
@@ -583,6 +594,9 @@ def run_text_sparse(N: int, on_accel: bool, platform: str):
     from transmogrifai_tpu.ops.transmogrify import transmogrify
     from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
                                             ModelCandidate, grid)
+    from transmogrifai_tpu.profiling import (install_compile_listeners,
+                                             new_compile_count,
+                                             racing_stats)
     from transmogrifai_tpu.sparse.transform import (reset_sparse_stats,
                                                     sparse_stats)
     from transmogrifai_tpu.workflow import Workflow
@@ -594,19 +608,24 @@ def run_text_sparse(N: int, on_accel: bool, platform: str):
     txt = FeatureBuilder.Text("txt").as_predictor()
     x0 = FeatureBuilder.Real("x0").as_predictor()
     fv = transmogrify([txt, x0], num_hashes=num_hashes)
+    grid_pts = grid(reg_param=[0.01, 0.1], max_iter=[50])
     selector = BinaryClassificationModelSelector(models=[
-        ModelCandidate(OpLogisticRegression(),
-                       grid(reg_param=[0.01, 0.1], max_iter=[50]),
+        ModelCandidate(OpLogisticRegression(), grid_pts,
                        "OpLogisticRegression")])
     selector.set_input(label, fv)
     pred = selector.get_output()
 
     reset_sparse_stats()
+    install_compile_listeners()
+    nc0 = new_compile_count()
     wf = Workflow().set_input_records(records).set_result_features(pred)
     t0 = time.time()
     model = wf.train()
     train_wall = time.time() - t0
     stats = sparse_stats()
+    new_compiles = new_compile_count() - nc0
+    fits_saved = racing_stats()["cv_fits_saved"]
+    n_cands = len(grid_pts)
 
     # compiled scoring in the SAME process — the acceptance bar is one
     # process training AND scoring with nnz-bounded peak memory
@@ -629,6 +648,7 @@ def run_text_sparse(N: int, on_accel: bool, platform: str):
         "aux": {
             "rows": N, "num_hashes": num_hashes, "platform": platform,
             "train_accuracy": round(acc, 4),
+            "best_model": model.selected_model.summary.best_model_name,
             "score_wall_s": round(score_wall, 2),
             "score_rows_per_s": round(N / max(score_wall, 1e-9)),
             "nnz_total": stats["nnz_total"],
@@ -636,6 +656,22 @@ def run_text_sparse(N: int, on_accel: bool, platform: str):
             "peak_rss_mb": round(peak_mb, 1),
             "dense_equivalent_mb": round(dense_equiv_mb, 1),
             "rss_vs_dense_equivalent": round(peak_mb / dense_equiv_mb, 4),
+            # mesh-scaling instrumentation (ISSUE 19): same contract as the
+            # dense workload so run_text_sparse_mesh can curve rows/s vs
+            # device count and pin winner parity across shardings
+            "cv_fits": 3 * n_cands - fits_saved,
+            "cv_fits_saved_by_racing": fits_saved,
+            "new_compiles_during_train": new_compiles,
+            "cv_fit_rows_per_s": round(
+                (3 * n_cands - fits_saved) * (2 * N / 3)
+                / max(train_wall, 1e-9)),
+            "degraded_mesh_notes": len(
+                [e for e in model.failure_log.events
+                 if e.action == "degraded"
+                 and e.point in ("selector.racing", "selector.mesh")]),
+            "telemetry": _telemetry_aux(None),
+            "memory": _memory_aux(),
+            "registry": _registry_aux(),
         },
     }
 
@@ -1247,6 +1283,120 @@ def run_mesh_sweep(N: int, on_accel: bool, platform: str):
     }
 
 
+def run_text_sparse_mesh(N: int, on_accel: bool, platform: str):
+    """`cv_fit_rows_per_s` vs device-count curve for the MESH-SHARDED SPARSE
+    sweep (ISSUE 19 headline): each point runs the hashed-text text_sparse
+    workload in a fresh child with `--xla_force_host_platform_device_count=K`
+    and TRANSMOGRIFAI_TPU_MESH forced for K > 1 — the DeviceTable entry
+    stream is what makes K > 1 possible at all for COO payloads.  Winner
+    parity across shardings is pinned in the aux, along with each point's
+    `device_table.*` telemetry and nnz-based memory plan.  A second phase
+    trains the same sparse model cold then registry-warm (fresh processes,
+    single device, fleet registry + managed compile cache at a temp root)
+    and reports both `new_compiles_during_train` counts — the sparse
+    fleet-warm story next to the scaling curve."""
+    import subprocess
+    import tempfile
+
+    counts = [int(c) for c in os.environ.get(
+        "BENCH_MESH_DEVICES", "1,8").split(",") if c.strip()]
+    try:
+        host_cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        host_cores = os.cpu_count() or 1
+
+    def _child(extra_env):
+        env = {**os.environ, "BENCH_WORKLOAD": "text_sparse",
+               "BENCH_SPARSE_ROWS": str(N), "BENCH_NO_RETRY": "1",
+               **extra_env}
+        if not on_accel:
+            env.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                capture_output=True, text=True, env=env,
+                timeout=int(os.environ.get("BENCH_CHILD_TIMEOUT_S", "2400")))
+        except subprocess.TimeoutExpired:
+            return {"rc": 124}
+        line = last_json_line(p.stdout)
+        if p.returncode != 0 or not line:
+            return {"rc": p.returncode,
+                    "stderr_tail": (p.stderr or "")[-1000:]}
+        rec = json.loads(line)
+        aux = rec.get("aux", {})
+        return {"rc": 0, "wall_s": rec.get("value"), "aux": aux}
+
+    points = {}
+    for k in counts:
+        extra = {"TRANSMOGRIFAI_TPU_MESH": "1" if k > 1 else "0"}
+        if not on_accel:
+            extra["XLA_FLAGS"] = (
+                f"--xla_force_host_platform_device_count={k} "
+                + os.environ.get("XLA_FLAGS", ""))
+        r = _child(extra)
+        aux = r.pop("aux", {})
+        points[str(k)] = dict(
+            r,
+            cv_fit_rows_per_s=aux.get("cv_fit_rows_per_s"),
+            winner=aux.get("best_model"),
+            cv_fits_saved_by_racing=aux.get("cv_fits_saved_by_racing"),
+            degraded_mesh_notes=aux.get("degraded_mesh_notes"),
+            nnz_total=aux.get("nnz_total"),
+            device_table=(aux.get("telemetry") or {}).get("device_table"),
+            path=(aux.get("telemetry") or {}).get("path"),
+            memory_plan=(aux.get("memory") or {}).get("plan"),
+        ) if r.get("rc") == 0 else r
+    ok = [p for p in points.values() if p.get("rc") == 0]
+    winners = {p.get("winner") for p in ok}
+    base = points.get(str(counts[0]), {})
+    top = points.get(str(counts[-1]), {})
+    speedup = None
+    if (base.get("cv_fit_rows_per_s") and top.get("cv_fit_rows_per_s")):
+        speedup = round(top["cv_fit_rows_per_s"]
+                        / base["cv_fit_rows_per_s"], 3)
+
+    # registry-warm phase: cold publish, then a FRESH process re-train whose
+    # grid-fit programs install from the fleet registry.  Single device
+    # (the registry seam serves unsharded programs; sharded leaves go
+    # through GSPMD layouts the publish side never saw).
+    registry = {}
+    if os.environ.get("BENCH_SPARSE_REGISTRY", "1") != "0":
+        with tempfile.TemporaryDirectory(prefix="bench-sparse-reg-") as root:
+            reg_env = {"TRANSMOGRIFAI_TPU_MESH": "0",
+                       "TRANSMOGRIFAI_AOT_REGISTRY": root,
+                       "TRANSMOGRIFAI_COMPILE_CACHE":
+                           os.path.join(root, "compile-cache")}
+            cold = _child(reg_env)
+            warm = _child(reg_env)
+            registry = {
+                "cold_rc": cold.get("rc"), "warm_rc": warm.get("rc"),
+                "cold_new_compiles_during_train":
+                    (cold.get("aux") or {}).get("new_compiles_during_train"),
+                "warm_new_compiles_during_train":
+                    (warm.get("aux") or {}).get("new_compiles_during_train"),
+                "warm_registry": (warm.get("aux") or {}).get("registry"),
+            }
+
+    return {
+        "metric": f"mesh-sharded SPARSE CV sweep rows/s curve "
+                  f"(hashed text {N} rows, devices={counts}, {platform})",
+        "value": top.get("cv_fit_rows_per_s") or 0,
+        "unit": "rows/s",
+        "vs_baseline": speedup or 0.0,
+        "aux": {
+            "rows": N, "platform": platform, "host_cores": host_cores,
+            "device_counts": counts, "points": points,
+            "winner_parity": len(winners) == 1 and len(ok) == len(counts),
+            "speedup_max_vs_min_devices": speedup,
+            "registry_warm": registry,
+            "simulated_mesh": not on_accel,
+            "note": (None if on_accel or host_cores >= max(counts) else
+                     f"forced host devices share {host_cores} core(s); "
+                     "rows/s scaling requires real parallel hardware"),
+        },
+    }
+
+
 def last_json_line(stdout: str):
     """The last JSON result line of a bench process' stdout (shared with
     scripts/run_scale_bench.py)."""
@@ -1387,6 +1537,9 @@ def main():
         ("selector_smoke", lambda: run_selector_smoke(on_accel, platform)),
         ("mesh_sweep", lambda: run_mesh_sweep(
             rows("BENCH_MESH_ROWS", 1_000_000, 65_536),
+            on_accel, platform)),
+        ("text_sparse_mesh", lambda: run_text_sparse_mesh(
+            rows("BENCH_SPARSE_MESH_ROWS", 100_000, 5_000),
             on_accel, platform)),
         ("serving_chaos", lambda: run_serving_chaos(on_accel, platform)),
         ("serve_cold_start", lambda: run_serve_cold_start(on_accel,

@@ -1,0 +1,284 @@
+"""CI smoke for the one device data plane (ISSUE 19): sparse COO payloads
+ride the same mesh/streaming/registry machinery as dense rows.
+
+Phase A — mesh parity: a fresh subprocess runs a hashed-text CV sweep at an
+indivisible row count (8 ∤ 2051) on a forced 8-virtual-device mesh, a second
+subprocess runs the identical sweep single-device.  Validate requires
+
+* the mesh sweep really sharded (``device_table.*`` stats populated, 8
+  shards, mesh device gauge == 8),
+* winner parity with metrics allclose and IDENTICAL racing prunes,
+* ZERO degraded ``selector.racing`` / ``selector.mesh`` notes — the sparse
+  carve-out is gone, not rerouted,
+* peak host staging <= 2x the streaming chunk budget (the double-buffer
+  bound now covers the three flat COO components).
+
+Phase B — registry warm train: a cold subprocess train (single device — the
+registry seam addresses unsharded leaves) populates the program registry and
+the managed compile cache; a second FRESH subprocess re-train must report
+``new_compiles_during_train == 0``: fleet-warm sparse trains.
+
+Usage:
+    python scripts/ci_sparse_mesh_smoke.py run OUT_DIR
+    python scripts/ci_sparse_mesh_smoke.py validate OUT_DIR
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+# runnable as `python scripts/ci_sparse_mesh_smoke.py` from the repo root
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SUMMARY_NAME = "sparse-mesh-smoke.json"
+ROWS = int(os.environ.get("SPARSE_MESH_SMOKE_ROWS", "2051"))  # 8 ∤ 2051
+CHUNK_BYTES = int(os.environ.get("SPARSE_MESH_SMOKE_CHUNK_BYTES", "65536"))
+METRIC_RTOL = 1e-4
+
+# sweep probe: hashed-text LR sweep; prints one JSON line with the winner,
+# per-candidate metrics/prunes, degraded notes, and the sparse data-plane
+# stats (device_table + streaming) so validate can pin the staging bound
+_SWEEP_CHILD = r"""
+import json, os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+n = int(sys.argv[1])
+rng = np.random.default_rng(3)
+half = 2000
+vpos = np.asarray([f"pos{i}" for i in range(half)])
+vneg = np.asarray([f"neg{i}" for i in range(half)])
+y = rng.integers(0, 2, n)
+toks_pos = vpos[rng.integers(0, half, size=(n, 8))]
+toks_neg = vneg[rng.integers(0, half, size=(n, 8))]
+txt = np.where(y[:, None] == 1, toks_pos, toks_neg)
+records = [{"label": float(y[i]), "txt": " ".join(txt[i]), "x0": float(v)}
+           for i, v in enumerate(rng.normal(size=n))]
+
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                        ModelCandidate, grid)
+from transmogrifai_tpu.workflow import Workflow
+from transmogrifai_tpu.parallel.device_table import device_table_stats
+from transmogrifai_tpu.parallel.streaming import streaming_stats
+from transmogrifai_tpu.parallel.memory import last_plan
+from transmogrifai_tpu.telemetry import REGISTRY
+
+label = FeatureBuilder.RealNN("label").as_response()
+t = FeatureBuilder.Text("txt").as_predictor()
+x0 = FeatureBuilder.Real("x0").as_predictor()
+fv = transmogrify([t, x0], num_hashes=4096)
+sel = BinaryClassificationModelSelector(models=[
+    ModelCandidate(OpLogisticRegression(),
+                   grid(reg_param=[0.001, 0.01, 0.03, 0.1, 0.3, 1.0],
+                        max_iter=[30]),
+                   "OpLogisticRegression")])
+sel.set_input(label, fv)
+wf = (Workflow().set_input_records(records)
+      .set_result_features(sel.get_output()))
+model = wf.train()
+s = model.selected_model.summary
+snap = REGISTRY.snapshot()
+plan = last_plan()
+print(json.dumps({
+    "devices": len(jax.devices()),
+    "mesh_devices_gauge": snap["gauges"].get("mesh.devices"),
+    "chunk_bytes_gauge": snap["gauges"].get("mesh.chunk_bytes"),
+    "winner": s.best_model_name,
+    "metrics": {str(sorted(r.params.items())):
+                float(r.metric_values[s.evaluation_metric])
+                for r in s.validation_results},
+    "raced_out": sorted(str(sorted(r.params.items()))
+                        for r in s.validation_results if r.raced_out),
+    "degraded_notes": sorted(
+        f"{e.point}:{e.action}" for e in model.failure_log.events
+        if e.action == "degraded"
+        and e.point in ("selector.racing", "selector.mesh")),
+    "device_table": device_table_stats(),
+    "streaming": streaming_stats(),
+    "memory_plan": plan.to_json() if plan is not None else None,
+}))
+"""
+
+# registry probe: train the same sparse workflow with compile listeners on;
+# argv[1] = bundle dir or "-" to skip saving (the warm re-train)
+_TRAIN_CHILD = r"""
+import json, sys, time
+t0 = time.time()
+from transmogrifai_tpu.profiling import (install_compile_listeners,
+                                         new_compile_count)
+install_compile_listeners()
+import numpy as np
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                        ModelCandidate, grid)
+from transmogrifai_tpu.workflow import Workflow
+
+rng = np.random.default_rng(7)
+n = 160
+y = rng.integers(0, 2, n)
+vocab = np.asarray([f"w{i}" for i in range(400)])
+toks = vocab[rng.integers(0, 400, size=(n, 6))]
+records = [{"label": float(y[i]),
+            "txt": " ".join(toks[i]) + (" hot" if y[i] else " cold"),
+            "x0": float(v)}
+           for i, v in enumerate(rng.normal(size=n))]
+label = FeatureBuilder.RealNN("label").as_response()
+t = FeatureBuilder.Text("txt").as_predictor()
+x0 = FeatureBuilder.Real("x0").as_predictor()
+fv = transmogrify([t, x0], num_hashes=4096)
+sel = BinaryClassificationModelSelector(models=[
+    ModelCandidate(OpLogisticRegression(),
+                   grid(reg_param=[0.01, 0.1], max_iter=[25]),
+                   "OpLogisticRegression")])
+sel.set_input(label, fv)
+wf = (Workflow().set_input_records(records)
+      .set_result_features(sel.get_output()))
+model = wf.train()
+from transmogrifai_tpu.aot import pretrace_drain
+pretrace_drain()
+train_compiles = new_compile_count()
+if sys.argv[1] != "-":
+    model.save(sys.argv[1])
+from transmogrifai_tpu.aot_registry import registry_stats
+print(json.dumps({
+    "new_compiles_during_train": train_compiles,
+    "winner": model.selected_model.summary.best_model_name,
+    "registry": registry_stats(),
+    "wall_s": round(time.time() - t0, 1),
+}))
+"""
+
+
+def _child(code, args, env):
+    p = subprocess.run([sys.executable, "-c", code, *args],
+                       capture_output=True, text=True, env=env, cwd=REPO,
+                       timeout=600)
+    line = next((ln for ln in reversed(p.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    if p.returncode != 0 or not line:
+        sys.stderr.write(p.stderr[-4000:])
+        raise SystemExit(f"child failed (rc={p.returncode})")
+    return json.loads(line)
+
+
+def run(out_dir):
+    os.makedirs(out_dir, exist_ok=True)
+    base = dict(os.environ, JAX_PLATFORMS="cpu")
+    for k in ("TRANSMOGRIFAI_AOT_REGISTRY", "TRANSMOGRIFAI_NO_AOT",
+              "TRANSMOGRIFAI_COMPILATION_CACHE", "XLA_FLAGS"):
+        base.pop(k, None)
+
+    # phase A: mesh parity.  Both runs force 8 virtual devices so numerics
+    # differ only by the mesh policy, never by the platform config
+    eight = dict(base, XLA_FLAGS="--xla_force_host_platform_device_count=8",
+                 TRANSMOGRIFAI_DEVICE_CHUNK_BYTES=str(CHUNK_BYTES))
+    single = _child(_SWEEP_CHILD, [str(ROWS)],
+                    dict(eight, TRANSMOGRIFAI_TPU_MESH="0"))
+    mesh = _child(_SWEEP_CHILD, [str(ROWS)],
+                  dict(eight, TRANSMOGRIFAI_TPU_MESH="1"))
+
+    # phase B: registry-warm sparse train.  Single device: the registry
+    # seam addresses unsharded leaves (sharded grid calls bypass it)
+    registry_root = os.path.join(out_dir, "registry")
+    reg_env = dict(base, TRANSMOGRIFAI_TPU_MESH="0",
+                   TRANSMOGRIFAI_AOT_LADDER_MAX="16",
+                   TRANSMOGRIFAI_AOT_REGISTRY=registry_root,
+                   TRANSMOGRIFAI_COMPILE_CACHE=os.path.join(
+                       registry_root, "compile-cache"))
+    cold = _child(_TRAIN_CHILD, [os.path.join(out_dir, "model")], reg_env)
+    warm = _child(_TRAIN_CHILD, ["-"], reg_env)
+
+    summary = {
+        "rows": ROWS,
+        "chunk_bytes": CHUNK_BYTES,
+        "single": single,
+        "mesh": mesh,
+        "cold": cold,
+        "warm": warm,
+    }
+    path = os.path.join(out_dir, SUMMARY_NAME)
+    with open(path, "w") as fh:
+        json.dump(summary, fh, indent=2)
+    print(f"wrote {path}: winner {mesh['winner']} "
+          f"(single {single['winner']}), "
+          f"{mesh['device_table']['shards']} sparse shards, warm train "
+          f"{warm['new_compiles_during_train']} compiles "
+          f"(cold {cold['new_compiles_during_train']})")
+    return 0
+
+
+def validate(out_dir):
+    with open(os.path.join(out_dir, SUMMARY_NAME)) as fh:
+        s = json.load(fh)
+    single, mesh, cold, warm = s["single"], s["mesh"], s["cold"], s["warm"]
+
+    # the sparse sweep really sharded — not a silent single-device fallback
+    assert mesh["devices"] == 8 and mesh["mesh_devices_gauge"] == 8, mesh
+    dt = mesh["device_table"]
+    assert dt["tables"] > 0 and dt["shards"] >= 8, dt
+    assert dt["nnz_streamed"] > 0, dt
+    assert single["device_table"]["tables"] == 0, \
+        "control sweep sharded too — parity check is vacuous"
+    plan = mesh["memory_plan"]
+    assert plan and plan.get("nnz"), \
+        f"mesh sweep planned without an nnz budget: {plan}"
+
+    # winner parity, metric agreement, identical racing prunes
+    assert mesh["winner"] == single["winner"], (mesh["winner"],
+                                                single["winner"])
+    assert mesh["metrics"].keys() == single["metrics"].keys()
+    for k, v0 in single["metrics"].items():
+        v1 = mesh["metrics"][k]
+        assert abs(v1 - v0) <= METRIC_RTOL * max(1.0, abs(v0)), (k, v0, v1)
+    assert mesh["raced_out"] == single["raced_out"], (single["raced_out"],
+                                                      mesh["raced_out"])
+    assert mesh["raced_out"], "racing pruned nothing — screen not exercised"
+
+    # honest-degrade bar: ZERO degraded racing/mesh notes on the mesh run
+    assert mesh["degraded_notes"] == [], mesh["degraded_notes"]
+
+    # the transfer bound covers sparse: peak staging <= 2x the chunk budget
+    st = mesh["streaming"]
+    budget = mesh["chunk_bytes_gauge"] or s["chunk_bytes"]
+    assert st["bytes_streamed"] > 0 and st["chunks"] > 0, st
+    assert st["peak_staging_bytes"] <= 2 * budget, (
+        f"peak host staging {st['peak_staging_bytes']} B > {2 * budget} B "
+        "(2x chunk) — sparse streaming is buffering more than two chunks")
+
+    # registry-warm sparse train: the compile ledger
+    assert cold["registry"]["publishes"] > 0 or cold["registry"]["hits"] > 0,\
+        f"cold train neither published nor hit: {cold['registry']}"
+    assert cold["new_compiles_during_train"] > 0, \
+        "cold sparse train compiled nothing — the warm assert is vacuous"
+    assert warm["new_compiles_during_train"] == 0, \
+        f"registry-warm fresh-process sparse train compiled " \
+        f"{warm['new_compiles_during_train']} programs"
+    assert warm["registry"]["hits"] > 0, \
+        f"warm train never hit the registry: {warm['registry']}"
+    assert warm["winner"] == cold["winner"] == mesh["winner"], \
+        (cold["winner"], warm["winner"], mesh["winner"])
+
+    print(f"OK: winner {mesh['winner']} on both layouts, "
+          f"{len(mesh['raced_out'])}/{len(mesh['metrics'])} raced out "
+          f"identically, {dt['shards']} sparse shards / "
+          f"{dt['nnz_streamed']} entries streamed, peak staging "
+          f"{st['peak_staging_bytes']} B <= {2 * budget} B, warm sparse "
+          f"train {warm['new_compiles_during_train']} compiles "
+          f"(cold {cold['new_compiles_during_train']})")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "run":
+        sys.exit(run(sys.argv[2]))
+    if len(sys.argv) == 3 and sys.argv[1] == "validate":
+        sys.exit(validate(sys.argv[2]))
+    sys.exit(f"usage: {sys.argv[0]} run OUT_DIR | validate OUT_DIR")

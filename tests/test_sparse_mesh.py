@@ -1,0 +1,403 @@
+"""One device data plane (ISSUE 19): sparse COO payloads are first-class
+citizens of mesh sharding, bounded streaming, memory planning, and the AOT
+registry — the same contracts test_mesh_sweep.py pins for dense rows.
+
+1. ``stream_to_device`` on a :class:`SparseMatrix` (via ``DeviceTable``)
+   assembles a row-sharded matrix whose densified content is BITWISE equal
+   to the host source, with host staging bounded by 2x the chunk budget
+   and ladder pad entries synthesized on-device (zero host-link bytes).
+2. A sparse hashed-text CV sweep at an indivisible row count picks the
+   same winner with the same metrics and the SAME racing prunes on the
+   8-device mesh as on a single device, with zero degraded
+   ``selector.racing``/``selector.mesh`` notes — the ``is_sparse`` mesh
+   carve-out is gone.
+3. A sparse text bundle exports aval-variant executables across the nnz
+   ladder and an AOT load serves a warmed token shape with ZERO new
+   traces, bit-identical to the JIT control.
+4. (slow) A fresh subprocess re-training the sparse workflow against a
+   warm program registry reports ``new_compiles_during_train == 0``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from transmogrifai_tpu.parallel import (DeviceTable, data_sharding,
+                                        device_table_stats, make_mesh,
+                                        reset_device_table_stats,
+                                        stream_to_device)
+from transmogrifai_tpu.parallel.streaming import (reset_streaming_stats,
+                                                  streaming_stats)
+from transmogrifai_tpu.sparse.matrix import SparseMatrix
+from transmogrifai_tpu.types import is_text_kind
+from transmogrifai_tpu.workflow import WorkflowModel
+
+needs_mesh = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs 8 virtual devices")
+
+
+# ---------------------------------------------------------------------------
+# 1. sparse streaming: bitwise content, sharded layout, staging bound
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_sparse_stream_bitwise_and_staging_bound():
+    """Chunked sparse streaming is a pure transport optimisation: densifying
+    the sharded matrix reproduces the host matrix bit for bit (every cell is
+    a single scatter addend — no reduction-order ambiguity), the flat
+    components divide evenly over the data axis, and the double-buffer bound
+    holds with pad entries costing zero host bytes."""
+    mesh = make_mesh(8)
+    n, d = 2051, 64
+    rng = np.random.default_rng(0)
+    dense = np.zeros((n, d), np.float32)
+    for i in range(n):                       # ~6 unique cols per row
+        cols = rng.choice(d, size=6, replace=False)
+        dense[i, cols] = rng.normal(size=6).astype(np.float32)
+    sm = SparseMatrix.from_dense(dense)
+
+    reset_streaming_stats()
+    reset_device_table_stats()
+    chunk = 4096                             # ~341 entries/chunk
+    pad_to = 2056                            # 8 * 257; 8 does not divide 2051
+    sms = stream_to_device(sm, mesh, pad_to=pad_to, chunk_bytes=chunk)
+
+    assert isinstance(sms, SparseMatrix)
+    assert sms.shape == (pad_to, d)
+    assert sms.nnz == sm.nnz
+    cap = int(sms.values.shape[0])
+    assert cap % 8 == 0, "flat capacity must divide over the data axis"
+    assert sms.values.sharding.is_equivalent_to(data_sharding(mesh, 1), 1)
+    assert sms.row_ids.sharding.is_equivalent_to(data_sharding(mesh, 1), 1)
+
+    got = np.asarray(sms.to_dense())
+    np.testing.assert_array_equal(got[:n], dense)
+    assert not got[n:].any(), "pad rows must stay empty"
+
+    st = streaming_stats()
+    assert st["chunks"] > 8, st              # actually chunked per shard
+    assert st["bytes_streamed"] == sm.nnz * 12   # real entries only
+    assert st["peak_staging_bytes"] <= 2 * chunk, st
+    dt = device_table_stats()
+    assert dt["tables"] == 1 and dt["shards"] == 8, dt
+    assert dt["rows"] == pad_to
+    assert dt["nnz_streamed"] == sm.nnz
+    assert dt["pad_entries"] == cap - sm.nnz
+
+
+@needs_mesh
+def test_device_table_nnz_rung_and_planner():
+    """The planner budget for a sparse payload comes from the sharded nnz
+    ladder rung, not rows x cols — the whole point of planning COO."""
+    from transmogrifai_tpu.parallel.memory import plan_sweep_memory
+    from transmogrifai_tpu.sparse.matrix import nnz_capacity
+    t = DeviceTable.from_coo(np.arange(5000) % 800, np.arange(5000) % 64,
+                             np.ones(5000, np.float32), 800, 100_000)
+    assert t.is_sparse and t.nnz == 5000
+    assert t.nnz_rung(1) == nnz_capacity(5000)
+    assert t.nnz_rung(8) == 8 * nnz_capacity(-(-5000 // 8))
+    plan = plan_sweep_memory(rows=800, cols=100_000, folds=3, grid_width=4,
+                             devices=8, nnz=5000)
+    dense_plan = plan_sweep_memory(rows=800, cols=100_000, folds=3,
+                                   grid_width=4, devices=8)
+    assert plan.nnz == 5000
+    assert plan.est_device_bytes < dense_plan.est_device_bytes
+    assert plan.to_json()["nnz"] == 5000
+
+
+# ---------------------------------------------------------------------------
+# 2. sparse sweep parity: mesh vs single device
+# ---------------------------------------------------------------------------
+
+def _sparse_sweep(n=2051):
+    """Hashed-text LR sweep at an indivisible row count; returns (winner,
+    {params: (metric, raced_out)}, degraded mesh/racing events)."""
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                            ModelCandidate, grid)
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(3)
+    half = 2000
+    vpos = np.asarray([f"pos{i}" for i in range(half)])
+    vneg = np.asarray([f"neg{i}" for i in range(half)])
+    y = rng.integers(0, 2, n)
+    toks_pos = vpos[rng.integers(0, half, size=(n, 8))]
+    toks_neg = vneg[rng.integers(0, half, size=(n, 8))]
+    txt = np.where(y[:, None] == 1, toks_pos, toks_neg)
+    records = [{"label": float(y[i]), "txt": " ".join(txt[i]),
+                "x0": float(v)}
+               for i, v in enumerate(rng.normal(size=n))]
+
+    label = FeatureBuilder.RealNN("label").as_response()
+    t = FeatureBuilder.Text("txt").as_predictor()
+    x0 = FeatureBuilder.Real("x0").as_predictor()
+    fv = transmogrify([t, x0], num_hashes=4096)
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(),
+                       grid(reg_param=[0.001, 0.01, 0.03, 0.1, 0.3, 1.0],
+                            max_iter=[30]),
+                       "OpLogisticRegression")])
+    sel.set_input(label, fv)
+    pred = sel.get_output()
+    wf = Workflow().set_input_records(records).set_result_features(pred)
+    model = wf.train()
+    s = model.selected_model.summary
+    res = {str(sorted(r.params.items())):
+           (float(r.metric_values[s.evaluation_metric]), r.raced_out)
+           for r in s.validation_results}
+    degraded = [f"{e.point}:{e.action}" for e in model.failure_log.events
+                if e.action == "degraded"
+                and e.point in ("selector.racing", "selector.mesh")]
+    return s.best_model_name, res, degraded
+
+
+@needs_mesh
+def test_sparse_sweep_mesh_parity_and_racing(monkeypatch):
+    """The sparse sweep (2051 rows -> 5 empty pad rows over 8 devices) picks
+    the same winner with the same metrics, races out the SAME candidates,
+    and records no degraded mesh/racing notes — sparse is no longer carved
+    out of the mesh path."""
+    monkeypatch.setenv("TRANSMOGRIFAI_TPU_MESH", "0")
+    b0, r0, _ = _sparse_sweep()
+    monkeypatch.setenv("TRANSMOGRIFAI_TPU_MESH", "1")
+
+    from transmogrifai_tpu import parallel as par
+    calls = []
+    real_make_mesh = par.make_mesh
+    monkeypatch.setattr(par, "make_mesh",
+                        lambda *a, **k: (calls.append(1) or
+                                         real_make_mesh(*a, **k)))
+    reset_device_table_stats()
+    b1, r1, notes1 = _sparse_sweep()
+    assert calls, "sparse sweep never engaged the mesh path"
+    dt = device_table_stats()
+    assert dt["tables"] > 0 and dt["shards"] > 0, dt
+
+    assert b1 == b0
+    assert r1.keys() == r0.keys()
+    pruned0 = {k for k, v in r0.items() if v[1]}
+    pruned1 = {k for k, v in r1.items() if v[1]}
+    assert pruned1 == pruned0
+    assert pruned0, "racing never pruned anything — screen not exercised"
+    for k in r0:
+        np.testing.assert_allclose(r1[k][0], r0[k][0], rtol=1e-4, atol=1e-5)
+    assert not notes1, notes1
+
+
+# ---------------------------------------------------------------------------
+# 3. sparse AOT: nnz-ladder export + zero-trace load round trip
+# ---------------------------------------------------------------------------
+
+def _train_sparse_text_model(n=160, num_hashes=4096):
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                            ModelCandidate, grid)
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(7)
+    y = rng.integers(0, 2, n)
+    vocab = np.asarray([f"w{i}" for i in range(400)])
+    toks = vocab[rng.integers(0, 400, size=(n, 6))]
+    records = [{"label": float(y[i]),
+                "txt": " ".join(toks[i]) + (" hot" if y[i] else " cold"),
+                "x0": float(v)}
+               for i, v in enumerate(rng.normal(size=n))]
+    label = FeatureBuilder.RealNN("label").as_response()
+    t = FeatureBuilder.Text("txt").as_predictor()
+    x0 = FeatureBuilder.Real("x0").as_predictor()
+    fv = transmogrify([t, x0], num_hashes=num_hashes)
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(),
+                       grid(reg_param=[0.01, 0.1], max_iter=[25]),
+                       "OpLogisticRegression")])
+    sel.set_input(label, fv)
+    wf = (Workflow().set_input_records(records)
+          .set_result_features(sel.get_output()))
+    return wf.train()
+
+
+@pytest.fixture(scope="module")
+def sparse_bundle(tmp_path_factory):
+    """A sparse text bundle exported with a high-density nnz-ladder warm so
+    at least one ladder size sees MORE than one input signature (floor rung
+    from the monoid-zero warm, a higher nnz rung from the token warm)."""
+    model = _train_sparse_text_model()
+    path = str(tmp_path_factory.mktemp("sparse-aot") / "model")
+    saved_env = {k: os.environ.get(k) for k in
+                 ("TRANSMOGRIFAI_NO_AOT", "TRANSMOGRIFAI_AOT_NNZ_LADDER",
+                  "TRANSMOGRIFAI_AOT_LADDER_MAX")}
+    os.environ.pop("TRANSMOGRIFAI_NO_AOT", None)
+    os.environ["TRANSMOGRIFAI_AOT_NNZ_LADDER"] = "600"
+    os.environ["TRANSMOGRIFAI_AOT_LADDER_MAX"] = "16"
+    try:
+        model.save(path)
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return path
+
+
+def _token_records(feats, size, k_tok):
+    text = " ".join(f"tok{j}" for j in range(k_tok))
+    return [{f.name: text for f in feats} for _ in range(size)]
+
+
+def _score_batch(model, records):
+    from transmogrifai_tpu.serving.engine import records_to_batch
+    pred = next(f.name for f in model.result_features)
+    batch = records_to_batch(model.raw_features, records)
+    scored = model.score(batch=batch)
+    return {k: np.asarray(v) for k, v in scored[pred].values.items()}
+
+
+def test_sparse_export_writes_nnz_variants(sparse_bundle):
+    """The bundle ships aval-variant executables: the same (uids, rows) key
+    exported once per input signature, tagged with argSig in the index."""
+    aot_dir = os.path.join(sparse_bundle, "aot-" + jax.default_backend())
+    assert os.path.isdir(aot_dir)
+    with open(os.path.join(aot_dir, "aot.json")) as fh:
+        meta = json.load(fh)
+    assert meta["executables"], "no executables exported"
+    sigs = [e for e in meta["executables"] if e.get("argSig")]
+    assert sigs, "nnz-ladder warm produced no aval-variant executables"
+    assert any(e["file"].endswith("-v00.aotx") or "-v" in e["file"]
+               for e in sigs)
+
+
+def test_sparse_aot_load_scores_warmed_shape_with_zero_traces(
+        sparse_bundle, monkeypatch):
+    """An AOT load of the sparse bundle serves a token batch at a warmed
+    (size, density) point from shipped executables — zero new traces — and
+    bit-identically to the same bundle forced onto the JIT path."""
+    from transmogrifai_tpu.compiled import trace_count
+    loaded = WorkflowModel.load(sparse_bundle)
+    assert loaded.aot_executables > 0
+    assert loaded.score_program().aot_installed_count() > 0
+
+    text_feats = [f for f in loaded.raw_features
+                  if f.kind is not None and is_text_kind(f.kind)]
+    assert text_feats, "fixture model lost its text features"
+    # size 4 x 600 tokens: exactly what the export's nnz-ladder warm scored
+    recs = _token_records(text_feats, 4, 600)
+    # the first score re-learns the host-segment split (an aborted partition
+    # probe counts one trace but compiles nothing); after that every segment
+    # at this warmed shape must serve from shipped executables, trace-free
+    prog = loaded.score_program()
+    variants_before = len(prog._aot_variants)
+    got = _score_batch(loaded, recs)
+    t0 = trace_count()
+    got = _score_batch(loaded, recs)
+    assert trace_count() == t0, "warmed sparse shape still traced"
+    # the aval variants actually served — none was popped by a dispatch
+    # failure falling back to JIT
+    assert len(prog._aot_variants) == variants_before
+
+    monkeypatch.setenv("TRANSMOGRIFAI_NO_AOT", "1")
+    jit = WorkflowModel.load(sparse_bundle)
+    assert jit.aot_executables == 0
+    monkeypatch.delenv("TRANSMOGRIFAI_NO_AOT")
+    want = _score_batch(jit, recs)
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k])
+
+
+# ---------------------------------------------------------------------------
+# 4. registry round trip for the sparse grid program (fresh subprocesses)
+# ---------------------------------------------------------------------------
+
+_REGISTRY_CHILD = r"""
+import json, sys
+from transmogrifai_tpu.profiling import (install_compile_listeners,
+                                         new_compile_count)
+install_compile_listeners()
+import numpy as np
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models.linear import OpLogisticRegression
+from transmogrifai_tpu.ops.transmogrify import transmogrify
+from transmogrifai_tpu.selector import (BinaryClassificationModelSelector,
+                                        ModelCandidate, grid)
+from transmogrifai_tpu.workflow import Workflow
+
+rng = np.random.default_rng(7)
+n = 160
+y = rng.integers(0, 2, n)
+vocab = np.asarray([f"w{i}" for i in range(400)])
+toks = vocab[rng.integers(0, 400, size=(n, 6))]
+records = [{"label": float(y[i]),
+            "txt": " ".join(toks[i]) + (" hot" if y[i] else " cold"),
+            "x0": float(v)}
+           for i, v in enumerate(rng.normal(size=n))]
+label = FeatureBuilder.RealNN("label").as_response()
+t = FeatureBuilder.Text("txt").as_predictor()
+x0 = FeatureBuilder.Real("x0").as_predictor()
+fv = transmogrify([t, x0], num_hashes=4096)
+sel = BinaryClassificationModelSelector(models=[
+    ModelCandidate(OpLogisticRegression(),
+                   grid(reg_param=[0.01, 0.1], max_iter=[25]),
+                   "OpLogisticRegression")])
+sel.set_input(label, fv)
+wf = (Workflow().set_input_records(records)
+      .set_result_features(sel.get_output()))
+model = wf.train()
+from transmogrifai_tpu.aot import pretrace_drain
+pretrace_drain()
+if sys.argv[1] != "-":
+    model.save(sys.argv[1])
+from transmogrifai_tpu.aot_registry import registry_stats
+print(json.dumps({
+    "new_compiles_during_train": new_compile_count(),
+    "winner": model.selected_model.summary.best_model_name,
+    "registry": registry_stats(),
+}))
+"""
+
+
+@pytest.mark.slow
+def test_sparse_grid_registry_warm_train_zero_compiles(tmp_path):
+    """Cold subprocess train publishes the sparse grid programs; a warm
+    fresh subprocess re-train compiles NOTHING — the fleet-warm story now
+    covers the hashed-text regime."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("TRANSMOGRIFAI_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TRANSMOGRIFAI_TPU_MESH"] = "0"
+    env["TRANSMOGRIFAI_AOT_LADDER_MAX"] = "16"
+    env["TRANSMOGRIFAI_AOT_REGISTRY"] = str(tmp_path / "registry")
+    env["TRANSMOGRIFAI_COMPILE_CACHE"] = str(tmp_path / "registry"
+                                             / "compile-cache")
+
+    def child(bundle):
+        p = subprocess.run([sys.executable, "-c", _REGISTRY_CHILD, bundle],
+                           capture_output=True, text=True, env=env,
+                           timeout=600,
+                           cwd=os.path.dirname(os.path.dirname(
+                               os.path.abspath(__file__))))
+        line = next((ln for ln in reversed(p.stdout.splitlines())
+                     if ln.startswith("{")), None)
+        assert p.returncode == 0 and line, p.stderr[-4000:]
+        return json.loads(line)
+
+    cold = child(str(tmp_path / "model"))
+    assert cold["registry"]["publishes"] > 0 or cold["registry"]["hits"] > 0
+    assert cold["new_compiles_during_train"] > 0, \
+        "cold sparse train compiled nothing — warm assert would be vacuous"
+
+    warm = child("-")
+    assert warm["new_compiles_during_train"] == 0, warm
+    assert warm["registry"]["hits"] > 0, warm
+    assert warm["winner"] == cold["winner"]

@@ -161,6 +161,38 @@ def _export_bundle_inner(model, bundle_dir: str) -> int:
                     record_failure("workflow.save", "swallowed", e,
                                    point="checkpoint.aot",
                                    detail=f"AOT warm at batch size {size}")
+            # nnz-ladder warm (ISSUE 19): a sparse (hashed-text) frontier
+            # column's flat-component shape is its nnz CAPACITY — the
+            # monoid-zero records above only exercise the floor rung
+            # (nnz=0 → cap 1024, which already serves every real batch with
+            # ≤1024 entries).  Synthetic token records push the program
+            # across higher nnz rungs so those serve with zero compiles
+            # too.  Densities are tokens/record
+            # (TRANSMOGRIFAI_AOT_NNZ_LADDER, comma-separated, "" disables);
+            # models without text features skip — same records, same avals,
+            # no new table entries.
+            from .types import is_text_kind
+            text_feats = [f for f in model.raw_features
+                          if f.kind is not None and is_text_kind(f.kind)]
+            densities = []
+            for tok in os.environ.get("TRANSMOGRIFAI_AOT_NNZ_LADDER",
+                                      "32").split(","):
+                with contextlib.suppress(ValueError):
+                    if int(tok) > 0:
+                        densities.append(int(tok))
+            for k_tok in densities if text_feats else []:
+                text = " ".join(f"tok{j}" for j in range(k_tok))
+                for size in sizes:
+                    try:
+                        recs = [{f.name: text for f in text_feats}
+                                for _ in range(size)]
+                        batch = records_to_batch(model.raw_features, recs)
+                        model.score(batch=batch)
+                    except Exception as e:  # noqa: BLE001
+                        record_failure("workflow.save", "swallowed", e,
+                                       point="checkpoint.aot",
+                                       detail=f"AOT nnz warm at batch size "
+                                              f"{size} x {k_tok} tokens")
         keys = [k for k in program._jitted
                 if k in program._input_specs
                 and (k in before or k[2] in sizes)]
@@ -189,33 +221,52 @@ def _export_bundle_inner(model, bundle_dir: str) -> int:
         try:
             for i, key in enumerate(sorted(keys,
                                            key=lambda k: (k[2], k[0]))):
-                try:
-                    rec = _serialize_key(program, key)
-                    if not aot_registry.payload_roundtrips(rec):
-                        # the executable came out of the persistent compile
-                        # cache (its payload deserializes to "Symbols not
-                        # found") — re-lower + re-compile once with every
-                        # cache layer suspended so the bundle ships an
-                        # installable build instead of silently skipping
-                        _count("aot_registry.recompiles_for_publish")
-                        with aot_registry.fresh_compile_env():
-                            rec = _serialize_key(program, key)
+                # aval variants (ISSUE 19): a key that saw more than one
+                # input signature (sparse nnz rungs) exports one record per
+                # signature; single-variant keys export the legacy record —
+                # byte-compatible with pre-variant bundles
+                variants = program._input_spec_variants.get(key) or {}
+                if len(variants) > 1:
+                    jobs = sorted(variants.items())
+                else:
+                    jobs = [(None, None)]
+                for j, (sig, specs) in enumerate(jobs):
+                    try:
+                        rec = _serialize_key(program, key, specs=specs,
+                                             sig=sig)
                         if not aot_registry.payload_roundtrips(rec):
-                            raise RuntimeError(
-                                "payload does not deserialize even after a "
-                                "cache-suspended rebuild")
-                except Exception as e:  # noqa: BLE001 — per-key best effort
-                    record_failure("workflow.save", "swallowed", e,
-                                   point="checkpoint.aot",
-                                   detail=f"AOT serialize rows={key[2]}")
-                    continue
-                fname = f"seg-{i:03d}.aotx"
-                with open(os.path.join(out_dir, fname), "wb") as f:
-                    f.write(rec)
-                index.append({"file": fname, **_key_json(key)})
-                written += 1
-                if family:
-                    aot_registry.publish_score(family, key, program, rec)
+                            # the executable came out of the persistent
+                            # compile cache (its payload deserializes to
+                            # "Symbols not found") — re-lower + re-compile
+                            # once with every cache layer suspended so the
+                            # bundle ships an installable build instead of
+                            # silently skipping
+                            _count("aot_registry.recompiles_for_publish")
+                            with aot_registry.fresh_compile_env():
+                                rec = _serialize_key(program, key,
+                                                     specs=specs, sig=sig)
+                            if not aot_registry.payload_roundtrips(rec):
+                                raise RuntimeError(
+                                    "payload does not deserialize even "
+                                    "after a cache-suspended rebuild")
+                    except Exception as e:  # noqa: BLE001 — best effort
+                        record_failure("workflow.save", "swallowed", e,
+                                       point="checkpoint.aot",
+                                       detail=f"AOT serialize "
+                                              f"rows={key[2]}")
+                        continue
+                    fname = (f"seg-{i:03d}.aotx" if sig is None
+                             else f"seg-{i:03d}-v{j:02d}.aotx")
+                    with open(os.path.join(out_dir, fname), "wb") as f:
+                        f.write(rec)
+                    ent = {"file": fname, **_key_json(key)}
+                    if sig is not None:
+                        ent["argSig"] = sig
+                    index.append(ent)
+                    written += 1
+                    if family:
+                        aot_registry.publish_score(family, key, program,
+                                                   rec, specs=specs)
         finally:
             jax.config.update("jax_enable_compilation_cache", prev_cache)
         if family:
@@ -234,10 +285,18 @@ def _export_bundle_inner(model, bundle_dir: str) -> int:
         return written
 
 
-def _serialize_key(program, key: Tuple) -> bytes:
+def _serialize_key(program, key: Tuple, specs: Any = None,
+                   sig: Optional[str] = None) -> bytes:
+    """Lower+compile+serialize one program-table entry.  ``specs``/``sig``
+    select an aval VARIANT (ISSUE 19): sparse frontier columns put an
+    nnz-capacity degree of freedom in the avals that the 3-field key cannot
+    see, so multi-variant keys export one record per observed signature
+    (tagged ``argSig``); single-variant keys stay byte-compatible with
+    pre-variant bundles."""
     from jax.experimental.serialize_executable import serialize
     jitted, canon_out = program._jitted[key]
-    specs = program._input_specs[key]
+    if specs is None:
+        specs = program._input_specs[key]
     compiled = jitted.lower(specs).compile()
     payload, in_tree, out_tree = serialize(compiled)
     rec = {
@@ -248,6 +307,8 @@ def _serialize_key(program, key: Tuple) -> bytes:
         "inTree": in_tree,
         "outTree": out_tree,
     }
+    if sig is not None:
+        rec["argSig"] = sig
     buf = io.BytesIO()
     pickle.dump(rec, buf, protocol=4)
     return buf.getvalue()
@@ -316,7 +377,8 @@ def install_bundle(model, bundle_path: str) -> int:
             # device memory
             fn = shared_load(hashlib.sha256(raw).hexdigest(), rec)
             program.install_executable(_key_tuple(rec["key"]), fn,
-                                       rec["canonOut"], rec["metas"])
+                                       rec["canonOut"], rec["metas"],
+                                       sig=rec.get("argSig"))
             installed += 1
         except Exception as e:  # noqa: BLE001
             _fallback(f"undeserializable executable "

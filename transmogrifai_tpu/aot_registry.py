@@ -782,11 +782,15 @@ def score_key(family: str, key_tuple: Tuple, arrays: Any) -> str:
 
 
 def publish_score(family: str, key_tuple: Tuple, program,
-                  rec_bytes: bytes) -> bool:
+                  rec_bytes: bytes, specs: Any = None) -> bool:
     """Publish one export-serialized scoring executable (``aot.py``'s
     ``_serialize_key`` record — a fresh build, the export loop already
-    compiles with the persistent cache disabled)."""
-    specs = program._input_specs.get(key_tuple)
+    compiles with the persistent cache disabled).  ``specs`` overrides the
+    program's first-call avals — the aval-VARIANT seam (ISSUE 19): sparse
+    nnz rungs publish one executable per observed input signature under
+    the same program-table key."""
+    if specs is None:
+        specs = program._input_specs.get(key_tuple)
     if specs is None:
         return False
     key = score_key(family, key_tuple, specs)
@@ -795,12 +799,16 @@ def publish_score(family: str, key_tuple: Tuple, program,
                     "rung": int(key_tuple[2])})
 
 
-def try_install_score(program, key_tuple: Tuple, arrays: Any) -> bool:
+def try_install_score(program, key_tuple: Tuple, arrays: Any,
+                      sig: Optional[str] = None) -> bool:
     """Consumer side of the scoring seam, called by ``ScoreProgram`` right
     before it would dispatch a freshly-traced program: a registry hit
     installs the published executable over the jit entry, so the call runs
     with zero compiles (pool workers booting on AOT-less bundles, tenants
-    activating, lifecycle re-scores)."""
+    activating, lifecycle re-scores).  With ``sig`` (the caller's canonical
+    aval signature) the executable installs as a per-(key, sig) VARIANT —
+    the registry address already hashes the avals, so each sparse nnz rung
+    resolves to its own published build."""
     from .resilience import record_failure
     family = getattr(program, "registry_family", None)
     if not (family and registry_enabled()):
@@ -813,7 +821,7 @@ def try_install_score(program, key_tuple: Tuple, arrays: Any) -> bool:
         rec = pickle.loads(payload)
         fn = shared_load(key, rec)
         program.install_executable(key_tuple, fn, rec["canonOut"],
-                                   rec["metas"])
+                                   rec["metas"], sig=sig)
         return True
     except Exception as e:  # noqa: BLE001 — stay on the jit path
         record_failure("aot_registry", "degraded", e,

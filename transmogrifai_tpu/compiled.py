@@ -83,6 +83,21 @@ def compile_attribution() -> Dict[str, Any]:
             **compile_stats()}
 
 
+def _args_sig(arrays) -> Optional[str]:
+    """Canonical JSON input-aval signature of one call's argument pytree —
+    the VARIANT coordinate for per-(key, sig) AOT executables.  The program
+    key carries only (stages, keep_intermediate, rows); sparse frontier
+    columns add an nnz-capacity degree of freedom only the avals see."""
+    try:
+        import json
+
+        from .aot_registry import args_signature
+        return json.dumps(args_signature(arrays), sort_keys=True,
+                          default=repr)
+    except Exception:  # noqa: BLE001 — unsignable args are just unexported
+        return None
+
+
 class _StageTraceError(Exception):
     """Tracing failed inside a specific stage; carries the stage uid."""
 
@@ -124,23 +139,46 @@ class ScoreProgram:
         # deserialized pre-compiled executable rather than a jit wrapper
         self._input_specs: Dict[Tuple, Any] = {}
         self._aot_installed: Set[Tuple] = set()
+        # aval-variant seam (ISSUE 19): the program-table key carries only
+        # (stage uids, keep_intermediate, rows) — sparse frontier columns
+        # add an nnz-capacity degree of freedom the key cannot see.  Every
+        # distinct input-aval signature observed per key records its specs
+        # here (what export lowers against), and pre-compiled executables
+        # for specific signatures install per (key, sig) so one padded row
+        # rung serves the whole nnz ladder with zero traces.
+        self._input_spec_variants: Dict[Tuple, Dict[str, Any]] = {}
+        self._aot_variants: Dict[Tuple[Tuple, str], Tuple] = {}
+        # (key, sig) pairs already offered to the fleet registry — a miss is
+        # memoized so steady-state calls pay zero registry lookups
+        self._registry_checked: Set[Tuple] = set()
         # model-content digest tying this program to the fleet registry
         # (aot_registry.py); set by workflow load/save, None = no registry
         self.registry_family: Optional[str] = None
 
     def install_executable(self, key: Tuple, fn: Any,
                            canon_out: Dict[str, str],
-                           metas: Dict[str, Any]) -> None:
+                           metas: Dict[str, Any],
+                           sig: Optional[str] = None) -> None:
         """Install a deserialized AOT executable for ``key`` — subsequent
         calls at that exact (stages, rows) signature dispatch straight to it
         with zero traces and zero compiles.  A call-time failure (shape or
-        ABI drift the stamp missed) uninstalls it and falls back to jit."""
+        ABI drift the stamp missed) uninstalls it and falls back to jit.
+
+        With ``sig`` (an input-aval signature, see ``_args_sig``) the
+        executable installs as a VARIANT for that exact signature only: the
+        key's jit entry stays intact, so calls at other signatures (e.g.
+        other sparse nnz capacities) still trace/compile correctly instead
+        of crashing into a mis-shaped executable."""
+        if sig is not None:
+            self._aot_variants[(key, sig)] = (fn, dict(canon_out),
+                                              dict(metas))
+            return
         self._jitted[key] = (fn, dict(canon_out))
         self._metas[key] = dict(metas)
         self._aot_installed.add(key)
 
     def aot_installed_count(self) -> int:
-        return len(self._aot_installed)
+        return len(self._aot_installed) + len(self._aot_variants)
 
     # -- partition ----------------------------------------------------------
     def _partition(self, batch: ColumnBatch) -> List[Tuple[bool, List[Transformer]]]:
@@ -315,6 +353,7 @@ class ScoreProgram:
                   for n in frontier}
         arrays.update({canon_in[k]: (_prep(v), None)
                        for k, v in wires.items()})
+        sig = _args_sig(arrays)
         if key not in self._input_specs:
             try:
                 # unsharded host-side avals — what AOT export lowers against
@@ -322,6 +361,17 @@ class ScoreProgram:
                     lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), arrays)
             except Exception:  # noqa: BLE001 — a non-array wire entry just
                 pass           # makes this key non-exportable
+        if sig is not None and sig not in self._input_spec_variants.get(
+                key, {}):
+            try:
+                # every observed aval signature keeps its own exportable
+                # specs: sparse nnz capacities vary per call under one key
+                self._input_spec_variants.setdefault(key, {})[sig] = \
+                    jax.tree_util.tree_map(
+                        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                        arrays)
+            except Exception:  # noqa: BLE001 — unexportable variant
+                pass
         # host-resident wire args copy to the device inside the jit call (or
         # in the sharding block below); count them toward the phase's link
         # bytes BEFORE _shard turns them into jax Arrays
@@ -352,12 +402,43 @@ class ScoreProgram:
                 record_failure("compiled", "degraded", e,
                                point="compiled.shard",
                                fallback="unsharded program")
-        if fresh and mesh is None and key not in self._aot_installed:
+        if (mesh is None and key not in self._aot_installed
+                and (key, sig) not in self._aot_variants
+                and (key, sig) not in self._registry_checked):
             # fleet-registry seam: a published executable for this exact
             # (family, stages, rows, avals) installs over the untraced jit
-            # entry — the dispatch below then runs with zero compiles
+            # entry (or as an aval variant when the signature is known) —
+            # the dispatch below then runs with zero compiles.  Misses are
+            # memoized per (key, sig) so steady-state traffic pays zero
+            # registry lookups.
+            self._registry_checked.add((key, sig))
             from .aot_registry import try_install_score
-            try_install_score(self, key, arrays)
+            try_install_score(self, key, arrays, sig=sig)
+        if mesh is None and sig is not None:
+            var = self._aot_variants.get((key, sig))
+            if var is not None:
+                # variant fast path: a pre-compiled executable for this
+                # exact aval signature — zero traces, zero compiles, own
+                # metas; the key's jit entry stays warm as the fallback
+                vfn, v_canon_out, v_metas = var
+                try:
+                    maybe_inject("compiled.segment", key=run[0].uid)
+                    out_c = vfn(arrays)
+                    out = {n: out_c[c] for n, c in v_canon_out.items()}
+                    new_cols = {}
+                    for n, (v, m) in out.items():
+                        meta, kind = v_metas[n]
+                        new_cols[n] = Column(kind, v, m, meta=meta)
+                    return batch.with_columns(new_cols)
+                except Exception as e:  # noqa: BLE001 — variants are an
+                    # optimization: a rejected dispatch (aval drift the sig
+                    # missed) falls through to the ordinary jit path below
+                    record_failure("compiled", "degraded", e,
+                                   point="compiled.aot",
+                                   fallback="JIT recompile")
+                    from .telemetry import REGISTRY
+                    REGISTRY.counter("aot.fallback").inc()
+                    self._aot_variants.pop((key, sig), None)
         jitted, canon_out_map = self._jitted[key]
         from .profiling import cost_analysis_enabled, record_program_cost
         if cost_analysis_enabled():

@@ -8,6 +8,8 @@ reference's parallelism mechanisms (SURVEY.md §2.6):
   P7 Spark shuffle/broadcast                    → XLA collectives via GSPMD
 """
 
+from .device_table import (DeviceTable, device_table_stats,
+                           reset_device_table_stats)
 from .mesh import (candidate_mesh_for, candidate_sharding, data_axis_size,
                    data_sharding, make_mesh, maybe_data_mesh,
                    model_axis_size, model_axis_width, pad_rows_for,
@@ -45,6 +47,7 @@ __all__ = [
     "HostLostError", "barrier_sync", "hostgroup_env_present",
     "launch_hosts", "maybe_init_hostgroup",
     "stream_to_device", "streaming_stats", "device_chunk_bytes",
+    "DeviceTable", "device_table_stats", "reset_device_table_stats",
     "HostMemoryPressure", "MemoryExhaustedError", "MemoryPlan",
     "RssWatchdog", "check_host_pressure", "device_memory_budget",
     "is_memory_exhaustion", "memory_governor_enabled", "plan_sweep_memory",

@@ -1,0 +1,321 @@
+"""One device data plane: ``DeviceTable`` over dense and COO payloads.
+
+Dense rows got mesh sharding, bounded-chunk streaming, memory planning and
+AOT zero-compile serving; sparse COO (the 100k-column hashed-text regime)
+stayed single-device because the flat entry stream had no row-sharding
+story.  ``DeviceTable`` is that story:
+
+  * **row partitioning** — entries sort by row (stable, so same-row entry
+    order is preserved) and partition exactly at the mesh's device row-shard
+    boundaries via ``searchsorted``; ``row_ids`` stay GLOBAL, so every
+    segment-sum consumer (``sp_matvec`` and friends) is already correct
+    under GSPMD without per-shard rebasing;
+  * **nnz ladder** — each device shard pads to one COMMON per-device entry
+    capacity on the same {2^k, 1.5*2^k} ladder dense fit shapes use, so the
+    assembled flat components divide evenly over the 'data' axis and the
+    jitted programs specialize on a small set of capacities.  Pad entries
+    are ``value 0.0`` — an exact zero addend for every segment sum;
+  * **bounded streaming** — each shard's real entries ship in chunks under
+    the same ``TRANSMOGRIFAI_DEVICE_CHUNK_BYTES`` budget as dense rows
+    (the three flat components stage together, 12 B per entry), reusing the
+    streaming module's double-buffer accounting so the ≤2×-chunk peak
+    staging bound covers sparse too.  Pad entries synthesize on-device —
+    zero host-link bytes;
+  * **hostgroup addressing** — ``row_offset`` / ``global_rows`` position a
+    local row slice in the global row space, mirroring
+    ``stream_to_device``'s multi-process contract;
+  * **memory planning / AOT stability** — ``nnz`` (ladder-rounded) is what
+    ``plan_sweep_memory`` budgets for sparse payloads, and the sharded
+    result is a plain :class:`SparseMatrix` (pytree-stable flat arrays), so
+    the registry/AOT seams see the same leaf layout as the single-device
+    path.
+
+Counters (``device_table_stats`` / ``reset_device_table_stats``) surface as
+read-through gauges ``device_table.*`` in ``telemetry.REGISTRY`` and ride
+the bench ``aux.telemetry.mesh`` block next to the dense ``mesh.*`` family.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import data_axis_size, data_sharding
+
+# one COO entry = f32 value + i32 col + i32 row = 12 host bytes; the three
+# flat components stage together under one chunk budget
+_ENTRY_BYTES = 12
+
+_lock = threading.Lock()
+_STATS = {
+    "tables": 0,          # DeviceTable payloads shipped
+    "rows": 0,            # logical rows shipped (padded row space)
+    "nnz_streamed": 0,    # real COO entries moved over the host link
+    "pad_entries": 0,     # ladder pad entries synthesized on-device
+    "shards": 0,          # per-device shards assembled
+}
+
+
+def device_table_stats() -> dict:
+    with _lock:
+        return dict(_STATS)
+
+
+def reset_device_table_stats() -> None:
+    with _lock:
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def _bump(**kv) -> None:
+    with _lock:
+        for k, v in kv.items():
+            _STATS[k] += int(v)
+
+
+class DeviceTable:
+    """A host-side table (dense rows or COO entries) ready to ship to the
+    data mesh.  ``kind`` is ``"dense"`` or ``"sparse"``; either way
+    ``to_device(mesh, ...)`` returns the device-resident, row-sharded form
+    (a ``jax.Array`` or a :class:`SparseMatrix`) with peak host staging
+    bounded by ~2× the chunk budget."""
+
+    __slots__ = ("kind", "payload", "n_rows", "n_cols", "row_offset",
+                 "global_rows", "_coo")
+
+    def __init__(self, kind: str, payload, n_rows: int, n_cols: int, *,
+                 row_offset: int = 0, global_rows: Optional[int] = None,
+                 coo: Optional[Tuple] = None):
+        self.kind = kind
+        self.payload = payload
+        self.n_rows = int(n_rows)
+        self.n_cols = int(n_cols)
+        self.row_offset = int(row_offset)
+        self.global_rows = int(global_rows) if global_rows is not None \
+            else self.row_offset + self.n_rows
+        self._coo = coo
+
+    # ---- construction -------------------------------------------------
+    @classmethod
+    def from_dense(cls, arr, *, row_offset: int = 0,
+                   global_rows: Optional[int] = None) -> "DeviceTable":
+        host = np.asarray(arr)
+        rows = host.shape[0]
+        cols = host.shape[1] if host.ndim == 2 else 1
+        return cls("dense", host, rows, cols, row_offset=row_offset,
+                   global_rows=global_rows)
+
+    @classmethod
+    def from_sparse(cls, sm, *, row_offset: int = 0,
+                    global_rows: Optional[int] = None) -> "DeviceTable":
+        """From a :class:`SparseMatrix` (device or host components): pulls
+        the REAL entries host-side and row-sorts them (stable — same-row
+        entry order is preserved, so segment sums see the same addend order
+        per row)."""
+        r, c, v = sm.host_coo()
+        order = np.argsort(r, kind="stable")
+        coo = (np.asarray(r, np.int32)[order], np.asarray(c, np.int32)[order],
+               np.asarray(v, np.float32)[order])
+        return cls("sparse", sm, int(sm.n_rows), int(sm.n_cols),
+                   row_offset=row_offset, global_rows=global_rows, coo=coo)
+
+    @classmethod
+    def from_coo(cls, rows, cols, vals, n_rows: int, n_cols: int, *,
+                 row_offset: int = 0,
+                 global_rows: Optional[int] = None) -> "DeviceTable":
+        r = np.asarray(rows, np.int32)
+        order = np.argsort(r, kind="stable")
+        coo = (r[order], np.asarray(cols, np.int32)[order],
+               np.asarray(vals, np.float32)[order])
+        return cls("sparse", None, int(n_rows), int(n_cols),
+                   row_offset=row_offset, global_rows=global_rows, coo=coo)
+
+    # ---- shape / planning protocol ------------------------------------
+    @property
+    def is_sparse(self) -> bool:
+        return self.kind == "sparse"
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.n_rows, self.n_cols)
+
+    @property
+    def nnz(self) -> int:
+        if self.is_sparse:
+            return int(len(self._coo[0]))
+        return int(self.n_rows * self.n_cols)
+
+    @property
+    def nbytes(self) -> int:
+        """Host bytes the stream will move (real payload, before pads)."""
+        if self.is_sparse:
+            return self.nnz * _ENTRY_BYTES
+        return int(np.asarray(self.payload).nbytes)
+
+    def nnz_rung(self, extent: int = 1) -> int:
+        """Ladder-rounded TOTAL entry capacity after sharding over
+        ``extent`` devices — what the memory planner budgets."""
+        from ..sparse.matrix import nnz_capacity
+        if not self.is_sparse:
+            return self.nnz
+        extent = max(1, int(extent))
+        if extent == 1:
+            return nnz_capacity(self.nnz)
+        per = -(-self.nnz // extent)
+        return extent * nnz_capacity(per)
+
+    # ---- device shipment ----------------------------------------------
+    def to_device(self, mesh, *, pad_to: Optional[int] = None,
+                  chunk_bytes: Optional[int] = None):
+        """Ship this table to the mesh, row-sharded over 'data'.
+
+        Dense tables delegate to :func:`stream_to_device` (row chunks);
+        sparse tables stream nnz ranges per shard (see module docstring).
+        ``pad_to`` grows the row space with zero-weight rows (dense) or
+        empty rows (sparse) — both exact.
+        """
+        from .streaming import stream_to_device
+        if not self.is_sparse:
+            return stream_to_device(self.payload, mesh, pad_to=pad_to,
+                                    chunk_bytes=chunk_bytes,
+                                    row_offset=self.row_offset,
+                                    global_rows=(self.global_rows
+                                                 if self.global_rows
+                                                 != self.row_offset
+                                                 + self.n_rows else None))
+        return _stream_sparse(self, mesh, pad_to=pad_to,
+                              chunk_bytes=chunk_bytes)
+
+
+def _stream_sparse(table: DeviceTable, mesh, *, pad_to: Optional[int],
+                   chunk_bytes: Optional[int]):
+    """Row-partition ``table``'s sorted COO entries at the mesh's device
+    row-shard boundaries and assemble one data-sharded
+    :class:`SparseMatrix` through bounded host chunks."""
+    from ..sparse.matrix import SparseMatrix, nnz_capacity
+    from ..telemetry import REGISTRY, event, span
+    from .memory import effective_chunk_bytes
+    from .streaming import (_STATS, _lock as _s_lock, _put_chunk, _stage,
+                            _unstage, device_chunk_bytes)
+    from ..profiling import add_host_link_bytes
+
+    rows_g, cols_g, vals_g = table._coo
+    rows_g = rows_g + np.int32(table.row_offset)
+    n_rows = table.global_rows
+    total_rows = n_rows if pad_to is None else max(int(pad_to), n_rows)
+    extent = data_axis_size(mesh)
+    if total_rows % extent:
+        raise ValueError(
+            f"sparse stream: padded row count {total_rows} is not "
+            f"divisible by the data axis extent {extent}")
+    rows_per = total_rows // extent
+
+    # entry partition at the device row-shard boundaries: entries are
+    # row-sorted, so each shard owns one contiguous entry range
+    bounds = np.searchsorted(rows_g, np.arange(1, extent) * rows_per,
+                             side="left")
+    starts = np.concatenate([[0], bounds]).astype(np.int64)
+    stops = np.concatenate([bounds, [len(rows_g)]]).astype(np.int64)
+    counts = stops - starts
+    # one COMMON per-device capacity on the nnz ladder: the flat components
+    # then divide evenly over 'data' and the fit programs specialize on a
+    # ladder rung instead of the exact entry count
+    per_cap = nnz_capacity(int(counts.max()) if len(counts) else 0)
+    total_cap = per_cap * extent
+
+    budget = effective_chunk_bytes(
+        chunk_bytes if chunk_bytes is not None else device_chunk_bytes())
+    chunk_entries = max(1, budget // _ENTRY_BYTES)
+    REGISTRY.gauge("mesh.chunk_bytes").set(budget)
+    h2d = REGISTRY.counter("host_to_device_bytes_total")
+
+    sharding = data_sharding(mesh, ndim=1)
+    dev_map = sharding.addressable_devices_indices_map((total_cap,))
+    # map each device to its entry-range index via its flat-component slice
+    comp_shards = {0: [], 1: [], 2: []}   # values, indices, row_ids
+    inflight = []
+    with span("mesh.stream_to_device", rows=int(n_rows),
+              pad_rows=int(total_rows - n_rows), sparse=True,
+              nnz=int(len(rows_g)), per_device_capacity=int(per_cap),
+              devices=len(dev_map), chunk_entries=int(chunk_entries)):
+        for dev, idx in dev_map.items():
+            (esl,) = idx
+            d = (0 if esl.start is None else esl.start) // per_cap
+            s, e = int(starts[d]), int(stops[d])
+            pieces = {0: [], 1: [], 2: []}
+            pos = s
+            while pos < e:
+                end = min(pos + chunk_entries, e)
+                from .supervisor import next_chunk_key
+                seq = next_chunk_key()
+                nbytes = (end - pos) * _ENTRY_BYTES
+                _stage(nbytes)
+                with span("mesh.stream_chunk", device=str(dev),
+                          entries=int(end - pos), bytes=int(nbytes),
+                          seq=int(seq)):
+                    try:
+                        sent, bufs = [], []
+                        for comp in (vals_g, cols_g, rows_g):
+                            buf = np.ascontiguousarray(comp[pos:end])
+                            bufs.append(buf)
+                            sent.append(_put_chunk(buf, dev, seq))
+                        for ci in range(3):
+                            pieces[ci].append(sent[ci])
+                    except BaseException:
+                        _unstage(nbytes)
+                        raise
+                # double buffering: the chunk's three host buffers stay
+                # alive while its transfers are in flight; before staging a
+                # third chunk the oldest retires — peak staging ≤ 2 chunks
+                inflight.append((sent, bufs, nbytes))
+                if len(inflight) > 1:
+                    old_sent, _old_bufs, old_bytes = inflight.pop(0)
+                    for p in old_sent:
+                        p.block_until_ready()
+                    _unstage(old_bytes)
+                h2d.inc(nbytes)
+                add_host_link_bytes(nbytes)
+                with _s_lock:
+                    _STATS["chunks"] += 1
+                    _STATS["bytes_streamed"] += nbytes
+                pos = end
+            pad = per_cap - (e - s)
+            if pad:
+                # pad entries synthesize on-device: value 0.0 (exact zero
+                # addend) at this shard's first row / col 0 — in-range ids
+                # keep every static-num_segments scatter well-formed
+                pad_row = np.int32(min(d * rows_per, total_rows - 1))
+                pieces[0].append(jax.device_put(
+                    jnp.zeros((pad,), jnp.float32), dev))
+                pieces[1].append(jax.device_put(
+                    jnp.zeros((pad,), jnp.int32), dev))
+                pieces[2].append(jax.device_put(
+                    jnp.full((pad,), pad_row, jnp.int32), dev))
+            for ci in range(3):
+                comp_shards[ci].append(
+                    pieces[ci][0] if len(pieces[ci]) == 1
+                    else jnp.concatenate(pieces[ci]))
+        while inflight:
+            sent, _bufs, nbytes = inflight.pop(0)
+            for p in sent:
+                p.block_until_ready()
+            _unstage(nbytes)
+        comps = [jax.make_array_from_single_device_arrays(
+                     (total_cap,), sharding, comp_shards[ci])
+                 for ci in range(3)]
+    with _s_lock:
+        _STATS["arrays"] += 1
+    _bump(tables=1, rows=total_rows, nnz_streamed=len(rows_g),
+          pad_entries=total_cap - len(rows_g), shards=extent)
+    if total_rows != n_rows:
+        with _s_lock:
+            _STATS["pad_rows"] += total_rows - n_rows
+        event("mesh.stream_pad", rows=int(n_rows),
+              pad_rows=int(total_rows - n_rows), sparse=True)
+    return SparseMatrix(comps[0], comps[1], comps[2], total_rows,
+                        table.n_cols, nnz=int(len(rows_g)))

@@ -191,6 +191,7 @@ class MemoryPlan:
     chunk_bytes: int               # chosen streaming chunk budget
     grid_parts: int = 1            # candidate-grid sub-batches
     shrinks: List[str] = field(default_factory=list)  # ladder steps applied
+    nnz: Optional[int] = None      # sparse payload: real COO entry count
 
     def fits(self) -> bool:
         return (self.device_budget is None
@@ -204,7 +205,8 @@ class MemoryPlan:
                 "estDeviceBytes": self.est_device_bytes,
                 "chunkBytes": self.chunk_bytes,
                 "gridParts": self.grid_parts,
-                "fits": self.fits(), "shrinks": list(self.shrinks)}
+                "fits": self.fits(), "shrinks": list(self.shrinks),
+                "nnz": self.nnz}
 
 
 _PLAN_LOCK = threading.Lock()
@@ -220,15 +222,27 @@ def last_plan() -> Optional[MemoryPlan]:
 def estimate_sweep_device_bytes(*, rows: int, cols: int, folds: int,
                                 grid_width: int, devices: int,
                                 dtype_bytes: int = 4,
-                                headroom: Optional[float] = None) -> int:
+                                headroom: Optional[float] = None,
+                                nnz: Optional[int] = None) -> int:
     """Analytic per-device footprint of one fused sweep: the row-sharded
     matrix shard, the fold weight/validation panels ((2·folds+1) row
     vectors: train masks, validation masks, labels), and the per-lane
     working set of the batched (fold × grid) fit programs (coefficients +
-    metric panels per lane), all under the XLA-temp headroom factor."""
+    metric panels per lane), all under the XLA-temp headroom factor.
+
+    ``nnz`` marks a sparse COO payload: the resident matrix is then the
+    ladder-rounded entry capacity × 3 flat components (value/col/row, one
+    dtype word each), not ``rows × cols`` — the dense-equivalent estimate
+    over-counts hashed-text matrices by orders of magnitude and would
+    shrink the plan for memory the sweep never allocates."""
     devices = max(1, int(devices))
     h = memory_headroom() if headroom is None else max(1.0, float(headroom))
-    matrix = rows * cols
+    if nnz is not None:
+        from ..sparse.matrix import nnz_capacity
+        per = -(-int(nnz) // devices)
+        matrix = devices * nnz_capacity(per) * 3
+    else:
+        matrix = rows * cols
     panels = (2 * folds + 1) * rows
     lanes = grid_width * folds * (cols + 8)
     return int((matrix + panels) * dtype_bytes * h / devices
@@ -238,7 +252,8 @@ def estimate_sweep_device_bytes(*, rows: int, cols: int, folds: int,
 def plan_sweep_memory(*, rows: int, cols: int, folds: int, grid_width: int,
                       devices: int = 1, dtype_bytes: int = 4,
                       budget: Optional[int] = None,
-                      chunk_bytes: Optional[int] = None) -> MemoryPlan:
+                      chunk_bytes: Optional[int] = None,
+                      nnz: Optional[int] = None) -> MemoryPlan:
     """Choose chunk bytes and grid partitioning BEFORE the first transfer.
 
     Deterministic: the same shapes and budget always produce the same plan.
@@ -260,7 +275,7 @@ def plan_sweep_memory(*, rows: int, cols: int, folds: int, grid_width: int,
     est = estimate_sweep_device_bytes(
         rows=rows, cols=cols, folds=folds,
         grid_width=-(-grid_width // parts), devices=devices,
-        dtype_bytes=dtype_bytes)
+        dtype_bytes=dtype_bytes, nnz=nnz)
     if budget is not None:
         # two chunk-sized staging buffers live beside the resident set
         # during streaming; keep them under a quarter of the budget
@@ -273,13 +288,14 @@ def plan_sweep_memory(*, rows: int, cols: int, folds: int, grid_width: int,
             est = estimate_sweep_device_bytes(
                 rows=rows, cols=cols, folds=folds,
                 grid_width=-(-grid_width // parts), devices=devices,
-                dtype_bytes=dtype_bytes)
+                dtype_bytes=dtype_bytes, nnz=nnz)
     plan = MemoryPlan(rows=int(rows), cols=int(cols), folds=int(folds),
                       grid_width=int(grid_width), devices=int(devices),
                       dtype_bytes=int(dtype_bytes),
                       headroom=memory_headroom(), device_budget=budget,
                       est_device_bytes=int(est), chunk_bytes=int(chunk),
-                      grid_parts=int(parts), shrinks=shrinks)
+                      grid_parts=int(parts), shrinks=shrinks,
+                      nnz=None if nnz is None else int(nnz))
     global _LAST_PLAN
     with _PLAN_LOCK:
         _LAST_PLAN = plan

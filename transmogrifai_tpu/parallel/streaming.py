@@ -140,6 +140,17 @@ def stream_to_device(arr,
     from ..profiling import add_host_link_bytes
     from ..telemetry import REGISTRY, event, span
 
+    # one device data plane (ISSUE 19): DeviceTable and SparseMatrix
+    # payloads stream under the SAME chunk budget and staging bound —
+    # dense tables chunk by rows, sparse tables by nnz ranges
+    from .device_table import DeviceTable
+    from ..sparse.matrix import SparseMatrix
+    if isinstance(arr, SparseMatrix):
+        arr = DeviceTable.from_sparse(arr, row_offset=row_offset,
+                                      global_rows=global_rows)
+    if isinstance(arr, DeviceTable):
+        return arr.to_device(mesh, pad_to=pad_to, chunk_bytes=chunk_bytes)
+
     host = np.asarray(arr)
     if ndim is None:
         ndim = host.ndim

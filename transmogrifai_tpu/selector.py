@@ -321,10 +321,18 @@ class ModelSelector(Estimator):
                 # match the CV call's shardings exactly — the jit cache keys
                 # on them, so a layout mismatch would recompile the whole
                 # batched program instead of reusing it
-                from .parallel import data_sharding
-                X = jax.device_put(
-                    X if isinstance(X, jax.Array)
-                    else jnp.asarray(X, jnp.float32), data_sharding(mesh, 2))
+                from .parallel import data_sharding, stream_to_device
+                if isinstance(X, SparseMatrix):
+                    # DeviceTable dispatch: same row partition and nnz-rung
+                    # capacities as the CV stream (same data, same mesh), so
+                    # the flat-component shapes match the sweep's compiled
+                    # program exactly
+                    X = stream_to_device(X, mesh, pad_to=rows)
+                else:
+                    X = jax.device_put(
+                        X if isinstance(X, jax.Array)
+                        else jnp.asarray(X, jnp.float32),
+                        data_sharding(mesh, 2))
                 y = jax.device_put(jnp.asarray(y, jnp.float32),
                                    data_sharding(mesh, 1))
                 W = jax.device_put(jnp.asarray(W),

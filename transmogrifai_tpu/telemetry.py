@@ -641,6 +641,22 @@ def _default_registry() -> MetricsRegistry:
     reg.gauge("sparse.matrices", _sparse_stat("matrices"))
     reg.gauge("sparse.density", _sparse_stat("density"))
 
+    def _dt_stat(key):
+        def read():
+            # lazy import: telemetry must not pull jax at module import
+            from .parallel.device_table import device_table_stats
+            return device_table_stats()[key]
+        return read
+
+    # one device data plane (ISSUE 19): DeviceTable sparse shipments —
+    # logical rows shipped, real COO entries over the link, ladder pad
+    # entries synthesized on-device, per-device shards assembled
+    reg.gauge("device_table.tables", _dt_stat("tables"))
+    reg.gauge("device_table.rows", _dt_stat("rows"))
+    reg.gauge("device_table.nnz_streamed", _dt_stat("nnz_streamed"))
+    reg.gauge("device_table.pad_entries", _dt_stat("pad_entries"))
+    reg.gauge("device_table.shards", _dt_stat("shards"))
+
     def _stream_stat(key):
         def read():
             # lazy import: telemetry must not pull jax at module import

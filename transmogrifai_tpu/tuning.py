@@ -27,10 +27,18 @@ from .resilience import (AllCandidatesFailed, active_failure_log,
 
 logger = logging.getLogger(__name__)
 
-# batched-metric fast-path fallbacks already logged, one per model family —
-# a silent fallback could hide a real fitted-state corruption behind the
-# (correct but slow) per-candidate path (VERDICT r4 next #7a)
+# batched-metric fast-path fallbacks already logged, one per model family
+# PER VALIDATE — a silent fallback could hide a real fitted-state corruption
+# behind the (correct but slow) per-candidate path (VERDICT r4 next #7a).
+# Scoped per-validate (reset by ``Validator.validate``): a module-lifetime
+# set would suppress the note for every later train in the same process
+# (lifecycle retrains, pool workers), exactly the runs where a NEW
+# corruption could appear.  The FailureLog record stays unconditional.
 _logged_fallback_families = set()
+
+
+def _reset_logged_fallbacks() -> None:
+    _logged_fallback_families.clear()
 
 
 def _log_metric_fallback(family: str, exc: BaseException) -> None:
@@ -736,6 +744,9 @@ class OpValidator:
         if _hostgroup.hostgroup_env_present():
             _hg_attrs = {"hostgroup_rank": _hostgroup.current_rank(),
                          "hostgroup_world": _hostgroup.group_world_size()}
+        # the one-per-family fallback warning is scoped to THIS validate:
+        # a second train in the same process surfaces its own fallbacks
+        _reset_logged_fallbacks()
         attempt = 0
         oom_attempt = 0
         while True:
@@ -1028,11 +1039,28 @@ class OpValidator:
         for X, fsplits in fold_groups():
             is_sparse = isinstance(X, SparseMatrix)
             N = X.shape[0]
-            # sparse matrices stay single-device: the COO entry stream has no
-            # row-sharding story, and jnp.asarray on one raises by design
-            mesh = None if is_sparse else self._maybe_mesh(
-                N, pad=pad_exact_all)
+            # one device data plane (ISSUE 19): sparse matrices shard over
+            # the mesh 'data' axis like dense ones — entries sort by row,
+            # partition at device row boundaries, pad to a common per-device
+            # nnz rung (DeviceTable).  Global row_ids let GSPMD insert the
+            # collectives; the segment-sum fitters tolerate the zero pads
+            # exactly (value 0.0 addends at an in-range row).
+            mesh = self._maybe_mesh(N, pad=pad_exact_all)
             self.last_mesh = mesh
+            if (mesh is None and not pad_exact_all
+                    and self._maybe_mesh(N, pad=True) is not None):
+                # honest degrade: the mesh WAS viable (pad-divisible) but a
+                # mixed grid (some family not weighted_pad_exact) pinned the
+                # matrix unpadded and indivisible — record it so bench aux
+                # and operators see single-device as a degrade, not a choice
+                record_failure(
+                    "sweep", "degraded",
+                    RuntimeError(
+                        f"N={N} indivisible and grid mixes non-pad-exact "
+                        f"families: sweep falls back to single device"),
+                    point="selector.mesh", fallback="single_device")
+                from .telemetry import REGISTRY as _REG
+                _REG.counter("selector.mesh_degraded").inc()
             from .parallel import (data_axis_size, data_sharding,
                                    pad_rows_for, stream_to_device)
             from .parallel import memory as _mem
@@ -1063,13 +1091,22 @@ class OpValidator:
                     plan = _mem.plan_sweep_memory(
                         rows=N_fit,
                         cols=(int(X.shape[1])
-                              if getattr(X, "ndim", 1) == 2 else 1),
+                              if is_sparse or getattr(X, "ndim", 1) == 2
+                              else 1),
                         folds=len(fsplits),
                         grid_width=max((len(c.grid) for c in candidates),
                                        default=1),
-                        devices=int(mesh.devices.size))
+                        devices=int(mesh.devices.size),
+                        nnz=int(X.nnz) if is_sparse else None)
                     _plan_chunk = plan.chunk_bytes
-                if isinstance(X, jax.Array):
+                if is_sparse:
+                    # COO entries stream by nnz range under the same chunk
+                    # budget (DeviceTable dispatch inside stream_to_device);
+                    # empty pad rows own no entries, so the nnz-rung pads are
+                    # the only on-device synthesis
+                    X = stream_to_device(X, mesh, pad_to=N_fit,
+                                         chunk_bytes=_plan_chunk)
+                elif isinstance(X, jax.Array):
                     # already device-resident (upstream DAG output): pad on
                     # device, then lay out over the mesh in one shot
                     Xj = X if X.dtype == jnp.float32 else X.astype(
@@ -1086,9 +1123,10 @@ class OpValidator:
                     X = stream_to_device(np.asarray(X, dtype=np.float32),
                                          mesh, pad_to=N_fit,
                                          chunk_bytes=_plan_chunk)
-                if N_fit > N:
+                if N_fit > N and not is_sparse:
                     # tree families quantile-bin over the true rows only —
                     # keeps padded split points identical to unpadded ones
+                    # (sparse grids are linear-only: no binning to protect)
                     from .models.trees import register_real_rows
                     register_real_rows(X, N)
             elif not isinstance(X, jax.Array) and not is_sparse:

@@ -1,0 +1,275 @@
+"""CI smoke for the training control plane (ISSUE 20): prove, with real
+processes and real HTTP scrapes, that an in-flight train is observable —
+
+* a live two-family CV sweep serves ``/statusz`` concurrently: the polled
+  snapshots show ≥2 distinct phases, a monotonically increasing ``seq``,
+  and ``/metrics`` parses as Prometheus text with registry families;
+* a 2-rank host group with an obs base port serves the launcher's merged
+  panel; SIGKILLing rank 1 mid-sweep flips ``hostgroup_rank_up{rank="1"}``
+  from 1 to 0 on that panel;
+* the surviving rank dumps a schema-valid ``blackbox-rank0.json`` naming
+  the peer-loss failure, and the loss's outage record references a
+  blackbox dump when one exists.
+
+Usage:
+    python scripts/ci_obsv_smoke.py run OUT_DIR       # train + drill
+    python scripts/ci_obsv_smoke.py validate OUT_DIR  # parse + assert
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# runnable as `python scripts/ci_obsv_smoke.py` from the repo root
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+
+ROWS = int(os.environ.get("OBSV_SMOKE_ROWS", "560"))
+SEED = int(os.environ.get("OBSV_SMOKE_SEED", "0"))
+BOOT_S = float(os.environ.get("OBSV_SMOKE_BOOT_S", "300"))
+GRACE_S = float(os.environ.get("OBSV_SMOKE_GRACE_S", "60"))
+
+_WORKER = os.path.join(_REPO, "scripts", "hostgroup_worker.py")
+
+
+def _get(url, timeout=2.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.read().decode("utf-8", "replace")
+    except Exception:  # noqa: BLE001 — a missed poll is data, not an error
+        return None
+
+
+def _single_process_train(out_dir):
+    """Train the deterministic two-family sweep with the control plane on
+    an ephemeral port, polling /statusz + /metrics from this thread the
+    whole time."""
+    from transmogrifai_tpu import obsv
+    from transmogrifai_tpu.telemetry import Tracer, use_tracer
+
+    obsv.BOARD.reset()
+    obsv.install_recorder(obsv.FlightRecorder())
+    server = obsv.ObsServer(0).start()
+    result = {}
+    errors = []
+
+    def _train():
+        try:
+            from chaos_train import _two_family_sweep
+            winner, params, _ = _two_family_sweep(ROWS, SEED)
+            result["winner"] = winner
+            result["params"] = params
+        except BaseException as e:  # noqa: BLE001
+            errors.append(f"{type(e).__name__}: {e}")
+
+    # installed from here (the tracer stack is process-global) so the
+    # post-join /traces scrape still sees the sweep's spans
+    tracer_cm = use_tracer(Tracer(run_name="obsv-smoke"))
+    tracer_cm.__enter__()
+    t = threading.Thread(target=_train, name="smoke-train")
+    t.start()
+    polls, phases, seqs = 0, set(), []
+    metrics_ok = False
+    while t.is_alive():
+        body = _get(f"{server.url}/statusz", timeout=1.0)
+        if body:
+            try:
+                doc = json.loads(body)
+            except ValueError:
+                doc = None
+            if doc:
+                polls += 1
+                prog = doc.get("progress") or {}
+                if prog.get("phase"):
+                    phases.add(prog["phase"])
+                if prog.get("seq") is not None:
+                    seqs.append(int(prog["seq"]))
+        if not metrics_ok:
+            mtext = _get(f"{server.url}/metrics", timeout=1.0)
+            metrics_ok = bool(
+                mtext and "# TYPE" in mtext
+                and "transmogrifai_train_" in mtext)
+        time.sleep(0.05)
+    t.join()
+    # final scrapes after the sweep: the board has accumulated every phase
+    final = json.loads(_get(f"{server.url}/statusz") or "{}")
+    mtext = _get(f"{server.url}/metrics") or ""
+    traces = json.loads(_get(f"{server.url}/traces") or "{}")
+    tracer_cm.__exit__(None, None, None)
+    server.stop()
+    obsv.install_recorder(None)
+    return {
+        "winner": result.get("winner"),
+        "errors": errors,
+        "polls": polls,
+        "phases": sorted(phases),
+        "seqMonotonic": all(b >= a for a, b in zip(seqs, seqs[1:])),
+        "seqSamples": len(seqs),
+        "metricsParsedMidTrain": metrics_ok,
+        "finalStatusz": final,
+        "finalMetricsHasRegistryFamilies":
+            "transmogrifai_train_" in mtext and "# TYPE" in mtext,
+        "tracesHasSpans": bool(
+            (traces.get("trace") or {}).get("spanCount")),
+    }
+
+
+def _hostgroup_drill(out_dir):
+    """2-rank group with an obs base port; rank 1 SIGKILLs itself after its
+    first family checkpoints.  A poller thread watches the launcher's
+    merged panel for the hostgroup_rank_up flip the whole time."""
+    from transmogrifai_tpu import obsv
+    from transmogrifai_tpu.parallel import hostgroup
+
+    run_dir = os.path.join(out_dir, "hostgroup")
+    base = hostgroup._free_port()
+    os.environ["TRANSMOGRIFAI_OBS_PORT"] = str(base)
+    # no manual recorder here: launch_hosts installs its own launcher-side
+    # FlightRecorder when obs is enabled, and the drill must exercise that
+    # production path (the loss adjudication dumps
+    # blackbox-launcher-gen<g>.json even when the SIGKILLed rank wrote
+    # nothing and the survivor wedged in a dead collective)
+    rank_up_seen = {"0": set(), "1": set()}
+    statusz_roles = set()
+    stop = threading.Event()
+
+    def _poll_panel():
+        while not stop.is_set():
+            body = _get(f"http://127.0.0.1:{base}/metrics", timeout=1.0)
+            if body:
+                for line in body.splitlines():
+                    if line.startswith("hostgroup_rank_up{"):
+                        for r in ("0", "1"):
+                            if f'rank="{r}"' in line:
+                                rank_up_seen[r].add(line.rsplit(" ", 1)[-1])
+            sbody = _get(f"http://127.0.0.1:{base}/statusz", timeout=1.0)
+            if sbody:
+                try:
+                    statusz_roles.add(json.loads(sbody).get("role"))
+                except ValueError:
+                    pass
+            time.sleep(0.2)
+
+    poller = threading.Thread(target=_poll_panel, name="panel-poller")
+    poller.start()
+    try:
+        res = hostgroup.launch_hosts(
+            [sys.executable, _WORKER, "--rows", str(ROWS),
+             "--seed", str(SEED),
+             "--ckpt-base", os.path.join(run_dir, "ckpt")],
+            2, run_dir=run_dir, boot_timeout=BOOT_S, liveness_timeout=30.0,
+            grace_s=GRACE_S, max_relaunches=1, preflight=False,
+            env={"HOSTGROUP_WORKER_DIE_RANK": "1",
+                 "HOSTGROUP_WORKER_DIE_GEN": "0"})
+    finally:
+        stop.set()
+        poller.join()
+        os.environ.pop("TRANSMOGRIFAI_OBS_PORT", None)
+        obsv.install_recorder(None)
+    blackboxes = {}
+    for f in sorted(os.listdir(run_dir)):
+        if f.startswith("blackbox") and f.endswith(".json"):
+            try:
+                with open(os.path.join(run_dir, f)) as fh:
+                    blackboxes[f] = json.load(fh)
+            except (OSError, ValueError):
+                blackboxes[f] = None
+    outage_path = os.path.join(run_dir, "OUTAGE_hostgroup_gen0.json")
+    outage = json.load(open(outage_path)) \
+        if os.path.exists(outage_path) else None
+    return {"result": res.to_json(),
+            "rankUpSeen": {k: sorted(v) for k, v in rank_up_seen.items()},
+            "statuszRoles": sorted(r for r in statusz_roles if r),
+            "blackboxes": blackboxes,
+            "outageRecord": outage,
+            "runDir": run_dir}
+
+
+def _off_by_default_check():
+    """With no obs port configured: zero live servers, no recorder."""
+    from transmogrifai_tpu import obsv
+    return {"obsEnabled": obsv.obs_enabled(),
+            "activeServers": len(obsv.active_servers()),
+            "recorder": obsv.active_recorder() is not None}
+
+
+def run(out_dir):
+    os.makedirs(out_dir, exist_ok=True)
+    os.environ.pop("TRANSMOGRIFAI_OBS_PORT", None)
+    record = {"rows": ROWS, "seed": SEED}
+    record["train"] = _single_process_train(out_dir)
+    record["off"] = _off_by_default_check()
+    record["drill"] = _hostgroup_drill(out_dir)
+    with open(os.path.join(out_dir, "obsv_smoke.json"), "w") as fh:
+        json.dump(record, fh, indent=2, default=str)
+    print(json.dumps({"train": {k: v for k, v in record["train"].items()
+                                if k != "finalStatusz"},
+                      "off": record["off"],
+                      "drill": {"rankUpSeen": record["drill"]["rankUpSeen"],
+                                "blackboxes":
+                                    sorted(record["drill"]["blackboxes"]),
+                                "ok": record["drill"]["result"]["ok"]}},
+                     indent=2))
+    return 0
+
+
+def _blackbox_schema_ok(doc):
+    from transmogrifai_tpu.obsv import BLACKBOX_KEYS, BLACKBOX_SCHEMA
+    return (isinstance(doc, dict)
+            and doc.get("schema") == BLACKBOX_SCHEMA
+            and set(BLACKBOX_KEYS) <= set(doc))
+
+
+def validate(out_dir):
+    with open(os.path.join(out_dir, "obsv_smoke.json")) as fh:
+        r = json.load(fh)
+    train, drill, off = r["train"], r["drill"], r["off"]
+    survivor_boxes = [doc for name, doc in drill["blackboxes"].items()
+                      if _blackbox_schema_ok(doc)]
+    checks = {
+        "train_completed": not train["errors"]
+        and train["winner"] is not None,
+        "statusz_polled_live": train["polls"] > 0,
+        "statusz_two_plus_phases": len(train["phases"]) >= 2,
+        "statusz_seq_monotonic": train["seqMonotonic"]
+        and train["seqSamples"] > 0,
+        "metrics_prometheus_midtrain": train["metricsParsedMidTrain"]
+        and train["finalMetricsHasRegistryFamilies"],
+        "traces_endpoint_has_spans": train["tracesHasSpans"],
+        "off_by_default_zero_sockets": not off["obsEnabled"]
+        and off["activeServers"] == 0 and not off["recorder"],
+        "drill_recovered": drill["result"]["ok"]
+        and drill["result"]["relaunches"] == 1,
+        "rank1_up_then_down": {"0", "1"} <= set(drill["rankUpSeen"]["1"]),
+        "rank0_seen_up": "1" in drill["rankUpSeen"]["0"],
+        "launcher_statusz_served": "launcher" in drill["statuszRoles"],
+        "blackbox_schema_valid": len(survivor_boxes) >= 1,
+        "blackbox_names_peer_loss": any(
+            "HostLost" in str(doc.get("reason", ""))
+            or "Preempted" in str(doc.get("reason", ""))
+            for doc in survivor_boxes),
+        "outage_record_written": isinstance(drill["outageRecord"], dict),
+        "outage_record_references_blackbox": bool(
+            (drill["outageRecord"] or {}).get("blackbox")),
+    }
+    print(json.dumps(checks, indent=2))
+    if not all(checks.values()):
+        failed = [k for k, v in checks.items() if not v]
+        print(f"FAILED: {failed}", file=sys.stderr)
+        return 1
+    print("obsv smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "run":
+        sys.exit(run(sys.argv[2]))
+    if len(sys.argv) == 3 and sys.argv[1] == "validate":
+        sys.exit(validate(sys.argv[2]))
+    sys.exit(f"usage: {sys.argv[0]} run OUT_DIR | validate OUT_DIR")

@@ -54,6 +54,7 @@ def main(argv=None):
     import jax
     jax.config.update("jax_platforms", "cpu")
 
+    from transmogrifai_tpu import obsv
     from transmogrifai_tpu.checkpoint import TrainingPreempted
     from transmogrifai_tpu.parallel import hostgroup
     from transmogrifai_tpu.telemetry import TraceContext, Tracer, use_tracer
@@ -63,6 +64,14 @@ def main(argv=None):
         raise SystemExit("hostgroup_worker must run under launch_hosts "
                          "(TRANSMOGRIFAI_HOSTGROUP_* env missing)")
     rank, gen = hg.rank, hg.generation
+
+    # training control plane: the launcher dealt this rank its own port
+    # (base+1+rank) when an obs base port was configured; off by default
+    obs_server = None
+    if obsv.obs_enabled():
+        obsv.install_recorder(obsv.FlightRecorder())
+        obs_server = obsv.maybe_start_obs_server()
+        obsv.BOARD.publish(phase="starting", rank=rank, generation=gen)
 
     die_rank = int(os.environ.get("HOSTGROUP_WORKER_DIE_RANK", "-1"))
     die_gen = int(os.environ.get("HOSTGROUP_WORKER_DIE_GEN", "0"))
@@ -93,11 +102,16 @@ def main(argv=None):
                       "traceId": tracer.trace_id})
         hg.close()
     except (TrainingPreempted, hostgroup.HostLostError) as e:
+        # the flight recorder's crash dump names the peer loss that killed
+        # this survivor (blackbox-rank<r>.json lands in the shared run dir)
+        obsv.dump_blackbox(reason=type(e).__name__, error=e)
         hg.close(state="aborted")
         print(f"rank {rank} gen {gen} aborted on peer loss: "
               f"{type(e).__name__}", file=sys.stderr)
         raise SystemExit(hostgroup.EXIT_HOST_LOST)
     finally:
+        if obs_server is not None:
+            obs_server.stop()
         tracer.export_chrome_trace(os.path.join(
             hg.run_dir, f"trace-rank{rank}-gen{gen}.json"))
     print(json.dumps({"rank": rank, "generation": gen, "winner": winner}))

@@ -1,0 +1,391 @@
+"""Training control plane (ISSUE 20): the /statusz progress board stays
+monotonic under a REAL two-family CV sweep, /metrics renders the telemetry
+registry as parseable Prometheus text, the flight recorder dumps a
+schema-valid blackbox.json on injected memory exhaustion and on a
+preemption signal, the ring bound holds, and — the zero-cost contract —
+with no obs port configured there are zero sockets and zero recorder.
+
+The cross-host merged panel + SIGKILL drill lives in
+scripts/ci_obsv_smoke.py (real processes, real HTTP).
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import obsv
+from transmogrifai_tpu.parallel import memory as mem
+from transmogrifai_tpu.resilience import FailureLog, use_failure_log
+from transmogrifai_tpu.telemetry import REGISTRY, Tracer, use_tracer
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    obsv.BOARD.reset()
+    obsv.install_recorder(None)
+    yield
+    obsv.BOARD.reset()
+    obsv.install_recorder(None)
+    mem.reset_memory_degrade()
+    for s in obsv.active_servers():
+        s.stop()
+
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def _tiny_two_family_train(n=220, seed=0):
+    from transmogrifai_tpu.columns import Column, ColumnBatch
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.models.linear import OpLogisticRegression
+    from transmogrifai_tpu.ops.transmogrify import transmogrify
+    from transmogrifai_tpu.selector import (
+        BinaryClassificationModelSelector, ModelCandidate, grid)
+    from transmogrifai_tpu.types import RealNN
+    from transmogrifai_tpu.workflow import Workflow
+
+    d = 4
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] > 0).astype(np.float32)
+    label = FeatureBuilder.RealNN("label").as_response()
+    feats = [FeatureBuilder.RealNN(f"f{i}").as_predictor()
+             for i in range(d)]
+    sel = BinaryClassificationModelSelector(models=[
+        ModelCandidate(OpLogisticRegression(),
+                       grid(reg_param=[0.01, 1.0], max_iter=[15]), "LR_A"),
+        ModelCandidate(OpLogisticRegression(),
+                       grid(reg_param=[10.0], max_iter=[15]), "LR_B"),
+    ])
+    sel.set_input(label, transmogrify(feats))
+    pred = sel.get_output()
+    cols = {"label": Column(RealNN, y)}
+    for i in range(d):
+        cols[f"f{i}"] = Column(RealNN, X[:, i])
+    wf = Workflow().set_input_batch(ColumnBatch(cols, n)) \
+                   .set_result_features(pred)
+    return wf.train()
+
+
+# --------------------------------------------------------------------------
+# progress board
+# --------------------------------------------------------------------------
+
+class TestProgressBoard:
+    def test_publish_merges_and_bumps_seq(self):
+        b = obsv.ProgressBoard()
+        b.publish(phase="sweep", candidate="LR_A")
+        b.publish(candidate="LR_B")
+        snap = b.snapshot()
+        assert snap["phase"] == "sweep"          # earlier field survives
+        assert snap["candidate"] == "LR_B"       # latest wins
+        assert snap["seq"] == 2
+
+    def test_snapshot_is_stable_across_publish(self):
+        b = obsv.ProgressBoard()
+        b.publish(phase="a")
+        before = b.snapshot()
+        b.publish(phase="b")
+        # readers hold the old dict untouched: publish swaps, never mutates
+        assert before["phase"] == "a"
+        assert b.snapshot()["phase"] == "b"
+
+    def test_note_unit_ewma_and_eta(self):
+        b = obsv.ProgressBoard(ewma_alpha=0.5)
+        b.note_unit(2.0, remaining_units=4)
+        assert b.snapshot()["etaS"] == pytest.approx(8.0)
+        b.note_unit(4.0, remaining_units=2)
+        # ewma = 0.5*4 + 0.5*2 = 3.0 -> eta 6.0
+        assert b.snapshot()["unitEwmaS"] == pytest.approx(3.0)
+        assert b.snapshot()["etaS"] == pytest.approx(6.0)
+
+    def test_publish_mirrors_into_recorder(self):
+        rec = obsv.install_recorder(obsv.FlightRecorder(cap=16))
+        obsv.BOARD.publish(phase="sweep")
+        kinds = [e["kind"] for e in rec.entries()]
+        assert "progress" in kinds
+
+
+# --------------------------------------------------------------------------
+# a real sweep publishes, monotonically, and /statusz serves it live
+# --------------------------------------------------------------------------
+
+class TestStatuszDuringSweep:
+    def test_statusz_monotonic_during_two_family_sweep(self):
+        # the board is latest-wins, so a poll can miss a fast family; the
+        # recorder mirrors every publish and keeps the full history
+        rec = obsv.install_recorder(obsv.FlightRecorder(cap=4096))
+        server = obsv.ObsServer(0).start()
+        try:
+            seqs, phases, candidates = [], set(), set()
+            done = threading.Event()
+            polled = []
+
+            def _poll():
+                while not done.is_set():
+                    try:
+                        doc = json.loads(_get(f"{server.url}/statusz",
+                                              timeout=1.0))
+                    except Exception:  # noqa: BLE001
+                        continue
+                    polled.append(doc)
+                    prog = doc.get("progress") or {}
+                    if prog.get("seq") is not None:
+                        seqs.append(prog["seq"])
+                    if prog.get("phase"):
+                        phases.add(prog["phase"])
+                    if prog.get("candidate"):
+                        candidates.add(prog["candidate"])
+                    done.wait(0.02)
+
+            t = threading.Thread(target=_poll)
+            t.start()
+            try:
+                model = _tiny_two_family_train()
+            finally:
+                done.set()
+                t.join()
+            assert model.selected_model is not None
+            assert polled, "statusz never answered during the sweep"
+            assert seqs == sorted(seqs), "board seq went backwards"
+            # the sweep's coarse seams published: phases + both families
+            final = obsv.BOARD.snapshot()
+            assert final["candidateFamilies"] == 2
+            published = {e.get("candidate") for e in rec.entries()
+                         if e["kind"] == "progress"}
+            assert {"LR_A", "LR_B"} <= (candidates | published)
+            assert final.get("phase"), "no phase ever published"
+        finally:
+            server.stop()
+
+    def test_statusz_doc_shape(self):
+        obsv.BOARD.publish(phase="sweep", candidate="LR_A")
+        doc = obsv.statusz_snapshot()
+        for key in ("utc", "pid", "uptimeS", "progress", "memory",
+                    "supervisor"):
+            assert key in doc, key
+        assert doc["progress"]["candidate"] == "LR_A"
+        assert "shrinkLevel" in doc["memory"]
+        assert "state" in doc["supervisor"]
+        json.dumps(doc)   # the whole thing must be serializable
+
+
+# --------------------------------------------------------------------------
+# /metrics: Prometheus text that matches the registry
+# --------------------------------------------------------------------------
+
+def _parse_prom(text):
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        assert name and value, f"unparseable sample line: {line!r}"
+        samples[name] = float(value)
+    return samples
+
+
+class TestMetricsEndpoint:
+    def test_metrics_families_match_registry(self):
+        REGISTRY.counter("obsv_test.scrapes_total").inc(3)
+        REGISTRY.gauge("obsv_test.depth").set(7)
+        server = obsv.ObsServer(0).start()
+        try:
+            text = _get(f"{server.url}/metrics")
+        finally:
+            server.stop()
+        samples = _parse_prom(text)
+        assert samples["transmogrifai_train_obsv_test_scrapes_total"] == 3.0
+        assert samples["transmogrifai_train_obsv_test_depth"] == 7.0
+        # every numeric registry counter surfaces as a family
+        snap = REGISTRY.snapshot()
+        for name, v in snap["counters"].items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            fam = "transmogrifai_train_" + obsv._sanitize(name)
+            assert fam in samples, f"counter {name} missing from /metrics"
+
+    def test_render_histogram_as_summary(self):
+        REGISTRY.histogram("obsv_test.latency").observe(0.25)
+        text = obsv.render_registry_metrics()
+        assert "transmogrifai_train_obsv_test_latency_seconds_count 1" \
+            in text
+        assert 'quantile="0.5"' in text
+
+    def test_healthz_and_404(self):
+        server = obsv.ObsServer(0).start()
+        try:
+            assert _get(f"{server.url}/healthz") == "ok\n"
+            with pytest.raises(urllib.error.HTTPError):
+                _get(f"{server.url}/nope")
+        finally:
+            server.stop()
+
+
+# --------------------------------------------------------------------------
+# flight recorder: dumps, triggering entries, ring bound
+# --------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_dump_on_injected_memory_oom(self, tmp_path):
+        rec = obsv.install_recorder(obsv.FlightRecorder(cap=64))
+        flog = FailureLog()
+        with use_failure_log(flog):
+            oom = RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+            mem.note_sweep_memory_exhaustion(oom, attempt=0)
+            path = obsv.dump_blackbox(
+                reason="MemoryExhaustedError",
+                error=mem.as_memory_exhausted(oom),
+                path=str(tmp_path / "blackbox.json"))
+        assert path and os.path.exists(path)
+        doc = json.load(open(path))
+        assert doc["schema"] == obsv.BLACKBOX_SCHEMA
+        assert set(obsv.BLACKBOX_KEYS) <= set(doc)
+        # the ring recorded the shrink note the seam emitted
+        kinds = [e["kind"] for e in doc["entries"]]
+        assert "memory.shrink" in kinds
+        # ... and the triggering FailureLog entry rode along in the tail
+        assert any(e["point"] == "memory.device_oom"
+                   for e in doc["failureLogTail"])
+        assert "MemoryExhaustedError" in doc["error"]
+        assert obsv.last_blackbox_path() == path
+
+    def test_dump_on_preemption_reason(self, tmp_path):
+        obsv.install_recorder(obsv.FlightRecorder(cap=64))
+        obsv.BOARD.publish(phase="sweep", candidate="LR_A")
+        path = obsv.dump_blackbox(reason="preempted",
+                                  path=str(tmp_path / "bb.json"))
+        doc = json.load(open(path))
+        assert doc["reason"] == "preempted"
+        assert doc["progress"]["candidate"] == "LR_A"
+        assert doc["error"] is None
+
+    def test_dump_attaches_span_summaries(self, tmp_path):
+        obsv.install_recorder(obsv.FlightRecorder(cap=64))
+        tracer = Tracer(run_name="bb-test")
+        with use_tracer(tracer):
+            with tracer.span("unit.work"):
+                pass
+            path = obsv.dump_blackbox(reason="test",
+                                      path=str(tmp_path / "bb.json"))
+        doc = json.load(open(path))
+        assert any(s["name"] == "unit.work" for s in doc["spanSummaries"])
+
+    def test_ring_bound_respected(self):
+        rec = obsv.FlightRecorder(cap=10)
+        for i in range(100):
+            rec.note("tick", i=i)
+        assert len(rec) == 10
+        entries = rec.entries()
+        assert [e["i"] for e in entries] == list(range(90, 100))
+
+    def test_cap_from_env(self, monkeypatch):
+        monkeypatch.setenv("TRANSMOGRIFAI_BLACKBOX_SPANS", "33")
+        assert obsv.FlightRecorder().cap == 33
+        monkeypatch.setenv("TRANSMOGRIFAI_BLACKBOX_SPANS", "junk")
+        assert obsv.FlightRecorder().cap == obsv.DEFAULT_BLACKBOX_CAP
+
+    def test_counter_deltas_are_relative_to_install(self):
+        REGISTRY.counter("obsv_test.delta").inc(5)
+        rec = obsv.FlightRecorder(cap=8)
+        REGISTRY.counter("obsv_test.delta").inc(2)
+        assert rec.counter_deltas().get("obsv_test.delta") == 2
+
+    def test_atomic_dump_leaves_no_tmp(self, tmp_path):
+        obsv.install_recorder(obsv.FlightRecorder(cap=8))
+        obsv.dump_blackbox(reason="x", path=str(tmp_path / "bb.json"))
+        assert sorted(p.name for p in tmp_path.iterdir()) == ["bb.json"]
+
+    def test_outage_record_references_dump(self, tmp_path):
+        from transmogrifai_tpu.parallel.supervisor import \
+            write_outage_record
+        obsv.install_recorder(obsv.FlightRecorder(cap=8))
+        bb = obsv.dump_blackbox(reason="x", path=str(tmp_path / "bb.json"))
+        rec = write_outage_record(
+            what="test outage", context="test", probe=None,
+            mitigations=("none",), will_update="never",
+            path=str(tmp_path / "OUTAGE_test.json"))
+        assert rec["blackbox"] == bb
+
+    def test_blackbox_note_is_noop_without_recorder(self):
+        assert obsv.active_recorder() is None
+        obsv.blackbox_note("anything", x=1)       # must not raise
+        assert obsv.dump_blackbox(reason="x") is None
+
+
+# --------------------------------------------------------------------------
+# off by default: zero sockets, zero recorder, zero new board traffic cost
+# --------------------------------------------------------------------------
+
+class TestOffByDefault:
+    def test_no_port_means_no_server(self, monkeypatch):
+        monkeypatch.delenv("TRANSMOGRIFAI_OBS_PORT", raising=False)
+        assert obsv.obs_port_from_env() == 0
+        assert not obsv.obs_enabled()
+        assert obsv.maybe_start_obs_server() is None
+        assert obsv.active_servers() == []
+        assert obsv.active_recorder() is None
+
+    def test_zero_port_means_off(self, monkeypatch):
+        monkeypatch.setenv("TRANSMOGRIFAI_OBS_PORT", "0")
+        assert not obsv.obs_enabled()
+        assert obsv.maybe_start_obs_server() is None
+
+    def test_train_without_port_opens_no_socket(self, monkeypatch):
+        monkeypatch.delenv("TRANSMOGRIFAI_OBS_PORT", raising=False)
+        model = _tiny_two_family_train(n=120)
+        assert model.selected_model is not None
+        assert obsv.active_servers() == []
+        assert obsv.active_recorder() is None
+
+    def test_port_env_parses(self, monkeypatch):
+        monkeypatch.setenv("TRANSMOGRIFAI_OBS_PORT", "9123")
+        assert obsv.obs_port_from_env() == 9123
+        assert obsv.obs_enabled()
+        monkeypatch.setenv("TRANSMOGRIFAI_OBS_PORT", "garbage")
+        assert obsv.obs_port_from_env() == 0
+
+
+# --------------------------------------------------------------------------
+# cross-host plumbing (unit level; process-level drill in ci_obsv_smoke)
+# --------------------------------------------------------------------------
+
+class TestCrossHost:
+    def test_rank_port_dealing(self):
+        from transmogrifai_tpu.parallel.hostgroup import _rank_obs_port
+        base = 9400
+        # launcher keeps base; ranks get distinct ports above it
+        ports = [_rank_obs_port(base, r) for r in range(4)]
+        assert ports == [9401, 9402, 9403, 9404]
+        assert base not in ports
+
+    def test_merged_panel_marks_dead_rank_down(self):
+        from transmogrifai_tpu.parallel.hostgroup import \
+            _rank_obs_port, _start_merged_panel
+        # rank 0 is a live ObsServer parked on its dealt port; rank 1 is
+        # nothing at all (a SIGKILLed host answers no polls)
+        probe = obsv.ObsServer(0).start()
+        base = probe.port   # a port the OS just proved free for the panel
+        probe.stop()
+        rank0 = obsv.ObsServer(_rank_obs_port(base, 0)).start()
+        panel = _start_merged_panel(base, {"world": 2, "generation": 0,
+                                           "pollTimeoutS": 0.5})
+        assert panel is not None
+        try:
+            text = _get(f"{panel.url}/metrics", timeout=10.0)
+            samples = _parse_prom(text)
+            assert samples['hostgroup_rank_up{rank="0"}'] == 1.0
+            assert samples['hostgroup_rank_up{rank="1"}'] == 0.0
+            doc = json.loads(_get(f"{panel.url}/statusz", timeout=10.0))
+            assert doc["role"] == "launcher"
+            assert doc["ranks"]["0"]["up"] is True
+            assert doc["ranks"]["1"]["up"] is False
+        finally:
+            panel.stop()
+            rank0.stop()

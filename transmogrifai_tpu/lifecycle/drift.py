@@ -208,6 +208,31 @@ class DriftMonitor:
         self.observe_records(records)
         self.observe_results(results)
 
+    def observe_columnar(self, batch, result_arrays) -> None:
+        """ScoringEngine column-observer entry point: the raw
+        ``ColumnBatch`` feeds the same sketch path ``observe_batch`` uses
+        (no per-record dict materialization), and the score stream comes
+        straight out of the packed result arrays
+        (``{name: (values, present_mask)}``)."""
+        if not self.enabled:
+            return
+        self.observe_batch(batch)
+        sf = self.baselines.score_feature
+        if sf is None or not result_arrays:
+            return
+        entry = result_arrays.get(f"{sf}.{self.baselines.score_field}")
+        if entry is None:
+            entry = result_arrays.get(f"{sf}.prediction")
+        if entry is None:
+            return
+        vals, mask = entry
+        arr = np.asarray(vals, dtype=np.float64).reshape(-1)
+        if mask is not None:
+            arr = arr[np.asarray(mask, dtype=bool).reshape(-1)]
+        arr = arr[np.isfinite(arr)]
+        if arr.size:
+            self.observe_scores(arr)
+
     @property
     def rows_observed(self) -> int:
         return self._rows

@@ -126,14 +126,20 @@ def lifecycle_main(workflow, root: str, *, evaluator=None, live_reader=None,
         per_check = int(per_check) if per_check is not None else None
         iterations = int(cfg.get("maxIterations", 1))
         shadow = incumbent
+        from ..obsv import BOARD
         for i in range(iterations):
             if shutdown_requested(key=f"lifecycle-{i}"):
                 break
+            BOARD.publish(phase="lifecycle", lifecycleIteration=i,
+                          lifecycleIterations=iterations,
+                          batchesIngested=ingested)
             if stream is not None and monitor is not None:
                 ingested += pump_stream(monitor, stream, shadow_model=shadow,
                                         max_batches=per_check)
             outcome = controller.run_once()
             outcomes.append(outcome.to_json() if outcome else None)
+            BOARD.publish(lastLifecycleOutcome=(outcome.status
+                                                if outcome else None))
             if outcome is not None and outcome.status == "promoted" and \
                     outcome.candidate_path:
                 shadow = WorkflowModel.load(outcome.candidate_path)

@@ -545,6 +545,87 @@ def maybe_init_hostgroup(*, distributed: Optional[bool] = None,
 # the launcher
 # --------------------------------------------------------------------------
 
+def _rank_obs_port(base: int, rank: int) -> int:
+    """Control-plane port for ``rank`` given the configured base port.
+
+    The launcher keeps ``base`` for its merged panel; rank ``r`` serves on
+    ``base + 1 + r`` (rank 0 may share the launcher's host, so it cannot
+    reuse ``base``).  ``launch_hosts`` exports the final per-rank value in
+    the child env — ranks consume ``TRANSMOGRIFAI_OBS_PORT`` as-is and
+    never offset themselves."""
+    return int(base) + 1 + int(rank)
+
+
+def _http_get(url: str, timeout_s: float = 1.0) -> Optional[str]:
+    """Best-effort control-plane poll; None on any failure (a dead rank is
+    a data point for ``hostgroup_rank_up``, not an error)."""
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return resp.read().decode("utf-8", "replace")
+    except Exception:  # noqa: BLE001 — refused/timeout/garbage all mean down
+        return None
+
+
+def _start_merged_panel(base_port: int,
+                        panel: Dict[str, Any]) -> Optional[Any]:
+    """Launcher-side admin endpoint: polls every live rank's per-rank
+    control plane at scrape time and re-serves ONE merged view —
+    ``/metrics`` is the launcher registry plus ``hostgroup_rank_up{rank=}``
+    plus every answering rank's exposition merged under a ``rank`` label
+    (``merge_worker_metrics``); ``/statusz`` nests each rank's own statusz
+    under ``ranks``.  ``panel`` is the launcher's mutable
+    ``{"world", "generation"}`` state, updated per generation."""
+    from ..obsv import maybe_start_obs_server, render_registry_metrics, \
+        statusz_snapshot
+
+    def _poll(endpoint: str) -> List[Any]:
+        out = []
+        for r in range(int(panel.get("world", 0))):
+            body = _http_get(
+                f"http://127.0.0.1:{_rank_obs_port(base_port, r)}"
+                f"{endpoint}", timeout_s=panel.get("pollTimeoutS", 1.0))
+            out.append((r, body))
+        return out
+
+    def merged_metrics() -> str:
+        from ..serving.pool import merge_worker_metrics
+        polled = _poll("/metrics")
+        up = ["# HELP hostgroup_rank_up 1 if the rank's control plane "
+              "answered the launcher's last poll",
+              "# TYPE hostgroup_rank_up gauge"]
+        texts = []
+        for r, body in polled:
+            up.append(f'hostgroup_rank_up{{rank="{r}"}} '
+                      f'{1 if body is not None else 0}')
+            if body is not None:
+                texts.append((str(r), body))
+        parts = [render_registry_metrics(), "\n".join(up) + "\n"]
+        if texts:
+            parts.append(merge_worker_metrics(texts, label="rank"))
+        return "".join(parts)
+
+    def merged_statusz() -> Dict[str, Any]:
+        doc = statusz_snapshot()
+        doc["role"] = "launcher"
+        doc["world"] = int(panel.get("world", 0))
+        doc["generation"] = int(panel.get("generation", 0))
+        ranks: Dict[str, Any] = {}
+        for r, body in _poll("/statusz"):
+            if body is None:
+                ranks[str(r)] = {"up": False}
+                continue
+            try:
+                ranks[str(r)] = {"up": True, **json.loads(body)}
+            except ValueError:
+                ranks[str(r)] = {"up": True}
+        doc["ranks"] = ranks
+        return doc
+
+    return maybe_start_obs_server(base_port, metrics_fn=merged_metrics,
+                                  statusz_fn=merged_statusz)
+
+
 def _free_port() -> int:
     s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     try:
@@ -708,6 +789,22 @@ def launch_hosts(cmd: Sequence[str], hosts: int, *,
     if _cache:
         base_env.setdefault("TRANSMOGRIFAI_COMPILE_CACHE", _cache)
 
+    # training control plane: when an obs port is configured the launcher
+    # keeps the base port for the merged rank panel and deals each child
+    # rank its own port below (base+1+rank)
+    from ..obsv import (FlightRecorder, active_recorder, blackbox_note,
+                        install_recorder, obs_port_from_env)
+    obs_base = obs_port_from_env()
+    panel_state: Dict[str, Any] = {"world": hosts, "generation": 0}
+    obs_panel = _start_merged_panel(obs_base, panel_state) \
+        if obs_base else None
+    # the launcher is the process that adjudicates host loss, so it needs
+    # its own flight recorder for the per-generation loss dump (ranks each
+    # carry theirs; a SIGKILLed rank writes nothing)
+    own_recorder = None
+    if obs_base and active_recorder() is None:
+        own_recorder = install_recorder(FlightRecorder())
+
     world = hosts
     generation = 0
     procs: Dict[int, subprocess.Popen] = {}
@@ -716,6 +813,8 @@ def launch_hosts(cmd: Sequence[str], hosts: int, *,
         while True:
             result.generations = generation + 1
             result.final_world = world
+            panel_state["world"] = world
+            panel_state["generation"] = generation
             REGISTRY.gauge("hostgroup.world_size").set(world)
             REGISTRY.gauge("hostgroup.generation").set(generation)
             port = _free_port()
@@ -737,6 +836,9 @@ def launch_hosts(cmd: Sequence[str], hosts: int, *,
                         ENV_DISTRIBUTED: "1" if distributed else "0",
                         TRACEPARENT_ENV:
                             parent_ctx.child().to_traceparent()})
+                    if obs_base:
+                        child_env["TRANSMOGRIFAI_OBS_PORT"] = \
+                            str(_rank_obs_port(obs_base, rank))
                     if beat_interval is not None:
                         child_env["TRANSMOGRIFAI_HOSTGROUP_BEAT_S"] = \
                             str(beat_interval)
@@ -765,6 +867,8 @@ def launch_hosts(cmd: Sequence[str], hosts: int, *,
             new_world = world - len(outcome["losses"])
             if new_world >= 1 and result.relaunches < max_relaunches:
                 result.relaunches += 1
+                blackbox_note("hostgroup.relaunch",
+                              generation=generation + 1, world=new_world)
                 REGISTRY.counter("hostgroup.relaunches_total").inc()
                 record_failure(
                     "hostgroup", "relaunched",
@@ -783,6 +887,10 @@ def launch_hosts(cmd: Sequence[str], hosts: int, *,
                              f"{max_relaunches} spent)")
             return result
     finally:
+        if own_recorder is not None:
+            install_recorder(None)
+        if obs_panel is not None:
+            obs_panel.stop()
         # zero orphans, in every outcome — kill anything still breathing
         stragglers = {r: p for r, p in procs.items() if p.poll() is None}
         if stragglers:
@@ -878,6 +986,19 @@ def _supervise_generation(procs: Dict[int, subprocess.Popen], run_dir: str,
                                f"({losses[0]['kind']})")
             REGISTRY.gauge("hostgroup.state").set(_STATE_CODES[
                 OUTAGE if len(lost_ranks) >= world else DEGRADED])
+            # the launcher is the process that adjudicated the loss, so it
+            # dumps the flight recorder here — BEFORE the outage record,
+            # which then references the dump (a SIGKILLed rank writes
+            # nothing, and a survivor wedged in a dead collective may never
+            # reach its own except path)
+            from ..obsv import blackbox_note, dump_blackbox
+            for l in losses:
+                blackbox_note("hostgroup.host_lost", loss=dict(l))
+            dump_blackbox(
+                reason=f"HostLostError: rank(s) {lost_ranks} lost "
+                       f"({losses[0]['kind']}, rc={losses[0]['rc']})",
+                path=os.path.join(run_dir,
+                                  f"blackbox-launcher-gen{generation}.json"))
             maybe_write_outage_record(
                 what=f"host(s) {lost_ranks} lost at generation "
                      f"{generation} (world {world}): "

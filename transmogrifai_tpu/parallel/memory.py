@@ -395,6 +395,9 @@ def note_sweep_memory_exhaustion(e: BaseException, *, attempt: int = 0,
         REGISTRY.gauge("memory.shrink_level").set(lvl)
         event("memory.shrink", attempt=attempt, level=lvl, step=step,
               cause=f"{type(e).__name__}: {e}"[:200])
+        from ..obsv import blackbox_note
+        blackbox_note("memory.shrink", attempt=attempt, level=lvl,
+                      step=step, cause=f"{type(e).__name__}: {e}"[:200])
     except Exception:  # noqa: BLE001
         pass
     return lvl

@@ -210,6 +210,10 @@ def note_sweep_device_loss(e: BaseException, *, attempt: int = 0,
         REGISTRY.counter("supervisor.mesh_degrades_total").inc()
         event("supervisor.mesh_degrade", attempt=attempt, device_cap=cap,
               cause=f"{type(e).__name__}: {e}"[:200])
+        from ..obsv import blackbox_note
+        blackbox_note("supervisor.device_loss", attempt=attempt,
+                      device_cap=cap,
+                      cause=f"{type(e).__name__}: {e}"[:200])
     except Exception:  # noqa: BLE001
         pass
     return cap
@@ -472,13 +476,24 @@ def write_outage_record(path: str, *, what: str, context: str = "",
                         probe: str = _PROBE_DESC,
                         timeline: Optional[Sequence[Dict[str, Any]]] = None,
                         mitigations: Sequence[str] = (),
-                        will_update: str = "") -> Dict[str, Any]:
+                        will_update: str = "",
+                        blackbox: Optional[str] = None) -> Dict[str, Any]:
     """Atomically write one outage record in the OUTAGE_r5.json schema;
-    returns the record dict."""
+    returns the record dict.  When the training control plane has dumped a
+    flight-recorder ``blackbox.json`` this run, the record points at it
+    (additive ``blackbox`` key — the r5 key set stays intact otherwise)."""
     rec = {"what": what, "context": context, "probe": probe,
            "timeline_utc": list(timeline or []),
            "mitigations_landed_this_round": list(mitigations),
            "will_update": will_update}
+    if blackbox is None:
+        try:
+            from ..obsv import last_blackbox_path
+            blackbox = last_blackbox_path()
+        except Exception:  # noqa: BLE001
+            blackbox = None
+    if blackbox:
+        rec["blackbox"] = blackbox
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     tmp = path + ".tmp"
@@ -642,6 +657,13 @@ class Heartbeat:
                            point="supervisor.heartbeat",
                            breaker=self.breaker.name)
             self._registry.counter("supervisor.outages_total").inc()
+            try:
+                from ..obsv import blackbox_note
+                blackbox_note("supervisor.outage",
+                              cause=(v.cause or v.status)[:200],
+                              from_state=old)
+            except Exception:  # noqa: BLE001
+                pass
             maybe_write_outage_record(
                 what="device runtime unavailable (heartbeat breaker open)",
                 context=self.context, attempts=v.attempts,

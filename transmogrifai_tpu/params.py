@@ -114,6 +114,13 @@ class OpParams:
     # DataQualityError past it), enabled (TRANSMOGRIFAI_QUALITY;
     # --no-quality)
     quality: Dict[str, Any] = field(default_factory=dict)
+    # training control plane knobs (obsv.py env equivalents): port
+    # (TRANSMOGRIFAI_OBS_PORT / --obs-port admin endpoint — /metrics,
+    # /statusz, /traces; 0/unset = off, zero hot-path cost),
+    # blackboxSpans (TRANSMOGRIFAI_BLACKBOX_SPANS flight-recorder ring
+    # cap), blackboxPath (TRANSMOGRIFAI_BLACKBOX_PATH crash-dump
+    # destination; defaults near the outage record)
+    obs: Dict[str, Any] = field(default_factory=dict)
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "OpParams":
@@ -142,7 +149,8 @@ class OpParams:
             supervisor=d.get("supervisorParams") or {},
             hostgroup=d.get("hostgroupParams") or {},
             memory=d.get("memoryParams") or {},
-            quality=d.get("qualityParams") or {})
+            quality=d.get("qualityParams") or {},
+            obs=d.get("obsParams") or {})
 
     @staticmethod
     def load(path: str) -> "OpParams":
@@ -174,6 +182,7 @@ class OpParams:
             "hostgroupParams": self.hostgroup,
             "memoryParams": self.memory,
             "qualityParams": self.quality,
+            "obsParams": self.obs,
         }
 
     def apply_stage_params(self, stages) -> None:

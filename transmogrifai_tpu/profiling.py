@@ -384,10 +384,14 @@ class PhaseTimer:
     def phase(self, name: str):
         # late import: telemetry imports profiling, so the reverse edge must
         # stay out of module load.  span() is a no-op without a tracer.
+        from .obsv import BOARD
         from .telemetry import span as _span
         t0 = time.time()
         link0 = host_link_bytes()
         compile0 = compile_seconds()
+        # training control plane: the phase boundary is the coarsest
+        # progress seam — /statusz shows it live.  A dict merge, no span.
+        BOARD.publish(phase=name)
         try:
             with _span(f"phase.{name}"):
                 yield
@@ -399,6 +403,8 @@ class PhaseTimer:
                 peak_bytes_in_use=mem["peak_bytes_in_use"],
                 host_link_bytes=host_link_bytes() - link0,
                 compile_s=compile_seconds() - compile0))
+            BOARD.publish(phase=f"{name}:done",
+                          phaseWallS=round(time.time() - t0, 3))
 
     def app_metrics(self, tag: Optional[str] = None) -> AppMetrics:
         return AppMetrics(tag, time.time() - self._t0, list(self.phases))

@@ -215,6 +215,18 @@ class OpWorkflowRunner:
         if qp.get("enabled") is not None:
             os.environ["TRANSMOGRIFAI_QUALITY"] = \
                 "1" if qp["enabled"] else "0"
+        # obsParams (ISSUE 20): the training control plane — admin HTTP
+        # endpoint + crash flight recorder.  Off by default; the env knob
+        # composes with the per-rank port a host-group launcher exported
+        obsp = params.obs or {}
+        if obsp.get("port") is not None:
+            os.environ["TRANSMOGRIFAI_OBS_PORT"] = str(obsp["port"])
+        if obsp.get("blackboxSpans") is not None:
+            os.environ["TRANSMOGRIFAI_BLACKBOX_SPANS"] = \
+                str(obsp["blackboxSpans"])
+        if obsp.get("blackboxPath") is not None:
+            os.environ["TRANSMOGRIFAI_BLACKBOX_PATH"] = \
+                str(obsp["blackboxPath"])
         tele = params.telemetry or {}
         trace_dir = tele.get("traceDir")
         enabled = bool(tele.get("enabled", trace_dir is not None))
@@ -261,9 +273,30 @@ class OpWorkflowRunner:
                      or os.environ.get("TRANSMOGRIFAI_HOST_MEM_HARD_BYTES"))):
             wd = _memory.RssWatchdog(interval_s=wd_interval).start()
             _memory.install_watchdog(wd)
+        # training control plane (ISSUE 20): when an obs port is configured
+        # for a train/lifecycle run, start the admin endpoint (/metrics,
+        # /statusz, /traces) and install the flight recorder.  Both are
+        # no-ops when TRANSMOGRIFAI_OBS_PORT is unset — no socket, no
+        # recorder, no new spans.
+        obs_server = None
+        recorder = None
+        if run_type in (RunType.TRAIN, RunType.LIFECYCLE):
+            from . import obsv
+            if obsv.obs_enabled():
+                recorder = obsv.install_recorder(obsv.FlightRecorder())
+                obs_server = obsv.maybe_start_obs_server()
+                obsv.BOARD.publish(runType=run_type, phase="starting",
+                                   pid=os.getpid())
         hg = None
+        guard = None
+        # the outer guard only wraps the run types the control plane
+        # covers — serve/score keep their own signal handling untouched.
+        # Re-entrant with the nested train/lifecycle guards (shared flag).
+        guard_ctx = (preemption_guard(run_type)
+                     if run_type in (RunType.TRAIN, RunType.LIFECYCLE)
+                     else contextlib.nullcontext())
         try:
-            with ctx:
+            with ctx, guard_ctx as guard:
                 # inside a launch_hosts rank: join the host group (start the
                 # heartbeat, optionally init jax.distributed, pass the init
                 # barrier) before dispatch; post this rank's done file after
@@ -271,7 +304,29 @@ class OpWorkflowRunner:
                 result = self._run_dispatch(run_type, params)
                 if hg is not None:
                     hg.mark_done({"runType": run_type, "ok": True})
+        except BaseException as e:
+            # crash flight recorder: DataQualityError / MemoryExhaustedError
+            # / HostLostError / anything else unhandled dumps the last ring
+            # of telemetry before the error propagates
+            if recorder is not None:
+                from . import obsv
+                obsv.dump_blackbox(reason=type(e).__name__, error=e)
+            raise
         finally:
+            # a graceful SIGTERM stop never reaches the except arm (the
+            # guard converts it into a drained, successful result) — dump
+            # the ring here so the preemption postmortem exists too
+            if recorder is not None and guard is not None \
+                    and guard.stop_requested:
+                from . import obsv
+                obsv.dump_blackbox(
+                    reason="preempted",
+                    error=RuntimeError(guard.reason or "graceful stop"))
+            if obs_server is not None:
+                obs_server.stop()
+            if recorder is not None:
+                from . import obsv
+                obsv.install_recorder(None)
             if hg is not None:
                 hg.close()
             if hb is not None:
@@ -803,6 +858,15 @@ class OpApp:
                        help="disable the data-quality firewall entirely "
                             "(schema screening, quarantine accounting and "
                             "non-finite guards)")
+        p.add_argument("--obs-port", type=int,
+                       help="training control plane: serve GET /metrics, "
+                            "/statusz and /traces on this port while the "
+                            "run is in flight, and arm the crash flight "
+                            "recorder (blackbox.json).  Inside a host "
+                            "group the launcher keeps this port for the "
+                            "merged rank panel and rank r serves on "
+                            "port+1+r.  Unset/0 = off (no socket, no "
+                            "recorder)")
         return p.parse_args(argv)
 
     def main(self, argv: Optional[List[str]] = None) -> OpWorkflowRunnerResult:
@@ -856,6 +920,8 @@ class OpApp:
                 args.max_quarantine_fraction
         if args.no_quality:
             params.quality["enabled"] = False
+        if args.obs_port is not None:
+            params.obs["port"] = args.obs_port
         from .parallel import hostgroup
         hosts = max(1, int(args.hosts or params.hostgroup.get("hosts", 1)))
         if hosts > 1 and not hostgroup.hostgroup_env_present():
@@ -866,6 +932,12 @@ class OpApp:
             child = list(sys.argv) if argv is None else [sys.argv[0]] + \
                 list(argv)
             hg_params = params.hostgroup or {}
+            # training control plane: the launcher owns the base obs port
+            # (merged rank panel); launch_hosts exports base+1+rank to each
+            # child, so every rank's own endpoint is reachable too
+            obs_port = (params.obs or {}).get("port")
+            if obs_port:
+                os.environ["TRANSMOGRIFAI_OBS_PORT"] = str(obs_port)
             res = hostgroup.launch_hosts(
                 [sys.executable] + child, hosts,
                 run_dir=args.hosts_run_dir or hg_params.get("runDir"),

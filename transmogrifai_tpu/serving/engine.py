@@ -261,8 +261,12 @@ class ScoringEngine:
             scope=tenant)
 
         # lifecycle hooks: batch observers see every successfully-scored
-        # (records, results) pair; the drift monitor is one such observer
+        # (records, results) pair; the drift monitor is one such observer.
+        # Column observers are their columnar-path twins — they consume the
+        # (ColumnBatch, result_arrays) pair directly, so columnar traffic
+        # is observed without per-record dict materialization
         self._batch_observers: List[Callable] = []
+        self._column_observers: List[Callable] = []
         self.drift_monitor = None
 
         self._entry = self._load_entry()
@@ -367,12 +371,19 @@ class ScoringEngine:
         into the FailureLog — observability never fails a request."""
         self._batch_observers.append(fn)
 
+    def add_column_observer(self, fn: Callable) -> None:
+        """Register ``fn(batch, result_arrays)`` to run after each columnar
+        request (the packed path's analog of ``add_batch_observer`` — same
+        swallowed-error contract)."""
+        self._column_observers.append(fn)
+
     def attach_drift_monitor(self, **kw):
         """Build a ``DriftMonitor`` from the active bundle's baselines,
-        register it as a batch observer, and export its gauges through this
-        engine's registry (→ ``/metrics``).  Returns the monitor, or
-        ``None`` (recorded as a degradation) when the bundle carries no
-        ``baselines.json``."""
+        register it on BOTH serving paths (batch observer for JSON rows,
+        column observer for packed columnar bodies), and export its gauges
+        through this engine's registry (→ ``/metrics``).  Returns the
+        monitor, or ``None`` (recorded as a degradation) when the bundle
+        carries no ``baselines.json``."""
         from ..lifecycle.drift import DriftMonitor
         with self._swap_lock:
             entry = self._entry
@@ -382,7 +393,23 @@ class ScoringEngine:
             return None
         self.drift_monitor = monitor
         self.add_batch_observer(monitor.observe_serving)
+        self.add_column_observer(monitor.observe_columnar)
         return monitor
+
+    def detach_drift_monitor(self) -> None:
+        """Unregister the attached drift monitor from both observer lists
+        (the tenant eviction/quarantine path: a closed engine's monitor
+        must stop publishing gauges the registry would keep scraping).
+        Idempotent; no-op when none is attached."""
+        monitor, self.drift_monitor = self.drift_monitor, None
+        if monitor is None:
+            return
+        self._batch_observers = [
+            fn for fn in self._batch_observers
+            if getattr(fn, "__self__", None) is not monitor]
+        self._column_observers = [
+            fn for fn in self._column_observers
+            if getattr(fn, "__self__", None) is not monitor]
 
     def reload_now(self) -> bool:
         """Check the checkpoint root once; swap if a newer valid version
@@ -994,6 +1021,7 @@ class ScoringEngine:
         with self._swap_lock:
             entry = self._entry
         chunks: List[Dict[str, Any]] = []
+        health = None
         for lo in range(0, req.rows, self.max_batch):
             hi = min(lo + self.max_batch, req.rows)
             chunk = self._slice_columns(req.batch, lo, hi)
@@ -1076,20 +1104,35 @@ class ScoringEngine:
             batch_s = time.perf_counter() - t0
             self.batch_latency.observe(batch_s)
             self.overload.observe_batch(batch_s)
-            self.overload.refresh_health(
+            health = self.overload.refresh_health(
                 queue_depth=self.queue_depth,
                 draining=self._draining or self._closed,
                 compiled_ok=self._compiled_ok)
             chunks.append(arrays)
-        if self._batch_observers:
-            # batch observers (drift, insights) consume per-record dicts;
-            # reconstructing them would put per-row Python back on the hot
-            # path, so the columnar path skips observers by design and
-            # counts the skipped rows (drift monitoring of columnar
-            # traffic is deferred — see README)
+        merged = concat_result_arrays(chunks)
+        if self._column_observers and health == BROWNOUT:
+            # same shed rule as the JSON path: under brownout, observer
+            # cycles go to draining the queue
+            self.metrics.counter("brownout_sheds_total").inc()
+        elif self._column_observers:
+            # column observers (drift) consume the ColumnBatch + packed
+            # result arrays directly — columnar traffic is observed with
+            # zero per-record dict materialization
+            for fn in list(self._column_observers):
+                try:
+                    fn(req.batch, merged)
+                except Exception as e:  # noqa: BLE001 — observers are
+                    #                     observability, not the hot path
+                    record_failure("serving", "swallowed", e,
+                                   point="serving.batch")
+        elif self._batch_observers:
+            # batch observers with no columnar twin still consume
+            # per-record dicts; reconstructing those would put per-row
+            # Python back on the hot path, so they are skipped and the
+            # skipped rows counted
             self.metrics.counter("columnar_observer_skips_total").inc(
                 req.rows)
-        req.result = (concat_result_arrays(chunks), entry.version)
+        req.result = (merged, entry.version)
         req.event.set()
 
     # -- metrics / shutdown ------------------------------------------------

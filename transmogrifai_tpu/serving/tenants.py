@@ -354,6 +354,10 @@ class TenantRegistry:
         if engine is None:
             return
         try:
+            # an evicted/quarantined tenant's drift monitor detaches with
+            # the engine — its gauges leave /metrics instead of freezing
+            # at the last pre-eviction window
+            engine.detach_drift_monitor()
             engine.close(drain=True, timeout_s=timeout_s)
         except Exception as e:  # noqa: BLE001 — a wedged engine must not
             #                     wedge the registry
@@ -478,6 +482,19 @@ class TenantRegistry:
         from .pool import _METRIC_PREFIX, merge_worker_metrics
         from .server import render_metrics
         with self._lock:
+            for s in self._active_slots():
+                # refresh each active tenant's drift gauges at scrape time
+                # so tenant-labeled drift_feature_psi / drift_score_psi
+                # track the live window, not the last manual evaluate()
+                mon = getattr(s.engine, "drift_monitor", None)
+                if mon is not None and mon.rows_observed:
+                    try:
+                        mon.evaluate()
+                    except Exception as e:  # noqa: BLE001 — a scrape must
+                        #                     never fail on monitor state
+                        record_failure("serving", "swallowed", e,
+                                       point="serving.tenants",
+                                       tenant=s.tenant)
             texts = [(s.tenant, render_metrics(s.engine))
                      for s in self._active_slots()]
             slots = [(name, self._slots[name])

@@ -747,11 +747,18 @@ class OpValidator:
         # the one-per-family fallback warning is scoped to THIS validate:
         # a second train in the same process surfaces its own fallbacks
         _reset_logged_fallbacks()
+        from .obsv import BOARD
         attempt = 0
         oom_attempt = 0
         while True:
             self._sweep_attempt = attempt
             self._oom_attempt = oom_attempt
+            # control-plane seam: the retry loop is the coarse boundary —
+            # /statusz shows which recovery lane the sweep is in
+            BOARD.publish(phase="sweep", sweepAttempt=attempt,
+                          oomAttempt=oom_attempt,
+                          candidateFamilies=len(candidates),
+                          gridPoints=sum(len(c.grid) for c in candidates))
             # the RSS watchdog's hard watermark surfaces HERE, on the
             # governed thread, where a typed error can be handled — not as
             # a kernel OOM-kill of an arbitrary victim
@@ -1013,6 +1020,8 @@ class OpValidator:
                     fitted_grid=fitted_grid
                     if isinstance(fitted_grid, list) else None)
                 sweep_cp.flush()
+                from .obsv import BOARD
+                BOARD.publish(lastCheckpointFamily=cand.model_name)
             except Exception as e:  # noqa: BLE001
                 record_failure(cand.model_name, "degraded", e,
                                point="checkpoint.save",
@@ -1270,14 +1279,30 @@ class OpValidator:
                     else:
                         _submit(W, cand.grid)
 
+            # control-plane progress: candidate-fit boundaries feed the
+            # /statusz board (current family + grid point) and the per-unit
+            # EWMA behind its ETA.  _fits_left is per round (A, then B).
+            _fits_left = [0]
+
             def fit_candidate(cand, Wblk, grid):
                 # per-candidate trace span: worker threads have no span of
                 # their own, so this parents under the orchestrating
                 # selector.sweep span even through the thread pool
+                import time as _time
+
+                from .obsv import BOARD
                 from .telemetry import span as _span
+                BOARD.publish(candidate=cand.model_name,
+                              candidateGrid=len(grid),
+                              candidateFolds=int(len(Wblk)))
+                t0 = _time.perf_counter()
                 with _span("selector.candidate_fit", model=cand.model_name,
                            grid=len(grid), folds=int(len(Wblk))):
-                    return _fit_candidate_body(cand, Wblk, grid)
+                    out = _fit_candidate_body(cand, Wblk, grid)
+                _fits_left[0] = max(0, _fits_left[0] - 1)
+                BOARD.note_unit(_time.perf_counter() - t0,
+                                remaining_units=_fits_left[0])
+                return out
 
             def _fit_candidate_body(cand, Wblk, grid):
                 from .parallel import memory as _memq
@@ -1417,6 +1442,9 @@ class OpValidator:
                     for c in candidates):
                 n_workers = 1
             indexed = list(enumerate(candidates))
+            _fits_left[0] = len(indexed)
+            from .obsv import BOARD
+            BOARD.publish(round="A", fitsQueued=len(indexed))
             if n_workers > 1:
                 from concurrent.futures import ThreadPoolExecutor
                 with ThreadPoolExecutor(max_workers=n_workers) as pool:
@@ -1460,6 +1488,8 @@ class OpValidator:
                 path first, device/host per-candidate fallback otherwise.
                 ``rec`` lets racing remap a survivor sub-grid's local
                 indices back to the family's full grid."""
+                BOARD.publish(scoring=cand.model_name,
+                              foldOffset=fold_offset, foldCount=n_folds)
                 # chaos seam: a device lost between fitting and scoring —
                 # fires AFTER earlier families checkpointed, so the recovery
                 # sweep demonstrably replays them from the SweepCheckpoint
@@ -1519,6 +1549,7 @@ class OpValidator:
             if race_live:
                 drain_deferred()   # ranking needs numbers, not deferred slots
                 sign = 1.0 if self.evaluator.is_larger_better else -1.0
+                _raced_out: Dict[str, int] = {}
 
                 def prune(ci, cand):
                     G = len(cand.grid)
@@ -1539,6 +1570,8 @@ class OpValidator:
                     from .telemetry import event as _event
                     _event("selector.racing.prune", model=cand.model_name,
                            grid=G, survivors=S, pruned=G - S)
+                    _raced_out[cand.model_name] = G - S
+                    BOARD.publish(racedOut=dict(_raced_out))
                     return sorted(order[:S])
 
                 survivors_by_ci = {ci: prune(ci, candidates[ci])
@@ -1559,6 +1592,8 @@ class OpValidator:
                     sub = sub_candidate(ci)
                     return fit_candidate(sub, W[1:], sub.grid)
 
+                _fits_left[0] = len(race_live)
+                BOARD.publish(round="B", fitsQueued=len(race_live))
                 if n_workers > 1 and len(race_live) > 1:
                     from concurrent.futures import ThreadPoolExecutor
                     with ThreadPoolExecutor(
